@@ -1,0 +1,22 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware (the driver separately dry-run-compiles the
+multi-chip path). Env must be set before jax is imported anywhere.
+"""
+
+import os
+
+# Force CPU: the ambient environment points JAX_PLATFORMS at the real TPU
+# tunnel, which tests must never use (slow remote compiles, single chip).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# float64 on CPU for tight numerical cross-checks against the numpy
+# reference kernel; the batched kernel is dtype-polymorphic and is also
+# exercised at float32 explicitly.
+jax.config.update("jax_enable_x64", True)

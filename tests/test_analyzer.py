@@ -1,0 +1,205 @@
+"""Unit tests for the queue analyzer (ops.analyzer).
+
+Covers the same surface the reference's queueanalyzer_test.go covers:
+service-time models, construction validation, analyze ranges, size
+inversion + achieved SLOs, effective concurrency clamping.
+"""
+
+import numpy as np
+import pytest
+
+from workload_variant_autoscaler_tpu.ops import (
+    QueueAnalyzer,
+    QueueConfig,
+    RequestSize,
+    ServiceParms,
+    TargetPerf,
+    decode_time,
+    effective_concurrency,
+    prefill_time,
+    service_rates,
+)
+from workload_variant_autoscaler_tpu.ops.analyzer import InfeasibleTargetError
+
+PARMS = ServiceParms(alpha=10.0, beta=0.3, gamma=10.0, delta=0.001)
+
+
+def make_analyzer(max_batch=8, max_queue=80, in_tok=1000, out_tok=100, parms=PARMS):
+    return QueueAnalyzer(
+        QueueConfig(max_batch_size=max_batch, max_queue_size=max_queue, parms=parms),
+        RequestSize(avg_input_tokens=in_tok, avg_output_tokens=out_tok),
+    )
+
+
+class TestServiceTimeModels:
+    """Expected values mirror reference queueanalyzer_test.go:236-311."""
+
+    def test_prefill_zero_tokens(self):
+        assert prefill_time(PARMS, 0, 1.0) == 0.0
+
+    def test_prefill_values(self):
+        assert prefill_time(PARMS, 1000, 1.0) == pytest.approx(11.0)
+        assert prefill_time(PARMS, 2000, 8.0) == pytest.approx(26.0)
+        assert prefill_time(PARMS, 500, 2.5) == pytest.approx(11.25)
+
+    def test_decode_values(self):
+        p = ServiceParms(alpha=1.0, beta=0.01, gamma=0, delta=0)
+        assert decode_time(p, 1.0) == pytest.approx(1.01)
+        assert decode_time(p, 4.0) == pytest.approx(1.04)
+        assert decode_time(p, 8.0) == pytest.approx(1.08)
+        assert decode_time(p, 2.5) == pytest.approx(1.025)
+
+
+class TestServiceRates:
+    def test_formula(self):
+        config = QueueConfig(max_batch_size=4, max_queue_size=40, parms=PARMS)
+        size = RequestSize(avg_input_tokens=1000, avg_output_tokens=100)
+        rates = service_rates(config, size)
+        assert rates.shape == (4,)
+        for i, n in enumerate(range(1, 5)):
+            pre = PARMS.gamma + PARMS.delta * 1000 * n
+            dec = 99 * (PARMS.alpha + PARMS.beta * n)
+            assert rates[i] == pytest.approx(n / (pre + dec))
+
+    def test_decode_only_single_token_special_case(self):
+        """in=0, out=1 allows one decode (reference queueanalyzer.go:106-109)."""
+        config = QueueConfig(max_batch_size=2, max_queue_size=20, parms=PARMS)
+        size = RequestSize(avg_input_tokens=0, avg_output_tokens=1)
+        rates = service_rates(config, size)
+        assert rates[0] == pytest.approx(1.0 / (PARMS.alpha + PARMS.beta))
+
+    def test_prefill_only_when_one_output_token(self):
+        config = QueueConfig(max_batch_size=2, max_queue_size=20, parms=PARMS)
+        size = RequestSize(avg_input_tokens=100, avg_output_tokens=1)
+        rates = service_rates(config, size)
+        assert rates[0] == pytest.approx(1.0 / prefill_time(PARMS, 100, 1.0))
+
+
+class TestConstruction:
+    def test_invalid_config(self):
+        with pytest.raises(ValueError):
+            make_analyzer(max_batch=0)
+        with pytest.raises(ValueError):
+            make_analyzer(max_queue=-1)
+
+    def test_invalid_request_size(self):
+        with pytest.raises(ValueError):
+            make_analyzer(in_tok=-1)
+        with pytest.raises(ValueError):
+            make_analyzer(out_tok=0)
+
+    def test_rate_range(self):
+        qa = make_analyzer()
+        assert 0 < qa.lambda_min < qa.lambda_max
+        assert qa.lambda_min == pytest.approx(qa.serv_rate[0] * 1e-3)
+        assert qa.lambda_max == pytest.approx(qa.serv_rate[-1] * (1 - 1e-3))
+        assert qa.occupancy == 88
+
+
+class TestAnalyze:
+    def test_rejects_nonpositive_rate(self):
+        qa = make_analyzer()
+        with pytest.raises(ValueError):
+            qa.analyze(0.0)
+        with pytest.raises(ValueError):
+            qa.analyze(-1.0)
+
+    def test_rejects_rate_above_max(self):
+        qa = make_analyzer()
+        with pytest.raises(ValueError):
+            qa.analyze(qa.max_rate * 1.01)
+
+    def test_light_load(self):
+        qa = make_analyzer()
+        m = qa.analyze(qa.min_rate)
+        assert m.rho < 0.05
+        assert m.avg_wait_time < 1.0
+        # At concurrency ~1 the token time approaches alpha + beta
+        assert m.avg_token_time <= decode_time(PARMS, 1.5)
+        assert m.throughput == pytest.approx(qa.min_rate, rel=1e-3)
+
+    def test_heavy_load(self):
+        qa = make_analyzer()
+        m = qa.analyze(qa.max_rate)
+        assert m.rho > 0.9
+        assert m.avg_wait_time > 0.0
+        assert m.throughput < qa.max_rate * 1.001
+
+    def test_metrics_monotone_in_rate(self):
+        qa = make_analyzer()
+        rates = np.linspace(qa.min_rate, qa.max_rate, 5)
+        waits = [qa.analyze(r).avg_wait_time for r in rates]
+        itls = [qa.analyze(r).avg_token_time for r in rates]
+        assert waits == sorted(waits)
+        assert itls == sorted(itls)
+
+
+class TestSize:
+    def test_ttft_binding(self):
+        qa = make_analyzer()
+        target_ttft = qa._ttft_at((qa.lambda_min + qa.lambda_max) / 2)
+        res = qa.size(TargetPerf(ttft=target_ttft * 1.0))
+        # sized rate achieves the target
+        assert res.achieved.ttft <= target_ttft * 1.01
+        assert res.rate_ttft <= qa.max_rate
+
+    def test_itl_binding(self):
+        qa = make_analyzer()
+        mid_itl = qa._itl_at((qa.lambda_min + qa.lambda_max) / 2)
+        res = qa.size(TargetPerf(itl=mid_itl))
+        assert res.achieved.itl == pytest.approx(mid_itl, rel=1e-3)
+
+    def test_tps_stability_margin(self):
+        qa = make_analyzer()
+        res = qa.size(TargetPerf(tps=100.0))
+        assert res.rate_tps == pytest.approx(qa.max_rate * 0.9, rel=1e-6)
+
+    def test_no_targets_uses_max_rate(self):
+        qa = make_analyzer()
+        res = qa.size(TargetPerf())
+        assert res.rate_ttft == pytest.approx(qa.max_rate)
+        assert res.rate_itl == pytest.approx(qa.max_rate)
+        assert res.metrics.throughput <= qa.max_rate
+
+    def test_binding_rate_is_min(self):
+        qa = make_analyzer()
+        mid = (qa.lambda_min + qa.lambda_max) / 2
+        res = qa.size(TargetPerf(ttft=qa._ttft_at(mid), itl=qa._itl_at(mid * 0.5)))
+        assert res.metrics.throughput <= min(res.rate_ttft, res.rate_itl) * 1.001
+
+    def test_infeasible_ttft(self):
+        qa = make_analyzer()
+        # Below the lightest-load TTFT -> infeasible
+        floor = qa._ttft_at(qa.lambda_min)
+        with pytest.raises(InfeasibleTargetError):
+            qa.size(TargetPerf(ttft=floor * 0.5))
+
+    def test_loose_target_above_region(self):
+        qa = make_analyzer()
+        ceil_itl = qa._itl_at(qa.lambda_max)
+        res = qa.size(TargetPerf(itl=ceil_itl * 10))
+        assert res.rate_itl == pytest.approx(qa.max_rate)
+
+    def test_invalid_targets(self):
+        qa = make_analyzer()
+        with pytest.raises(ValueError):
+            qa.size(TargetPerf(ttft=-1))
+
+
+class TestEffectiveConcurrency:
+    def test_clamped(self):
+        size = RequestSize(avg_input_tokens=1000, avg_output_tokens=100)
+        assert effective_concurrency(0.0, PARMS, size, 8) == 0.0
+        assert effective_concurrency(1e9, PARMS, size, 8) == 8.0
+
+    def test_inversion_roundtrip(self):
+        size = RequestSize(avg_input_tokens=1000, avg_output_tokens=100)
+        n = 3.7
+        serv = prefill_time(PARMS, 1000, n) + 99 * decode_time(PARMS, n)
+        assert effective_concurrency(serv, PARMS, size, 8) == pytest.approx(n, rel=1e-9)
+
+    def test_degenerate_denominator(self):
+        size = RequestSize(avg_input_tokens=0, avg_output_tokens=1)
+        p = ServiceParms(alpha=1.0, beta=0.5, gamma=0.0, delta=0.0)
+        assert effective_concurrency(10.0, p, size, 8) == 8.0
+        assert effective_concurrency(-1.0, p, size, 8) == 0.0

@@ -1,0 +1,168 @@
+"""Cross-check the batched JAX kernel against the numpy reference kernel.
+
+The two implementations share semantics (log-space solve); under x64 they
+must agree to ~1e-9. A float32 pass checks TPU-dtype tolerances.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from workload_variant_autoscaler_tpu.ops import (
+    QueueAnalyzer,
+    QueueConfig,
+    RequestSize,
+    ServiceParms,
+    TargetPerf,
+)
+from workload_variant_autoscaler_tpu.ops.analyzer import InfeasibleTargetError
+from workload_variant_autoscaler_tpu.ops.batched import (
+    SLOTargets,
+    analyze_batch,
+    k_max_for,
+    make_queue_batch,
+    size_batch,
+)
+
+# (alpha, beta, gamma, delta, in_tok, out_tok, max_batch)
+CASES = [
+    (10.0, 0.3, 10.0, 0.001, 1000, 100, 8),
+    (6.973, 0.027, 5.2, 0.1, 128, 128, 64),   # Llama-3.1-8B fit (BASELINE.md)
+    (20.58, 0.41, 5.2, 0.1, 64, 100, 4),      # sample CR params
+    (2.0, 0.05, 1.0, 0.0005, 2048, 256, 32),  # long-context-ish profile
+    (10.0, 0.3, 10.0, 0.001, 0, 1, 8),        # decode-only single token
+    (5.0, 0.1, 3.0, 0.01, 200, 1, 16),        # prefill-dominated
+]
+
+
+def batch_from_cases(cases, dtype=None):
+    a, b, g, d, it, ot, mb = map(np.array, zip(*cases))
+    return make_queue_batch(a, b, g, d, it, ot, mb, dtype=dtype), k_max_for(mb)
+
+
+def scalar_analyzer(case):
+    a, b, g, d, it, ot, mb = case
+    return QueueAnalyzer(
+        QueueConfig(max_batch_size=mb, max_queue_size=10 * mb,
+                    parms=ServiceParms(alpha=a, beta=b, gamma=g, delta=d)),
+        RequestSize(avg_input_tokens=it, avg_output_tokens=ot),
+    )
+
+
+class TestAnalyzeBatch:
+    def test_matches_scalar_kernel(self):
+        q, k_max = batch_from_cases(CASES)
+        rates = np.array([sa.max_rate * 0.6 for sa in map(scalar_analyzer, CASES)])
+        out = analyze_batch(q, jnp.asarray(rates), k_max)
+        for i, case in enumerate(CASES):
+            m = scalar_analyzer(case).analyze(rates[i])
+            assert float(out["throughput"][i]) == pytest.approx(m.throughput, rel=1e-9)
+            assert float(out["avg_wait_time"][i]) == pytest.approx(m.avg_wait_time, rel=1e-7, abs=1e-9)
+            assert float(out["avg_token_time"][i]) == pytest.approx(m.avg_token_time, rel=1e-9)
+            assert float(out["avg_prefill_time"][i]) == pytest.approx(m.avg_prefill_time, rel=1e-9)
+            assert float(out["rho"][i]) == pytest.approx(m.rho, rel=1e-9)
+            assert bool(out["valid_rate"][i])
+
+    def test_invalid_rates_flagged(self):
+        q, k_max = batch_from_cases(CASES[:1])
+        sa = scalar_analyzer(CASES[0])
+        out = analyze_batch(q, jnp.asarray([sa.max_rate * 2.0]), k_max)
+        assert not bool(out["valid_rate"][0])
+
+
+class TestSizeBatch:
+    def test_matches_scalar_sizing(self):
+        # targets chosen mid-region per case so every search bisects
+        targets_ttft, targets_itl = [], []
+        for case in CASES:
+            sa = scalar_analyzer(case)
+            mid = (sa.lambda_min + sa.lambda_max) / 2
+            targets_ttft.append(sa._ttft_at(mid))
+            targets_itl.append(sa._itl_at(mid * 0.7))
+        q, k_max = batch_from_cases(CASES)
+        res = size_batch(
+            q,
+            SLOTargets(
+                ttft=jnp.asarray(targets_ttft),
+                itl=jnp.asarray(targets_itl),
+                tps=jnp.zeros(len(CASES)),
+            ),
+            k_max,
+        )
+        for i, case in enumerate(CASES):
+            sa = scalar_analyzer(case)
+            sr = sa.size(TargetPerf(ttft=targets_ttft[i], itl=targets_itl[i]))
+            assert bool(res.feasible[i])
+            assert float(res.lam_ttft[i]) * 1000 == pytest.approx(sr.rate_ttft, rel=1e-6)
+            assert float(res.lam_itl[i]) * 1000 == pytest.approx(sr.rate_itl, rel=1e-6)
+            assert float(res.throughput[i]) * 1000 == pytest.approx(
+                sr.metrics.throughput, rel=1e-6
+            )
+            assert float(res.achieved_itl[i]) == pytest.approx(sr.achieved.itl, rel=1e-6)
+            assert float(res.achieved_ttft[i]) == pytest.approx(sr.achieved.ttft, rel=1e-5, abs=1e-8)
+
+    def test_infeasible_matches_scalar(self):
+        case = CASES[0]
+        sa = scalar_analyzer(case)
+        floor = sa._ttft_at(sa.lambda_min)
+        q, k_max = batch_from_cases([case])
+        res = size_batch(
+            q,
+            SLOTargets(ttft=jnp.asarray([floor * 0.5]), itl=jnp.zeros(1), tps=jnp.zeros(1)),
+            k_max,
+        )
+        assert not bool(res.feasible[0])
+        with pytest.raises(InfeasibleTargetError):
+            sa.size(TargetPerf(ttft=floor * 0.5))
+
+    def test_tps_margin(self):
+        q, k_max = batch_from_cases(CASES[:2])
+        res = size_batch(
+            q,
+            SLOTargets(ttft=jnp.zeros(2), itl=jnp.zeros(2), tps=jnp.asarray([50.0, 100.0])),
+            k_max,
+        )
+        for i, case in enumerate(CASES[:2]):
+            sa = scalar_analyzer(case)
+            assert float(res.lam_tps[i]) * 1000 == pytest.approx(sa.max_rate * 0.9, rel=1e-6)
+
+    def test_disabled_targets_use_max_rate(self):
+        q, k_max = batch_from_cases(CASES[:1])
+        res = size_batch(
+            q, SLOTargets(ttft=jnp.zeros(1), itl=jnp.zeros(1), tps=jnp.zeros(1)), k_max
+        )
+        sa = scalar_analyzer(CASES[0])
+        assert float(res.lam_star[0]) * 1000 == pytest.approx(sa.max_rate, rel=1e-6)
+
+    def test_float32_tolerance(self):
+        """TPU dtype: results stay within ~0.5% of the f64 reference."""
+        q32, k_max = batch_from_cases(CASES, dtype=jnp.float32)
+        targets = []
+        for case in CASES:
+            sa = scalar_analyzer(case)
+            targets.append(sa._itl_at((sa.lambda_min + sa.lambda_max) / 2))
+        res = size_batch(
+            q32,
+            SLOTargets(
+                ttft=jnp.zeros(len(CASES), jnp.float32),
+                itl=jnp.asarray(targets, jnp.float32),
+                tps=jnp.zeros(len(CASES), jnp.float32),
+            ),
+            k_max,
+        )
+        for i, case in enumerate(CASES):
+            sa = scalar_analyzer(case)
+            sr = sa.size(TargetPerf(itl=targets[i]))
+            assert float(res.lam_itl[i]) * 1000 == pytest.approx(sr.rate_itl, rel=5e-3)
+
+    def test_padding_lanes_masked(self):
+        """A padded (invalid) lane must not be reported feasible."""
+        case = CASES[0]
+        a, b, g, d, it, ot, mb = map(np.array, zip(case, case))
+        q = make_queue_batch(a, b, g, d, it, ot, mb, valid=np.array([True, False]))
+        res = size_batch(
+            q, SLOTargets(ttft=jnp.zeros(2), itl=jnp.zeros(2), tps=jnp.zeros(2)),
+            k_max_for(mb),
+        )
+        assert bool(res.feasible[0])
+        assert not bool(res.feasible[1])

@@ -1,0 +1,31 @@
+"""TPU-native workload variant autoscaler.
+
+A from-scratch rebuild of llm-d's Workload-Variant-Autoscaler (WVA) for TPU
+fleets. The pipeline per reconcile cycle is Collector -> Model Analyzer ->
+Optimizer -> Actuator (reference: /root/reference README.md:91-114), but the
+numerical core is redesigned TPU-first:
+
+- the M/M/1 state-dependent queueing solve runs as a *batched, log-space*
+  JAX kernel (`ops.batched`) that sizes every (variant, slice-shape)
+  candidate in one XLA call instead of the reference's sequential per-server
+  Go loop (reference: pkg/core/server.go:55-67),
+- accelerators are TPU slice shapes (v5e-1/v5e-8/v5e-16/...) with
+  chips-per-replica cost semantics instead of GPU SKUs x multiplicity
+  (reference: pkg/config/types.go:28-41),
+- the candidate fan-out shards over a `jax.sharding.Mesh` so fleet-wide
+  analysis scales across hosts (`parallel.mesh`).
+
+Package layout:
+  ops/        pure math kernel (numpy reference impl + JAX batched kernel)
+  models/     domain model: chips, slices, profiles, servers, allocations
+  solver/     unlimited + greedy capacity solvers, optimizer facade
+  parallel/   mesh-sharded batched analysis
+  collector/  Prometheus ingestion (vLLM-TPU / JetStream metric names)
+  controller/ VariantAutoscaling CRD types + reconcile loop
+  actuator/   scaling-signal emission (desired/current/ratio gauges)
+  metrics/    emitted Prometheus series (the HPA/KEDA-facing output API)
+  emulator/   discrete-event TPU serving emulator + loadgen (test backbone)
+  utils/      logging, backoff, translation helpers
+"""
+
+__version__ = "0.1.0"

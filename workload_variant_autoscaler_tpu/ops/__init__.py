@@ -1,0 +1,60 @@
+"""Math kernel: analytical queueing models for LLM serving.
+
+Two implementations with identical semantics:
+
+- `queueing` / `analyzer`: scalar float64 numpy reference implementation,
+  instance-scoped (unlike the reference's package-global eval state,
+  /root/reference pkg/analyzer/queueanalyzer.go:176-179). Used for exact
+  unit-test cross-checks and as a dependency-light fallback.
+- `batched`: the TPU-native JAX kernel. Solves B independent queues at once
+  in log-space (cumulative sums + logsumexp instead of the reference's
+  overflow-rescaling recursion, mm1modelstatedependent.go:70-116) and runs
+  the SLO binary searches as a vectorised, fixed-trip-count bisection under
+  `jit`.
+"""
+
+from .search import BinarySearchResult, binary_search, within_tolerance
+from .queueing import (
+    EPSILON,
+    STABILITY_SAFETY_FRACTION,
+    mm1k_closed_form,
+    state_dependent_probabilities,
+    state_dependent_solve,
+    QueueStats,
+)
+from .analyzer import (
+    AnalysisMetrics,
+    QueueAnalyzer,
+    QueueConfig,
+    RequestSize,
+    ServiceParms,
+    SizeResult,
+    TargetPerf,
+    decode_time,
+    effective_concurrency,
+    prefill_time,
+    service_rates,
+)
+
+__all__ = [
+    "AnalysisMetrics",
+    "BinarySearchResult",
+    "EPSILON",
+    "QueueAnalyzer",
+    "QueueConfig",
+    "QueueStats",
+    "RequestSize",
+    "STABILITY_SAFETY_FRACTION",
+    "ServiceParms",
+    "SizeResult",
+    "TargetPerf",
+    "binary_search",
+    "decode_time",
+    "effective_concurrency",
+    "mm1k_closed_form",
+    "prefill_time",
+    "service_rates",
+    "state_dependent_probabilities",
+    "state_dependent_solve",
+    "within_tolerance",
+]
