@@ -1,0 +1,104 @@
+"""Shared fixtures: a small TPU fleet system (mirrors the role of the
+reference's test/utils/unitutils.go canned configs)."""
+
+from workload_variant_autoscaler_tpu.models import (
+    AllocationData,
+    ModelSliceProfile,
+    ModelTarget,
+    OptimizerSpec,
+    ServerLoadSpec,
+    ServerSpec,
+    ServiceClassSpec,
+    System,
+    SystemSpec,
+    make_slice,
+)
+
+# Llama-3.1-8B decode fit (BASELINE.md) on v5e-1; slower on the bigger
+# slices per-chip but higher batch capacity; 70B needs v5e-8 or larger.
+PROFILES = [
+    ModelSliceProfile(model="llama-8b", accelerator="v5e-1",
+                      alpha=6.973, beta=0.027, gamma=5.2, delta=0.1,
+                      max_batch_size=64, at_tokens=128),
+    ModelSliceProfile(model="llama-8b", accelerator="v5e-4",
+                      alpha=3.2, beta=0.012, gamma=2.4, delta=0.04,
+                      max_batch_size=192, at_tokens=128),
+    ModelSliceProfile(model="llama-8b", accelerator="v5p-4",
+                      alpha=2.1, beta=0.008, gamma=1.5, delta=0.025,
+                      max_batch_size=256, at_tokens=128),
+    ModelSliceProfile(model="llama-70b", accelerator="v5e-8",
+                      alpha=18.0, beta=0.12, gamma=14.0, delta=0.3,
+                      max_batch_size=48, at_tokens=1024),
+    ModelSliceProfile(model="llama-70b", accelerator="v5e-16",
+                      alpha=11.0, beta=0.07, gamma=9.0, delta=0.18,
+                      max_batch_size=96, at_tokens=1024),
+]
+
+SERVICE_CLASSES = [
+    ServiceClassSpec(
+        name="Premium", priority=1,
+        model_targets=(
+            ModelTarget(model="llama-8b", slo_itl=24.0, slo_ttft=500.0),
+            ModelTarget(model="llama-70b", slo_itl=80.0, slo_ttft=2000.0),
+        ),
+    ),
+    ServiceClassSpec(
+        name="Freemium", priority=10,
+        model_targets=(
+            ModelTarget(model="llama-8b", slo_itl=150.0, slo_ttft=1500.0),
+            ModelTarget(model="llama-70b", slo_itl=200.0, slo_ttft=4000.0),
+        ),
+    ),
+]
+
+SLICES = [
+    make_slice("v5e", 1, "1x1"),
+    make_slice("v5e", 4, "2x2"),
+    make_slice("v5e", 8, "2x4"),
+    make_slice("v5e", 16, "4x4"),
+    make_slice("v5p", 4, "2x2x1"),
+]
+
+
+def server_spec(
+    name="var-8b:default",
+    model="llama-8b",
+    service_class="Premium",
+    arrival_rpm=1200.0,
+    in_tokens=128,
+    out_tokens=128,
+    accelerator="v5e-1",
+    num_replicas=1,
+    min_replicas=1,
+    max_batch=0,
+    keep_accelerator=False,
+    cur_cost=0.0,
+):
+    load = ServerLoadSpec(
+        arrival_rate=arrival_rpm, avg_in_tokens=in_tokens, avg_out_tokens=out_tokens
+    )
+    return ServerSpec(
+        name=name,
+        service_class=service_class,
+        model=model,
+        keep_accelerator=keep_accelerator,
+        min_num_replicas=min_replicas,
+        max_batch_size=max_batch,
+        current_alloc=AllocationData(
+            accelerator=accelerator, num_replicas=num_replicas, cost=cur_cost, load=load
+        ),
+    )
+
+
+def make_system(servers=None, capacity=None, optimizer=None) -> tuple[System, OptimizerSpec]:
+    spec = SystemSpec(
+        accelerators=list(SLICES),
+        profiles=list(PROFILES),
+        service_classes=list(SERVICE_CLASSES),
+        servers=servers if servers is not None else [server_spec()],
+        capacity=capacity or {},
+        optimizer=optimizer or OptimizerSpec(unlimited=True),
+    )
+    system = System()
+    opt_spec = system.set_from_spec(spec)
+    return system, opt_spec

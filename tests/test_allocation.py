@@ -1,0 +1,205 @@
+"""Tests for allocation construction (models.allocation).
+
+Mirrors the coverage of the reference's pkg/core/allocation_test.go:
+feasibility, zero-load paths, replica math, cost, transition penalties,
+diffs.
+"""
+
+import math
+
+import pytest
+
+from workload_variant_autoscaler_tpu.models import (
+    Allocation,
+    allocation_diff,
+    create_allocation,
+    reallocate,
+    scale_allocation,
+)
+from workload_variant_autoscaler_tpu.models.allocation import (
+    effective_batch_size,
+    replica_demand,
+)
+from workload_variant_autoscaler_tpu.models.spec import ACCEL_PENALTY_FACTOR
+
+from helpers import PROFILES, make_system, server_spec
+
+
+class TestCreateAllocation:
+    def test_feasible_allocation(self):
+        system, _ = make_system()
+        alloc = create_allocation(system, "var-8b:default", "v5e-1")
+        assert alloc is not None
+        assert alloc.accelerator == "v5e-1"
+        assert alloc.num_replicas >= 1
+        assert alloc.batch_size == 64  # at_tokens==out_tokens -> full profile batch
+        assert alloc.itl <= 24.0 * 1.001       # meets Premium ITL
+        assert alloc.ttft <= 500.0 * 1.001     # meets Premium TTFT
+        assert 0 <= alloc.rho <= 1
+
+    def test_replica_count_scales_with_load(self):
+        lo, _ = make_system([server_spec(arrival_rpm=600.0)])
+        hi, _ = make_system([server_spec(arrival_rpm=6000.0)])
+        a_lo = create_allocation(lo, "var-8b:default", "v5e-1")
+        a_hi = create_allocation(hi, "var-8b:default", "v5e-1")
+        assert a_hi.num_replicas > a_lo.num_replicas
+        # replicas = ceil(total_rate / per-replica max rate)
+        total = 6000.0 / 60.0
+        expect = math.ceil(total / (a_hi.max_arrv_rate_per_replica * 1000.0))
+        assert a_hi.num_replicas == expect
+
+    def test_cost_is_chip_cost_times_replicas(self):
+        system, _ = make_system()
+        alloc = create_allocation(system, "var-8b:default", "v5e-1")
+        acc = system.accelerator("v5e-1")
+        assert alloc.cost == pytest.approx(acc.cost * alloc.num_replicas)
+
+    def test_multi_chip_slice_cost(self):
+        system, _ = make_system(
+            [server_spec(name="var-70b", model="llama-70b", accelerator="v5e-8",
+                         in_tokens=512, out_tokens=1024, arrival_rpm=120.0)]
+        )
+        alloc = create_allocation(system, "var-70b", "v5e-8")
+        assert alloc is not None
+        acc = system.accelerator("v5e-8")
+        assert acc.chips == 8
+        assert alloc.cost == pytest.approx(acc.cost * alloc.num_replicas)
+
+    def test_missing_profile_returns_none(self):
+        # llama-8b has no profile on v5e-16
+        system, _ = make_system()
+        assert create_allocation(system, "var-8b:default", "v5e-16") is None
+
+    def test_unknown_server_or_accelerator(self):
+        system, _ = make_system()
+        assert create_allocation(system, "nope", "v5e-1") is None
+        assert create_allocation(system, "var-8b:default", "h100") is None
+
+    def test_unknown_service_class(self):
+        system, _ = make_system([server_spec(service_class="Platinum")])
+        assert create_allocation(system, "var-8b:default", "v5e-1") is None
+
+    def test_infeasible_slo_returns_none(self):
+        # ITL target below alpha can never be met
+        from workload_variant_autoscaler_tpu.models import ModelTarget, ServiceClassSpec
+
+        system, _ = make_system()
+        system.add_service_class_spec(
+            ServiceClassSpec(name="Premium", priority=1, model_targets=(
+                ModelTarget(model="llama-8b", slo_itl=5.0, slo_ttft=500.0),
+            ))
+        )
+        assert create_allocation(system, "var-8b:default", "v5e-1") is None
+
+    def test_zero_load_min_replicas(self):
+        system, _ = make_system([server_spec(arrival_rpm=0.0, min_replicas=1)])
+        alloc = create_allocation(system, "var-8b:default", "v5e-1")
+        assert alloc.num_replicas == 1
+        assert alloc.rho == 0.0
+        assert alloc.cost > 0
+
+    def test_zero_load_scale_to_zero(self):
+        system, _ = make_system([server_spec(arrival_rpm=0.0, min_replicas=0)])
+        alloc = create_allocation(system, "var-8b:default", "v5e-1")
+        assert alloc.num_replicas == 0
+        assert alloc.accelerator == ""
+        assert alloc.cost == 0.0
+
+    def test_negative_load_invalid(self):
+        system, _ = make_system([server_spec(arrival_rpm=-5.0)])
+        assert create_allocation(system, "var-8b:default", "v5e-1") is None
+
+    def test_server_max_batch_override(self):
+        system, _ = make_system([server_spec(max_batch=16)])
+        alloc = create_allocation(system, "var-8b:default", "v5e-1")
+        assert alloc.batch_size == 16
+
+
+class TestBatchAndDemandHelpers:
+    def test_effective_batch_token_scaling(self):
+        p = PROFILES[0]  # max_batch 64 at 128 tokens
+        assert effective_batch_size(p, 0, 128) == 64
+        assert effective_batch_size(p, 0, 256) == 32   # longer requests shrink batch
+        assert effective_batch_size(p, 0, 100000) == 1  # floor at 1
+        assert effective_batch_size(p, 8, 128) == 8     # override wins
+
+    def test_replica_demand(self):
+        assert replica_demand(600.0, 0.0, 128) == pytest.approx(10.0)
+        # TPS target converts to request rate
+        assert replica_demand(600.0, 1280.0, 128) == pytest.approx(10.0)
+
+
+class TestTransitionPenalty:
+    def test_same_everything_is_free(self):
+        a = Allocation(accelerator="v5e-1", num_replicas=2, cost=40.0)
+        assert a.transition_penalty(a.clone()) == 0.0
+
+    def test_same_slice_rescale_costs_delta(self):
+        a = Allocation(accelerator="v5e-1", num_replicas=2, cost=40.0)
+        b = Allocation(accelerator="v5e-1", num_replicas=3, cost=60.0)
+        assert a.transition_penalty(b) == pytest.approx(20.0)
+        assert b.transition_penalty(a) == pytest.approx(-20.0)
+
+    def test_slice_switch_surcharge(self):
+        a = Allocation(accelerator="v5e-1", num_replicas=2, cost=40.0)
+        b = Allocation(accelerator="v5p-4", num_replicas=1, cost=340.0)
+        expect = ACCEL_PENALTY_FACTOR * (40.0 + 340.0) + (340.0 - 40.0)
+        assert a.transition_penalty(b) == pytest.approx(expect)
+
+
+class TestScaleAndReallocate:
+    def test_scale_recomputes_on_same_slice(self):
+        system, _ = make_system([server_spec(arrival_rpm=6000.0)])
+        base = Allocation(accelerator="v5e-1", num_replicas=1)
+        new, inc = scale_allocation(system, base, "var-8b:default")
+        assert new is not None
+        assert new.accelerator == "v5e-1"
+        assert inc == new.num_replicas - 1
+
+    def test_scale_infeasible_returns_none(self):
+        system, _ = make_system()
+        base = Allocation(accelerator="v5e-16", num_replicas=1)  # no 8b profile
+        new, inc = scale_allocation(system, base, "var-8b:default")
+        assert new is None and inc == 0
+
+    def test_reallocate_picks_min_value(self):
+        system, _ = make_system()
+        alloc, acc = reallocate(system, "var-8b:default")
+        assert alloc is not None
+        # must be the cheapest feasible candidate by value
+        candidates = [
+            create_allocation(system, "var-8b:default", g)
+            for g in system.accelerators
+        ]
+        best = min((c for c in candidates if c is not None), key=lambda c: c.value)
+        assert alloc.value == pytest.approx(best.value)
+        assert acc == best.accelerator
+
+
+class TestAllocationDiff:
+    def test_both_none(self):
+        assert allocation_diff(None, None) is None
+
+    def test_new_allocation(self):
+        b = Allocation(accelerator="v5e-1", num_replicas=2, cost=40.0)
+        d = allocation_diff(None, b)
+        assert d.old_accelerator == "none"
+        assert d.new_num_replicas == 2
+        assert d.cost_diff == pytest.approx(40.0)
+
+    def test_removed_allocation(self):
+        a = Allocation(accelerator="v5e-1", num_replicas=2, cost=40.0)
+        d = allocation_diff(a, None)
+        assert d.new_accelerator == "none"
+        assert d.cost_diff == pytest.approx(-40.0)
+
+
+class TestDataRoundtrip:
+    def test_to_from_data(self):
+        a = Allocation(accelerator="v5e-4", num_replicas=3, batch_size=32,
+                       cost=240.0, itl=11.5, ttft=80.0)
+        d = a.to_data()
+        b = Allocation.from_data(d)
+        assert (b.accelerator, b.num_replicas, b.batch_size) == ("v5e-4", 3, 32)
+        assert b.cost == pytest.approx(240.0)
+        assert b.itl == pytest.approx(11.5)

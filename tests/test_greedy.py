@@ -1,0 +1,193 @@
+"""Tests for the greedy capacity solver + saturation policies
+(mirrors reference pkg/solver/greedy_test.go coverage)."""
+
+import pytest
+
+from workload_variant_autoscaler_tpu.models import (
+    Allocation,
+    OptimizerSpec,
+    SaturationPolicy,
+)
+from workload_variant_autoscaler_tpu.solver import Solver
+from workload_variant_autoscaler_tpu.solver.greedy import priority_groups, solve_greedy
+
+from helpers import make_system, server_spec
+
+
+def set_candidates(system, server_name, candidates):
+    """Install synthetic candidate allocations (value already set)."""
+    server = system.servers[server_name]
+    server.all_allocations = {a.accelerator: a for a in candidates}
+
+
+def alloc(acc, replicas, cost, value=None):
+    a = Allocation(accelerator=acc, num_replicas=replicas, cost=cost)
+    a.value = cost if value is None else value
+    return a
+
+
+def greedy_system(servers, capacity):
+    system, _ = make_system(servers, capacity=capacity)
+    return system
+
+
+class TestGreedyAllocate:
+    def test_allocates_best_when_capacity_suffices(self):
+        system = greedy_system([server_spec(name="a")], {"v5e": 8})
+        set_candidates(system, "a", [alloc("v5e-1", 2, 40.0), alloc("v5e-4", 1, 80.0)])
+        solve_greedy(system, SaturationPolicy.NONE)
+        assert system.servers["a"].allocation.accelerator == "v5e-1"
+
+    def test_falls_to_next_candidate_when_pool_exhausted(self):
+        # best is v5e-1 x 4 chips needed=4 but only 2 v5e chips; v5p pool open
+        system = greedy_system([server_spec(name="a")], {"v5e": 2, "v5p": 8})
+        set_candidates(system, "a", [alloc("v5e-1", 4, 80.0), alloc("v5p-4", 1, 340.0)])
+        solve_greedy(system, SaturationPolicy.NONE)
+        assert system.servers["a"].allocation.accelerator == "v5p-4"
+
+    def test_unallocated_when_nothing_fits(self):
+        system = greedy_system([server_spec(name="a")], {"v5e": 0, "v5p": 0})
+        set_candidates(system, "a", [alloc("v5e-1", 1, 20.0)])
+        solve_greedy(system, SaturationPolicy.NONE)
+        assert system.servers["a"].allocation is None
+
+    def test_priority_wins_scarce_capacity(self):
+        servers = [
+            server_spec(name="free", service_class="Freemium"),
+            server_spec(name="prem", service_class="Premium"),
+        ]
+        system = greedy_system(servers, {"v5e": 2})
+        set_candidates(system, "free", [alloc("v5e-1", 2, 40.0)])
+        set_candidates(system, "prem", [alloc("v5e-1", 2, 40.0)])
+        solve_greedy(system, SaturationPolicy.NONE)
+        assert system.servers["prem"].allocation is not None
+        assert system.servers["free"].allocation is None
+
+    def test_regret_ordering_within_priority(self):
+        """Within one priority group, the server with more to lose (larger
+        delta to its next candidate) allocates first."""
+        servers = [
+            server_spec(name="small-regret"),
+            server_spec(name="big-regret"),
+        ]
+        system = greedy_system(servers, {"v5e": 1, "v5p": 4})
+        # both want the single v5e chip; big-regret's fallback is much worse
+        set_candidates(system, "small-regret",
+                       [alloc("v5e-1", 1, 20.0), alloc("v5p-4", 1, 25.0)])
+        set_candidates(system, "big-regret",
+                       [alloc("v5e-1", 1, 20.0), alloc("v5p-4", 1, 340.0)])
+        solve_greedy(system, SaturationPolicy.NONE)
+        assert system.servers["big-regret"].allocation.accelerator == "v5e-1"
+        assert system.servers["small-regret"].allocation.accelerator == "v5p-4"
+
+    def test_capacity_is_chip_granular(self):
+        # v5e-4 slice consumes 4 chips per replica
+        system = greedy_system([server_spec(name="a")], {"v5e": 7})
+        set_candidates(system, "a", [alloc("v5e-4", 2, 160.0)])  # needs 8 chips
+        solve_greedy(system, SaturationPolicy.NONE)
+        assert system.servers["a"].allocation is None
+
+
+class TestSaturationPolicies:
+    def test_priority_exhaustive_partial_allocation(self):
+        system = greedy_system([server_spec(name="a")], {"v5e": 3})
+        set_candidates(system, "a", [alloc("v5e-1", 5, 100.0)])
+        solve_greedy(system, SaturationPolicy.PRIORITY_EXHAUSTIVE)
+        a = system.servers["a"].allocation
+        assert a.num_replicas == 3
+        assert a.cost == pytest.approx(60.0)  # scaled pro rata
+
+    def test_round_robin_distributes_capacity(self):
+        servers = [server_spec(name="a"), server_spec(name="b")]
+        system = greedy_system(servers, {"v5e": 4})
+        set_candidates(system, "a", [alloc("v5e-1", 10, 200.0)])
+        set_candidates(system, "b", [alloc("v5e-1", 10, 200.0)])
+        solve_greedy(system, SaturationPolicy.ROUND_ROBIN)
+        ra = system.servers["a"].allocation.num_replicas
+        rb = system.servers["b"].allocation.num_replicas
+        assert ra + rb == 4
+        assert abs(ra - rb) <= 1  # equal shares
+
+    def test_priority_round_robin_groups_first(self):
+        servers = [
+            server_spec(name="p1", service_class="Premium"),
+            server_spec(name="p2", service_class="Premium"),
+            server_spec(name="f1", service_class="Freemium"),
+        ]
+        system = greedy_system(servers, {"v5e": 4})
+        for n in ("p1", "p2", "f1"):
+            set_candidates(system, n, [alloc("v5e-1", 10, 200.0)])
+        solve_greedy(system, SaturationPolicy.PRIORITY_ROUND_ROBIN)
+        # Premium group drains the pool before Freemium sees it
+        assert system.servers["p1"].allocation.num_replicas \
+            + system.servers["p2"].allocation.num_replicas == 4
+        assert system.servers["f1"].allocation is None
+
+    def test_none_policy_leaves_unallocated(self):
+        system = greedy_system([server_spec(name="a")], {"v5e": 3})
+        set_candidates(system, "a", [alloc("v5e-1", 5, 100.0)])
+        solve_greedy(system, SaturationPolicy.NONE)
+        assert system.servers["a"].allocation is None
+
+
+class TestDelayedBestEffort:
+    def test_delayed_runs_best_effort_after_all_groups(self):
+        """With delayed best effort, a lower-priority server that fits fully
+        can take capacity before best-effort tops up the higher-priority
+        leftover server."""
+        servers = [
+            server_spec(name="prem", service_class="Premium"),
+            server_spec(name="free", service_class="Freemium"),
+        ]
+        system = greedy_system(servers, {"v5e": 4})
+        set_candidates(system, "prem", [alloc("v5e-1", 10, 200.0)])  # can't fit fully
+        set_candidates(system, "free", [alloc("v5e-1", 2, 40.0)])    # fits
+        solve_greedy(system, SaturationPolicy.PRIORITY_EXHAUSTIVE, delayed_best_effort=True)
+        assert system.servers["free"].allocation.num_replicas == 2
+        assert system.servers["prem"].allocation.num_replicas == 2  # leftovers
+
+    def test_grouped_default_gives_priority_first_claim(self):
+        servers = [
+            server_spec(name="prem", service_class="Premium"),
+            server_spec(name="free", service_class="Freemium"),
+        ]
+        system = greedy_system(servers, {"v5e": 4})
+        set_candidates(system, "prem", [alloc("v5e-1", 10, 200.0)])
+        set_candidates(system, "free", [alloc("v5e-1", 2, 40.0)])
+        solve_greedy(system, SaturationPolicy.PRIORITY_EXHAUSTIVE, delayed_best_effort=False)
+        # Premium's best-effort pass drains the pool within its group
+        assert system.servers["prem"].allocation.num_replicas == 4
+        assert system.servers["free"].allocation is None
+
+
+class TestSolverDispatch:
+    def test_limited_mode_routes_to_greedy(self):
+        system, _ = make_system(
+            [server_spec(name="a")], capacity={"v5e": 64, "v5p": 16},
+            optimizer=OptimizerSpec(unlimited=False, saturation_policy="None"),
+        )
+        system.calculate()
+        solver = Solver(OptimizerSpec(unlimited=False, saturation_policy="None"))
+        solver.solve(system)
+        a = system.servers["a"].allocation
+        assert a is not None
+        # capacity accounting holds
+        chips_used = a.num_replicas * system.accelerator(a.accelerator).chips
+        assert chips_used <= 64 + 16
+
+
+class TestPriorityGroups:
+    def test_partition(self):
+        from workload_variant_autoscaler_tpu.solver.greedy import _Entry
+
+        def entry(prio):
+            e = _Entry.__new__(_Entry)
+            e.priority = prio
+            return e
+
+        groups = priority_groups([entry(1), entry(1), entry(5), entry(10), entry(10)])
+        assert [len(g) for g in groups] == [2, 1, 2]
+        assert [g[0].priority for g in groups] == [1, 5, 10]
+
+    def test_empty(self):
+        assert priority_groups([]) == []

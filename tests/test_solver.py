@@ -1,0 +1,81 @@
+"""Tests for the unlimited solver + optimizer facade
+(mirrors reference pkg/solver/{solver,optimizer}_test.go coverage)."""
+
+import pytest
+
+from workload_variant_autoscaler_tpu.models import OptimizerSpec
+from workload_variant_autoscaler_tpu.solver import Manager, Optimizer, Solver
+
+from helpers import make_system, server_spec
+
+
+class TestSolveUnlimited:
+    def test_picks_min_value_per_server(self):
+        system, opt_spec = make_system([server_spec(name="a"), server_spec(name="b")])
+        system.calculate()
+        solver = Solver(opt_spec)
+        solver.solve(system)
+        for server in system.servers.values():
+            chosen = server.allocation
+            assert chosen is not None
+            assert chosen.value == min(a.value for a in server.all_allocations.values())
+
+    def test_switch_aversion(self):
+        """With value = transition penalty, staying on the current slice wins
+        unless another is enough cheaper to pay the switching surcharge."""
+        system, opt_spec = make_system(
+            [server_spec(accelerator="v5e-1", num_replicas=2, cur_cost=40.0)]
+        )
+        system.calculate()
+        Solver(opt_spec).solve(system)
+        server = system.servers["var-8b:default"]
+        stay = server.all_allocations["v5e-1"]
+        assert server.allocation.value <= stay.value
+
+    def test_no_candidates_no_allocation(self):
+        system, opt_spec = make_system([server_spec(model="unknown-model")])
+        system.calculate()
+        Solver(opt_spec).solve(system)
+        assert system.servers["var-8b:default"].allocation is None
+
+    def test_diffs_computed(self):
+        system, opt_spec = make_system(
+            [server_spec(accelerator="v5e-1", num_replicas=1)]
+        )
+        system.calculate()
+        solver = Solver(opt_spec)
+        solver.solve(system)
+        diff = solver.diff_allocation["var-8b:default"]
+        assert diff.old_accelerator == "v5e-1"
+        assert diff.old_num_replicas == 1
+        assert diff.new_num_replicas == system.servers["var-8b:default"].allocation.num_replicas
+
+    def test_desired_alloc_updated(self):
+        system, opt_spec = make_system()
+        system.calculate()
+        Solver(opt_spec).solve(system)
+        server = system.servers["var-8b:default"]
+        assert server.spec.desired_alloc.accelerator == server.allocation.accelerator
+        assert server.spec.desired_alloc.load == server.load
+
+
+class TestOptimizerFacade:
+    def test_optimize_times_solution(self):
+        system, opt_spec = make_system()
+        system.calculate()
+        opt = Optimizer(opt_spec)
+        opt.optimize(system)
+        assert opt.solution_time_msec >= 0.0
+        assert opt.solver is not None
+
+    def test_missing_spec_raises(self):
+        opt = Optimizer(None)
+        system, _ = make_system()
+        with pytest.raises(ValueError):
+            opt.optimize(system)
+
+    def test_manager_accumulates_by_type(self):
+        system, opt_spec = make_system(capacity={"v5e": 32, "v5p": 8})
+        system.calculate()
+        Manager(system, Optimizer(opt_spec)).optimize()
+        assert system.allocation_by_type  # populated

@@ -1,0 +1,156 @@
+"""Tests for the System registry + batched candidate analysis
+(mirrors reference pkg/core/system_test.go coverage, plus the
+scalar-vs-batched kernel equivalence that replaces it)."""
+
+import pytest
+
+from workload_variant_autoscaler_tpu.models import System, make_slice
+
+from helpers import make_system, server_spec
+
+
+class TestRegistry:
+    def test_set_from_spec(self):
+        system, opt = make_system()
+        assert set(system.accelerators) == {"v5e-1", "v5e-4", "v5e-8", "v5e-16", "v5p-4"}
+        assert set(system.models) == {"llama-8b", "llama-70b"}
+        assert set(system.service_classes) == {"Premium", "Freemium"}
+        assert "var-8b:default" in system.servers
+        assert opt.unlimited
+
+    def test_priority_clamping(self):
+        from workload_variant_autoscaler_tpu.models import ServiceClass
+
+        assert ServiceClass("x", 0).priority == 100
+        assert ServiceClass("x", 101).priority == 100
+        assert ServiceClass("x", 1).priority == 1
+
+    def test_remove_unknown_raises(self):
+        system = System()
+        with pytest.raises(KeyError):
+            system.remove_accelerator("nope")
+        with pytest.raises(KeyError):
+            system.remove_server("nope")
+
+    def test_replace_accelerator(self):
+        system, _ = make_system()
+        system.add_accelerator(make_slice("v5e", 1, cost_per_chip=99.0))
+        assert system.accelerator("v5e-1").cost == pytest.approx(99.0)
+
+    def test_num_instances_default(self):
+        system, _ = make_system()
+        assert system.model("llama-8b").num_instances("v5e-1") == 1
+        assert system.model("llama-8b").num_instances("v5e-16") == 0  # no profile
+
+
+class TestPowerModel:
+    def test_piecewise_linear(self):
+        system, _ = make_system()
+        acc = system.accelerator("v5e-1")
+        acc.calculate()
+        p = acc.spec.power
+        assert acc.power(0.0) == pytest.approx(p.idle)
+        assert acc.power(p.mid_util) == pytest.approx(p.mid_power)
+        assert acc.power(1.0) == pytest.approx(p.full)
+
+
+class TestCalculateBackends:
+    def _snapshot(self, system):
+        out = {}
+        for name, server in system.servers.items():
+            out[name] = {
+                g: (a.num_replicas, a.cost, a.batch_size, a.itl, a.ttft, a.value)
+                for g, a in server.all_allocations.items()
+            }
+        return out
+
+    def test_scalar_and_batched_agree(self):
+        servers = [
+            server_spec(name="a", arrival_rpm=1200.0),
+            server_spec(name="b", arrival_rpm=4800.0, service_class="Freemium"),
+            server_spec(name="c", model="llama-70b", accelerator="v5e-8",
+                        in_tokens=512, out_tokens=1024, arrival_rpm=60.0),
+            server_spec(name="zero", arrival_rpm=0.0),
+        ]
+        s1, _ = make_system(servers)
+        s1.calculate(backend="scalar")
+        s2, _ = make_system(servers)
+        s2.calculate(backend="batched")
+
+        snap1, snap2 = self._snapshot(s1), self._snapshot(s2)
+        assert set(snap1) == set(snap2)
+        for name in snap1:
+            assert set(snap1[name]) == set(snap2[name]), name
+            for g in snap1[name]:
+                r1, c1, b1, itl1, ttft1, v1 = snap1[name][g]
+                r2, c2, b2, itl2, ttft2, v2 = snap2[name][g]
+                assert r1 == r2, (name, g)
+                assert b1 == b2, (name, g)
+                assert c1 == pytest.approx(c2, rel=1e-9)
+                assert itl1 == pytest.approx(itl2, rel=1e-6)
+                assert ttft1 == pytest.approx(ttft2, rel=1e-6, abs=1e-9)
+                assert v1 == pytest.approx(v2, rel=1e-6, abs=1e-9)
+
+    def test_keep_accelerator_pins_candidates(self):
+        system, _ = make_system([server_spec(keep_accelerator=True)])
+        system.calculate()
+        allocs = system.servers["var-8b:default"].all_allocations
+        assert set(allocs) == {"v5e-1"}
+
+    def test_unpinned_server_gets_all_feasible_slices(self):
+        system, _ = make_system([server_spec()])
+        system.calculate()
+        allocs = system.servers["var-8b:default"].all_allocations
+        assert set(allocs) == {"v5e-1", "v5e-4", "v5p-4"}  # the profiled slices
+
+    def test_value_is_transition_penalty(self):
+        system, _ = make_system([server_spec(accelerator="v5e-1", num_replicas=1)])
+        system.calculate()
+        server = system.servers["var-8b:default"]
+        for g, alloc in server.all_allocations.items():
+            assert alloc.value == pytest.approx(
+                server.cur_allocation.transition_penalty(alloc), rel=1e-9
+            )
+
+
+class TestAccountingAndSolution:
+    def _solved_system(self):
+        from workload_variant_autoscaler_tpu.solver import Manager, Optimizer
+
+        servers = [
+            server_spec(name="a", arrival_rpm=2400.0),
+            server_spec(name="c", model="llama-70b", accelerator="v5e-8",
+                        in_tokens=512, out_tokens=1024, arrival_rpm=60.0),
+        ]
+        system, opt_spec = make_system(servers, capacity={"v5e": 64, "v5p": 16})
+        system.calculate()
+        Manager(system, Optimizer(opt_spec)).optimize()
+        return system
+
+    def test_allocate_by_type_counts_chips(self):
+        system = self._solved_system()
+        by_type = system.allocation_by_type
+        total = 0
+        for server in system.servers.values():
+            alloc = server.allocation
+            acc = system.accelerator(alloc.accelerator)
+            total += alloc.num_replicas * acc.chips
+        assert sum(a.count for a in by_type.values()) == total
+        for chip, agg in by_type.items():
+            assert agg.limit == system.capacity[chip]
+
+    def test_generate_solution(self):
+        system = self._solved_system()
+        sol = system.generate_solution()
+        assert set(sol.allocations) == {"a", "c"}
+        for name, data in sol.allocations.items():
+            server = system.servers[name]
+            assert data.num_replicas == server.allocation.num_replicas
+            assert data.load == server.load
+
+    def test_total_cost_and_chips(self):
+        system = self._solved_system()
+        assert system.total_cost() == pytest.approx(
+            sum(s.allocation.cost for s in system.servers.values())
+        )
+        assert system.total_chips() > 0

@@ -1,0 +1,274 @@
+"""Allocation: a feasible (slice shape, replicas, batch) assignment.
+
+The heart of the engine (reference /root/reference pkg/core/allocation.go).
+`create_allocation` builds an SLO-feasible allocation for one server on one
+slice shape; `System.calculate` (system.py) instead batches every
+(server, slice) candidate through the JAX kernel in one XLA call — the
+scalar path here is the exact-semantics fallback and the per-candidate
+specification.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Optional
+
+from ..ops import (
+    QueueAnalyzer,
+    QueueConfig,
+    RequestSize,
+    ServiceParms,
+    TargetPerf,
+)
+from ..ops.analyzer import InfeasibleTargetError
+from .spec import (
+    ACCEL_PENALTY_FACTOR,
+    MAX_QUEUE_TO_BATCH_RATIO,
+    AllocationData,
+    ModelSliceProfile,
+    ServerLoadSpec,
+)
+
+if TYPE_CHECKING:
+    from .system import System
+
+
+@dataclass
+class Allocation:
+    accelerator: str = ""
+    num_replicas: int = 0
+    batch_size: int = 0
+    cost: float = 0.0
+    value: float = 0.0
+    itl: float = 0.0   # expected avg token decode time (msec)
+    ttft: float = 0.0  # expected avg queueing + prefill time (msec)
+    rho: float = 0.0
+    max_arrv_rate_per_replica: float = 0.0  # req/msec
+
+    @property
+    def max_rpm(self) -> float:
+        """Max sustainable request rate per replica, req/min."""
+        return self.max_arrv_rate_per_replica * 1000.0 * 60.0
+
+    def saturated(self, total_rate_rpm: float) -> bool:
+        return total_rate_rpm > self.num_replicas * self.max_rpm
+
+    def transition_penalty(self, other: "Allocation") -> float:
+        """Cost of moving this allocation to `other`: free if identical,
+        cost delta on a pure rescale, plus a switching surcharge of
+        ACCEL_PENALTY_FACTOR*(cost_a+cost_b) when the slice shape changes
+        (reference allocation.go:291-300)."""
+        if self.accelerator == other.accelerator:
+            if self.num_replicas == other.num_replicas:
+                return 0.0
+            return other.cost - self.cost
+        return ACCEL_PENALTY_FACTOR * (self.cost + other.cost) + (other.cost - self.cost)
+
+    def clone(self) -> "Allocation":
+        return Allocation(**self.__dict__)
+
+    def to_data(self, load: ServerLoadSpec | None = None) -> AllocationData:
+        return AllocationData(
+            accelerator=self.accelerator,
+            num_replicas=self.num_replicas,
+            max_batch=self.batch_size,
+            cost=self.cost,
+            itl_average=self.itl,
+            ttft_average=self.ttft,
+            load=load or ServerLoadSpec(),
+        )
+
+    @classmethod
+    def from_data(cls, data: AllocationData) -> "Allocation":
+        return cls(
+            accelerator=data.accelerator,
+            num_replicas=data.num_replicas,
+            batch_size=data.max_batch,
+            cost=data.cost,
+            itl=data.itl_average,
+            ttft=data.ttft_average,
+        )
+
+
+@dataclass(frozen=True)
+class AllocationDiff:
+    """Orchestration delta between old and new allocations
+    (reference allocation.go:345-380)."""
+
+    old_accelerator: str = "none"
+    new_accelerator: str = "none"
+    old_num_replicas: int = 0
+    new_num_replicas: int = 0
+    cost_diff: float = 0.0
+
+
+def allocation_diff(a: Optional[Allocation], b: Optional[Allocation]) -> Optional[AllocationDiff]:
+    if a is None and b is None:
+        return None
+    return AllocationDiff(
+        old_accelerator=a.accelerator if a else "none",
+        new_accelerator=b.accelerator if b else "none",
+        old_num_replicas=a.num_replicas if a else 0,
+        new_num_replicas=b.num_replicas if b else 0,
+        cost_diff=(b.cost if b else 0.0) - (a.cost if a else 0.0),
+    )
+
+
+def effective_batch_size(profile: ModelSliceProfile, server_max_batch: int, out_tokens: int) -> int:
+    """Max batch N: the server override, or the profile's max batch scaled
+    by token length (longer requests shrink the usable batch; reference
+    allocation.go:77-86)."""
+    if server_max_batch > 0:
+        return server_max_batch
+    return max(profile.max_batch_size * profile.at_tokens // max(out_tokens, 1), 1)
+
+
+def replica_demand(arrival_rate_rpm: float, slo_tps: float, out_tokens: int) -> float:
+    """Aggregate rate to provision for, req/sec: the observed arrival rate,
+    or the TPS target translated to request rate when one is set
+    (reference allocation.go:133-139)."""
+    if slo_tps > 0:
+        return slo_tps / max(out_tokens, 1)
+    return arrival_rate_rpm / 60.0
+
+
+def zero_load_allocation(
+    system: "System", server_name: str, acc_name: str
+) -> Optional[Allocation]:
+    """Allocation when there is no traffic: min replicas at the profile's
+    batch bound (reference allocation.go:259-288)."""
+    server = system.server(server_name)
+    acc = system.accelerator(acc_name)
+    if server is None or acc is None:
+        return None
+    model = system.model(server.model_name)
+    profile = model.profile(acc_name) if model else None
+    if profile is None:
+        return None
+
+    if server.min_num_replicas == 0:
+        return Allocation()  # scale to zero
+
+    max_batch = server.max_batch_size or profile.max_batch_size
+    num_replicas = server.min_num_replicas
+    cost = acc.cost * model.num_instances(acc_name) * num_replicas
+
+    decode = profile.alpha + profile.beta
+    max_decode = profile.alpha + profile.beta * max_batch
+    prefill = profile.gamma + profile.delta
+    max_serv = prefill + max_decode
+    alloc = Allocation(
+        accelerator=acc_name,
+        num_replicas=num_replicas,
+        batch_size=max_batch,
+        cost=cost,
+        itl=decode,
+        ttft=prefill,
+        rho=0.0,
+        max_arrv_rate_per_replica=max_batch / max_serv,
+    )
+    alloc.value = alloc.cost
+    return alloc
+
+
+def create_allocation(system: "System", server_name: str, acc_name: str) -> Optional[Allocation]:
+    """Scalar-path allocation construction (reference allocation.go:27-163).
+
+    Returns None when the candidate is infeasible: missing profile/target,
+    invalid load, or SLO below the achievable region.
+    """
+    acc = system.accelerator(acc_name)
+    server = system.server(server_name)
+    if acc is None or server is None:
+        return None
+    load = server.load
+    if load is None or load.arrival_rate < 0 or load.avg_in_tokens < 0 or load.avg_out_tokens < 0:
+        return None
+    model = system.model(server.model_name)
+    if model is None:
+        return None
+    profile = model.profile(acc_name)
+    if profile is None:
+        return None
+    svc = system.service_class(server.service_class_name)
+    if svc is None:
+        return None
+    target = svc.target(server.model_name)
+    if target is None:
+        return None
+
+    if load.arrival_rate == 0 or load.avg_out_tokens == 0:
+        return zero_load_allocation(system, server_name, acc_name)
+
+    out_tokens = load.avg_out_tokens
+    n = effective_batch_size(profile, server.max_batch_size, out_tokens)
+
+    try:
+        analyzer = QueueAnalyzer(
+            QueueConfig(
+                max_batch_size=n,
+                max_queue_size=n * MAX_QUEUE_TO_BATCH_RATIO,
+                parms=ServiceParms(
+                    alpha=profile.alpha, beta=profile.beta,
+                    gamma=profile.gamma, delta=profile.delta,
+                ),
+            ),
+            RequestSize(avg_input_tokens=load.avg_in_tokens, avg_output_tokens=out_tokens),
+        )
+        sized = analyzer.size(
+            TargetPerf(ttft=target.slo_ttft, itl=target.slo_itl, tps=target.slo_tps)
+        )
+    except (ValueError, InfeasibleTargetError):
+        return None
+
+    rate_star = sized.metrics.throughput  # req/sec per replica at the SLO
+    total_rate = replica_demand(load.arrival_rate, target.slo_tps, out_tokens)
+    num_replicas = max(math.ceil(total_rate / rate_star), server.min_num_replicas)
+
+    cost = acc.cost * model.num_instances(acc_name) * num_replicas
+
+    try:
+        per_replica = analyzer.analyze(total_rate / num_replicas)
+    except ValueError:
+        return None
+
+    alloc = Allocation(
+        accelerator=acc_name,
+        num_replicas=num_replicas,
+        batch_size=n,
+        cost=cost,
+        itl=per_replica.avg_token_time,
+        ttft=per_replica.avg_wait_time + per_replica.avg_prefill_time,
+        rho=per_replica.rho,
+        max_arrv_rate_per_replica=rate_star / 1000.0,
+    )
+    alloc.value = alloc.cost
+    return alloc
+
+
+def scale_allocation(
+    system: "System", alloc: Allocation, server_name: str
+) -> tuple[Optional[Allocation], int]:
+    """Recompute this server's allocation on the same slice shape; returns
+    (new allocation, replica delta). Reference allocation.go:166-189 —
+    with the nil-deref on an infeasible recompute fixed."""
+    new = create_allocation(system, server_name, alloc.accelerator)
+    if new is None:
+        return None, 0
+    return new, new.num_replicas - alloc.num_replicas
+
+
+def reallocate(
+    system: "System", server_name: str
+) -> tuple[Optional[Allocation], str]:
+    """Pick the min-value allocation across all slice shapes
+    (reference allocation.go:191-207)."""
+    best: Optional[Allocation] = None
+    for acc_name in system.accelerators:
+        alloc = create_allocation(system, server_name, acc_name)
+        if alloc is not None and (best is None or alloc.value < best.value):
+            best = alloc
+    if best is None:
+        return None, ""
+    return best, best.accelerator

@@ -1,0 +1,181 @@
+"""Data shapes for the autoscaling system (the engine's wire format).
+
+TPU-shaped equivalent of the reference's config specs
+(/root/reference pkg/config/types.go). The accelerator model is a *slice
+shape* — a pod slice of a TPU generation — rather than a GPU SKU:
+capacity is counted in chips per generation and an allocation consumes
+num_replicas * slices_per_replica * chips_per_slice chips (the reference's
+replicas x accCount x multiplicity, pkg/core/system.go:296).
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+from dataclasses import dataclass, field, replace as dc_replace
+
+from ..ops.queueing import MAX_QUEUE_TO_BATCH_RATIO  # single source of truth
+
+# ---------------------------------------------------------------------------
+# Engine constants (reference pkg/config/defaults.go)
+# ---------------------------------------------------------------------------
+
+SLO_PERCENTILE = 0.95
+SLO_MARGIN = -math.log(1 - SLO_PERCENTILE)
+ACCEL_PENALTY_FACTOR = 0.1
+
+DEFAULT_SERVICE_CLASS_NAME = "Free"
+DEFAULT_LOW_PRIORITY = 100
+DEFAULT_HIGH_PRIORITY = 1
+DEFAULT_SERVICE_CLASS_PRIORITY = DEFAULT_LOW_PRIORITY
+
+
+class SaturationPolicy(enum.Enum):
+    """Best-effort allocation policy once capacity saturates
+    (reference pkg/config/config.go:4-41)."""
+
+    NONE = "None"
+    PRIORITY_EXHAUSTIVE = "PriorityExhaustive"
+    PRIORITY_ROUND_ROBIN = "PriorityRoundRobin"
+    ROUND_ROBIN = "RoundRobin"
+
+    @classmethod
+    def parse(cls, s: str) -> "SaturationPolicy":
+        for p in cls:
+            if p.value == s:
+                return p
+        return cls.NONE
+
+
+# ---------------------------------------------------------------------------
+# Specs
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class PowerSpec:
+    """Piecewise-linear power curve per chip (Watts)."""
+
+    idle: float = 0.0
+    full: float = 0.0
+    mid_power: float = 0.0
+    mid_util: float = 0.0
+
+
+@dataclass(frozen=True)
+class AcceleratorSpec:
+    """A TPU slice shape offered to the optimizer, e.g. v5e-8 (2x4).
+
+    `chip` names the capacity pool (chips of one generation are fungible
+    within a node pool); `chips` is the slice's chip count — the unit an
+    allocation multiplies into capacity. `cost` is cents/hr for the whole
+    slice unit.
+    """
+
+    name: str
+    chip: str
+    chips: int = 1
+    topology: str = ""
+    multi_host: bool = False
+    mem_gb: float = 0.0
+    power: PowerSpec = field(default_factory=PowerSpec)
+    cost: float = 0.0
+
+
+@dataclass(frozen=True)
+class ModelSliceProfile:
+    """Fitted perf of (model x slice shape): decode itl = alpha + beta*b,
+    prefill ttft = gamma + delta*tokens*b (msec), plus batch capacity.
+
+    `slices_per_replica` is the number of slice units one model instance
+    occupies (reference accCount, pkg/core/model.go:45-54); for multi-host
+    serving a replica may span several slice units.
+    """
+
+    model: str
+    accelerator: str           # slice shape name
+    alpha: float
+    beta: float
+    gamma: float
+    delta: float
+    max_batch_size: int
+    at_tokens: int = 0         # token count at which max_batch_size holds
+    slices_per_replica: int = 1
+
+
+@dataclass(frozen=True)
+class ModelTarget:
+    model: str
+    slo_itl: float = 0.0   # msec
+    slo_ttft: float = 0.0  # msec (queueing + prefill)
+    slo_tps: float = 0.0   # tokens/sec
+
+
+@dataclass(frozen=True)
+class ServiceClassSpec:
+    name: str
+    priority: int = DEFAULT_SERVICE_CLASS_PRIORITY
+    model_targets: tuple[ModelTarget, ...] = ()
+
+
+@dataclass(frozen=True)
+class ServerLoadSpec:
+    arrival_rate: float = 0.0   # req/min
+    avg_in_tokens: int = 0
+    avg_out_tokens: int = 0
+
+
+@dataclass(frozen=True)
+class AllocationData:
+    """Serializable allocation (reference pkg/config/types.go:118-131)."""
+
+    accelerator: str = ""
+    num_replicas: int = 0
+    max_batch: int = 0
+    cost: float = 0.0
+    itl_average: float = 0.0
+    ttft_average: float = 0.0
+    load: ServerLoadSpec = field(default_factory=ServerLoadSpec)
+
+
+@dataclass(frozen=True)
+class ServerSpec:
+    """A variant server: one (service class, model) deployment."""
+
+    name: str
+    service_class: str = ""
+    model: str = ""
+    keep_accelerator: bool = False
+    min_num_replicas: int = 0
+    max_batch_size: int = 0  # 0 = derive from profile
+    current_alloc: AllocationData = field(default_factory=AllocationData)
+    desired_alloc: AllocationData = field(default_factory=AllocationData)
+
+
+@dataclass(frozen=True)
+class OptimizerSpec:
+    unlimited: bool = True
+    delayed_best_effort: bool = False
+    saturation_policy: str = SaturationPolicy.NONE.value
+
+
+@dataclass
+class SystemSpec:
+    """Everything the engine needs for one optimization cycle."""
+
+    accelerators: list[AcceleratorSpec] = field(default_factory=list)
+    profiles: list[ModelSliceProfile] = field(default_factory=list)
+    service_classes: list[ServiceClassSpec] = field(default_factory=list)
+    servers: list[ServerSpec] = field(default_factory=list)
+    capacity: dict[str, int] = field(default_factory=dict)  # chip -> chip count
+    optimizer: OptimizerSpec = field(default_factory=OptimizerSpec)
+
+
+@dataclass(frozen=True)
+class AllocationSolution:
+    """Solver output: server name -> allocation data."""
+
+    allocations: dict[str, AllocationData] = field(default_factory=dict)
+
+
+def with_load(data: AllocationData, load: ServerLoadSpec) -> AllocationData:
+    return dc_replace(data, load=load)

@@ -1,0 +1,287 @@
+"""System: the instance-scoped registry + batched candidate analysis.
+
+Replaces the reference's global-singleton system
+(/root/reference pkg/core/system.go, `TheSystem` at :10-13) with a plain
+object, and replaces the per-server sequential analysis loop
+(server.go:55-67 -> allocation.go:27-163, one queue solve chain per
+candidate) with ONE batched JAX kernel call across every
+(server, slice-shape) candidate — the TPU-native hot path.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .allocation import (
+    Allocation,
+    effective_batch_size,
+    replica_demand,
+    zero_load_allocation,
+)
+from .entities import Accelerator, Model, Server, ServiceClass
+from .spec import (
+    AcceleratorSpec,
+    AllocationData,
+    AllocationSolution,
+    ModelSliceProfile,
+    OptimizerSpec,
+    ServerSpec,
+    ServiceClassSpec,
+    SystemSpec,
+)
+
+
+@dataclass
+class AllocationByType:
+    """Aggregate usage per chip generation (reference system.go:60-66):
+    count is in chips."""
+
+    name: str
+    count: int = 0
+    limit: int = 0
+    cost: float = 0.0
+
+
+class System:
+    def __init__(self) -> None:
+        self.accelerators: dict[str, Accelerator] = {}
+        self.models: dict[str, Model] = {}
+        self.service_classes: dict[str, ServiceClass] = {}
+        self.servers: dict[str, Server] = {}
+        self.capacity: dict[str, int] = {}  # chip generation -> chips
+        self.allocation_by_type: dict[str, AllocationByType] = {}
+        self.allocation_solution: Optional[AllocationSolution] = None
+
+    # -- spec ingestion (reference system.go:82-175) --------------------
+
+    def set_from_spec(self, spec: SystemSpec) -> OptimizerSpec:
+        for acc in spec.accelerators:
+            self.add_accelerator(acc)
+        for profile in spec.profiles:
+            self.add_profile(profile)
+        for svc in spec.service_classes:
+            self.add_service_class_spec(svc)
+        for server in spec.servers:
+            self.add_server(server)
+        self.capacity.update(spec.capacity)
+        return spec.optimizer
+
+    def add_accelerator(self, spec: AcceleratorSpec) -> None:
+        self.accelerators[spec.name] = Accelerator(spec)
+
+    def remove_accelerator(self, name: str) -> None:
+        if name not in self.accelerators:
+            raise KeyError(f"accelerator {name} not found")
+        del self.accelerators[name]
+
+    def add_profile(self, profile: ModelSliceProfile) -> None:
+        model = self.models.get(profile.model)
+        if model is None:
+            model = self.models[profile.model] = Model(profile.model)
+        model.add_profile(profile)
+
+    def add_service_class_spec(self, spec: ServiceClassSpec) -> None:
+        self.service_classes[spec.name] = ServiceClass.from_spec(spec)
+
+    def add_server(self, spec: ServerSpec) -> None:
+        self.servers[spec.name] = Server(spec)
+
+    def remove_server(self, name: str) -> None:
+        if name not in self.servers:
+            raise KeyError(f"server {name} not found")
+        del self.servers[name]
+
+    # -- lookups --------------------------------------------------------
+
+    def accelerator(self, name: str) -> Optional[Accelerator]:
+        return self.accelerators.get(name)
+
+    def model(self, name: str) -> Optional[Model]:
+        return self.models.get(name)
+
+    def service_class(self, name: str) -> Optional[ServiceClass]:
+        return self.service_classes.get(name)
+
+    def server(self, name: str) -> Optional[Server]:
+        return self.servers.get(name)
+
+    # -- candidate analysis --------------------------------------------
+
+    def calculate(self, backend: str = "batched") -> None:
+        """Compute candidate allocations for every server.
+
+        backend="batched": gather all (server, slice) candidates and solve
+        them in one `ops.batched.size_batch` + one `analyze_batch` call.
+        backend="scalar": per-candidate numpy path (exact reference
+        semantics; used for cross-checking).
+        """
+        for acc in self.accelerators.values():
+            acc.calculate()
+        if backend == "scalar":
+            for server in self.servers.values():
+                server.calculate(self)
+            return
+        self._calculate_batched()
+
+    def _candidate_pairs(self):
+        """Feasible (server, acc) candidates with resolved profile/target;
+        mirrors the lookup guards of allocation.go:42-75."""
+        sized_pairs = []   # need a kernel solve
+        for server in self.servers.values():
+            server.all_allocations = {}
+            load = server.load
+            if load is None or load.arrival_rate < 0 or load.avg_in_tokens < 0 \
+                    or load.avg_out_tokens < 0:
+                continue
+            model = self.models.get(server.model_name)
+            if model is None:
+                continue
+            svc = self.service_classes.get(server.service_class_name)
+            if svc is None:
+                continue
+            target = svc.target(server.model_name)
+            if target is None:
+                continue
+            for acc_name in server.candidate_accelerators(self.accelerators):
+                profile = model.profile(acc_name)
+                if profile is None:
+                    continue
+                if load.arrival_rate == 0 or load.avg_out_tokens == 0:
+                    alloc = zero_load_allocation(self, server.name, acc_name)
+                    if alloc is not None:
+                        self._value_and_store(server, acc_name, alloc)
+                    continue
+                sized_pairs.append((server, acc_name, profile, target))
+        return sized_pairs
+
+    def _value_and_store(self, server: Server, acc_name: str, alloc: Allocation) -> None:
+        if server.cur_allocation is not None:
+            alloc.value = server.cur_allocation.transition_penalty(alloc)
+        server.all_allocations[acc_name] = alloc
+
+    def _calculate_batched(self) -> None:
+        import jax.numpy as jnp
+
+        from ..ops.batched import (
+            SLOTargets,
+            analyze_batch,
+            k_max_for,
+            make_queue_batch,
+            size_batch,
+        )
+
+        pairs = self._candidate_pairs()
+        if not pairs:
+            return
+
+        n_eff, alphas, betas, gammas, deltas, in_toks, out_toks = [], [], [], [], [], [], []
+        ttfts, itls, tpss = [], [], []
+        for server, acc_name, profile, target in pairs:
+            out_tok = server.load.avg_out_tokens
+            n_eff.append(effective_batch_size(profile, server.max_batch_size, out_tok))
+            alphas.append(profile.alpha)
+            betas.append(profile.beta)
+            gammas.append(profile.gamma)
+            deltas.append(profile.delta)
+            in_toks.append(server.load.avg_in_tokens)
+            out_toks.append(out_tok)
+            ttfts.append(target.slo_ttft)
+            itls.append(target.slo_itl)
+            tpss.append(target.slo_tps)
+
+        q = make_queue_batch(alphas, betas, gammas, deltas, in_toks, out_toks, n_eff)
+        k_max = k_max_for(n_eff)
+        dtype = q.alpha.dtype
+        sized = size_batch(
+            q,
+            SLOTargets(
+                ttft=jnp.asarray(ttfts, dtype),
+                itl=jnp.asarray(itls, dtype),
+                tps=jnp.asarray(tpss, dtype),
+            ),
+            k_max,
+        )
+        feasible = np.asarray(sized.feasible)
+        rate_star = np.asarray(sized.throughput) * 1000.0  # req/sec per replica
+
+        # replica counts + per-replica rates on host (tiny arrays)
+        num_replicas = np.zeros(len(pairs), dtype=np.int64)
+        per_replica_rate = np.zeros(len(pairs))
+        for i, (server, acc_name, profile, target) in enumerate(pairs):
+            if not feasible[i] or rate_star[i] <= 0:
+                continue
+            total = replica_demand(
+                server.load.arrival_rate, target.slo_tps, server.load.avg_out_tokens
+            )
+            num_replicas[i] = max(
+                math.ceil(total / rate_star[i]), server.min_num_replicas
+            )
+            per_replica_rate[i] = total / num_replicas[i]
+
+        per_rep = analyze_batch(q, jnp.asarray(per_replica_rate, dtype), k_max)
+        itl_a = np.asarray(per_rep["avg_token_time"])
+        ttft_a = np.asarray(per_rep["ttft"])
+        rho_a = np.asarray(per_rep["rho"])
+        rate_ok = np.asarray(per_rep["valid_rate"])
+        max_batch_a = np.asarray(q.max_batch)
+
+        for i, (server, acc_name, profile, target) in enumerate(pairs):
+            if not feasible[i] or num_replicas[i] <= 0 or not rate_ok[i]:
+                continue
+            acc = self.accelerators[acc_name]
+            model = self.models[server.model_name]
+            cost = acc.cost * model.num_instances(acc_name) * int(num_replicas[i])
+            alloc = Allocation(
+                accelerator=acc_name,
+                num_replicas=int(num_replicas[i]),
+                batch_size=int(max_batch_a[i]),
+                cost=cost,
+                itl=float(itl_a[i]),
+                ttft=float(ttft_a[i]),
+                rho=float(rho_a[i]),
+                max_arrv_rate_per_replica=float(rate_star[i]) / 1000.0,
+            )
+            alloc.value = alloc.cost
+            self._value_and_store(server, acc_name, alloc)
+
+    # -- accounting + solution (reference system.go:271-319) ------------
+
+    def allocate_by_type(self) -> dict[str, AllocationByType]:
+        self.allocation_by_type = {}
+        for server in self.servers.values():
+            alloc = server.allocation
+            if alloc is None:
+                continue
+            acc = self.accelerators.get(alloc.accelerator)
+            model = self.models.get(server.model_name)
+            if acc is None or model is None:
+                continue
+            chip = acc.chip
+            agg = self.allocation_by_type.setdefault(
+                chip, AllocationByType(name=chip, limit=self.capacity.get(chip, 0))
+            )
+            agg.count += alloc.num_replicas * model.num_instances(acc.name) * acc.chips
+            agg.cost += alloc.cost
+        return self.allocation_by_type
+
+    def generate_solution(self) -> AllocationSolution:
+        allocations: dict[str, AllocationData] = {}
+        for name, server in self.servers.items():
+            if server.allocation is None:
+                continue
+            allocations[name] = server.allocation.to_data(server.load)
+        self.allocation_solution = AllocationSolution(allocations=allocations)
+        return self.allocation_solution
+
+    def total_cost(self) -> float:
+        return sum(
+            s.allocation.cost for s in self.servers.values() if s.allocation is not None
+        )
+
+    def total_chips(self) -> int:
+        self.allocate_by_type()
+        return sum(a.count for a in self.allocation_by_type.values())

@@ -26,15 +26,12 @@ import numpy as np
 
 from .queueing import (
     EPSILON,
+    MAX_QUEUE_TO_BATCH_RATIO,
     STABILITY_SAFETY_FRACTION,
     QueueStats,
     state_dependent_solve,
 )
 from .search import BELOW_REGION, binary_search
-
-# maximum occupancy as a multiple of max batch size
-# (reference pkg/config/defaults.go:18)
-MAX_QUEUE_TO_BATCH_RATIO = 10
 
 
 class InfeasibleTargetError(ValueError):

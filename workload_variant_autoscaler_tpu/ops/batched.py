@@ -32,11 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .queueing import EPSILON, STABILITY_SAFETY_FRACTION
+from .queueing import EPSILON, MAX_QUEUE_TO_BATCH_RATIO, STABILITY_SAFETY_FRACTION
 from .search import MAX_ITERATIONS, TOLERANCE
-
-# occupancy bound as multiple of batch (reference pkg/config/defaults.go:18)
-MAX_QUEUE_TO_BATCH_RATIO = 10
 
 
 class QueueBatch(NamedTuple):

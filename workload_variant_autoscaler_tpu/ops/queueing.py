@@ -25,6 +25,11 @@ EPSILON = 1e-3
 # (reference queueanalyzer.go:11).
 STABILITY_SAFETY_FRACTION = 0.1
 
+# Maximum queue occupancy as a multiple of the max batch size — the single
+# source of truth for the K = N * (1 + ratio) bound used by both kernel
+# backends and the domain model (reference pkg/config/defaults.go:18).
+MAX_QUEUE_TO_BATCH_RATIO = 10
+
 
 @dataclass(frozen=True)
 class QueueStats:

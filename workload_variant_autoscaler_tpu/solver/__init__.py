@@ -1,0 +1,8 @@
+"""Solvers: unlimited (per-variant argmin) + greedy capacity-aware
+list scheduling with saturation policies, and the Optimizer/Manager facade."""
+
+from .solver import Solver
+from .greedy import solve_greedy
+from .optimizer import Manager, Optimizer
+
+__all__ = ["Manager", "Optimizer", "Solver", "solve_greedy"]

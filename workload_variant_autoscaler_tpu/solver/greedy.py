@@ -1,0 +1,239 @@
+"""Greedy capacity-aware solver with saturation policies.
+
+Reference: /root/reference pkg/solver/greedy.go. Servers are sorted by
+(priority, regret) — regret being the value delta to each server's next-best
+candidate — then list-scheduled against finite per-generation chip pools.
+Capacity is chip-granular: one replica consumes
+slices_per_replica * chips_per_slice chips of the slice's generation
+(the reference's numInstances x multiplicity, greedy.go:139-140). Servers
+that fit no full allocation get best-effort treatment per the configured
+saturation policy.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+from dataclasses import dataclass, field
+
+from ..models import Allocation, SaturationPolicy, System
+from ..models.entities import Server
+
+
+@dataclass
+class _Entry:
+    """Per-server scheduling state (reference greedy.go:17-27)."""
+
+    server: Server
+    priority: int
+    allocations: list[Allocation]  # sorted by value ascending
+    cur_index: int = 0
+    delta: float = field(default=0.0)  # regret to next-best candidate
+
+    def current(self) -> Allocation:
+        return self.allocations[self.cur_index]
+
+    def sort_key(self) -> tuple:
+        # priority asc, then regret desc, then current value desc
+        # (reference greedy.go:77-88)
+        return (self.priority, -self.delta, -self.current().value)
+
+
+def _chips_per_replica(system: System, server: Server, alloc: Allocation) -> int:
+    acc = system.accelerator(alloc.accelerator)
+    model = system.model(server.model_name)
+    if acc is None or model is None:
+        return 0
+    return model.num_instances(acc.name) * acc.chips
+
+
+def _make_entries(system: System) -> list[_Entry]:
+    entries = []
+    for server in system.servers.values():
+        server.remove_allocation()
+        if not server.all_allocations:
+            continue
+        allocs = sorted(server.all_allocations.values(), key=lambda a: a.value)
+        e = _Entry(server=server, priority=server.priority(system), allocations=allocs)
+        e.delta = allocs[1].value - allocs[0].value if len(allocs) > 1 else math.inf
+        entries.append(e)
+    entries.sort(key=_Entry.sort_key)
+    return entries
+
+
+def solve_greedy(
+    system: System,
+    policy: SaturationPolicy,
+    delayed_best_effort: bool = False,
+) -> None:
+    """Entry point (reference greedy.go:35-104)."""
+    available = dict(system.capacity)  # chip generation -> chips
+    entries = _make_entries(system)
+
+    if delayed_best_effort:
+        unallocated = _allocate(system, entries, available)
+        _best_effort(system, unallocated, available, policy)
+    else:
+        for group in priority_groups(entries):
+            unallocated = _allocate(system, group, available)
+            _best_effort(system, unallocated, available, policy)
+
+
+def _allocate(
+    system: System, entries: list[_Entry], available: dict[str, int]
+) -> list[_Entry]:
+    """Greedy list allocation; returns servers that fit no candidate
+    (reference greedy.go:107-166)."""
+    entries = list(entries)
+    keys = [e.sort_key() for e in entries]
+    unallocated: list[_Entry] = []
+    while entries:
+        top = entries.pop(0)
+        keys.pop(0)
+        if not top.allocations:
+            continue
+        alloc = top.current()
+        acc = system.accelerator(alloc.accelerator)
+        if acc is None:
+            continue
+        units = _chips_per_replica(system, top.server, alloc)
+        count = alloc.num_replicas * units
+        chip = acc.chip
+        if available.get(chip, 0) >= count:
+            available[chip] = available.get(chip, 0) - count
+            top.server.set_allocation(alloc)
+        else:
+            # advance to the next-best candidate and re-insert in order
+            top.cur_index += 1
+            if top.cur_index >= len(top.allocations):
+                unallocated.append(top)
+                continue
+            if top.cur_index + 1 < len(top.allocations):
+                top.delta = (
+                    top.allocations[top.cur_index + 1].value
+                    - top.allocations[top.cur_index].value
+                )
+            else:
+                top.delta = math.inf
+            key = top.sort_key()
+            i = bisect.bisect_left(keys, key)
+            entries.insert(i, top)
+            keys.insert(i, key)
+    return unallocated
+
+
+def _best_effort(
+    system: System,
+    unallocated: list[_Entry],
+    available: dict[str, int],
+    policy: SaturationPolicy,
+) -> None:
+    """Dispatch on saturation policy (reference greedy.go:169-190)."""
+    if policy is SaturationPolicy.PRIORITY_EXHAUSTIVE:
+        _allocate_maximally(system, unallocated, available)
+    elif policy is SaturationPolicy.PRIORITY_ROUND_ROBIN:
+        for group in priority_groups(unallocated):
+            _allocate_equally(system, group, available)
+    elif policy is SaturationPolicy.ROUND_ROBIN:
+        _allocate_equally(system, unallocated, available)
+    # NONE: no allocation beyond satisfying SLOs
+
+
+def _allocate_maximally(
+    system: System, entries: list[_Entry], available: dict[str, int]
+) -> None:
+    """Priority ordering, one server at a time exhaustively
+    (reference greedy.go:194-223): give each server as many replicas of its
+    best-value candidate as remaining capacity allows (capped at desired),
+    scaling cost/value pro rata."""
+    for entry in entries:
+        for alloc in entry.allocations:
+            acc = system.accelerator(alloc.accelerator)
+            if acc is None:
+                continue
+            units = _chips_per_replica(system, entry.server, alloc)
+            if units <= 0:
+                continue
+            max_replicas = min(available.get(acc.chip, 0) // units, alloc.num_replicas)
+            if max_replicas <= 0:
+                continue
+            factor = max_replicas / alloc.num_replicas
+            alloc.cost *= factor
+            alloc.value *= factor
+            alloc.num_replicas = max_replicas
+            entry.server.set_allocation(alloc)
+            available[acc.chip] = available.get(acc.chip, 0) - max_replicas * units
+            break
+
+
+@dataclass
+class _Ticket:
+    entry: _Entry
+    active: bool = False
+    chip: str = ""
+    units: int = 0
+    num_replicas: int = 0
+    final_alloc: Allocation | None = None
+
+
+def _allocate_equally(
+    system: System, entries: list[_Entry], available: dict[str, int]
+) -> None:
+    """Round-robin one replica per visit until capacity runs out
+    (reference greedy.go:239-316). Distribution continues while chips
+    remain — best-effort deliberately hands out all remaining capacity."""
+    tickets: dict[str, _Ticket] = {}
+    for entry in entries:
+        if system.model(entry.server.model_name) is None:
+            continue
+        tickets[entry.server.name] = _Ticket(entry=entry)
+
+    allocated: dict[str, _Ticket] = {}
+    while tickets:
+        for entry in entries:
+            name = entry.server.name
+            ticket = tickets.get(name)
+            if ticket is None:
+                continue
+            if not ticket.active:
+                for alloc in entry.allocations:
+                    acc = system.accelerator(alloc.accelerator)
+                    if acc is None:
+                        continue
+                    units = _chips_per_replica(system, entry.server, alloc)
+                    if units > 0 and available.get(acc.chip, 0) >= units:
+                        ticket.active = True
+                        ticket.chip = acc.chip
+                        ticket.units = units
+                        ticket.final_alloc = alloc
+                        break
+                if not ticket.active:
+                    del tickets[name]
+                    continue
+            replicas_available = available.get(ticket.chip, 0) // ticket.units
+            if min(replicas_available, ticket.final_alloc.num_replicas) > 0:
+                ticket.num_replicas += 1
+                available[ticket.chip] -= ticket.units
+                allocated[name] = ticket
+            else:
+                del tickets[name]
+
+    for name, ticket in allocated.items():
+        alloc = ticket.final_alloc
+        factor = ticket.num_replicas / alloc.num_replicas
+        alloc.cost *= factor
+        alloc.value *= factor
+        alloc.num_replicas = ticket.num_replicas
+        ticket.entry.server.set_allocation(alloc)
+
+
+def priority_groups(entries: list[_Entry]) -> list[list[_Entry]]:
+    """Partition a priority-sorted entry list into runs of equal priority
+    (reference greedy.go:321-341)."""
+    groups: list[list[_Entry]] = []
+    for e in entries:
+        if groups and groups[-1][0].priority == e.priority:
+            groups[-1].append(e)
+        else:
+            groups.append([e])
+    return groups

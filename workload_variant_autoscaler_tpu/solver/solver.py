@@ -1,0 +1,60 @@
+"""Allocation assignment solvers.
+
+Reference: /root/reference pkg/solver/solver.go. Two modes:
+- unlimited: per-server argmin over candidate allocations (separable
+  objective; value = transition penalty, so the solution is cost-minimal
+  and switch-averse). The only mode the controller currently drives
+  (reference internal/utils/utils.go:168-173 hardwires Unlimited).
+- greedy: capacity-aware list scheduling over finite chip pools, in
+  `greedy.py`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..models import Allocation, AllocationDiff, SaturationPolicy, System, allocation_diff
+from ..models.spec import OptimizerSpec
+from .greedy import solve_greedy
+
+
+class Solver:
+    def __init__(self, optimizer_spec: OptimizerSpec):
+        self.spec = optimizer_spec
+        self.current_allocation: dict[str, Allocation] = {}
+        self.diff_allocation: dict[str, AllocationDiff] = {}
+
+    def solve(self, system: System) -> None:
+        """Snapshot current allocations, dispatch by mode, compute diffs
+        (reference solver.go:32-59)."""
+        self.current_allocation = {
+            name: server.cur_allocation
+            for name, server in system.servers.items()
+            if server.cur_allocation is not None
+        }
+
+        if self.spec.unlimited:
+            self.solve_unlimited(system)
+        else:
+            solve_greedy(
+                system,
+                SaturationPolicy.parse(self.spec.saturation_policy),
+                delayed_best_effort=self.spec.delayed_best_effort,
+            )
+
+        self.diff_allocation = {}
+        for name, server in system.servers.items():
+            diff = allocation_diff(self.current_allocation.get(name), server.allocation)
+            if diff is not None:
+                self.diff_allocation[name] = diff
+
+    def solve_unlimited(self, system: System) -> None:
+        """Per-server min-value candidate (reference solver.go:63-79)."""
+        for server in system.servers.values():
+            server.remove_allocation()
+            best: Optional[Allocation] = None
+            for alloc in server.all_allocations.values():
+                if best is None or alloc.value < best.value:
+                    best = alloc
+            if best is not None:
+                server.set_allocation(best)
