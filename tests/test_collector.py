@@ -1,0 +1,178 @@
+"""Tests for the collector (PromQL builders, availability gate, load
+collection) — mirrors reference internal/collector coverage."""
+
+import math
+
+import pytest
+
+from workload_variant_autoscaler_tpu.collector import (
+    FakePromAPI,
+    PrometheusConfig,
+    arrival_rate_query,
+    availability_query,
+    avg_generation_tokens_query,
+    avg_itl_query,
+    avg_prompt_tokens_query,
+    avg_ttft_query,
+    collect_load,
+    validate_metrics_availability,
+    validate_prometheus_api,
+    validate_tls_config,
+)
+from workload_variant_autoscaler_tpu.collector.prometheus import Sample
+from workload_variant_autoscaler_tpu.controller import crd
+from workload_variant_autoscaler_tpu.utils import Backoff
+
+
+class TestQueryBuilders:
+    def test_arrival_rate(self):
+        q = arrival_rate_query("llama-8b", "prod")
+        assert q == (
+            'sum(rate(vllm:request_success_total{model_name="llama-8b",'
+            'namespace="prod"}[1m]))'
+        )
+
+    def test_ratio_queries_shape(self):
+        for q in (
+            avg_prompt_tokens_query("m", "ns"),
+            avg_generation_tokens_query("m", "ns"),
+            avg_ttft_query("m", "ns"),
+            avg_itl_query("m", "ns"),
+        ):
+            num, den = q.split("/")
+            assert num.startswith("sum(rate(vllm:")
+            assert den.startswith("sum(rate(vllm:")
+            assert "[1m]" in num and "[1m]" in den
+
+    def test_availability_with_and_without_namespace(self):
+        assert "namespace=" in availability_query("m", "ns")
+        assert "namespace=" not in availability_query("m")
+
+
+class TestValidateMetricsAvailability:
+    def test_available(self):
+        prom = FakePromAPI()
+        res = validate_metrics_availability(prom, "llama-8b", "prod")
+        assert res.available
+        assert res.reason == crd.REASON_METRICS_FOUND
+
+    def test_missing_everywhere(self):
+        prom = FakePromAPI()
+        prom.set_empty(availability_query("llama-8b", "prod"))
+        prom.set_empty(availability_query("llama-8b"))
+        res = validate_metrics_availability(prom, "llama-8b", "prod")
+        assert not res.available
+        assert res.reason == crd.REASON_METRICS_MISSING
+        assert "ServiceMonitor" in res.message  # troubleshooting text
+
+    def test_fallback_to_namespaceless(self):
+        """Emulator endpoints lack the namespace label
+        (reference collector.go:110-135)."""
+        prom = FakePromAPI()
+        prom.set_empty(availability_query("llama-8b", "prod"))
+        prom.set_result(availability_query("llama-8b"), 5.0)
+        res = validate_metrics_availability(prom, "llama-8b", "prod")
+        assert res.available
+
+    def test_stale_metrics(self):
+        prom = FakePromAPI()
+        prom.set_result(availability_query("llama-8b", "prod"), 5.0, age_seconds=400)
+        res = validate_metrics_availability(prom, "llama-8b", "prod")
+        assert not res.available
+        assert res.reason == crd.REASON_METRICS_STALE
+
+    def test_fresh_within_limit(self):
+        prom = FakePromAPI()
+        prom.set_result(availability_query("llama-8b", "prod"), 5.0, age_seconds=100)
+        assert validate_metrics_availability(prom, "llama-8b", "prod").available
+
+    def test_prometheus_error(self):
+        prom = FakePromAPI()
+        prom.set_error(availability_query("llama-8b", "prod"), RuntimeError("boom"))
+        res = validate_metrics_availability(prom, "llama-8b", "prod")
+        assert not res.available
+        assert res.reason == crd.REASON_PROMETHEUS_ERROR
+
+
+class TestCollectLoad:
+    def test_unit_conversions(self):
+        prom = FakePromAPI()
+        prom.set_result(arrival_rate_query("m", "ns"), 2.0)        # req/s
+        prom.set_result(avg_prompt_tokens_query("m", "ns"), 128.0)
+        prom.set_result(avg_generation_tokens_query("m", "ns"), 256.0)
+        prom.set_result(avg_ttft_query("m", "ns"), 0.120)          # seconds
+        prom.set_result(avg_itl_query("m", "ns"), 0.015)
+        load = collect_load(prom, "m", "ns")
+        assert load.arrival_rate_rpm == pytest.approx(120.0)  # req/min
+        assert load.avg_input_tokens == 128.0
+        assert load.avg_output_tokens == 256.0
+        assert load.avg_ttft_ms == pytest.approx(120.0)
+        assert load.avg_itl_ms == pytest.approx(15.0)
+
+    def test_nan_scrubbed(self):
+        """NaN from 0/0 PromQL ratios must not poison the engine
+        (reference collector.go:281-285)."""
+        prom = FakePromAPI()
+        prom.query_results[avg_prompt_tokens_query("m", "ns")] = [
+            Sample(labels={}, value=math.nan, timestamp=0)
+        ]
+        load = collect_load(prom, "m", "ns")
+        assert load.avg_input_tokens == 0.0
+
+    def test_empty_vector_is_zero(self):
+        prom = FakePromAPI()
+        prom.set_empty(arrival_rate_query("m", "ns"))
+        assert collect_load(prom, "m", "ns").arrival_rate_rpm == 0.0
+
+
+class TestTLSValidation:
+    def test_https_required(self):
+        with pytest.raises(ValueError):
+            validate_tls_config(PrometheusConfig(base_url="http://prom:9090"))
+        validate_tls_config(PrometheusConfig(base_url="https://prom:9090"))
+
+    def test_http_allowed_for_emulation(self):
+        validate_tls_config(
+            PrometheusConfig(base_url="http://prom:9090"), allow_http=True
+        )
+
+    def test_empty_url_rejected(self):
+        with pytest.raises(ValueError):
+            validate_tls_config(PrometheusConfig(base_url=""))
+
+    def test_garbage_scheme_rejected(self):
+        with pytest.raises(ValueError):
+            validate_tls_config(PrometheusConfig(base_url="ftp://x"))
+
+    def test_mtls_requires_both_halves(self):
+        with pytest.raises(ValueError):
+            validate_tls_config(
+                PrometheusConfig(base_url="https://x", client_cert_path="/cert")
+            )
+
+
+class TestValidatePrometheusAPI:
+    def test_retries_then_succeeds(self):
+        calls = {"n": 0}
+
+        class Flaky:
+            def query(self, q):
+                calls["n"] += 1
+                if calls["n"] < 3:
+                    raise RuntimeError("not up yet")
+                return []
+
+        validate_prometheus_api(
+            Flaky(), backoff=Backoff(duration=0.001, steps=5), sleep=lambda _s: None
+        )
+        assert calls["n"] == 3
+
+    def test_exhausted_raises(self):
+        class Down:
+            def query(self, q):
+                raise RuntimeError("down")
+
+        with pytest.raises(RuntimeError):
+            validate_prometheus_api(
+                Down(), backoff=Backoff(duration=0.001, steps=2), sleep=lambda _s: None
+            )

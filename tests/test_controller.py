@@ -1,0 +1,299 @@
+"""Reconciler tests against the in-memory kube + fake Prometheus — the
+envtest-equivalent tier (reference internal/controller/
+variantautoscaling_controller_test.go scenarios)."""
+
+import json
+
+import pytest
+
+from workload_variant_autoscaler_tpu.collector import (
+    FakePromAPI,
+    arrival_rate_query,
+    availability_query,
+    avg_generation_tokens_query,
+    avg_itl_query,
+    avg_prompt_tokens_query,
+    avg_ttft_query,
+)
+from workload_variant_autoscaler_tpu.controller import (
+    ACCELERATOR_CM_NAME,
+    CONFIG_MAP_NAME,
+    CONFIG_MAP_NAMESPACE,
+    SERVICE_CLASS_CM_NAME,
+    ConfigMap,
+    Deployment,
+    InMemoryKube,
+    NotFoundError,
+    Reconciler,
+    crd,
+)
+from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
+
+MODEL = "llama-8b"
+NS = "default"
+VARIANT = "chat-8b"
+FULL = VARIANT + ":" + NS
+
+
+def make_va(name=VARIANT, namespace=NS, model=MODEL, acc="v5e-1",
+            deleted=False, model_id=None):
+    va = crd.VariantAutoscaling(
+        metadata=crd.ObjectMeta(
+            name=name, namespace=namespace,
+            labels={crd.ACCELERATOR_LABEL: acc},
+            deletion_timestamp=123.0 if deleted else None,
+        ),
+        spec=crd.VariantAutoscalingSpec(
+            model_id=model if model_id is None else model_id,
+            slo_class_ref=crd.ConfigMapKeyRef(name=SERVICE_CLASS_CM_NAME, key="premium"),
+            model_profile=crd.ModelProfile(
+                accelerators=[
+                    crd.AcceleratorProfile(
+                        acc="v5e-1", acc_count=1,
+                        perf_parms=crd.PerfParms(
+                            decode_parms={"alpha": "6.973", "beta": "0.027"},
+                            prefill_parms={"gamma": "5.2", "delta": "0.1"},
+                        ),
+                        max_batch_size=64,
+                    ),
+                    crd.AcceleratorProfile(
+                        acc="v5e-4", acc_count=1,
+                        perf_parms=crd.PerfParms(
+                            decode_parms={"alpha": "3.2", "beta": "0.012"},
+                            prefill_parms={"gamma": "2.4", "delta": "0.04"},
+                        ),
+                        max_batch_size=192,
+                    ),
+                ],
+            ),
+        ),
+    )
+    return va
+
+
+def make_cluster(arrival_rps=2.0, interval="30s", replicas=2):
+    kube = InMemoryKube()
+    kube.put_configmap(ConfigMap(
+        name=CONFIG_MAP_NAME, namespace=CONFIG_MAP_NAMESPACE,
+        data={"GLOBAL_OPT_INTERVAL": interval},
+    ))
+    kube.put_configmap(ConfigMap(
+        name=ACCELERATOR_CM_NAME, namespace=CONFIG_MAP_NAMESPACE,
+        data={
+            "v5e-1": json.dumps({"chip": "v5e", "chips": "1", "cost": "20.0"}),
+            "v5e-4": json.dumps({"chip": "v5e", "chips": "4", "cost": "80.0"}),
+        },
+    ))
+    kube.put_configmap(ConfigMap(
+        name=SERVICE_CLASS_CM_NAME, namespace=CONFIG_MAP_NAMESPACE,
+        data={
+            "premium": (
+                "name: Premium\npriority: 1\ndata:\n"
+                f"  - model: {MODEL}\n    slo-tpot: 24\n    slo-ttft: 500\n"
+            ),
+        },
+    ))
+    kube.put_deployment(Deployment(name=VARIANT, namespace=NS,
+                                   spec_replicas=replicas, status_replicas=replicas))
+    kube.put_variant_autoscaling(make_va())
+
+    prom = FakePromAPI()
+    prom.set_result(arrival_rate_query(MODEL, NS), arrival_rps)
+    prom.set_result(avg_prompt_tokens_query(MODEL, NS), 128.0)
+    prom.set_result(avg_generation_tokens_query(MODEL, NS), 128.0)
+    prom.set_result(avg_ttft_query(MODEL, NS), 0.050)
+    prom.set_result(avg_itl_query(MODEL, NS), 0.009)
+
+    emitter = MetricsEmitter()
+    rec = Reconciler(kube=kube, prom=prom, emitter=emitter, sleep=lambda _s: None)
+    return kube, prom, emitter, rec
+
+
+class TestReconcileHappyPath:
+    def test_status_and_conditions(self):
+        kube, _prom, _emitter, rec = make_cluster()
+        result = rec.reconcile()
+        assert result.requeue_after == 30.0
+        assert result.processed == [FULL]
+        assert result.error is None
+
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert va.status.desired_optimized_alloc.accelerator == "v5e-1"
+        assert va.status.desired_optimized_alloc.num_replicas >= 1
+        assert va.status.current_alloc.num_replicas == 2
+        assert va.status.current_alloc.load.arrival_rate == "120.00"
+        assert va.status.actuation.applied
+        assert crd.is_condition_true(va, crd.TYPE_METRICS_AVAILABLE)
+        assert crd.is_condition_true(va, crd.TYPE_OPTIMIZATION_READY)
+
+    def test_scale_out_under_load(self):
+        _kube, _p, emitter, rec = make_cluster(arrival_rps=60.0)
+        rec.reconcile()
+        desired = emitter.value(
+            "inferno_desired_replicas", variant_name=VARIANT, namespace=NS
+        )
+        assert desired is not None and desired > 1
+        # CR status agrees with the emitted series (the kind-e2e invariant,
+        # reference test/e2e/e2e_test.go:358-444)
+        va = _kube.get_variant_autoscaling(VARIANT, NS)
+        assert va.status.desired_optimized_alloc.num_replicas == desired
+
+    def test_keep_accelerator_pins_slice(self):
+        """The controller pins variants to their current slice shape
+        (reference utils.go:290), so v5e-4 never gets chosen even if cheap."""
+        kube, _p, _e, rec = make_cluster(arrival_rps=60.0)
+        rec.reconcile()
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert va.status.desired_optimized_alloc.accelerator == "v5e-1"
+
+    def test_owner_reference_set(self):
+        kube, _p, _e, rec = make_cluster()
+        rec.reconcile()
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert va.is_controlled_by(f"uid-{NS}-{VARIANT}")
+
+    def test_emitted_ratio(self):
+        _kube, _p, emitter, rec = make_cluster(arrival_rps=60.0, replicas=2)
+        rec.reconcile()
+        desired = emitter.value("inferno_desired_replicas", variant_name=VARIANT)
+        ratio = emitter.value("inferno_desired_ratio", variant_name=VARIANT)
+        assert ratio == pytest.approx(desired / 2)
+
+    def test_metric_current_from_live_deployment(self):
+        kube, _p, emitter, rec = make_cluster()
+        # live deployment says 5, regardless of VA status
+        kube.put_deployment(Deployment(name=VARIANT, namespace=NS,
+                                       spec_replicas=5, status_replicas=5))
+        rec.reconcile()
+        assert emitter.value("inferno_current_replicas", variant_name=VARIANT) == 5
+
+
+class TestDegradedPaths:
+    def test_missing_operator_config_raises(self):
+        kube, _p, _e, rec = make_cluster()
+        del kube.configmaps[(CONFIG_MAP_NAMESPACE, CONFIG_MAP_NAME)]
+        with pytest.raises(NotFoundError):
+            rec.reconcile()
+
+    def test_missing_accelerator_config_raises(self):
+        kube, _p, _e, rec = make_cluster()
+        del kube.configmaps[(CONFIG_MAP_NAMESPACE, ACCELERATOR_CM_NAME)]
+        with pytest.raises(NotFoundError):
+            rec.reconcile()
+
+    def test_deleted_va_filtered(self):
+        kube, _p, _e, rec = make_cluster()
+        kube.put_variant_autoscaling(make_va(deleted=True))
+        result = rec.reconcile()
+        assert result.skipped.get(FULL) == "deleted"
+        assert FULL not in result.processed
+
+    def test_empty_model_id_skipped(self):
+        kube, _p, _e, rec = make_cluster()
+        kube.put_variant_autoscaling(make_va(model_id=""))
+        result = rec.reconcile()
+        assert result.skipped.get(FULL) == "missing modelID"
+
+    def test_no_slo_for_model_skipped(self):
+        kube, _p, _e, rec = make_cluster()
+        kube.put_variant_autoscaling(make_va(model_id="unknown-model"))
+        result = rec.reconcile()
+        assert result.skipped.get(FULL) == "no SLO for model"
+
+    def test_missing_accelerator_cost_skipped(self):
+        kube, _p, _e, rec = make_cluster()
+        va = make_va()
+        va.metadata.labels[crd.ACCELERATOR_LABEL] = "h100"
+        kube.put_variant_autoscaling(va)
+        result = rec.reconcile()
+        assert result.skipped.get(FULL) == "missing accelerator cost"
+
+    def test_missing_deployment_skipped(self):
+        kube, _p, _e, rec = make_cluster()
+        del kube.deployments[(NS, VARIANT)]
+        result = rec.reconcile()
+        assert result.skipped.get(FULL) == "deployment not found"
+
+    def test_metrics_missing_skips_without_status_write(self):
+        kube, prom, _e, rec = make_cluster()
+        prom.set_empty(availability_query(MODEL, NS))
+        prom.set_empty(availability_query(MODEL))
+        result = rec.reconcile()
+        assert result.skipped.get(FULL) == crd.REASON_METRICS_MISSING
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert va.status.desired_optimized_alloc.num_replicas == 0
+
+    def test_stale_metrics_skip(self):
+        kube, prom, _e, rec = make_cluster()
+        prom.set_result(availability_query(MODEL, NS), 1.0, age_seconds=400)
+        result = rec.reconcile()
+        assert result.skipped.get(FULL) == crd.REASON_METRICS_STALE
+
+    def test_optimization_failure_sets_condition(self):
+        """All candidate profiles malformed -> no feasible allocations ->
+        OptimizationReady=False on prepared VAs
+        (reference controller.go:164-186)."""
+        kube, _p, _e, rec = make_cluster()
+        va = make_va()
+        for ap in va.spec.model_profile.accelerators:
+            ap.perf_parms.decode_parms = {"alpha": "garbage", "beta": "x"}
+        kube.put_variant_autoscaling(va)
+        result = rec.reconcile()
+        assert result.error is not None
+        stored = kube.get_variant_autoscaling(VARIANT, NS)
+        assert crd.is_condition_false(stored, crd.TYPE_OPTIMIZATION_READY)
+
+    def test_transient_kube_errors_retried(self):
+        kube, _p, _e, rec = make_cluster()
+        kube.inject_fault("get", "ConfigMap", RuntimeError("etcd hiccup"), count=2)
+        result = rec.reconcile()  # backoff absorbs the transient failures
+        assert result.processed == [FULL]
+
+
+class TestScaleToZero:
+    def test_zero_load_scales_to_zero_when_enabled(self, monkeypatch):
+        monkeypatch.setenv("WVA_SCALE_TO_ZERO", "true")
+        kube, prom, emitter, rec = make_cluster(arrival_rps=0.0)
+        prom.set_result(avg_generation_tokens_query(MODEL, NS), 0.0)
+        prom.set_result(avg_prompt_tokens_query(MODEL, NS), 0.0)
+        rec.reconcile()
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert va.status.desired_optimized_alloc.num_replicas == 0
+        # 0 -> N encoding: current 2, desired 0 -> ratio 0
+        assert emitter.value("inferno_desired_ratio", variant_name=VARIANT) == 0.0
+
+    def test_zero_load_holds_one_replica_by_default(self, monkeypatch):
+        monkeypatch.delenv("WVA_SCALE_TO_ZERO", raising=False)
+        kube, prom, _e, rec = make_cluster(arrival_rps=0.0)
+        prom.set_result(avg_generation_tokens_query(MODEL, NS), 0.0)
+        prom.set_result(avg_prompt_tokens_query(MODEL, NS), 0.0)
+        rec.reconcile()
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert va.status.desired_optimized_alloc.num_replicas == 1
+
+
+class TestConfigParsing:
+    def test_parse_duration(self):
+        from workload_variant_autoscaler_tpu.controller.translate import parse_duration
+
+        assert parse_duration("60s") == 60.0
+        assert parse_duration("2m30s") == 150.0
+        assert parse_duration("1h") == 3600.0
+        assert parse_duration("500ms") == 0.5
+        with pytest.raises(ValueError):
+            parse_duration("nonsense")
+
+    def test_default_interval_when_unset(self):
+        kube, _p, _e, rec = make_cluster()
+        kube.put_configmap(ConfigMap(
+            name=CONFIG_MAP_NAME, namespace=CONFIG_MAP_NAMESPACE, data={}
+        ))
+        assert rec.read_optimization_interval() == 60.0
+
+    def test_gc_on_deployment_delete(self):
+        """Owner references garbage-collect the VA when its Deployment goes
+        (reference e2e scenario, test/e2e/e2e_test.go:630)."""
+        kube, _p, _e, rec = make_cluster()
+        rec.reconcile()  # sets ownerReference
+        kube.delete_deployment(VARIANT, NS)
+        assert kube.list_variant_autoscalings() == []

@@ -1,0 +1,47 @@
+"""Metrics ingestion: Prometheus client + vLLM-TPU/JetStream collectors."""
+
+from .prometheus import (
+    FakePromAPI,
+    HTTPPromAPI,
+    PromAPI,
+    PrometheusConfig,
+    Sample,
+    validate_prometheus_api,
+    validate_tls_config,
+)
+from .collector import (
+    STALENESS_LIMIT_SECONDS,
+    CollectedLoad,
+    MetricsValidation,
+    arrival_rate_query,
+    availability_query,
+    avg_generation_tokens_query,
+    avg_itl_query,
+    avg_prompt_tokens_query,
+    avg_ttft_query,
+    collect_load,
+    collect_tpu_utilization,
+    validate_metrics_availability,
+)
+
+__all__ = [
+    "CollectedLoad",
+    "FakePromAPI",
+    "HTTPPromAPI",
+    "MetricsValidation",
+    "PromAPI",
+    "PrometheusConfig",
+    "STALENESS_LIMIT_SECONDS",
+    "Sample",
+    "arrival_rate_query",
+    "availability_query",
+    "avg_generation_tokens_query",
+    "avg_itl_query",
+    "avg_prompt_tokens_query",
+    "avg_ttft_query",
+    "collect_load",
+    "collect_tpu_utilization",
+    "validate_metrics_availability",
+    "validate_prometheus_api",
+    "validate_tls_config",
+]

@@ -1,0 +1,200 @@
+"""Collector: vLLM-TPU serving metrics -> current load/latency profile.
+
+Equivalent of /root/reference internal/collector/collector.go, aimed at
+vLLM-TPU / JetStream Prometheus endpoints. The scraped series keep the
+`vllm:*` names (vLLM-TPU exports the same family; constants below mirror
+internal/constants/metrics.go:7-43), with optional TPU runtime gauges
+(duty cycle / HBM) collected opportunistically for observability.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from ..utils import fix_value, get_logger, kv
+from .prometheus import PromAPI
+
+log = get_logger("wva.collector")
+
+# -- scraped input series (vLLM-TPU exports the same vllm:* family) --------
+VLLM_REQUEST_SUCCESS_TOTAL = "vllm:request_success_total"
+VLLM_REQUEST_PROMPT_TOKENS_SUM = "vllm:request_prompt_tokens_sum"
+VLLM_REQUEST_PROMPT_TOKENS_COUNT = "vllm:request_prompt_tokens_count"
+VLLM_REQUEST_GENERATION_TOKENS_SUM = "vllm:request_generation_tokens_sum"
+VLLM_REQUEST_GENERATION_TOKENS_COUNT = "vllm:request_generation_tokens_count"
+VLLM_TTFT_SECONDS_SUM = "vllm:time_to_first_token_seconds_sum"
+VLLM_TTFT_SECONDS_COUNT = "vllm:time_to_first_token_seconds_count"
+VLLM_TPOT_SECONDS_SUM = "vllm:time_per_output_token_seconds_sum"
+VLLM_TPOT_SECONDS_COUNT = "vllm:time_per_output_token_seconds_count"
+
+# optional TPU runtime gauges (tpu-monitoring-library / libtpu names)
+TPU_DUTY_CYCLE = "tpu_duty_cycle_percent"
+TPU_HBM_USAGE = "tpu_hbm_memory_usage_bytes"
+
+LABEL_MODEL_NAME = "model_name"
+LABEL_NAMESPACE = "namespace"
+
+STALENESS_LIMIT_SECONDS = 300.0  # 5 min (reference collector.go:139-149)
+RATE_WINDOW = "1m"               # (reference collector.go:170-209)
+
+
+def _rate_sum(metric: str, model: str, namespace: str) -> str:
+    return (
+        f'sum(rate({metric}{{{LABEL_MODEL_NAME}="{model}",'
+        f'{LABEL_NAMESPACE}="{namespace}"}}[{RATE_WINDOW}]))'
+    )
+
+
+def _ratio(num: str, den: str, model: str, namespace: str) -> str:
+    return f"{_rate_sum(num, model, namespace)}/{_rate_sum(den, model, namespace)}"
+
+
+def arrival_rate_query(model: str, namespace: str) -> str:
+    return _rate_sum(VLLM_REQUEST_SUCCESS_TOTAL, model, namespace)
+
+
+def avg_prompt_tokens_query(model: str, namespace: str) -> str:
+    return _ratio(
+        VLLM_REQUEST_PROMPT_TOKENS_SUM, VLLM_REQUEST_PROMPT_TOKENS_COUNT,
+        model, namespace,
+    )
+
+
+def avg_generation_tokens_query(model: str, namespace: str) -> str:
+    return _ratio(
+        VLLM_REQUEST_GENERATION_TOKENS_SUM, VLLM_REQUEST_GENERATION_TOKENS_COUNT,
+        model, namespace,
+    )
+
+
+def avg_ttft_query(model: str, namespace: str) -> str:
+    return _ratio(VLLM_TTFT_SECONDS_SUM, VLLM_TTFT_SECONDS_COUNT, model, namespace)
+
+
+def avg_itl_query(model: str, namespace: str) -> str:
+    return _ratio(VLLM_TPOT_SECONDS_SUM, VLLM_TPOT_SECONDS_COUNT, model, namespace)
+
+
+def availability_query(model: str, namespace: str | None = None) -> str:
+    if namespace is None:
+        return f'{VLLM_REQUEST_SUCCESS_TOTAL}{{{LABEL_MODEL_NAME}="{model}"}}'
+    return (
+        f'{VLLM_REQUEST_SUCCESS_TOTAL}{{{LABEL_MODEL_NAME}="{model}",'
+        f'{LABEL_NAMESPACE}="{namespace}"}}'
+    )
+
+
+@dataclass(frozen=True)
+class MetricsValidation:
+    """Result of the availability/staleness gate
+    (reference collector.go:79-156)."""
+
+    available: bool
+    reason: str
+    message: str
+
+
+@dataclass(frozen=True)
+class CollectedLoad:
+    """Scraped load/latency snapshot for one variant (units converted:
+    req/min, tokens, msec)."""
+
+    arrival_rate_rpm: float
+    avg_input_tokens: float
+    avg_output_tokens: float
+    avg_ttft_ms: float
+    avg_itl_ms: float
+
+
+def _first_value(prom: PromAPI, promql: str) -> float:
+    samples = prom.query(promql)
+    if not samples:
+        return 0.0
+    return fix_value(samples[0].value)
+
+
+def validate_metrics_availability(
+    prom: PromAPI, model: str, namespace: str, now: float | None = None
+) -> MetricsValidation:
+    """Check serving metrics exist and are fresh. Falls back to a
+    namespace-less query for emulator endpoints (reference
+    collector.go:87-156)."""
+    from ..controller import crd
+
+    try:
+        samples = prom.query(availability_query(model, namespace))
+        if not samples:
+            samples = prom.query(availability_query(model))
+    except Exception as e:  # noqa: BLE001 - any query failure is a condition
+        log.error("prometheus query failed during validation",
+                  extra=kv(model=model, namespace=namespace, error=str(e)))
+        return MetricsValidation(
+            available=False,
+            reason=crd.REASON_PROMETHEUS_ERROR,
+            message=f"Failed to query Prometheus: {e}",
+        )
+
+    if not samples:
+        return MetricsValidation(
+            available=False,
+            reason=crd.REASON_METRICS_MISSING,
+            message=(
+                f"No serving metrics found for model '{model}' in namespace "
+                f"'{namespace}'. Check: (1) ServiceMonitor/PodMonitor exists and "
+                "matches the serving pods, (2) vLLM-TPU/JetStream pods expose "
+                "/metrics, (3) Prometheus scrapes the monitoring namespace"
+            ),
+        )
+
+    t = time.time() if now is None else now
+    for s in samples:
+        age = t - s.timestamp
+        if age > STALENESS_LIMIT_SECONDS:
+            return MetricsValidation(
+                available=False,
+                reason=crd.REASON_METRICS_STALE,
+                message=(
+                    f"Serving metrics for model '{model}' are stale "
+                    f"(last update {age:.0f}s ago); scrape may be broken"
+                ),
+            )
+
+    return MetricsValidation(
+        available=True,
+        reason=crd.REASON_METRICS_FOUND,
+        message="serving metrics are available and fresh",
+    )
+
+
+def collect_load(prom: PromAPI, model: str, namespace: str) -> CollectedLoad:
+    """Run the 5 aggregate queries (reference collector.go:158-278) and
+    convert units: arrival req/s -> req/min, latencies sec -> msec."""
+    arrival = _first_value(prom, arrival_rate_query(model, namespace)) * 60.0
+    in_tok = _first_value(prom, avg_prompt_tokens_query(model, namespace))
+    out_tok = _first_value(prom, avg_generation_tokens_query(model, namespace))
+    ttft_ms = _first_value(prom, avg_ttft_query(model, namespace)) * 1000.0
+    itl_ms = _first_value(prom, avg_itl_query(model, namespace)) * 1000.0
+    return CollectedLoad(
+        arrival_rate_rpm=arrival,
+        avg_input_tokens=in_tok,
+        avg_output_tokens=out_tok,
+        avg_ttft_ms=ttft_ms,
+        avg_itl_ms=itl_ms,
+    )
+
+
+def collect_tpu_utilization(prom: PromAPI, namespace: str) -> dict[str, float]:
+    """Opportunistic TPU runtime gauges; absent series yield {} (these are
+    observability-only, never gating)."""
+    out: dict[str, float] = {}
+    try:
+        duty = prom.query(f'avg({TPU_DUTY_CYCLE}{{{LABEL_NAMESPACE}="{namespace}"}})')
+        if duty:
+            out["duty_cycle_percent"] = fix_value(duty[0].value)
+        hbm = prom.query(f'sum({TPU_HBM_USAGE}{{{LABEL_NAMESPACE}="{namespace}"}})')
+        if hbm:
+            out["hbm_usage_bytes"] = fix_value(hbm[0].value)
+    except Exception:  # noqa: BLE001
+        return out
+    return out

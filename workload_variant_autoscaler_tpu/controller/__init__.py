@@ -1,0 +1,42 @@
+"""Control loop: VariantAutoscaling CRD + reconciler + kube clients."""
+
+from . import crd, translate
+from .kube import (
+    ConfigMap,
+    ConflictError,
+    Deployment,
+    InMemoryKube,
+    InvalidError,
+    KubeClient,
+    NotFoundError,
+    RestKube,
+)
+from .reconciler import (
+    ACCELERATOR_CM_NAME,
+    CONFIG_MAP_NAME,
+    CONFIG_MAP_NAMESPACE,
+    DEFAULT_INTERVAL_SECONDS,
+    SERVICE_CLASS_CM_NAME,
+    Reconciler,
+    ReconcileResult,
+)
+
+__all__ = [
+    "ACCELERATOR_CM_NAME",
+    "CONFIG_MAP_NAME",
+    "CONFIG_MAP_NAMESPACE",
+    "ConfigMap",
+    "ConflictError",
+    "DEFAULT_INTERVAL_SECONDS",
+    "Deployment",
+    "InMemoryKube",
+    "InvalidError",
+    "KubeClient",
+    "NotFoundError",
+    "ReconcileResult",
+    "Reconciler",
+    "RestKube",
+    "SERVICE_CLASS_CM_NAME",
+    "crd",
+    "translate",
+]

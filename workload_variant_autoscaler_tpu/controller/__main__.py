@@ -1,0 +1,66 @@
+"""Controller entry point (the reference's cmd/main.go equivalent).
+
+Wires the REST kube client, the HTTPS Prometheus client (validated with
+backoff — the controller hard-fails without Prometheus, reference
+cmd/main.go + controller SetupWithManager :448-451), the metrics server,
+and starts the reconcile loop.
+
+Usage:
+    python -m workload_variant_autoscaler_tpu.controller \
+        [--metrics-port 8443] [--config-namespace NS] [--allow-http-prom]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..collector import HTTPPromAPI, PrometheusConfig, validate_prometheus_api
+from ..metrics import MetricsEmitter
+from ..utils import get_logger, kv
+from .kube import RestKube
+from .reconciler import CONFIG_MAP_NAMESPACE, Reconciler
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="TPU-native workload variant autoscaler")
+    parser.add_argument("--metrics-port", type=int, default=8080,
+                        help="port for the emitted /metrics endpoint")
+    parser.add_argument("--metrics-addr", default="0.0.0.0")
+    parser.add_argument("--config-namespace", default=CONFIG_MAP_NAMESPACE)
+    parser.add_argument("--kube-url", default=None,
+                        help="API server URL (default: in-cluster)")
+    parser.add_argument("--allow-http-prom", action="store_true",
+                        help="permit plain-http Prometheus (emulation only)")
+    args = parser.parse_args(argv)
+
+    log = get_logger("wva.main")
+
+    prom_config = PrometheusConfig.from_env()
+    if prom_config is None:
+        log.error("no Prometheus configuration found; set PROMETHEUS_BASE_URL")
+        return 1
+    prom = HTTPPromAPI(prom_config, allow_http=args.allow_http_prom)
+    log.info("validating Prometheus connectivity", extra=kv(url=prom_config.base_url))
+    try:
+        validate_prometheus_api(prom)
+    except Exception as e:  # noqa: BLE001
+        log.error("CRITICAL: cannot reach Prometheus; autoscaling requires it",
+                  extra=kv(error=str(e)))
+        return 1
+
+    kube = RestKube(base_url=args.kube_url)
+    emitter = MetricsEmitter()
+    emitter.serve(args.metrics_port, addr=args.metrics_addr)
+
+    reconciler = Reconciler(
+        kube=kube, prom=prom, emitter=emitter,
+        config_namespace=args.config_namespace,
+    )
+    log.info("starting reconcile loop")
+    reconciler.run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

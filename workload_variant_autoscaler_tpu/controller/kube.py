@@ -1,0 +1,300 @@
+"""Kubernetes client abstraction: in-memory fake + REST client.
+
+The reference uses controller-runtime's cached client
+(/root/reference internal/utils/utils.go:58-104 wraps it in backoff). Here
+the controller talks through a small `KubeClient` protocol with two
+implementations:
+
+- `InMemoryKube`: a dict-backed API server used by unit tests and the
+  GPU/TPU-free e2e loop (the envtest equivalent in this rebuild's test
+  strategy). Supports fault injection per (verb, resource) for backoff and
+  degradation tests.
+- `RestKube`: a thin HTTPS client for a real cluster (in-cluster service
+  account or explicit kubeconfig-style parameters). Speaks the standard
+  REST paths for Deployments, ConfigMaps and the VariantAutoscaling CRD.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Protocol
+
+from ..utils import TerminalError
+from .crd import GROUP, PLURAL, VERSION, VariantAutoscaling, va_from_dict, va_to_dict
+
+
+class NotFoundError(TerminalError):
+    """Resource does not exist (terminal for gets, reference utils.go:62-64)."""
+
+
+class InvalidError(TerminalError):
+    """Validation failure (terminal for updates, reference utils.go:95-97)."""
+
+
+class ConflictError(Exception):
+    """Stale resourceVersion on update (transient: re-get and retry)."""
+
+
+@dataclass
+class Deployment:
+    name: str
+    namespace: str = "default"
+    spec_replicas: int = 1
+    status_replicas: int = -1  # -1: status not reported yet
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+
+    def current_replicas(self) -> int:
+        """Actual replicas, preferring live status (reference
+        actuator.go:29-48)."""
+        if self.status_replicas >= 0:
+            return self.status_replicas
+        if self.spec_replicas >= 0:
+            return self.spec_replicas
+        return 1
+
+
+@dataclass
+class ConfigMap:
+    name: str
+    namespace: str
+    data: dict[str, str] = field(default_factory=dict)
+
+
+class KubeClient(Protocol):
+    def get_configmap(self, name: str, namespace: str) -> ConfigMap: ...
+    def get_deployment(self, name: str, namespace: str) -> Deployment: ...
+    def list_variant_autoscalings(self) -> list[VariantAutoscaling]: ...
+    def get_variant_autoscaling(self, name: str, namespace: str) -> VariantAutoscaling: ...
+    def update_variant_autoscaling_status(self, va: VariantAutoscaling) -> None: ...
+    def patch_owner_reference(self, va: VariantAutoscaling, deploy: Deployment) -> None: ...
+
+
+class InMemoryKube:
+    """Dict-backed fake API server with optional fault injection."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.configmaps: dict[tuple[str, str], ConfigMap] = {}
+        self.deployments: dict[tuple[str, str], Deployment] = {}
+        self.vas: dict[tuple[str, str], VariantAutoscaling] = {}
+        # (verb, kind) -> callable raising the injected error; removed after
+        # `count` trips when count > 0
+        self._faults: dict[tuple[str, str], tuple[Callable[[], None], int]] = {}
+        self.status_update_count = 0
+
+    # -- setup helpers ---------------------------------------------------
+
+    def put_configmap(self, cm: ConfigMap) -> None:
+        self.configmaps[(cm.namespace, cm.name)] = cm
+
+    def put_deployment(self, d: Deployment) -> None:
+        if not d.uid:
+            d.uid = f"uid-{d.namespace}-{d.name}"
+        self.deployments[(d.namespace, d.name)] = d
+
+    def put_variant_autoscaling(self, va: VariantAutoscaling) -> None:
+        self.vas[(va.namespace, va.name)] = copy.deepcopy(va)
+
+    def inject_fault(self, verb: str, kind: str, exc: Exception, count: int = 0) -> None:
+        def raiser() -> None:
+            raise exc
+
+        self._faults[(verb, kind)] = (raiser, count)
+
+    def _trip(self, verb: str, kind: str) -> None:
+        entry = self._faults.get((verb, kind))
+        if entry is None:
+            return
+        raiser, count = entry
+        if count > 0:
+            if count == 1:
+                del self._faults[(verb, kind)]
+            else:
+                self._faults[(verb, kind)] = (raiser, count - 1)
+        raiser()
+
+    # -- KubeClient ------------------------------------------------------
+
+    def get_configmap(self, name: str, namespace: str) -> ConfigMap:
+        with self._lock:
+            self._trip("get", "ConfigMap")
+            cm = self.configmaps.get((namespace, name))
+            if cm is None:
+                raise NotFoundError(f"configmap {namespace}/{name} not found")
+            return copy.deepcopy(cm)
+
+    def get_deployment(self, name: str, namespace: str) -> Deployment:
+        with self._lock:
+            self._trip("get", "Deployment")
+            d = self.deployments.get((namespace, name))
+            if d is None:
+                raise NotFoundError(f"deployment {namespace}/{name} not found")
+            return copy.deepcopy(d)
+
+    def list_variant_autoscalings(self) -> list[VariantAutoscaling]:
+        with self._lock:
+            self._trip("list", "VariantAutoscaling")
+            return [copy.deepcopy(va) for va in self.vas.values()]
+
+    def get_variant_autoscaling(self, name: str, namespace: str) -> VariantAutoscaling:
+        with self._lock:
+            self._trip("get", "VariantAutoscaling")
+            va = self.vas.get((namespace, name))
+            if va is None:
+                raise NotFoundError(f"variantautoscaling {namespace}/{name} not found")
+            return copy.deepcopy(va)
+
+    def update_variant_autoscaling_status(self, va: VariantAutoscaling) -> None:
+        with self._lock:
+            self._trip("update_status", "VariantAutoscaling")
+            key = (va.namespace, va.name)
+            if key not in self.vas:
+                raise NotFoundError(f"variantautoscaling {key} not found")
+            stored = self.vas[key]
+            stored.status = copy.deepcopy(va.status)
+            stored.metadata.resource_version = str(
+                int(stored.metadata.resource_version or "0") + 1
+            )
+            self.status_update_count += 1
+
+    def patch_owner_reference(self, va: VariantAutoscaling, deploy: Deployment) -> None:
+        with self._lock:
+            self._trip("patch", "VariantAutoscaling")
+            key = (va.namespace, va.name)
+            if key not in self.vas:
+                raise NotFoundError(f"variantautoscaling {key} not found")
+            ref = {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "name": deploy.name,
+                "uid": deploy.uid,
+                "controller": True,
+                "blockOwnerDeletion": False,
+            }
+            stored = self.vas[key]
+            stored.metadata.owner_references = [ref]
+            va.metadata.owner_references = [ref]
+
+    # -- test conveniences ----------------------------------------------
+
+    def delete_deployment(self, name: str, namespace: str) -> None:
+        self.deployments.pop((namespace, name), None)
+        # garbage-collect owned VAs (ownerReference semantics)
+        uid = f"uid-{namespace}-{name}"
+        for key, va in list(self.vas.items()):
+            if va.is_controlled_by(uid):
+                del self.vas[key]
+
+
+class RestKube:
+    """Minimal REST client for a real API server.
+
+    Auth: in-cluster (service account token + CA at the standard paths) or
+    explicit base_url/token/ca. Only the verbs the controller needs.
+    """
+
+    SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        token: Optional[str] = None,
+        ca_cert: Optional[str] = None,
+        verify: bool | str = True,
+        timeout: float = 10.0,
+    ) -> None:
+        import requests
+
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            base_url = f"https://{host}:{port}"
+            token_path = os.path.join(self.SA_DIR, "token")
+            if token is None and os.path.exists(token_path):
+                with open(token_path) as f:
+                    token = f.read().strip()
+            ca_path = os.path.join(self.SA_DIR, "ca.crt")
+            if ca_cert is None and os.path.exists(ca_path):
+                ca_cert = ca_path
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._session = requests.Session()
+        if token:
+            self._session.headers["Authorization"] = f"Bearer {token}"
+        self._session.verify = ca_cert if ca_cert else verify
+
+    def _request(self, method: str, path: str, body: Any = None, content_type: str = "application/json") -> Any:
+        url = f"{self.base_url}{path}"
+        resp = self._session.request(
+            method, url, json=body, timeout=self.timeout,
+            headers={"Content-Type": content_type} if body is not None else None,
+        )
+        if resp.status_code == 404:
+            raise NotFoundError(f"{method} {path}: not found")
+        if resp.status_code == 409:
+            raise ConflictError(f"{method} {path}: conflict")
+        if resp.status_code in (400, 422):
+            raise InvalidError(f"{method} {path}: {resp.text[:200]}")
+        resp.raise_for_status()
+        return resp.json() if resp.content else None
+
+    def get_configmap(self, name: str, namespace: str) -> ConfigMap:
+        obj = self._request("GET", f"/api/v1/namespaces/{namespace}/configmaps/{name}")
+        return ConfigMap(name=name, namespace=namespace, data=obj.get("data", {}))
+
+    def get_deployment(self, name: str, namespace: str) -> Deployment:
+        obj = self._request(
+            "GET", f"/apis/apps/v1/namespaces/{namespace}/deployments/{name}"
+        )
+        return Deployment(
+            name=name,
+            namespace=namespace,
+            spec_replicas=obj.get("spec", {}).get("replicas", 1),
+            status_replicas=obj.get("status", {}).get("replicas", -1),
+            uid=obj.get("metadata", {}).get("uid", ""),
+            labels=obj.get("metadata", {}).get("labels", {}),
+        )
+
+    def list_variant_autoscalings(self) -> list[VariantAutoscaling]:
+        obj = self._request("GET", f"/apis/{GROUP}/{VERSION}/{PLURAL}")
+        return [va_from_dict(item) for item in obj.get("items", [])]
+
+    def get_variant_autoscaling(self, name: str, namespace: str) -> VariantAutoscaling:
+        obj = self._request(
+            "GET", f"/apis/{GROUP}/{VERSION}/namespaces/{namespace}/{PLURAL}/{name}"
+        )
+        return va_from_dict(obj)
+
+    def update_variant_autoscaling_status(self, va: VariantAutoscaling) -> None:
+        self._request(
+            "PUT",
+            f"/apis/{GROUP}/{VERSION}/namespaces/{va.namespace}/{PLURAL}/{va.name}/status",
+            body=va_to_dict(va),
+        )
+
+    def patch_owner_reference(self, va: VariantAutoscaling, deploy: Deployment) -> None:
+        patch = {
+            "metadata": {
+                "ownerReferences": [
+                    {
+                        "apiVersion": "apps/v1",
+                        "kind": "Deployment",
+                        "name": deploy.name,
+                        "uid": deploy.uid,
+                        "controller": True,
+                        "blockOwnerDeletion": False,
+                    }
+                ]
+            }
+        }
+        self._request(
+            "PATCH",
+            f"/apis/{GROUP}/{VERSION}/namespaces/{va.namespace}/{PLURAL}/{va.name}",
+            body=patch,
+            content_type="application/merge-patch+json",
+        )

@@ -1,0 +1,336 @@
+"""The reconcile loop: collect -> analyze -> optimize -> publish.
+
+Equivalent of /root/reference
+internal/controller/variantautoscaling_controller.go:86-407. Each cycle is
+level-triggered and stateless: configuration is re-read from the three
+ConfigMaps, load is re-scraped from Prometheus, the engine system is
+rebuilt from scratch, and all state lands back in the CR status + emitted
+metrics (checkpoint-free recovery, SURVEY.md §5). The analysis step runs
+all (variant, slice) candidates through the batched JAX kernel in one XLA
+call (System.calculate), instead of the reference's per-variant loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..actuator import Actuator
+from ..collector import PromAPI, collect_load, validate_metrics_availability
+from ..metrics import MetricsEmitter
+from ..models import System
+from ..solver import Manager, Optimizer
+from ..utils import (
+    STANDARD_BACKOFF,
+    full_name,
+    get_logger,
+    kv,
+    parse_float_or,
+    with_backoff,
+)
+from . import crd, translate
+from .kube import Deployment, KubeClient
+
+log = get_logger("wva.controller")
+
+# Operator ConfigMap coordinates (reference variantautoscaling_controller.go:74-77)
+CONFIG_MAP_NAME = "workload-variant-autoscaler-variantautoscaling-config"
+CONFIG_MAP_NAMESPACE = "workload-variant-autoscaler-system"
+ACCELERATOR_CM_NAME = "accelerator-unit-costs"
+SERVICE_CLASS_CM_NAME = "service-classes-config"
+
+DEFAULT_INTERVAL_SECONDS = 60.0
+
+
+@dataclass
+class ReconcileResult:
+    requeue_after: float
+    processed: list[str] = field(default_factory=list)
+    skipped: dict[str, str] = field(default_factory=dict)  # name -> reason
+    error: Optional[str] = None
+
+
+class Reconciler:
+    def __init__(
+        self,
+        kube: KubeClient,
+        prom: PromAPI,
+        emitter: Optional[MetricsEmitter] = None,
+        config_namespace: str = CONFIG_MAP_NAMESPACE,
+        now=time.time,
+        sleep=time.sleep,
+    ):
+        self.kube = kube
+        self.prom = prom
+        self.emitter = emitter or MetricsEmitter()
+        self.actuator = Actuator(kube, self.emitter)
+        self.config_namespace = config_namespace
+        self.now = now
+        self.sleep = sleep
+
+    # -- config reading (reference controller.go:490-594) ----------------
+
+    def read_optimization_interval(self) -> float:
+        cm = with_backoff(
+            lambda: self.kube.get_configmap(CONFIG_MAP_NAME, self.config_namespace),
+            backoff=STANDARD_BACKOFF, sleep=self.sleep,
+        )
+        interval = cm.data.get("GLOBAL_OPT_INTERVAL", "")
+        if not interval:
+            return DEFAULT_INTERVAL_SECONDS
+        return translate.parse_duration(interval)
+
+    def read_accelerator_config(self) -> dict[str, dict[str, str]]:
+        cm = with_backoff(
+            lambda: self.kube.get_configmap(ACCELERATOR_CM_NAME, self.config_namespace),
+            backoff=STANDARD_BACKOFF, sleep=self.sleep,
+        )
+        return translate.parse_accelerator_configmap(cm.data)
+
+    def read_service_class_config(self) -> dict[str, str]:
+        cm = with_backoff(
+            lambda: self.kube.get_configmap(SERVICE_CLASS_CM_NAME, self.config_namespace),
+            backoff=STANDARD_BACKOFF, sleep=self.sleep,
+        )
+        return cm.data
+
+    # -- the cycle (reference controller.go:86-202) ----------------------
+
+    def reconcile(self) -> ReconcileResult:
+        interval = self.read_optimization_interval()
+        result = ReconcileResult(requeue_after=interval)
+
+        accelerator_cm = self.read_accelerator_config()
+        service_class_cm = self.read_service_class_config()
+
+        vas = self.kube.list_variant_autoscalings()
+        active = [va for va in vas if va.is_active()]
+        for va in vas:
+            if not va.is_active():
+                result.skipped[full_name(va.name, va.namespace)] = "deleted"
+        if not active:
+            log.info("no active VariantAutoscalings, skipping optimization")
+            return result
+
+        system_spec = translate.create_system_data(accelerator_cm, service_class_cm)
+
+        prepared = self._prepare(active, accelerator_cm, service_class_cm,
+                                 system_spec, result)
+        if not prepared:
+            return result
+
+        # analyze: ONE batched kernel call across all candidates
+        system = System()
+        optimizer_spec = system.set_from_spec(system_spec)
+        system.calculate()
+
+        # optimize
+        try:
+            manager = Manager(system, Optimizer(optimizer_spec))
+            manager.optimize()
+            solution = system.generate_solution()
+            if not solution.allocations:
+                raise RuntimeError("no feasible allocations found for any variant")
+        except Exception as e:  # noqa: BLE001
+            log.error("optimization failed, retrying next cycle", extra=kv(error=str(e)))
+            result.error = str(e)
+            for va, _deploy in prepared:
+                crd.set_condition(
+                    va, crd.TYPE_OPTIMIZATION_READY, "False",
+                    crd.REASON_OPTIMIZATION_FAILED, f"Optimization failed: {e}",
+                    now=self.now(),
+                )
+                self._update_status(va)
+            return result
+
+        # publish (keyed by full name: same-named VAs in different
+        # namespaces must not collide)
+        optimized: dict[str, crd.OptimizedAlloc] = {}
+        for va, _deploy in prepared:
+            try:
+                optimized[full_name(va.name, va.namespace)] = translate.create_optimized_alloc(
+                    va.name, va.namespace, solution, now=self.now()
+                )
+            except KeyError:
+                log.info("no optimized allocation for variant", extra=kv(variant=va.name))
+
+        self._apply(prepared, optimized, result)
+        return result
+
+    # -- preparation (reference controller.go:218-335) -------------------
+
+    def _prepare(self, active, accelerator_cm, service_class_cm, system_spec, result):
+        prepared: list[tuple[crd.VariantAutoscaling, Deployment]] = []
+        for va_listed in active:
+            name = va_listed.name
+            key = full_name(va_listed.name, va_listed.namespace)
+            model = va_listed.spec.model_id
+            if not model:
+                result.skipped[key] = "missing modelID"
+                continue
+
+            try:
+                _target, class_name = translate.find_model_slo_in_spec(system_spec, model)
+            except (KeyError, ValueError) as e:
+                log.error("no SLO for model", extra=kv(variant=name, model=model, error=str(e)))
+                result.skipped[key] = "no SLO for model"
+                continue
+
+            # a malformed profile drops that slice shape only, not the VA
+            # (reference controller.go:243-248)
+            for profile in va_listed.spec.model_profile.accelerators:
+                try:
+                    translate.add_profile_to_system_data(system_spec, model, profile)
+                except ValueError as e:
+                    log.error("bad accelerator profile, dropping candidate",
+                              extra=kv(variant=name, acc=profile.acc, error=str(e)))
+
+            acc_name = va_listed.metadata.labels.get(crd.ACCELERATOR_LABEL, "")
+            cost_str = accelerator_cm.get(acc_name, {}).get("cost")
+            cost = parse_float_or(cost_str, default=float("nan"))
+            if cost != cost:
+                result.skipped[key] = "missing accelerator cost"
+                continue
+
+            try:
+                deploy = with_backoff(
+                    lambda: self.kube.get_deployment(name, va_listed.namespace),
+                    backoff=STANDARD_BACKOFF, sleep=self.sleep,
+                )
+            except Exception as e:  # noqa: BLE001
+                log.error("failed to get Deployment", extra=kv(variant=name, error=str(e)))
+                result.skipped[key] = "deployment not found"
+                continue
+
+            try:
+                va = with_backoff(
+                    lambda: self.kube.get_variant_autoscaling(name, va_listed.namespace),
+                    backoff=STANDARD_BACKOFF, sleep=self.sleep,
+                )
+            except Exception as e:  # noqa: BLE001
+                result.skipped[key] = "variant not found"
+                continue
+
+            # ownerReference first, so GC works even before metrics exist
+            # (reference controller.go:276-293)
+            if not va.is_controlled_by(deploy.uid):
+                try:
+                    self.kube.patch_owner_reference(va, deploy)
+                except Exception as e:  # noqa: BLE001
+                    log.error("failed to set ownerReference", extra=kv(variant=name, error=str(e)))
+                    result.skipped[key] = "ownerReference patch failed"
+                    continue
+
+            validation = validate_metrics_availability(
+                self.prom, model, deploy.namespace, now=self.now()
+            )
+            if validation.available:
+                crd.set_condition(
+                    va, crd.TYPE_METRICS_AVAILABLE, "True",
+                    validation.reason, validation.message, now=self.now(),
+                )
+            else:
+                log.warning(
+                    "metrics unavailable, skipping variant",
+                    extra=kv(variant=name, reason=validation.reason,
+                             troubleshooting=validation.message),
+                )
+                result.skipped[key] = validation.reason
+                continue
+
+            try:
+                load = collect_load(self.prom, model, deploy.namespace)
+            except Exception as e:  # noqa: BLE001
+                log.error("failed to collect metrics", extra=kv(variant=name, error=str(e)))
+                result.skipped[key] = "metric collection failed"
+                continue
+
+            va.status.current_alloc = crd.Allocation(
+                accelerator=acc_name,
+                num_replicas=deploy.current_replicas(),
+                max_batch=self._configured_max_batch(va, acc_name),
+                variant_cost=f"{deploy.current_replicas() * cost:.2f}",
+                itl_average=f"{load.avg_itl_ms:.2f}",
+                ttft_average=f"{load.avg_ttft_ms:.2f}",
+                load=crd.LoadProfile(
+                    arrival_rate=f"{load.arrival_rate_rpm:.2f}",
+                    avg_input_tokens=f"{load.avg_input_tokens:.2f}",
+                    avg_output_tokens=f"{load.avg_output_tokens:.2f}",
+                ),
+            )
+
+            translate.add_server_info_to_system_data(system_spec, va, class_name)
+            prepared.append((va, deploy))
+            result.processed.append(key)
+        return prepared
+
+    @staticmethod
+    def _configured_max_batch(va: crd.VariantAutoscaling, acc_name: str) -> int:
+        """Max batch for status publication: the variant's profile value,
+        defaulting to 256 when unprofiled (the reference hardcodes 256 with
+        a TODO, collector.go:259). Shares the lookup with the engine
+        translation via translate.profile_max_batch."""
+        return translate.profile_max_batch(va, acc_name) or 256
+
+    # -- application (reference controller.go:338-407) -------------------
+
+    def _apply(self, prepared, optimized, result) -> None:
+        for va, _deploy in prepared:
+            key = full_name(va.name, va.namespace)
+            if key not in optimized:
+                continue
+            try:
+                fresh = with_backoff(
+                    lambda: self.kube.get_variant_autoscaling(va.name, va.namespace),
+                    backoff=STANDARD_BACKOFF, sleep=self.sleep,
+                )
+            except Exception as e:  # noqa: BLE001
+                log.error("failed to re-get variant", extra=kv(variant=va.name, error=str(e)))
+                continue
+
+            fresh.status.current_alloc = va.status.current_alloc
+            fresh.status.desired_optimized_alloc = optimized[key]
+            fresh.status.actuation.applied = False
+            # carry conditions set during preparation across the fresh get
+            # (reference controller.go:367-370)
+            fresh.status.conditions = va.status.conditions
+
+            crd.set_condition(
+                fresh, crd.TYPE_OPTIMIZATION_READY, "True",
+                crd.REASON_OPTIMIZATION_SUCCEEDED,
+                f"Optimization completed: {fresh.status.desired_optimized_alloc.num_replicas} "
+                f"replicas on {fresh.status.desired_optimized_alloc.accelerator}",
+                now=self.now(),
+            )
+
+            if self.actuator.emit_metrics(fresh):
+                fresh.status.actuation.applied = True
+
+            self._update_status(fresh)
+
+    def _update_status(self, va: crd.VariantAutoscaling) -> None:
+        try:
+            with_backoff(
+                lambda: self.kube.update_variant_autoscaling_status(va),
+                backoff=STANDARD_BACKOFF, sleep=self.sleep,
+            )
+        except Exception as e:  # noqa: BLE001
+            log.error("failed to update status", extra=kv(variant=va.name, error=str(e)))
+
+    # -- loop -------------------------------------------------------------
+
+    def run_forever(self, stop: Optional[threading.Event] = None) -> None:
+        """RequeueAfter-driven cadence (the reference drops all watch events
+        except Create and paces itself purely by requeue,
+        controller.go:456-487)."""
+        stop = stop or threading.Event()
+        while not stop.is_set():
+            try:
+                result = self.reconcile()
+                delay = result.requeue_after
+            except Exception as e:  # noqa: BLE001
+                log.error("reconcile cycle failed", extra=kv(error=str(e)))
+                delay = DEFAULT_INTERVAL_SECONDS
+            stop.wait(delay)

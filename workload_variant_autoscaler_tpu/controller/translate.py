@@ -1,0 +1,281 @@
+"""ConfigMap/CR -> engine SystemSpec translation.
+
+Equivalent of /root/reference internal/utils/utils.go:108-383 (CreateSystemData,
+AddModelAcceleratorProfileToSystemData, AddServerInfoToSystemData,
+FindModelSLO, CreateOptimizedAlloc), TPU-shaped: accelerator ConfigMap
+entries describe slice shapes ({"chip": "v5e", "chips": "8", "cost": ...})
+and capacity is counted in chips per generation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from dataclasses import dataclass
+
+import yaml
+
+from ..models import (
+    AcceleratorSpec,
+    AllocationData,
+    ModelSliceProfile,
+    ModelTarget,
+    OptimizerSpec,
+    ServerLoadSpec,
+    ServerSpec,
+    ServiceClassSpec,
+    SystemSpec,
+)
+from ..models.spec import AllocationSolution
+from ..utils import full_name, get_logger, kv, parse_float_or
+from . import crd
+
+log = get_logger("wva.translate")
+
+SCALE_TO_ZERO_ENV = "WVA_SCALE_TO_ZERO"
+
+
+@dataclass(frozen=True)
+class ServiceClassEntry:
+    """One model's SLO row in a service-class ConfigMap document
+    (reference internal/interfaces/types.go:19-29)."""
+
+    model: str
+    slo_tpot: float  # msec (ITL target)
+    slo_ttft: float  # msec
+
+
+def parse_duration(s: str) -> float:
+    """Go-style duration ('60s', '2m30s', '1h') -> seconds."""
+    s = s.strip()
+    if not s:
+        raise ValueError("empty duration")
+    units = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 0.001}
+    total = 0.0
+    matched = False
+    for value, unit in re.findall(r"(\d+(?:\.\d+)?)(ms|h|m|s)", s):
+        total += float(value) * units[unit]
+        matched = True
+    if not matched:
+        raise ValueError(f"invalid duration {s!r}")
+    return total
+
+
+def _chips_from_name(name: str) -> int:
+    m = re.search(r"-(\d+)$", name)
+    return int(m.group(1)) if m else 1
+
+
+def parse_accelerator_configmap(data: dict[str, str]) -> dict[str, dict[str, str]]:
+    """accelerator-unit-costs ConfigMap: each entry is a JSON object
+    (reference variantautoscaling_controller.go:499-514). Accepts both the
+    TPU form {"chip": "v5e", "chips": "8", "cost": "160"} and the
+    reference's {"device": ..., "cost": ...}."""
+    out: dict[str, dict[str, str]] = {}
+    for name, raw in data.items():
+        info = json.loads(raw)
+        if not isinstance(info, dict):
+            raise ValueError(f"accelerator entry {name} must be a JSON object")
+        out[name] = {str(k): str(v) for k, v in info.items()}
+    return out
+
+
+def create_system_data(
+    accelerator_cm: dict[str, dict[str, str]],
+    service_class_cm: dict[str, str],
+    capacity: dict[str, int] | None = None,
+    unlimited: bool = True,
+    saturation_policy: str = "None",
+) -> SystemSpec:
+    """Static system data from the two admin ConfigMaps
+    (reference internal/utils/utils.go:108-182)."""
+    accelerators = []
+    for name, info in accelerator_cm.items():
+        cost = parse_float_or(info.get("cost"), default=float("nan"))
+        if cost != cost:  # NaN -> unparseable
+            log.warning("skipping accelerator with bad cost", extra=kv(name=name))
+            continue
+        chip = info.get("chip") or info.get("device") or name.split("-")[0]
+        chips = int(parse_float_or(info.get("chips"), _chips_from_name(name)))
+        accelerators.append(
+            AcceleratorSpec(
+                name=name, chip=chip, chips=max(chips, 1),
+                mem_gb=parse_float_or(info.get("memGB"), 0.0), cost=cost,
+            )
+        )
+
+    service_classes = []
+    for key, raw in service_class_cm.items():
+        try:
+            doc = yaml.safe_load(raw)
+        except yaml.YAMLError as e:
+            log.warning("skipping unparseable service class", extra=kv(key=key, error=str(e)))
+            continue
+        if not isinstance(doc, dict):
+            continue
+        targets = tuple(
+            ModelTarget(
+                model=row.get("model", ""),
+                slo_itl=float(row.get("slo-tpot", 0) or 0),
+                slo_ttft=float(row.get("slo-ttft", 0) or 0),
+            )
+            for row in doc.get("data", []) or []
+        )
+        service_classes.append(
+            ServiceClassSpec(
+                name=doc.get("name", key),
+                priority=int(doc.get("priority", 100) or 100),
+                model_targets=targets,
+            )
+        )
+
+    return SystemSpec(
+        accelerators=accelerators,
+        profiles=[],
+        service_classes=service_classes,
+        servers=[],
+        capacity=dict(capacity or {}),
+        optimizer=OptimizerSpec(
+            unlimited=unlimited, saturation_policy=saturation_policy
+        ),
+    )
+
+
+def find_model_slo_in_spec(
+    spec: SystemSpec, model: str
+) -> tuple[ModelTarget, str]:
+    """Locate the SLO target + class name in already-parsed system data
+    (avoids re-parsing the service-class YAML per variant). Raises KeyError
+    when absent."""
+    for svc in spec.service_classes:
+        for target in svc.model_targets:
+            if target.model == model:
+                return target, svc.name
+    raise KeyError(f"model {model!r} not found in any service class")
+
+
+def profile_max_batch(va: crd.VariantAutoscaling, acc_name: str) -> int:
+    """Max batch from the variant's profile for a slice shape; 0 when the
+    profile is absent (shared by status publication and engine translation
+    so the two can't diverge)."""
+    for ap in va.spec.model_profile.accelerators:
+        if ap.acc == acc_name and ap.max_batch_size > 0:
+            return ap.max_batch_size
+    return 0
+
+
+def find_model_slo(
+    service_class_cm: dict[str, str], model: str
+) -> tuple[ServiceClassEntry, str]:
+    """Locate the SLO row + class name for a model
+    (reference utils.go:369-383). Raises KeyError when absent."""
+    for key, raw in service_class_cm.items():
+        try:
+            doc = yaml.safe_load(raw)
+        except yaml.YAMLError as e:
+            raise ValueError(f"failed to parse service class {key}: {e}") from e
+        if not isinstance(doc, dict):
+            continue
+        for row in doc.get("data", []) or []:
+            if row.get("model") == model:
+                return (
+                    ServiceClassEntry(
+                        model=model,
+                        slo_tpot=float(row.get("slo-tpot", 0) or 0),
+                        slo_ttft=float(row.get("slo-ttft", 0) or 0),
+                    ),
+                    doc.get("name", key),
+                )
+    raise KeyError(f"model {model!r} not found in any service class")
+
+
+def add_profile_to_system_data(
+    spec: SystemSpec, model: str, profile: crd.AcceleratorProfile
+) -> None:
+    """Parse the CR's string-typed alpha/beta/gamma/delta into a
+    ModelSliceProfile (reference utils.go:185-234). Raises ValueError on
+    missing/invalid parameters."""
+    decode = profile.perf_parms.decode_parms
+    prefill = profile.perf_parms.prefill_parms
+    if len(decode) < 2:
+        raise ValueError("decodeParms must contain alpha and beta")
+    if len(prefill) < 2:
+        raise ValueError("prefillParms must contain gamma and delta")
+    try:
+        alpha = float(decode["alpha"])
+        beta = float(decode["beta"])
+        gamma = float(prefill["gamma"])
+        delta = float(prefill["delta"])
+    except (KeyError, ValueError) as e:
+        raise ValueError(f"bad perf parameters: {e}") from e
+
+    spec.profiles.append(
+        ModelSliceProfile(
+            model=model,
+            accelerator=profile.acc,
+            alpha=alpha, beta=beta, gamma=gamma, delta=delta,
+            max_batch_size=profile.max_batch_size,
+            at_tokens=0,
+            slices_per_replica=max(profile.acc_count, 1),
+        )
+    )
+
+
+def scale_to_zero_enabled() -> bool:
+    return os.environ.get(SCALE_TO_ZERO_ENV, "").lower() == "true"
+
+
+def add_server_info_to_system_data(
+    spec: SystemSpec, va: crd.VariantAutoscaling, class_name: str
+) -> None:
+    """CR status -> ServerSpec (reference utils.go:237-311): pinned to its
+    current slice shape, min replicas 1 unless scale-to-zero is enabled,
+    NaN-scrubbed load."""
+    cur = va.status.current_alloc
+    load = ServerLoadSpec(
+        arrival_rate=parse_float_or(cur.load.arrival_rate),
+        avg_in_tokens=int(parse_float_or(cur.load.avg_input_tokens)),
+        avg_out_tokens=int(parse_float_or(cur.load.avg_output_tokens)),
+    )
+    alloc = AllocationData(
+        accelerator=cur.accelerator,
+        num_replicas=cur.num_replicas,
+        max_batch=cur.max_batch,
+        cost=parse_float_or(cur.variant_cost),
+        itl_average=parse_float_or(cur.itl_average),
+        ttft_average=parse_float_or(cur.ttft_average),
+        load=load,
+    )
+
+    acc_name = va.metadata.labels.get(crd.ACCELERATOR_LABEL, "")
+    max_batch = profile_max_batch(va, acc_name)
+
+    spec.servers.append(
+        ServerSpec(
+            name=full_name(va.name, va.namespace),
+            service_class=class_name,
+            model=va.spec.model_id,
+            keep_accelerator=True,
+            min_num_replicas=0 if scale_to_zero_enabled() else 1,
+            max_batch_size=max_batch,
+            current_alloc=alloc,
+        )
+    )
+
+
+def create_optimized_alloc(
+    name: str, namespace: str, solution: AllocationSolution, now: float | None = None
+) -> crd.OptimizedAlloc:
+    """Solver output -> CR desired allocation (reference utils.go:314-331).
+    Raises KeyError when the server is absent from the solution."""
+    key = full_name(name, namespace)
+    if key not in solution.allocations:
+        raise KeyError(f"server {key} not found in solution")
+    data = solution.allocations[key]
+    return crd.OptimizedAlloc(
+        last_run_time=time.time() if now is None else now,
+        accelerator=data.accelerator,
+        num_replicas=data.num_replicas,
+    )

@@ -1,0 +1,113 @@
+"""Emitted Prometheus series — the output API HPA/KEDA consumes.
+
+Equivalent of /root/reference internal/metrics/metrics.go. Series names are
+kept identical to the reference (`inferno_*`) so existing HPA external
+metric rules and KEDA ScaledObjects work unchanged against this controller.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, start_http_server
+
+from ..utils import get_logger, kv
+
+log = get_logger("wva.metrics")
+
+INFERNO_REPLICA_SCALING_TOTAL = "inferno_replica_scaling_total"
+INFERNO_DESIRED_REPLICAS = "inferno_desired_replicas"
+INFERNO_CURRENT_REPLICAS = "inferno_current_replicas"
+INFERNO_DESIRED_RATIO = "inferno_desired_ratio"
+
+LABEL_VARIANT_NAME = "variant_name"
+LABEL_NAMESPACE = "namespace"
+LABEL_DIRECTION = "direction"
+LABEL_REASON = "reason"
+LABEL_ACCELERATOR_TYPE = "accelerator_type"
+
+
+class MetricsEmitter:
+    """Registers and sets the four scaling-signal series
+    (reference metrics.go:20-126). Instance-scoped registry so tests and
+    multiple controllers don't collide."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        self._lock = threading.Lock()
+        self.replica_scaling_total = Counter(
+            INFERNO_REPLICA_SCALING_TOTAL.removesuffix("_total"),
+            "Total number of replica scaling operations",
+            [LABEL_VARIANT_NAME, LABEL_NAMESPACE, LABEL_DIRECTION, LABEL_REASON],
+            registry=self.registry,
+        )
+        self.desired_replicas = Gauge(
+            INFERNO_DESIRED_REPLICAS,
+            "Desired number of replicas for each variant",
+            [LABEL_VARIANT_NAME, LABEL_NAMESPACE, LABEL_ACCELERATOR_TYPE],
+            registry=self.registry,
+        )
+        self.current_replicas = Gauge(
+            INFERNO_CURRENT_REPLICAS,
+            "Current number of replicas for each variant",
+            [LABEL_VARIANT_NAME, LABEL_NAMESPACE, LABEL_ACCELERATOR_TYPE],
+            registry=self.registry,
+        )
+        self.desired_ratio = Gauge(
+            INFERNO_DESIRED_RATIO,
+            "Ratio of desired to current replicas for each variant",
+            [LABEL_VARIANT_NAME, LABEL_NAMESPACE, LABEL_ACCELERATOR_TYPE],
+            registry=self.registry,
+        )
+
+    def emit_replica_metrics(
+        self,
+        variant_name: str,
+        namespace: str,
+        current: int,
+        desired: int,
+        accelerator_type: str,
+    ) -> None:
+        """Set current/desired/ratio. Scale-from-zero encodes 0 -> N as
+        ratio = N (reference metrics.go:118-124)."""
+        labels = {
+            LABEL_VARIANT_NAME: variant_name,
+            LABEL_NAMESPACE: namespace,
+            LABEL_ACCELERATOR_TYPE: accelerator_type,
+        }
+        with self._lock:
+            self.current_replicas.labels(**labels).set(current)
+            self.desired_replicas.labels(**labels).set(desired)
+            if current == 0:
+                self.desired_ratio.labels(**labels).set(desired)
+            else:
+                self.desired_ratio.labels(**labels).set(desired / current)
+
+    def emit_scaling_event(
+        self, variant_name: str, namespace: str, direction: str, reason: str
+    ) -> None:
+        self.replica_scaling_total.labels(
+            **{
+                LABEL_VARIANT_NAME: variant_name,
+                LABEL_NAMESPACE: namespace,
+                LABEL_DIRECTION: direction,
+                LABEL_REASON: reason,
+            }
+        ).inc()
+
+    def value(self, series: str, **labels) -> Optional[float]:
+        """Read back a sample (test/debug helper)."""
+        for metric in self.registry.collect():
+            for sample in metric.samples:
+                if sample.name == series and all(
+                    sample.labels.get(k) == v for k, v in labels.items()
+                ):
+                    return sample.value
+        return None
+
+    def serve(self, port: int, addr: str = "0.0.0.0"):
+        """Expose /metrics for Prometheus to scrape."""
+        server, thread = start_http_server(port, addr=addr, registry=self.registry)
+        log.info("metrics server started", extra=kv(port=port))
+        return server, thread

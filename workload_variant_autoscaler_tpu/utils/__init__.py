@@ -1,0 +1,52 @@
+"""Cross-cutting utilities: logging, backoff, value scrubbing."""
+
+import math
+
+from .backoff import (
+    PROMETHEUS_BACKOFF,
+    RECONCILE_BACKOFF,
+    STANDARD_BACKOFF,
+    Backoff,
+    TerminalError,
+    with_backoff,
+)
+from .logging import get_logger, kv
+
+
+def full_name(name: str, namespace: str) -> str:
+    """Unique server key (reference internal/utils/utils.go:363-365)."""
+    return f"{name}:{namespace}"
+
+
+def check_value(x: float) -> bool:
+    """True when x is a usable number (reference utils.go:368-370)."""
+    return not (math.isnan(x) or math.isinf(x))
+
+
+def fix_value(x: float) -> float:
+    """NaN/Inf scrub to 0 (reference internal/collector/collector.go:281-285)."""
+    return 0.0 if not check_value(x) else x
+
+
+def parse_float_or(s, default: float = 0.0) -> float:
+    try:
+        v = float(s)
+    except (TypeError, ValueError):
+        return default
+    return v if check_value(v) else default
+
+
+__all__ = [
+    "Backoff",
+    "PROMETHEUS_BACKOFF",
+    "RECONCILE_BACKOFF",
+    "STANDARD_BACKOFF",
+    "TerminalError",
+    "check_value",
+    "fix_value",
+    "full_name",
+    "get_logger",
+    "kv",
+    "parse_float_or",
+    "with_backoff",
+]

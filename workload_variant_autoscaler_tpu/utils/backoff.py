@@ -1,0 +1,62 @@
+"""Exponential backoff for control-plane calls.
+
+Equivalent of the reference's wait.Backoff wrappers (/root/reference
+internal/utils/utils.go:31-104): a handful of presets and a retry helper
+that distinguishes terminal from transient errors.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class TerminalError(Exception):
+    """Not worth retrying (e.g. NotFound on a get, Invalid on an update)."""
+
+
+@dataclass(frozen=True)
+class Backoff:
+    duration: float  # initial sleep, seconds
+    factor: float = 2.0
+    jitter: float = 0.0
+    steps: int = 5
+
+
+# Presets (reference utils.go:33-55)
+STANDARD_BACKOFF = Backoff(duration=0.1, factor=2.0, jitter=0.1, steps=5)
+RECONCILE_BACKOFF = Backoff(duration=0.5, factor=2.0, steps=5)
+PROMETHEUS_BACKOFF = Backoff(duration=5.0, factor=2.0, jitter=0.1, steps=6)  # ~5 min
+
+
+def with_backoff(
+    fn: Callable[[], T],
+    backoff: Backoff = STANDARD_BACKOFF,
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Run fn with exponential backoff. TerminalError propagates
+    immediately; other exceptions retry until steps are exhausted, then the
+    last one propagates.
+    """
+    delay = backoff.duration
+    last: Exception | None = None
+    for step in range(backoff.steps):
+        try:
+            return fn()
+        except TerminalError:
+            raise
+        except Exception as e:  # noqa: BLE001 - transient by contract
+            last = e
+            if step == backoff.steps - 1:
+                break
+            d = delay
+            if backoff.jitter > 0:
+                d += delay * backoff.jitter * random.random()
+            sleep(d)
+            delay *= backoff.factor
+    assert last is not None
+    raise last

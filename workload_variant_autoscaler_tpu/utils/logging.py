@@ -1,0 +1,57 @@
+"""Structured JSON logging, level from LOG_LEVEL.
+
+Equivalent of the reference's zap singleton (/root/reference
+internal/logger/logger.go) built on stdlib logging: JSON lines to stdout,
+level parsed from the LOG_LEVEL env var, safe to call from multiple
+threads.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+
+def _level_from_env() -> int:
+    return {
+        "debug": logging.DEBUG,
+        "info": logging.INFO,
+        "warn": logging.WARNING,
+        "warning": logging.WARNING,
+        "error": logging.ERROR,
+    }.get(os.environ.get("LOG_LEVEL", "").lower(), logging.INFO)
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": round(time.time(), 3),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            entry["exc"] = self.formatException(record.exc_info)
+        extra = getattr(record, "kv", None)
+        if extra:
+            entry.update(extra)
+        return json.dumps(entry, default=str)
+
+
+def get_logger(name: str = "wva") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(JsonFormatter())
+        logger.addHandler(handler)
+        logger.setLevel(_level_from_env())
+        logger.propagate = False
+    return logger
+
+
+def kv(**kwargs) -> dict:
+    """Attach structured key/values: log.info("msg", extra=kv(variant=name))."""
+    return {"kv": kwargs}
