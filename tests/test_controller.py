@@ -297,3 +297,21 @@ class TestConfigParsing:
         rec.reconcile()  # sets ownerReference
         kube.delete_deployment(VARIANT, NS)
         assert kube.list_variant_autoscalings() == []
+
+
+class TestMetricsOutageCondition:
+    def test_metrics_false_condition_persisted(self):
+        """A broken scrape must flip MetricsAvailable to False on the CR
+        instead of leaving a stale True."""
+        kube, prom, _e, rec = make_cluster()
+        rec.reconcile()  # healthy cycle -> True
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert crd.is_condition_true(va, crd.TYPE_METRICS_AVAILABLE)
+
+        prom.set_empty(availability_query(MODEL, NS))
+        prom.set_empty(availability_query(MODEL))
+        rec.reconcile()
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert crd.is_condition_false(va, crd.TYPE_METRICS_AVAILABLE)
+        cond = crd.get_condition(va, crd.TYPE_METRICS_AVAILABLE)
+        assert cond.reason == crd.REASON_METRICS_MISSING

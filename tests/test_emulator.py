@@ -1,0 +1,216 @@
+"""Tests for the discrete-event TPU serving emulator."""
+
+import pytest
+
+from workload_variant_autoscaler_tpu.emulator import (
+    Fleet,
+    PoissonLoadGenerator,
+    PrometheusSink,
+    RecordingSink,
+    Replica,
+    Request,
+    SimPromAPI,
+    Simulation,
+    SliceModelConfig,
+    TokenDistribution,
+    rate_at,
+)
+
+CFG = SliceModelConfig(
+    model_name="llama-8b", alpha=7.0, beta=0.03, gamma=5.0, delta=0.1,
+    max_batch_size=4, hbm_gb=16.0, model_size_gb=8.0, kv_mb_per_token=0.5,
+)
+
+
+def drain(replica, now=0.0, max_steps=100000):
+    while replica.busy() and max_steps:
+        now += replica.step(now)
+        max_steps -= 1
+    return now
+
+
+class TestReplica:
+    def test_single_request_latencies(self):
+        sink = RecordingSink()
+        r = Replica(CFG, sink)
+        req = Request(req_id=0, in_tokens=100, out_tokens=8, arrival_ms=0.0)
+        r.enqueue(req, 0.0)
+        drain(r)
+        assert len(sink.finished) == 1
+        # TTFT ~= prefill at batch 1 (quantized to decode iterations)
+        assert sink.ttfts_ms[0] == pytest.approx(CFG.prefill_ms(100, 1), abs=CFG.decode_ms(1))
+        # ITL of a lone request = decode at batch 1
+        assert all(itl == pytest.approx(CFG.decode_ms(1)) for itl in sink.itls_ms)
+        assert req.tokens_out == 8
+
+    def test_batching_slows_tokens(self):
+        sink = RecordingSink()
+        r = Replica(CFG, sink)
+        for i in range(4):
+            r.enqueue(Request(req_id=i, in_tokens=10, out_tokens=16, arrival_ms=0.0), 0.0)
+        assert len(r.running) == 4
+        drain(r)
+        assert max(sink.itls_ms) == pytest.approx(CFG.decode_ms(4))
+
+    def test_max_batch_respected(self):
+        sink = RecordingSink()
+        r = Replica(CFG, sink)
+        for i in range(6):
+            r.enqueue(Request(req_id=i, in_tokens=10, out_tokens=4, arrival_ms=0.0), 0.0)
+        assert len(r.running) == 4
+        assert len(r.waiting) == 2
+        drain(r)
+        assert len(sink.finished) == 6
+
+    def test_kv_memory_gates_admission(self):
+        tight = SliceModelConfig(
+            model_name="m", alpha=7.0, beta=0.03, gamma=5.0, delta=0.1,
+            max_batch_size=8, hbm_gb=16.0, model_size_gb=8.0, kv_mb_per_token=4.0,
+        )  # 0.8*16GB - 8GB = ~4.9GB KV budget -> ~1200 tokens
+        sink = RecordingSink()
+        r = Replica(tight, sink)
+        for i in range(4):
+            r.enqueue(Request(req_id=i, in_tokens=400, out_tokens=4, arrival_ms=0.0), 0.0)
+        assert len(r.running) < 4  # memory, not batch, is the binding limit
+        assert r.waiting
+        drain(r)
+        assert len(sink.finished) == 4  # everyone completes eventually
+
+    def test_queue_fifo_completion(self):
+        sink = RecordingSink()
+        r = Replica(CFG, sink)
+        for i in range(8):
+            r.enqueue(Request(req_id=i, in_tokens=10, out_tokens=2, arrival_ms=0.0), 0.0)
+        drain(r)
+        assert [q.req_id for q in sink.finished[:4]] == [0, 1, 2, 3]
+
+
+class TestFleet:
+    def test_least_loaded_dispatch(self):
+        sink = RecordingSink()
+        fleet = Fleet(CFG, sink, replicas=2)
+        for i in range(4):
+            fleet.dispatch(Request(req_id=i, in_tokens=10, out_tokens=4, arrival_ms=0.0), 0.0)
+        assert [len(r.running) for r in fleet.replicas] == [2, 2]
+
+    def test_scale_up_and_down(self):
+        sink = RecordingSink()
+        fleet = Fleet(CFG, sink, replicas=1)
+        fleet.set_replicas(3, 0.0)
+        assert fleet.size() == 3
+        for i in range(6):
+            fleet.dispatch(Request(req_id=i, in_tokens=10, out_tokens=4, arrival_ms=0.0), 0.0)
+        fleet.set_replicas(1, 0.0)
+        assert fleet.size() == 1
+        # work from retired replicas was re-dispatched, none lost
+        r = fleet.replicas[0]
+        assert len(r.running) + len(r.waiting) == 6
+
+
+class TestSimulationAndLoadgen:
+    def test_poisson_rate(self):
+        sink = RecordingSink()
+        fleet = Fleet(CFG, sink, replicas=4)
+        sim = Simulation(fleet, seed=7)
+        gen = PoissonLoadGenerator(
+            sim, schedule=600.0,  # 10 req/s
+            tokens=TokenDistribution(avg_input_tokens=10, avg_output_tokens=2),
+            seed=7,
+        )
+        gen.start()
+        sim.run_until(30_000.0)
+        assert gen.generated == pytest.approx(300, rel=0.25)
+
+    def test_schedule_segments_and_end(self):
+        assert rate_at(10, [(60, 120), (60, 600)]) == 120
+        assert rate_at(90, [(60, 120), (60, 600)]) == 600
+        assert rate_at(1000, [(60, 120), (60, 600)]) == 0.0
+        assert rate_at(5.0, 42.0) == 42.0
+
+    def test_deterministic_mode(self):
+        sink = RecordingSink()
+        sim = Simulation(Fleet(CFG, sink, replicas=2), seed=1)
+        gen = PoissonLoadGenerator(
+            sim, schedule=[(10, 60)], poisson=False,
+            tokens=TokenDistribution(avg_input_tokens=10, avg_output_tokens=2),
+        )
+        gen.start()
+        sim.run_until(20_000.0)
+        # 1/s for 10s; the segment boundary is inclusive (reference
+        # loadgen.py:10-18), so the arrival scheduled AT t=10s also fires
+        assert gen.generated == 11
+
+
+class TestPrometheusSink:
+    def test_series_names_and_counts(self):
+        sink = PrometheusSink("llama-8b", "default")
+        r = Replica(CFG, sink)
+        for i in range(3):
+            r.enqueue(Request(req_id=i, in_tokens=50, out_tokens=4, arrival_ms=0.0), 0.0)
+        drain(r)
+        c = sink.counters()
+        assert c["vllm:request_success_total"] == 3.0
+        assert c["vllm:request_prompt_tokens_sum"] == 150.0
+        assert c["vllm:request_generation_tokens_sum"] == 12.0
+        assert c["vllm:time_per_output_token_seconds_count"] > 0
+        assert c["vllm:time_to_first_token_seconds_count"] == 3.0
+
+
+class TestSimProm:
+    def test_rates_over_window(self):
+        sink = PrometheusSink("llama-8b", "default")
+        fleet = Fleet(CFG, sink, replicas=4)
+        sim = Simulation(fleet, seed=3)
+        prom = SimPromAPI(sink, "llama-8b", "default")
+        gen = PoissonLoadGenerator(
+            sim, schedule=600.0,
+            tokens=TokenDistribution(avg_input_tokens=20, avg_output_tokens=2),
+            seed=3,
+        )
+        gen.start()
+        sim.run_until(90_000.0, on_tick=prom.scrape, tick_ms=5000.0)
+
+        from workload_variant_autoscaler_tpu.collector import (
+            arrival_rate_query, avg_generation_tokens_query, collect_load,
+            validate_metrics_availability,
+        )
+
+        load = collect_load(prom, "llama-8b", "default")
+        assert load.arrival_rate_rpm == pytest.approx(600.0, rel=0.3)
+        assert load.avg_output_tokens == pytest.approx(2.0, rel=0.05)
+        assert load.avg_itl_ms > 0
+        # availability gate passes against sim timestamps
+        v = validate_metrics_availability(prom, "llama-8b", "default", now=prom.now_s)
+        assert v.available
+
+    def test_unknown_query_empty(self):
+        sink = PrometheusSink("m", "ns")
+        prom = SimPromAPI(sink, "m", "ns")
+        assert prom.query("sum(nonexistent)") == []
+
+
+class TestLoadgenGaps:
+    def test_zero_rpm_gap_pauses_not_kills(self):
+        sink = RecordingSink()
+        sim = Simulation(Fleet(CFG, sink, replicas=4), seed=2)
+        gen = PoissonLoadGenerator(
+            sim, schedule=[(10, 60), (10, 0), (10, 600)], poisson=False,
+            tokens=TokenDistribution(avg_input_tokens=10, avg_output_tokens=2),
+        )
+        gen.start()
+        sim.run_until(40_000.0)
+        # ~11 from the first segment + ~100 from the third; the gap must
+        # not terminate the generator
+        assert gen.generated > 50
+
+
+class TestFleetScaleDownKeepsBusy:
+    def test_retires_emptiest_replica(self):
+        sink = RecordingSink()
+        fleet = Fleet(CFG, sink, replicas=2)
+        for i in range(3):
+            fleet.replicas[0].enqueue(
+                Request(req_id=i, in_tokens=10, out_tokens=4, arrival_ms=0.0), 0.0)
+        fleet.set_replicas(1, 0.0)
+        # the busy replica survived; its requests kept their progress
+        assert len(fleet.replicas[0].running) == 3

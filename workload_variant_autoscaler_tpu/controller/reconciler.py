@@ -237,6 +237,13 @@ class Reconciler:
                     extra=kv(variant=name, reason=validation.reason,
                              troubleshooting=validation.message),
                 )
+                # surface the outage on the CR: a stale MetricsAvailable=True
+                # must not outlive a broken scrape
+                crd.set_condition(
+                    va, crd.TYPE_METRICS_AVAILABLE, "False",
+                    validation.reason, validation.message, now=self.now(),
+                )
+                self._update_status(va)
                 result.skipped[key] = validation.reason
                 continue
 
@@ -311,11 +318,20 @@ class Reconciler:
             self._update_status(fresh)
 
     def _update_status(self, va: crd.VariantAutoscaling) -> None:
+        from .kube import ConflictError
+
+        def attempt() -> None:
+            try:
+                self.kube.update_variant_autoscaling_status(va)
+            except ConflictError:
+                # stale resourceVersion: refresh it and retry with our
+                # intended status (conditions/allocs computed this cycle)
+                fresh = self.kube.get_variant_autoscaling(va.name, va.namespace)
+                va.metadata.resource_version = fresh.metadata.resource_version
+                raise
+
         try:
-            with_backoff(
-                lambda: self.kube.update_variant_autoscaling_status(va),
-                backoff=STANDARD_BACKOFF, sleep=self.sleep,
-            )
+            with_backoff(attempt, backoff=STANDARD_BACKOFF, sleep=self.sleep)
         except Exception as e:  # noqa: BLE001
             log.error("failed to update status", extra=kv(variant=va.name, error=str(e)))
 

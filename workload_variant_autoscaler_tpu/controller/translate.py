@@ -37,16 +37,6 @@ log = get_logger("wva.translate")
 SCALE_TO_ZERO_ENV = "WVA_SCALE_TO_ZERO"
 
 
-@dataclass(frozen=True)
-class ServiceClassEntry:
-    """One model's SLO row in a service-class ConfigMap document
-    (reference internal/interfaces/types.go:19-29)."""
-
-    model: str
-    slo_tpot: float  # msec (ITL target)
-    slo_ttft: float  # msec
-
-
 def parse_duration(s: str) -> float:
     """Go-style duration ('60s', '2m30s', '1h') -> seconds."""
     s = s.strip()
@@ -164,31 +154,6 @@ def profile_max_batch(va: crd.VariantAutoscaling, acc_name: str) -> int:
         if ap.acc == acc_name and ap.max_batch_size > 0:
             return ap.max_batch_size
     return 0
-
-
-def find_model_slo(
-    service_class_cm: dict[str, str], model: str
-) -> tuple[ServiceClassEntry, str]:
-    """Locate the SLO row + class name for a model
-    (reference utils.go:369-383). Raises KeyError when absent."""
-    for key, raw in service_class_cm.items():
-        try:
-            doc = yaml.safe_load(raw)
-        except yaml.YAMLError as e:
-            raise ValueError(f"failed to parse service class {key}: {e}") from e
-        if not isinstance(doc, dict):
-            continue
-        for row in doc.get("data", []) or []:
-            if row.get("model") == model:
-                return (
-                    ServiceClassEntry(
-                        model=model,
-                        slo_tpot=float(row.get("slo-tpot", 0) or 0),
-                        slo_ttft=float(row.get("slo-ttft", 0) or 0),
-                    ),
-                    doc.get("name", key),
-                )
-    raise KeyError(f"model {model!r} not found in any service class")
 
 
 def add_profile_to_system_data(

@@ -1,0 +1,27 @@
+"""Discrete-event TPU serving emulator + loadgen + sim-time Prometheus.
+
+The GPU/TPU-free test backbone: the full collect->analyze->optimize->
+actuate loop runs against this package in simulated time (tests) or in
+real time over HTTP (`python -m workload_variant_autoscaler_tpu.emulator`).
+"""
+
+from .engine import Fleet, MetricsSink, Replica, Request, Simulation, SliceModelConfig
+from .loadgen import PoissonLoadGenerator, TokenDistribution, rate_at, total_duration_s
+from .metrics import PrometheusSink, RecordingSink
+from .simprom import SimPromAPI
+
+__all__ = [
+    "Fleet",
+    "MetricsSink",
+    "PoissonLoadGenerator",
+    "PrometheusSink",
+    "RecordingSink",
+    "Replica",
+    "Request",
+    "SimPromAPI",
+    "Simulation",
+    "SliceModelConfig",
+    "TokenDistribution",
+    "rate_at",
+    "total_duration_s",
+]

@@ -1,0 +1,123 @@
+"""Open-loop load generation with piecewise time-varying rates.
+
+Equivalent of the reference loadgen (/root/reference
+tools/vllm-emulator/loadgen.py): Poisson or deterministic arrivals, with a
+rate schedule of [duration_seconds, requests_per_minute] segments — e.g.
+a ShareGPT-style ramp [[60, 120], [60, 600], [60, 1200]]. Emits into the
+simulation's event heap (sim mode) or over HTTP (real-time mode uses the
+same schedule logic).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Sequence
+
+from .engine import Request, Simulation
+
+RateSchedule = Sequence[tuple[float, float]]  # (duration_s, rpm)
+
+
+def rate_at(elapsed_s: float, schedule: RateSchedule | float) -> float:
+    """Current requests-per-minute at an elapsed time
+    (reference loadgen.py:10-18). 0 after the schedule ends."""
+    if isinstance(schedule, (int, float)):
+        return float(schedule)
+    marker = 0.0
+    for duration, rpm in schedule:
+        if elapsed_s <= marker + duration:
+            return float(rpm)
+        marker += duration
+    return 0.0
+
+
+def next_active_time(elapsed_s: float, schedule: RateSchedule | float) -> float | None:
+    """Start of the next segment with rpm > 0 strictly after elapsed_s, or
+    None when the schedule has no further active segments. Lets a zero-rpm
+    gap pause (not kill) the generator."""
+    if isinstance(schedule, (int, float)):
+        return None
+    marker = 0.0
+    for duration, rpm in schedule:
+        if marker > elapsed_s and rpm > 0:
+            return marker
+        marker += duration
+    return None
+
+
+def total_duration_s(schedule: RateSchedule | float) -> float:
+    if isinstance(schedule, (int, float)):
+        return float("inf")
+    return sum(d for d, _ in schedule)
+
+
+@dataclass
+class TokenDistribution:
+    avg_input_tokens: int = 128
+    avg_output_tokens: int = 128
+    distribution: str = "deterministic"  # or "uniform": U[1, 2*avg]
+
+    def sample(self, rng: random.Random) -> tuple[int, int]:
+        if self.distribution == "uniform":
+            return (
+                max(rng.randint(1, 2 * self.avg_input_tokens), 1),
+                max(rng.randint(1, 2 * self.avg_output_tokens), 1),
+            )
+        return self.avg_input_tokens, self.avg_output_tokens
+
+
+class PoissonLoadGenerator:
+    """Feeds a Simulation with Poisson (or deterministic) arrivals."""
+
+    def __init__(
+        self,
+        sim: Simulation,
+        schedule: RateSchedule | float,
+        tokens: TokenDistribution | None = None,
+        poisson: bool = True,
+        seed: int = 1,
+    ):
+        self.sim = sim
+        self.schedule = schedule
+        self.tokens = tokens or TokenDistribution()
+        self.poisson = poisson
+        self.rng = random.Random(seed)
+        self._ids = itertools.count()
+        self.start_ms = sim.now_ms
+        self.generated = 0
+
+    def _next_interval_ms(self, rpm: float) -> float:
+        mean_ms = 60000.0 / rpm
+        if self.poisson:
+            return self.rng.expovariate(1.0 / mean_ms)
+        return mean_ms
+
+    def start(self) -> None:
+        self._schedule_next()
+
+    def _schedule_next(self) -> None:
+        elapsed_s = (self.sim.now_ms - self.start_ms) / 1000.0
+        rpm = rate_at(elapsed_s, self.schedule)
+        if rpm <= 0:
+            resume_s = next_active_time(elapsed_s, self.schedule)
+            if resume_s is not None:  # idle gap: pause until the next segment
+                # +1ms past the boundary: rate_at treats segment ends as
+                # inclusive, so exactly-at-boundary still reads the gap
+                delay_ms = (resume_s - elapsed_s) * 1000.0 + 1.0
+                self.sim.schedule(delay_ms, "call", lambda _now: self._schedule_next())
+            return  # else: schedule exhausted
+        self.sim.schedule(self._next_interval_ms(rpm), "call", self._fire)
+
+    def _fire(self, now_ms: float) -> None:
+        in_tok, out_tok = self.tokens.sample(self.rng)
+        req = Request(
+            req_id=next(self._ids),
+            in_tokens=in_tok,
+            out_tokens=out_tok,
+            arrival_ms=now_ms,
+        )
+        self.sim.submit(req)
+        self.generated += 1
+        self._schedule_next()

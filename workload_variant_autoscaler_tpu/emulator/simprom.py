@@ -1,0 +1,105 @@
+"""Sim-time Prometheus: answers the collector's queries from emulator
+counters.
+
+Plays the role of a real Prometheus server in the GPU/TPU-free closed loop
+(the reference gets this from an actual in-cluster Prometheus scraping the
+emulator; here the whole loop runs in simulated time). It snapshots the
+emulator's cumulative counters on every scrape tick and evaluates the five
+aggregate queries the collector issues — sum(rate(x[1m])) and
+sum(rate(a))/sum(rate(b)) ratios — over the sim clock.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from collections import deque
+
+from ..collector import (
+    arrival_rate_query,
+    availability_query,
+    avg_generation_tokens_query,
+    avg_itl_query,
+    avg_prompt_tokens_query,
+    avg_ttft_query,
+)
+from ..collector.prometheus import Sample
+from .metrics import PrometheusSink
+
+RATE_WINDOW_S = 60.0
+
+
+class SimPromAPI:
+    """PromAPI over a snapshot history of PrometheusSink counters."""
+
+    def __init__(self, sink: PrometheusSink, model: str, namespace: str):
+        self.sink = sink
+        self.model = model
+        self.namespace = namespace
+        self.history: deque[tuple[float, dict[str, float]]] = deque(maxlen=4096)
+        self.now_s = 0.0
+        self._queries: dict[str, tuple[str, str | None]] = {}
+        self._register_queries()
+
+    def _register_queries(self) -> None:
+        m, ns = self.model, self.namespace
+        self._queries = {
+            arrival_rate_query(m, ns): ("rate", "vllm:request_success_total"),
+            avg_prompt_tokens_query(m, ns): (
+                "ratio", ("vllm:request_prompt_tokens_sum",
+                          "vllm:request_prompt_tokens_count")),
+            avg_generation_tokens_query(m, ns): (
+                "ratio", ("vllm:request_generation_tokens_sum",
+                          "vllm:request_generation_tokens_count")),
+            avg_ttft_query(m, ns): (
+                "ratio", ("vllm:time_to_first_token_seconds_sum",
+                          "vllm:time_to_first_token_seconds_count")),
+            avg_itl_query(m, ns): (
+                "ratio", ("vllm:time_per_output_token_seconds_sum",
+                          "vllm:time_per_output_token_seconds_count")),
+        }
+
+    # -- driven by the simulation ---------------------------------------
+
+    def scrape(self, now_ms: float) -> None:
+        self.now_s = now_ms / 1000.0
+        self.history.append((self.now_s, self.sink.counters()))
+
+    # -- PromAPI ---------------------------------------------------------
+
+    def _rate(self, series: str) -> float:
+        if len(self.history) < 2:
+            return 0.0
+        t_now, latest = self.history[-1]
+        t_start = t_now - RATE_WINDOW_S
+        times = [t for t, _ in self.history]
+        i = max(bisect_left(times, t_start) - 1, 0)
+        t_old, oldest = self.history[i]
+        if t_now <= t_old:
+            return 0.0
+        return max(latest.get(series, 0.0) - oldest.get(series, 0.0), 0.0) / (
+            t_now - t_old
+        )
+
+    def query(self, promql: str) -> list[Sample]:
+        labels = {"model_name": self.model, "namespace": self.namespace}
+        if promql == "up":
+            return [Sample(labels={}, value=1.0, timestamp=self.now_s)]
+        if promql in (
+            availability_query(self.model, self.namespace),
+            availability_query(self.model),
+        ):
+            if not self.history:
+                return []
+            return [Sample(labels=labels,
+                           value=self.history[-1][1].get("vllm:request_success_total", 0.0),
+                           timestamp=self.now_s)]
+        spec = self._queries.get(promql)
+        if spec is None:
+            return []
+        kind, payload = spec
+        if kind == "rate":
+            return [Sample(labels=labels, value=self._rate(payload), timestamp=self.now_s)]
+        num, den = payload
+        den_rate = self._rate(den)
+        value = self._rate(num) / den_rate if den_rate > 0 else 0.0
+        return [Sample(labels=labels, value=value, timestamp=self.now_s)]
