@@ -140,7 +140,7 @@ def build_loop(min_replicas_env=None, monkeypatch=None):
 def run_loop(sim, fleet, prom, kube, rec, until_ms, reconcile_every_ms=30_000.0,
              desired_history=None):
     """Advance sim; scrape every 5s; reconcile + emulate HPA actuation."""
-    next_reconcile = reconcile_every_ms
+    next_reconcile = sim.now_ms + reconcile_every_ms
 
     def on_tick(now_ms):
         nonlocal next_reconcile
@@ -201,8 +201,8 @@ class TestClosedLoop:
         mean_ttft = sum(ttfts) / len(ttfts)
         assert mean_ttft < SLO_TTFT_MS, f"mean TTFT {mean_ttft:.0f}ms violates SLO"
 
-        # zero-load tail: rates decay, next cycles scale back toward min
-        gen2 = PoissonLoadGenerator(sim, schedule=[(1, 1)], seed=5)  # nothing
+        # zero-load tail (no generator): rates decay, next cycles scale
+        # back toward min
         run_loop(sim, fleet, prom, kube, rec, until_ms=480_000.0,
                  desired_history=history)
         final = history[-1][1]

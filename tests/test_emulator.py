@@ -105,6 +105,25 @@ class TestFleet:
         # work from retired replicas was re-dispatched, none lost
         r = fleet.replicas[0]
         assert len(r.running) + len(r.waiting) == 6
+        # re-dispatch must not re-fire the arrival hook
+        assert sink.arrivals == 6
+
+    def test_gauges_aggregate_across_replicas(self):
+        class GaugeSink(RecordingSink):
+            def __init__(self):
+                super().__init__()
+                self.running = self.waiting = 0
+
+            def set_queue_sizes(self, running, waiting):
+                self.running, self.waiting = running, waiting
+
+        sink = GaugeSink()
+        fleet = Fleet(CFG, sink, replicas=4)
+        for i in range(8):
+            fleet.dispatch(Request(req_id=i, in_tokens=10, out_tokens=4, arrival_ms=0.0), 0.0)
+        # each replica runs 2; gauges must report the fleet total, not the
+        # last-stepped replica's own count
+        assert sink.running == 8
 
 
 class TestSimulationAndLoadgen:

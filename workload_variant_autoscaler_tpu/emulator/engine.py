@@ -113,8 +113,9 @@ class Replica:
 
     # -- queue management ------------------------------------------------
 
-    def enqueue(self, req: Request, now_ms: float) -> None:
-        self.sink.on_arrival(req)
+    def enqueue(self, req: Request, now_ms: float, *, fresh: bool = True) -> None:
+        if fresh:
+            self.sink.on_arrival(req)
         if not self.waiting and self._fits(req):
             self._admit(req, now_ms)
         else:
@@ -196,6 +197,40 @@ class MetricsSink:
     def set_kv_usage(self, frac: float) -> None: ...
 
 
+class _FleetSink(MetricsSink):
+    """Per-replica sink wrapper: forwards event hooks unchanged but
+    republishes the queue/KV gauges as fleet-wide totals (a lone replica
+    would otherwise overwrite them with just its own counts)."""
+
+    def __init__(self, fleet: "Fleet"):
+        self._fleet = fleet
+
+    def on_arrival(self, req: Request) -> None:
+        self._fleet.sink.on_arrival(req)
+
+    def on_first_token(self, req: Request) -> None:
+        self._fleet.sink.on_first_token(req)
+
+    def on_token(self, dt_ms: float) -> None:
+        self._fleet.sink.on_token(dt_ms)
+
+    def on_finish(self, req: Request) -> None:
+        self._fleet.sink.on_finish(req)
+
+    def set_queue_sizes(self, running: int, waiting: int) -> None:
+        f = self._fleet
+        f.sink.set_queue_sizes(
+            sum(len(r.running) for r in f.replicas),
+            sum(len(r.waiting) for r in f.replicas),
+        )
+
+    def set_kv_usage(self, frac: float) -> None:
+        f = self._fleet
+        budget = len(f.replicas) * f.config.kv_budget_mb
+        used = sum(r.kv_used_mb() for r in f.replicas)
+        f.sink.set_kv_usage(used / budget if budget > 0 else 0.0)
+
+
 class Fleet:
     """N replicas behind least-loaded dispatch, resizable at runtime (the
     autoscaler's actuation surface in closed-loop tests)."""
@@ -203,7 +238,10 @@ class Fleet:
     def __init__(self, config: SliceModelConfig, sink: MetricsSink, replicas: int = 1):
         self.config = config
         self.sink = sink
-        self.replicas: list[Replica] = [Replica(config, sink) for _ in range(replicas)]
+        self._replica_sink = _FleetSink(self)
+        self.replicas: list[Replica] = [
+            Replica(config, self._replica_sink) for _ in range(replicas)
+        ]
 
     def size(self) -> int:
         return len(self.replicas)
@@ -212,7 +250,7 @@ class Fleet:
         n = max(n, 0)
         if n > len(self.replicas):
             while len(self.replicas) < n:
-                self.replicas.append(Replica(self.config, self.sink))
+                self.replicas.append(Replica(self.config, self._replica_sink))
             self._rebalance_waiting(now_ms)
         if n < len(self.replicas):
             # keep the busiest replicas; retire the emptiest and
@@ -225,7 +263,7 @@ class Fleet:
             for r in retire:
                 for req in r.running + r.waiting:
                     if self.replicas:
-                        self.dispatch(req, now_ms)
+                        self.dispatch(req, now_ms, fresh=False)
 
     def _rebalance_waiting(self, now_ms: float) -> None:
         """Spread not-yet-admitted (waiting) requests across all replicas.
@@ -237,13 +275,13 @@ class Fleet:
             r.waiting = []
         backlog.sort(key=lambda q: q.arrival_ms)
         for req in backlog:
-            self.dispatch(req, now_ms)
+            self.dispatch(req, now_ms, fresh=False)
 
-    def dispatch(self, req: Request, now_ms: float) -> None:
+    def dispatch(self, req: Request, now_ms: float, *, fresh: bool = True) -> None:
         if not self.replicas:
             return  # scaled to zero: drop (no serving capacity)
         target = min(self.replicas, key=lambda r: len(r.running) + len(r.waiting))
-        target.enqueue(req, now_ms)
+        target.enqueue(req, now_ms, fresh=fresh)
 
 
 @dataclass(order=True)

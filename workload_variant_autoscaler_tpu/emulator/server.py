@@ -108,7 +108,14 @@ def build_app(config: SliceModelConfig | None = None, with_prom_api: bool = Fals
         except Exception:  # noqa: BLE001 - malformed body is a client error
             return web.json_response({"error": "invalid JSON body"}, status=400)
         messages = body.get("messages", [])
+        if not isinstance(messages, list) or any(
+            not isinstance(m, dict) for m in messages
+        ):
+            return web.json_response(
+                {"error": "messages must be a list of objects"}, status=400)
         content = messages[-1].get("content", "") if messages else ""
+        if not isinstance(content, str):
+            content = str(content)
         req = await emulator.handle_request(in_tokens=max(len(content), 1))
         return web.json_response({
             "id": str(req.req_id),
