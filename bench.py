@@ -1,0 +1,119 @@
+"""Benchmark: batched TPU candidate-sizing throughput.
+
+The autoscaler's hot path is SLO-sizing every (variant, slice-shape)
+candidate each reconcile cycle. The reference runs this as a sequential
+per-candidate scalar loop (Go: pkg/core/server.go:55-67 calling
+pkg/analyzer per candidate, each a ~100-iteration binary search over an
+O(K) queue solve). Our TPU-native design solves all B candidates in ONE
+fused XLA computation (ops/batched.py): a [2B, K+1] log-space
+state-dependent M/M/1 solve inside a fixed-trip vectorised bisection.
+
+Metric: candidate sizings per second on the TPU, batch B=256.
+Baseline: the same 256 sizings through the scalar numpy kernel (the
+reference-architecture equivalent) on the host CPU. vs_baseline is the
+TPU/scalar speedup (>1 is better).
+
+Prints ONE JSON line. Runs with the ambient env (real TPU chip via axon).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def build_candidates(b: int, seed: int = 0):
+    """B plausible (model x slice) perf profiles around the Llama-3.1-8B
+    fit (BASELINE.md: alpha=6.973, beta=0.027, gamma=5.2, delta=0.1)."""
+    rng = np.random.default_rng(seed)
+    return {
+        "alpha": rng.uniform(4.0, 8.0, b),
+        "beta": rng.uniform(0.01, 0.05, b),
+        "gamma": rng.uniform(2.0, 6.0, b),
+        "delta": rng.uniform(0.05, 0.15, b),
+        "in_tokens": np.full(b, 128.0),
+        "out_tokens": np.full(b, 128.0),
+        "max_batch": np.full(b, 64, dtype=np.int64),
+        "ttft": np.full(b, 500.0),
+        "itl": np.full(b, 24.0),
+    }
+
+
+def bench_tpu(c, iters: int = 20) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from workload_variant_autoscaler_tpu.ops.batched import (
+        SLOTargets,
+        k_max_for,
+        make_queue_batch,
+        size_batch,
+    )
+
+    q = make_queue_batch(
+        c["alpha"], c["beta"], c["gamma"], c["delta"],
+        c["in_tokens"], c["out_tokens"], c["max_batch"],
+    )
+    k_max = k_max_for(c["max_batch"])
+    dtype = q.alpha.dtype
+    targets = SLOTargets(
+        ttft=jnp.asarray(c["ttft"], dtype),
+        itl=jnp.asarray(c["itl"], dtype),
+        tps=jnp.zeros(len(c["alpha"]), dtype),
+    )
+    # warmup/compile
+    jax.block_until_ready(size_batch(q, targets, k_max))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = size_batch(q, targets, k_max)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return len(c["alpha"]) * iters / dt
+
+
+def bench_scalar(c) -> float:
+    """Reference-architecture equivalent: one sequential sizing per
+    candidate through the scalar kernel."""
+    from workload_variant_autoscaler_tpu.ops.analyzer import (
+        QueueAnalyzer,
+        QueueConfig,
+        RequestSize,
+        ServiceParms,
+        TargetPerf,
+    )
+
+    b = len(c["alpha"])
+    t0 = time.perf_counter()
+    for i in range(b):
+        qa = QueueAnalyzer(
+            QueueConfig(
+                max_batch_size=int(c["max_batch"][i]),
+                max_queue_size=int(c["max_batch"][i]) * 10,
+                parms=ServiceParms(
+                    alpha=float(c["alpha"][i]), beta=float(c["beta"][i]),
+                    gamma=float(c["gamma"][i]), delta=float(c["delta"][i]),
+                ),
+            ),
+            RequestSize(avg_input_tokens=int(c["in_tokens"][i]),
+                        avg_output_tokens=int(c["out_tokens"][i])),
+        )
+        qa.size(TargetPerf(ttft=float(c["ttft"][i]), itl=float(c["itl"][i])))
+    return b / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    candidates = build_candidates(256)
+    tpu_rate = bench_tpu(candidates)
+    scalar_rate = bench_scalar(candidates)
+    print(json.dumps({
+        "metric": "candidate_sizings_per_sec",
+        "value": round(tpu_rate, 1),
+        "unit": "candidates/s",
+        "vs_baseline": round(tpu_rate / scalar_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
