@@ -1,0 +1,83 @@
+"""Mesh-sharded candidate analysis agrees with the single-device path
+(runs on the 8-virtual-device CPU mesh from conftest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workload_variant_autoscaler_tpu.ops.batched import (
+    SLOTargets,
+    k_max_for,
+    make_queue_batch,
+    size_batch,
+)
+from workload_variant_autoscaler_tpu.parallel import (
+    candidate_mesh,
+    pad_to_multiple,
+    size_batch_sharded,
+)
+
+from helpers import make_system, server_spec
+
+
+def _random_batch(b, seed=0):
+    rng = np.random.default_rng(seed)
+    q = make_queue_batch(
+        rng.uniform(4.0, 8.0, b), rng.uniform(0.01, 0.05, b),
+        rng.uniform(2.0, 6.0, b), rng.uniform(0.05, 0.15, b),
+        np.full(b, 128.0), np.full(b, 128.0), np.full(b, 16, dtype=np.int64),
+    )
+    d = q.alpha.dtype
+    t = SLOTargets(ttft=jnp.full(b, 500.0, d), itl=jnp.full(b, 24.0, d),
+                   tps=jnp.zeros(b, d))
+    return q, t, k_max_for(np.full(b, 16))
+
+
+class TestMesh:
+    def test_mesh_spans_devices(self):
+        mesh = candidate_mesh()
+        assert mesh.devices.size == 8
+
+    def test_pad_to_multiple(self):
+        q, t, _ = _random_batch(5)
+        qp, tp, b = pad_to_multiple(q, t, 8)
+        assert b == 5 and qp.batch_size == 8
+        assert not bool(qp.valid[-1]) and bool(qp.valid[0])
+        # already-aligned batches pass through untouched
+        q8, t8, b8 = pad_to_multiple(qp, tp, 8)
+        assert q8 is qp and b8 == 8
+
+    @pytest.mark.parametrize("b", [8, 11])
+    def test_sharded_matches_single_device(self, b):
+        q, t, k_max = _random_batch(b)
+        mesh = candidate_mesh()
+        sharded = size_batch_sharded(q, t, k_max, mesh)
+        local = size_batch(q, t, k_max)
+        for name in ("lam_star", "lam_ttft", "lam_itl", "throughput", "rho"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(sharded, name)),
+                np.asarray(getattr(local, name)),
+                rtol=1e-12,
+            )
+        np.testing.assert_array_equal(
+            np.asarray(sharded.feasible), np.asarray(local.feasible)
+        )
+        assert sharded.lam_star.shape == (b,)
+
+
+class TestSystemWithMesh:
+    def test_calculate_on_mesh_matches_default(self):
+        specs = [server_spec(name=f"s{i}") for i in range(3)]
+        sys_mesh, _ = make_system(specs)
+        sys_local, _ = make_system(specs)
+        sys_mesh.calculate(mesh=candidate_mesh())
+        sys_local.calculate()
+        for name in sys_local.servers:
+            a = sys_local.servers[name].all_allocations
+            b = sys_mesh.servers[name].all_allocations
+            assert a.keys() == b.keys()
+            for acc in a:
+                assert a[acc].num_replicas == b[acc].num_replicas
+                assert a[acc].cost == pytest.approx(b[acc].cost)
+                assert a[acc].itl == pytest.approx(b[acc].itl, rel=1e-9)

@@ -111,13 +111,15 @@ class System:
 
     # -- candidate analysis --------------------------------------------
 
-    def calculate(self, backend: str = "batched") -> None:
+    def calculate(self, backend: str = "batched", mesh=None) -> None:
         """Compute candidate allocations for every server.
 
         backend="batched": gather all (server, slice) candidates and solve
         them in one `ops.batched.size_batch` + one `analyze_batch` call.
         backend="scalar": per-candidate numpy path (exact reference
         semantics; used for cross-checking).
+        mesh: optional 1-D jax.sharding.Mesh; shards the candidate batch
+        across its devices (parallel.size_batch_sharded) for large fleets.
         """
         for acc in self.accelerators.values():
             acc.calculate()
@@ -125,7 +127,7 @@ class System:
             for server in self.servers.values():
                 server.calculate(self)
             return
-        self._calculate_batched()
+        self._calculate_batched(mesh=mesh)
 
     def _candidate_pairs(self):
         """Feasible (server, acc) candidates with resolved profile/target;
@@ -163,7 +165,7 @@ class System:
             alloc.value = server.cur_allocation.transition_penalty(alloc)
         server.all_allocations[acc_name] = alloc
 
-    def _calculate_batched(self) -> None:
+    def _calculate_batched(self, mesh=None) -> None:
         import jax.numpy as jnp
 
         from ..ops.batched import (
@@ -196,15 +198,17 @@ class System:
         q = make_queue_batch(alphas, betas, gammas, deltas, in_toks, out_toks, n_eff)
         k_max = k_max_for(n_eff)
         dtype = q.alpha.dtype
-        sized = size_batch(
-            q,
-            SLOTargets(
-                ttft=jnp.asarray(ttfts, dtype),
-                itl=jnp.asarray(itls, dtype),
-                tps=jnp.asarray(tpss, dtype),
-            ),
-            k_max,
+        slo = SLOTargets(
+            ttft=jnp.asarray(ttfts, dtype),
+            itl=jnp.asarray(itls, dtype),
+            tps=jnp.asarray(tpss, dtype),
         )
+        if mesh is not None:
+            from ..parallel import size_batch_sharded
+
+            sized = size_batch_sharded(q, slo, k_max, mesh)
+        else:
+            sized = size_batch(q, slo, k_max)
         feasible = np.asarray(sized.feasible)
         rate_star = np.asarray(sized.throughput) * 1000.0  # req/sec per replica
 

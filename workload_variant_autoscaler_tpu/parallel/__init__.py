@@ -1,0 +1,25 @@
+"""Device-mesh parallelism for fleet-scale candidate analysis.
+
+The reference analyzes candidates sequentially on one CPU core
+(/root/reference pkg/core/server.go:55-67); our batched kernel already
+fuses them into one XLA call. This package adds the multi-chip axis: the
+candidate batch is sharded over a 1-D `jax.sharding.Mesh` so a fleet of
+thousands of (variant, slice-shape) candidates sizes in parallel across
+chips, with XLA inserting any collectives (there are none on the forward
+path — candidates are embarrassingly parallel, so scaling is linear and
+rides ICI only for result gathering).
+"""
+
+from .mesh import (
+    candidate_mesh,
+    pad_to_multiple,
+    shard_batch,
+    size_batch_sharded,
+)
+
+__all__ = [
+    "candidate_mesh",
+    "pad_to_multiple",
+    "shard_batch",
+    "size_batch_sharded",
+]

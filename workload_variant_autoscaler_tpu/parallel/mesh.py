@@ -1,0 +1,86 @@
+"""Candidate-axis sharding helpers.
+
+One mesh axis ("candidates") is enough: each (variant, slice-shape)
+candidate's queue solve is independent, so data parallelism over the
+batch dimension is the whole story. Lane padding reuses QueueBatch.valid,
+so padded lanes are benign (batch=1 queues marked invalid) and excluded
+from feasibility downstream.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.batched import QueueBatch, SizingResult, SLOTargets, size_batch
+
+AXIS = "candidates"
+
+
+def candidate_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D mesh over the first n (default: all) local devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+def pad_to_multiple(q: QueueBatch, targets: SLOTargets, m: int):
+    """Pad the candidate batch to a multiple of m with invalid benign lanes
+    (alpha=1, max_batch=1, valid=False). Returns (q, targets, original_b)."""
+    b = q.batch_size
+    pad = (-b) % m
+    if pad == 0:
+        return q, targets, b
+
+    def pad_with(a, fill):
+        return jnp.concatenate([a, jnp.full((pad,), fill, a.dtype)])
+
+    q = QueueBatch(
+        alpha=pad_with(q.alpha, 1.0),
+        beta=pad_with(q.beta, 0.0),
+        gamma=pad_with(q.gamma, 0.0),
+        delta=pad_with(q.delta, 0.0),
+        in_tokens=pad_with(q.in_tokens, 0.0),
+        out_tokens=pad_with(q.out_tokens, 2.0),
+        max_batch=pad_with(q.max_batch, 1),
+        occupancy=pad_with(q.occupancy, 1),
+        valid=pad_with(q.valid, False),
+    )
+    targets = SLOTargets(
+        ttft=pad_with(targets.ttft, 0.0),
+        itl=pad_with(targets.itl, 0.0),
+        tps=pad_with(targets.tps, 0.0),
+    )
+    return q, targets, b
+
+
+def shard_batch(tree, mesh: Mesh):
+    """Place every leaf with its leading axis split over the mesh."""
+    sharding = NamedSharding(mesh, P(AXIS))
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
+
+
+def size_batch_sharded(
+    q: QueueBatch, targets: SLOTargets, k_max: int, mesh: Mesh
+) -> SizingResult:
+    """size_batch with the candidate axis sharded over `mesh`.
+
+    Pads to a multiple of the mesh size, shards inputs, runs the fused
+    kernel with sharded outputs, and slices the padding back off. Padded
+    lanes come back feasible=False via the valid mask.
+    """
+    n = mesh.devices.size
+    q, targets, b = pad_to_multiple(q, targets, n)
+    q = shard_batch(q, mesh)
+    targets = shard_batch(targets, mesh)
+    sized = jax.jit(
+        partial(size_batch, k_max=k_max),
+        out_shardings=NamedSharding(mesh, P(AXIS)),
+    )(q, targets)
+    return jax.tree.map(lambda a: a[:b], sized)
