@@ -1,0 +1,286 @@
+"""Process runtime: leader election, health probes, TLS metrics serving.
+
+Covers the manager plumbing parity with the reference entry point
+(cmd/main.go:62-279): Lease acquisition/renewal/takeover/failover,
+/healthz + /readyz gating, and HTTPS metrics with a self-signed cert.
+"""
+
+import threading
+import urllib.request
+
+import pytest
+
+from workload_variant_autoscaler_tpu.controller.kube import (
+    ConflictError,
+    InMemoryKube,
+)
+from workload_variant_autoscaler_tpu.controller.runtime import (
+    HealthServer,
+    LeaderElector,
+    Lease,
+)
+from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class TestLeaderElection:
+    def test_acquires_by_creating_lease(self):
+        kube = InMemoryKube()
+        clock = FakeClock()
+        e = LeaderElector(kube, identity="a", now=clock)
+        assert e.try_acquire_or_renew()
+        assert e.is_leader
+        lease = kube.get_lease(e.lease_name, e.lease_namespace)
+        assert lease.holder == "a"
+        assert lease.acquire_time == clock.t
+
+    def test_second_candidate_blocked_while_lease_fresh(self):
+        kube = InMemoryKube()
+        clock = FakeClock()
+        a = LeaderElector(kube, identity="a", now=clock)
+        b = LeaderElector(kube, identity="b", now=clock)
+        assert a.try_acquire_or_renew()
+        clock.advance(5.0)  # < lease duration 15s
+        assert not b.try_acquire_or_renew()
+        assert not b.is_leader
+
+    def test_renewal_keeps_holder_and_advances_renew_time(self):
+        kube = InMemoryKube()
+        clock = FakeClock()
+        a = LeaderElector(kube, identity="a", now=clock)
+        a.try_acquire_or_renew()
+        clock.advance(10.0)
+        assert a.try_acquire_or_renew()
+        lease = kube.get_lease(a.lease_name, a.lease_namespace)
+        assert lease.holder == "a"
+        assert lease.renew_time == clock.t
+        assert lease.transitions == 0
+
+    def test_takeover_after_expiry_bumps_transitions(self):
+        kube = InMemoryKube()
+        clock = FakeClock()
+        a = LeaderElector(kube, identity="a", now=clock)
+        b = LeaderElector(kube, identity="b", now=clock)
+        a.try_acquire_or_renew()
+        clock.advance(20.0)  # > 15s lease duration: a is dead
+        assert b.try_acquire_or_renew()
+        lease = kube.get_lease(b.lease_name, b.lease_namespace)
+        assert lease.holder == "b"
+        assert lease.transitions == 1
+
+    def test_renew_deadline_must_undercut_lease_duration(self):
+        with pytest.raises(ValueError):
+            LeaderElector(InMemoryKube(), identity="a",
+                          lease_duration=15.0, renew_deadline=20.0)
+
+    def test_concurrent_create_race_loses_cleanly(self):
+        kube = InMemoryKube()
+        clock = FakeClock()
+        kube.inject_fault("create", "Lease", ConflictError("already exists"), count=1)
+        e = LeaderElector(kube, identity="a", now=clock)
+        assert not e.try_acquire_or_renew()
+        assert not e.is_leader
+
+    def test_release_frees_lease_for_next_candidate(self):
+        kube = InMemoryKube()
+        clock = FakeClock()
+        a = LeaderElector(kube, identity="a", now=clock)
+        b = LeaderElector(kube, identity="b", now=clock)
+        a.try_acquire_or_renew()
+        a.release()
+        clock.advance(1.0)  # well within original lease duration
+        assert b.try_acquire_or_renew()
+
+    def test_run_calls_back_then_returns_on_lost_lease(self):
+        kube = InMemoryKube()
+        clock = FakeClock()
+        a = LeaderElector(kube, identity="a", now=clock,
+                          renew_deadline=10.0, retry_period=2.0)
+        started = []
+        stop = threading.Event()
+
+        def sleep(dt):
+            clock.advance(dt)
+            # after leading starts, make every renewal fail
+            if started:
+                kube.inject_fault("update", "Lease", ConflictError("stale"))
+
+        a.run(stop, on_started_leading=lambda: started.append(True), sleep=sleep)
+        assert started == [True]
+        assert not a.is_leader
+
+    def test_run_respects_stop_before_acquisition(self):
+        kube = InMemoryKube()
+        clock = FakeClock()
+        # lease held by someone else forever
+        other = LeaderElector(kube, identity="other", now=clock)
+        other.try_acquire_or_renew()
+        a = LeaderElector(kube, identity="a", now=clock)
+        stop = threading.Event()
+        calls = []
+
+        def sleep(dt):
+            clock.advance(0.1)  # lease stays fresh
+            calls.append(dt)
+            if len(calls) >= 3:
+                stop.set()
+
+        a.run(stop, on_started_leading=lambda: calls.append("led"), sleep=sleep)
+        assert "led" not in calls
+
+    def test_failover_two_electors(self):
+        """a leads, dies (stops renewing); b takes over after expiry."""
+        kube = InMemoryKube()
+        clock = FakeClock()
+        a = LeaderElector(kube, identity="a", now=clock)
+        b = LeaderElector(kube, identity="b", now=clock)
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+        clock.advance(16.0)
+        assert b.try_acquire_or_renew()
+        # a comes back: its lease is gone, it must defer to b
+        assert not a.try_acquire_or_renew()
+        assert not a.is_leader
+
+
+class TestLeaseStore:
+    def test_update_with_stale_resource_version_conflicts(self):
+        kube = InMemoryKube()
+        lease = Lease(name="l", namespace="ns", holder="a",
+                      acquire_time=1.0, renew_time=1.0)
+        kube.create_lease(lease)
+        stale = kube.get_lease("l", "ns")
+        fresh = kube.get_lease("l", "ns")
+        fresh.renew_time = 2.0
+        kube.update_lease(fresh)
+        stale.renew_time = 3.0
+        with pytest.raises(ConflictError):
+            kube.update_lease(stale)
+
+    def test_rest_micro_time_roundtrip_and_whole_seconds(self):
+        from workload_variant_autoscaler_tpu.controller.kube import RestKube
+
+        t = 1753788600.123456
+        s = RestKube._micro_time(t)
+        assert abs(RestKube._from_micro_time(s) - t) < 1e-6
+        # other clients (kubectl-applied leases) omit the fractional part
+        assert RestKube._from_micro_time("2026-07-29T00:00:00Z") > 0
+        assert RestKube._micro_time(0.0) is None
+        assert RestKube._from_micro_time(None) == 0.0
+
+
+class TestHealthServer:
+    def _get(self, port: int, path: str):
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def test_healthz_readyz_and_gating(self):
+        ready = threading.Event()
+        hs = HealthServer(0, addr="127.0.0.1", ready_check=ready.is_set).start()
+        try:
+            assert self._get(hs.port, "/healthz") == (200, b"ok")
+            code, _ = self._get(hs.port, "/readyz")
+            assert code == 503
+            ready.set()
+            assert self._get(hs.port, "/readyz") == (200, b"ok")
+            code, _ = self._get(hs.port, "/nope")
+            assert code == 404
+        finally:
+            hs.stop()
+
+
+class TestMetricsTLS:
+    @pytest.fixture
+    def certpair(self, tmp_path):
+        """Self-signed localhost cert via the cryptography package."""
+        import datetime
+
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+
+        key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=1))
+            .not_valid_after(now + datetime.timedelta(hours=1))
+            .add_extension(
+                x509.SubjectAlternativeName([x509.DNSName("localhost")]),
+                critical=False,
+            )
+            .sign(key, hashes.SHA256())
+        )
+        certfile = tmp_path / "tls.crt"
+        keyfile = tmp_path / "tls.key"
+        certfile.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+        keyfile.write_bytes(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            )
+        )
+        return str(certfile), str(keyfile)
+
+    def test_serves_https_when_cert_given(self, certpair):
+        import ssl
+
+        certfile, keyfile = certpair
+        emitter = MetricsEmitter()
+        emitter.emit_replica_metrics("v", "ns", current=1, desired=3,
+                                     accelerator_type="v5e-8")
+        server, _thread = emitter.serve(0, addr="127.0.0.1",
+                                        certfile=certfile, keyfile=keyfile)
+        try:
+            port = server.server_address[1]
+            ctx = ssl.create_default_context(cafile=certfile)
+            ctx.check_hostname = False
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{port}/metrics", timeout=5, context=ctx
+            ) as r:
+                body = r.read().decode()
+            assert "inferno_desired_replicas" in body
+            assert 'variant_name="v"' in body
+        finally:
+            server.shutdown()
+
+    def test_cert_without_key_rejected(self):
+        with pytest.raises(ValueError):
+            MetricsEmitter().serve(0, certfile="/tmp/x.crt")
+
+    def test_client_ca_without_cert_rejected(self):
+        with pytest.raises(ValueError):
+            MetricsEmitter().serve(0, client_cafile="/tmp/ca.crt")
+
+    def test_plain_http_still_works(self):
+        emitter = MetricsEmitter()
+        emitter.emit_replica_metrics("v", "ns", current=2, desired=2,
+                                     accelerator_type="v5e-1")
+        server, _thread = emitter.serve(0, addr="127.0.0.1")
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ) as r:
+                assert "inferno_current_replicas" in r.read().decode()
+        finally:
+            server.shutdown()

@@ -2,24 +2,29 @@
 
 Wires the REST kube client, the HTTPS Prometheus client (validated with
 backoff — the controller hard-fails without Prometheus, reference
-cmd/main.go + controller SetupWithManager :448-451), the metrics server,
-and starts the reconcile loop.
+cmd/main.go + controller SetupWithManager :448-451), the TLS-capable
+metrics server (cmd/main.go:122-199), health probes (:252-262), optional
+Lease-based leader election (:206-218), and starts the reconcile loop.
 
 Usage:
     python -m workload_variant_autoscaler_tpu.controller \
-        [--metrics-port 8443] [--config-namespace NS] [--allow-http-prom]
+        [--metrics-port 8443] [--health-port 8081] [--leader-elect] \
+        [--config-namespace NS] [--allow-http-prom]
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+import threading
 
 from ..collector import HTTPPromAPI, PrometheusConfig, validate_prometheus_api
 from ..metrics import MetricsEmitter
 from ..utils import get_logger, kv
 from .kube import RestKube
 from .reconciler import CONFIG_MAP_NAMESPACE, Reconciler
+from .runtime import HealthServer, LeaderElector
 
 
 def main(argv=None) -> int:
@@ -27,6 +32,15 @@ def main(argv=None) -> int:
     parser.add_argument("--metrics-port", type=int, default=8080,
                         help="port for the emitted /metrics endpoint")
     parser.add_argument("--metrics-addr", default="0.0.0.0")
+    parser.add_argument("--metrics-cert", default=os.environ.get("METRICS_TLS_CERT", ""),
+                        help="TLS cert for the metrics endpoint (serves https)")
+    parser.add_argument("--metrics-key", default=os.environ.get("METRICS_TLS_KEY", ""))
+    parser.add_argument("--metrics-client-ca", default=os.environ.get("METRICS_CLIENT_CA", ""),
+                        help="require+verify client certs against this CA")
+    parser.add_argument("--health-port", type=int, default=8081,
+                        help="port for /healthz and /readyz probes")
+    parser.add_argument("--leader-elect", action="store_true",
+                        help="enable Lease-based leader election for HA")
     parser.add_argument("--config-namespace", default=CONFIG_MAP_NAMESPACE)
     parser.add_argument("--kube-url", default=None,
                         help="API server URL (default: in-cluster)")
@@ -41,6 +55,10 @@ def main(argv=None) -> int:
         log.error("no Prometheus configuration found; set PROMETHEUS_BASE_URL")
         return 1
     prom = HTTPPromAPI(prom_config, allow_http=args.allow_http_prom)
+
+    ready = threading.Event()
+    health = HealthServer(args.health_port, ready_check=ready.is_set).start()
+
     log.info("validating Prometheus connectivity", extra=kv(url=prom_config.base_url))
     try:
         validate_prometheus_api(prom)
@@ -51,15 +69,63 @@ def main(argv=None) -> int:
 
     kube = RestKube(base_url=args.kube_url)
     emitter = MetricsEmitter()
-    emitter.serve(args.metrics_port, addr=args.metrics_addr)
+    try:
+        emitter.serve(
+            args.metrics_port, addr=args.metrics_addr,
+            certfile=args.metrics_cert or None, keyfile=args.metrics_key or None,
+            client_cafile=args.metrics_client_ca or None,
+        )
+    except ValueError as e:
+        log.error("invalid metrics TLS configuration", extra=kv(error=str(e)))
+        return 1
 
     reconciler = Reconciler(
         kube=kube, prom=prom, emitter=emitter,
         config_namespace=args.config_namespace,
     )
-    log.info("starting reconcile loop")
-    reconciler.run_forever()
-    return 0
+    stop = threading.Event()
+    # Process is serviceable once dependencies are validated; readiness does
+    # NOT gate on holding the leader lease (follower replicas must go Ready
+    # or rollouts stall — matches controller-runtime's readyz semantics).
+    ready.set()
+
+    # Kubernetes terminates pods with SIGTERM: route it through `stop` so
+    # the lease is released instead of held for the full lease duration.
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+
+    def lead() -> None:
+        log.info("starting reconcile loop")
+        thread = threading.Thread(
+            target=reconciler.run_forever, args=(stop,), daemon=True,
+            name="wva-reconcile",
+        )
+        thread.start()
+
+    rc = 0
+    if args.leader_elect:
+        elector = LeaderElector(kube, lease_namespace=args.config_namespace)
+        try:
+            # run() returns only when leadership is lost -> exit non-zero so
+            # the pod restarts and re-contends (controller-runtime policy)
+            elector.run(stop, on_started_leading=lead)
+            if not stop.is_set():
+                log.error("leadership lost; exiting for restart")
+                rc = 1
+        except KeyboardInterrupt:
+            pass
+        finally:
+            stop.set()
+            elector.release()
+    else:
+        lead()
+        try:
+            stop.wait()
+        except KeyboardInterrupt:
+            stop.set()
+    health.stop()
+    return rc
 
 
 if __name__ == "__main__":

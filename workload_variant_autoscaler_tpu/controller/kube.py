@@ -72,6 +72,10 @@ class KubeClient(Protocol):
     def get_variant_autoscaling(self, name: str, namespace: str) -> VariantAutoscaling: ...
     def update_variant_autoscaling_status(self, va: VariantAutoscaling) -> None: ...
     def patch_owner_reference(self, va: VariantAutoscaling, deploy: Deployment) -> None: ...
+    # coordination.k8s.io Leases (leader election, runtime.py)
+    def get_lease(self, name: str, namespace: str): ...
+    def create_lease(self, lease) -> None: ...
+    def update_lease(self, lease) -> None: ...
 
 
 class InMemoryKube:
@@ -82,6 +86,7 @@ class InMemoryKube:
         self.configmaps: dict[tuple[str, str], ConfigMap] = {}
         self.deployments: dict[tuple[str, str], Deployment] = {}
         self.vas: dict[tuple[str, str], VariantAutoscaling] = {}
+        self.leases: dict[tuple[str, str], Any] = {}
         # (verb, kind) -> callable raising the injected error; removed after
         # `count` trips when count > 0
         self._faults: dict[tuple[str, str], tuple[Callable[[], None], int]] = {}
@@ -179,6 +184,37 @@ class InMemoryKube:
             stored = self.vas[key]
             stored.metadata.owner_references = [ref]
             va.metadata.owner_references = [ref]
+
+    # -- Leases (leader election) ----------------------------------------
+
+    def get_lease(self, name: str, namespace: str):
+        with self._lock:
+            self._trip("get", "Lease")
+            lease = self.leases.get((namespace, name))
+            if lease is None:
+                raise NotFoundError(f"lease {namespace}/{name} not found")
+            return copy.deepcopy(lease)
+
+    def create_lease(self, lease) -> None:
+        with self._lock:
+            self._trip("create", "Lease")
+            key = (lease.namespace, lease.name)
+            if key in self.leases:
+                raise ConflictError(f"lease {key} already exists")
+            lease.resource_version = "1"
+            self.leases[key] = copy.deepcopy(lease)
+
+    def update_lease(self, lease) -> None:
+        with self._lock:
+            self._trip("update", "Lease")
+            key = (lease.namespace, lease.name)
+            stored = self.leases.get(key)
+            if stored is None:
+                raise NotFoundError(f"lease {key} not found")
+            if stored.resource_version != lease.resource_version:
+                raise ConflictError(f"lease {key}: stale resourceVersion")
+            lease.resource_version = str(int(stored.resource_version) + 1)
+            self.leases[key] = copy.deepcopy(lease)
 
     # -- test conveniences ----------------------------------------------
 
@@ -297,4 +333,91 @@ class RestKube:
             f"/apis/{GROUP}/{VERSION}/namespaces/{va.namespace}/{PLURAL}/{va.name}",
             body=patch,
             content_type="application/merge-patch+json",
+        )
+
+    # -- Leases (coordination.k8s.io/v1) ---------------------------------
+
+    _LEASE_PATH = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
+
+    @staticmethod
+    def _micro_time(unix: float) -> Optional[str]:
+        if unix <= 0:
+            return None
+        import datetime
+
+        return datetime.datetime.fromtimestamp(
+            unix, tz=datetime.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+    @staticmethod
+    def _from_micro_time(s: Optional[str]) -> float:
+        """Accept both MicroTime and whole-second RFC3339 (other clients,
+        e.g. kubectl-applied leases, omit the fractional part)."""
+        if not s:
+            return 0.0
+        import datetime
+
+        s = s.replace("Z", "+0000")
+        for fmt in ("%Y-%m-%dT%H:%M:%S.%f%z", "%Y-%m-%dT%H:%M:%S%z"):
+            try:
+                return datetime.datetime.strptime(s, fmt).timestamp()
+            except ValueError:
+                continue
+        raise InvalidError(f"unparseable lease timestamp {s!r}")
+
+    def _lease_body(self, lease) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {
+                "name": lease.name,
+                "namespace": lease.namespace,
+                **(
+                    {"resourceVersion": lease.resource_version}
+                    if lease.resource_version != "0"
+                    else {}
+                ),
+            },
+            "spec": {
+                "holderIdentity": lease.holder,
+                "acquireTime": self._micro_time(lease.acquire_time),
+                "renewTime": self._micro_time(lease.renew_time),
+                "leaseDurationSeconds": int(lease.duration_seconds),
+                "leaseTransitions": lease.transitions,
+            },
+        }
+
+    def _lease_from_obj(self, obj: dict):
+        from .runtime import Lease
+
+        spec = obj.get("spec", {})
+        meta = obj.get("metadata", {})
+        return Lease(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", ""),
+            holder=spec.get("holderIdentity") or "",
+            acquire_time=self._from_micro_time(spec.get("acquireTime")),
+            renew_time=self._from_micro_time(spec.get("renewTime")),
+            duration_seconds=float(spec.get("leaseDurationSeconds") or 15),
+            transitions=int(spec.get("leaseTransitions") or 0),
+            resource_version=meta.get("resourceVersion", "0"),
+        )
+
+    def get_lease(self, name: str, namespace: str):
+        obj = self._request(
+            "GET", f"{self._LEASE_PATH.format(ns=namespace)}/{name}"
+        )
+        return self._lease_from_obj(obj)
+
+    def create_lease(self, lease) -> None:
+        self._request(
+            "POST", self._LEASE_PATH.format(ns=lease.namespace),
+            body=self._lease_body(lease),
+        )
+
+    def update_lease(self, lease) -> None:
+        self._request(
+            "PUT",
+            f"{self._LEASE_PATH.format(ns=lease.namespace)}/{lease.name}",
+            body=self._lease_body(lease),
         )

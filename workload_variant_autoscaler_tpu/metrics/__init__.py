@@ -106,8 +106,25 @@ class MetricsEmitter:
                     return sample.value
         return None
 
-    def serve(self, port: int, addr: str = "0.0.0.0"):
-        """Expose /metrics for Prometheus to scrape."""
-        server, thread = start_http_server(port, addr=addr, registry=self.registry)
-        log.info("metrics server started", extra=kv(port=port))
+    def serve(self, port: int, addr: str = "0.0.0.0",
+              certfile: Optional[str] = None, keyfile: Optional[str] = None,
+              client_cafile: Optional[str] = None):
+        """Expose /metrics for Prometheus to scrape — plain HTTP, or HTTPS
+        when a cert/key pair is supplied, with optional required client-CA
+        verification (reference cmd/main.go:122-199: TLS-capable metrics
+        endpoint with authn/authz). Returns (server, thread)."""
+        if bool(certfile) != bool(keyfile):
+            raise ValueError("metrics TLS requires both certfile and keyfile")
+        if client_cafile and not certfile:
+            raise ValueError("metrics client-CA verification requires a server "
+                             "certfile/keyfile pair")
+        kwargs = {}
+        if certfile:
+            kwargs = dict(certfile=certfile, keyfile=keyfile)
+            if client_cafile:
+                kwargs.update(client_cafile=client_cafile, client_auth_required=True)
+        server, thread = start_http_server(port, addr=addr,
+                                           registry=self.registry, **kwargs)
+        log.info("metrics server started",
+                 extra=kv(port=server.server_address[1], tls=bool(certfile)))
         return server, thread

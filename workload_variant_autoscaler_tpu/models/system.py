@@ -124,6 +124,8 @@ class System:
         for acc in self.accelerators.values():
             acc.calculate()
         if backend == "scalar":
+            if mesh is not None:
+                raise ValueError("mesh sharding requires backend='batched'")
             for server in self.servers.values():
                 server.calculate(self)
             return

@@ -9,7 +9,7 @@ from feasibility downstream.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -79,8 +79,16 @@ def size_batch_sharded(
     q, targets, b = pad_to_multiple(q, targets, n)
     q = shard_batch(q, mesh)
     targets = shard_batch(targets, mesh)
-    sized = jax.jit(
+    sized = _sharded_size_fn(k_max, mesh)(q, targets)
+    return jax.tree.map(lambda a: a[:b], sized)
+
+
+@lru_cache(maxsize=32)
+def _sharded_size_fn(k_max: int, mesh: Mesh):
+    """Jitted sharded kernel, cached per (k_max, mesh) so repeated
+    reconcile cycles reuse the compiled executable instead of retracing
+    (Mesh hashes by device assignment + axis names)."""
+    return jax.jit(
         partial(size_batch, k_max=k_max),
         out_shardings=NamedSharding(mesh, P(AXIS)),
-    )(q, targets)
-    return jax.tree.map(lambda a: a[:b], sized)
+    )
