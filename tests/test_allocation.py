@@ -102,7 +102,9 @@ class TestCreateAllocation:
         system, _ = make_system([server_spec(arrival_rpm=0.0, min_replicas=0)])
         alloc = create_allocation(system, "var-8b:default", "v5e-1")
         assert alloc.num_replicas == 0
-        assert alloc.accelerator == ""
+        # slice name retained so the emitted series keeps its label through
+        # the zero phase
+        assert alloc.accelerator == "v5e-1"
         assert alloc.cost == 0.0
 
     def test_negative_load_invalid(self):

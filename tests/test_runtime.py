@@ -72,11 +72,45 @@ class TestLeaderElection:
         a = LeaderElector(kube, identity="a", now=clock)
         b = LeaderElector(kube, identity="b", now=clock)
         a.try_acquire_or_renew()
-        clock.advance(20.0)  # > 15s lease duration: a is dead
+        clock.advance(20.0)
+        # first sight of the record only arms b's local observation timer
+        # (expiry is judged by local observation, not the written renewTime)
+        assert not b.try_acquire_or_renew()
+        clock.advance(16.0)  # record unmoved for > lease duration: a is dead
         assert b.try_acquire_or_renew()
         lease = kube.get_lease(b.lease_name, b.lease_namespace)
         assert lease.holder == "b"
         assert lease.transitions == 1
+
+    def test_clock_skew_does_not_cause_takeover(self):
+        """b's clock runs 20s ahead of a's; as long as a keeps renewing,
+        b must never take over (client-go local-observation semantics)."""
+        kube = InMemoryKube()
+        clock_a = FakeClock(1000.0)
+        clock_b = FakeClock(1020.0)
+        a = LeaderElector(kube, identity="a", now=clock_a)
+        b = LeaderElector(kube, identity="b", now=clock_b)
+        assert a.try_acquire_or_renew()
+        for _ in range(20):  # 40s of skewed coexistence
+            clock_a.advance(2.0)
+            clock_b.advance(2.0)
+            assert a.try_acquire_or_renew()
+            assert not b.try_acquire_or_renew()
+
+    def test_takeover_rewrites_stale_lease_duration(self):
+        """A new replica taking over an expired lease written with a longer
+        duration must stamp its own configured duration."""
+        kube = InMemoryKube()
+        clock = FakeClock()
+        old = LeaderElector(kube, identity="old", now=clock, lease_duration=60.0,
+                            renew_deadline=10.0)
+        new = LeaderElector(kube, identity="new", now=clock)  # 15s default
+        old.try_acquire_or_renew()
+        assert not new.try_acquire_or_renew()  # arm observation
+        clock.advance(61.0)
+        assert new.try_acquire_or_renew()
+        lease = kube.get_lease(new.lease_name, new.lease_namespace)
+        assert lease.duration_seconds == 15.0
 
     def test_renew_deadline_must_undercut_lease_duration(self):
         with pytest.raises(ValueError):

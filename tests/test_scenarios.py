@@ -1,0 +1,354 @@
+"""BASELINE.json scenario coverage (configs 2, 4, 5).
+
+Config 1 (single VA closed loop) lives in test_e2e_loop.py; config 3 is
+the real-cluster scrape path (covered by the RestKube/HTTPPromAPI units).
+Here:
+
+- config 2: multi-model / multi-service-class optimization in one cycle
+  (8B Premium + 70B Freemium), distinct SLOs and slices per variant.
+- config 4: multi-host v5e-16 pod-slice allocation for a TP=8-profiled
+  70B — atomic whole-slice scaling, chip-granular capacity in the greedy
+  solver.
+- config 5: heterogeneous v5e + v5p fleet with KEDA-shaped signals —
+  scale-to-zero on idle, scale-from-zero ratio encoding, load ramp.
+"""
+
+import json
+
+import pytest
+
+from workload_variant_autoscaler_tpu.collector import (
+    FakePromAPI,
+    arrival_rate_query,
+    avg_generation_tokens_query,
+    avg_itl_query,
+    avg_prompt_tokens_query,
+    avg_ttft_query,
+)
+from workload_variant_autoscaler_tpu.controller import (
+    ACCELERATOR_CM_NAME,
+    CONFIG_MAP_NAME,
+    CONFIG_MAP_NAMESPACE,
+    SERVICE_CLASS_CM_NAME,
+    ConfigMap,
+    Deployment,
+    InMemoryKube,
+    Reconciler,
+    crd,
+)
+from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
+from workload_variant_autoscaler_tpu.models import OptimizerSpec
+from workload_variant_autoscaler_tpu.solver import Manager, Optimizer
+
+from helpers import make_system, server_spec
+
+NS = "default"
+
+# Per-slice profiles (helpers.PROFILES values, as CRD string params)
+PROFILE_8B_V5E1 = ("v5e-1", 1, "6.973", "0.027", "5.2", "0.1", 64)
+PROFILE_8B_V5E4 = ("v5e-4", 1, "3.2", "0.012", "2.4", "0.04", 192)
+PROFILE_8B_V5P4 = ("v5p-4", 1, "2.1", "0.008", "1.5", "0.025", 256)
+PROFILE_70B_V5E8 = ("v5e-8", 1, "18.0", "0.12", "14.0", "0.3", 48)
+# TP=8 over two hosts: the slice is one atomic 4x4 unit
+PROFILE_70B_V5E16 = ("v5e-16", 1, "11.0", "0.07", "9.0", "0.18", 96)
+
+SERVICE_CLASS_YAML = {
+    "premium": (
+        "name: Premium\npriority: 1\ndata:\n"
+        "  - model: llama-8b\n    slo-tpot: 24\n    slo-ttft: 500\n"
+        "  - model: llama-70b\n    slo-tpot: 15\n    slo-ttft: 1500\n"
+    ),
+    "freemium": (
+        "name: Freemium\npriority: 10\ndata:\n"
+        "  - model: llama-8b\n    slo-tpot: 150\n    slo-ttft: 1500\n"
+        "  - model: llama-70b\n    slo-tpot: 200\n    slo-ttft: 4000\n"
+    ),
+}
+
+SLICE_COSTS = {
+    "v5e-1": {"chip": "v5e", "chips": "1", "cost": "20.0"},
+    "v5e-4": {"chip": "v5e", "chips": "4", "cost": "80.0"},
+    "v5e-8": {"chip": "v5e", "chips": "8", "cost": "160.0"},
+    "v5e-16": {"chip": "v5e", "chips": "16", "cost": "320.0"},
+    "v5p-4": {"chip": "v5p", "chips": "4", "cost": "340.0"},
+}
+
+
+def make_profile(entry):
+    acc, count, alpha, beta, gamma, delta, max_batch = entry
+    return crd.AcceleratorProfile(
+        acc=acc, acc_count=count,
+        perf_parms=crd.PerfParms(
+            decode_parms={"alpha": alpha, "beta": beta},
+            prefill_parms={"gamma": gamma, "delta": delta},
+        ),
+        max_batch_size=max_batch,
+    )
+
+
+def make_va(name, model, acc, sc_key, profiles):
+    return crd.VariantAutoscaling(
+        metadata=crd.ObjectMeta(name=name, namespace=NS,
+                                labels={crd.ACCELERATOR_LABEL: acc}),
+        spec=crd.VariantAutoscalingSpec(
+            model_id=model,
+            slo_class_ref=crd.ConfigMapKeyRef(name=SERVICE_CLASS_CM_NAME, key=sc_key),
+            model_profile=crd.ModelProfile(
+                accelerators=[make_profile(p) for p in profiles]
+            ),
+        ),
+    )
+
+
+def make_fleet_cluster(variants):
+    """variants: list of (name, model, acc, sc_key, profiles, replicas)."""
+    kube = InMemoryKube()
+    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
+                                 {"GLOBAL_OPT_INTERVAL": "30s"}))
+    kube.put_configmap(ConfigMap(
+        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {k: json.dumps(v) for k, v in SLICE_COSTS.items()},
+    ))
+    kube.put_configmap(ConfigMap(SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+                                 dict(SERVICE_CLASS_YAML)))
+    for name, model, acc, sc_key, profiles, replicas in variants:
+        kube.put_deployment(Deployment(name=name, namespace=NS,
+                                       spec_replicas=replicas,
+                                       status_replicas=replicas))
+        kube.put_variant_autoscaling(make_va(name, model, acc, sc_key, profiles))
+    prom = FakePromAPI()
+    emitter = MetricsEmitter()
+    rec = Reconciler(kube=kube, prom=prom, emitter=emitter, sleep=lambda _s: None)
+    return kube, prom, emitter, rec
+
+
+def set_load(prom, model, rps, in_tok, out_tok, ttft_s=0.05, itl_s=0.009):
+    prom.set_result(arrival_rate_query(model, NS), rps)
+    prom.set_result(avg_prompt_tokens_query(model, NS), in_tok)
+    prom.set_result(avg_generation_tokens_query(model, NS), out_tok)
+    prom.set_result(avg_ttft_query(model, NS), ttft_s)
+    prom.set_result(avg_itl_query(model, NS), itl_s)
+
+
+class TestMultiModelMultiClass:
+    """BASELINE config 2: 8B Premium + 70B Freemium in one optimizer run."""
+
+    def _cluster(self):
+        return make_fleet_cluster([
+            ("chat-8b", "llama-8b", "v5e-1", "premium", [PROFILE_8B_V5E1], 1),
+            ("batch-70b", "llama-70b", "v5e-8", "freemium", [PROFILE_70B_V5E8], 1),
+        ])
+
+    def test_both_variants_optimized_in_one_cycle(self):
+        kube, prom, emitter, rec = self._cluster()
+        set_load(prom, "llama-8b", 40.0, 128.0, 128.0)
+        set_load(prom, "llama-70b", 1.5, 1024.0, 256.0, ttft_s=0.4, itl_s=0.03)
+
+        result = rec.reconcile()
+        assert sorted(result.processed) == ["batch-70b:default", "chat-8b:default"]
+        assert not result.error
+
+        va8 = kube.get_variant_autoscaling("chat-8b", NS)
+        va70 = kube.get_variant_autoscaling("batch-70b", NS)
+        assert crd.is_condition_true(va8, crd.TYPE_OPTIMIZATION_READY)
+        assert crd.is_condition_true(va70, crd.TYPE_OPTIMIZATION_READY)
+
+        # 8B: ~24.8 req/s per v5e-1 replica at Premium SLO -> 40 rps needs 2
+        assert va8.status.desired_optimized_alloc.accelerator == "v5e-1"
+        assert va8.status.desired_optimized_alloc.num_replicas == 2
+
+        # 70B stays on its pinned v5e-8, sized for the relaxed Freemium SLO
+        assert va70.status.desired_optimized_alloc.accelerator == "v5e-8"
+        assert va70.status.desired_optimized_alloc.num_replicas >= 1
+
+        # per-variant series with distinct slice labels
+        assert emitter.value("inferno_desired_replicas", variant_name="chat-8b",
+                             accelerator_type="v5e-1") == 2
+        assert emitter.value("inferno_desired_replicas", variant_name="batch-70b",
+                             accelerator_type="v5e-8") is not None
+
+    def test_distinct_slos_produce_distinct_sizing(self):
+        """Same model + load under Premium vs Freemium: the tighter class
+        needs at least as many replicas (engine-level, unpinned)."""
+        def replicas_for(sc):
+            system, opt = make_system(servers=[server_spec(
+                name=f"v:{sc}", service_class=sc, arrival_rpm=4800.0,
+                accelerator="v5e-1", keep_accelerator=True,
+            )])
+            system.calculate()
+            Manager(system, Optimizer(opt)).optimize()
+            return system.servers[f"v:{sc}"].allocation.num_replicas
+
+        assert replicas_for("Premium") >= replicas_for("Freemium") >= 1
+        assert replicas_for("Premium") > 1
+
+
+class TestMultiHostSliceAllocation:
+    """BASELINE config 4: v5e-16 (4x4, TP=8) pod slices are atomic units."""
+
+    def test_premium_70b_lands_on_multi_host_slice(self):
+        # Premium 70B SLO (itl 15ms) is infeasible on v5e-8 (alpha=18ms
+        # decode floor) — only the v5e-16 TP=8 profile can hold it
+        kube, prom, emitter, rec = make_fleet_cluster([
+            ("chat-70b", "llama-70b", "v5e-16", "premium",
+             [PROFILE_70B_V5E8, PROFILE_70B_V5E16], 1),
+        ])
+        set_load(prom, "llama-70b", 4.0, 1024.0, 256.0, ttft_s=0.5, itl_s=0.012)
+        rec.reconcile()
+        va = kube.get_variant_autoscaling("chat-70b", NS)
+        alloc = va.status.desired_optimized_alloc
+        assert alloc.accelerator == "v5e-16"
+        assert alloc.num_replicas >= 1
+
+    def test_infeasible_slice_yields_no_allocation(self):
+        """Pinned to v5e-8, the Premium 70B SLO cannot be met: optimization
+        must surface failure rather than emit an SLO-violating allocation."""
+        kube, prom, _emitter, rec = make_fleet_cluster([
+            ("chat-70b", "llama-70b", "v5e-8", "premium", [PROFILE_70B_V5E8], 1),
+        ])
+        set_load(prom, "llama-70b", 4.0, 1024.0, 256.0, ttft_s=0.5, itl_s=0.02)
+        result = rec.reconcile()
+        va = kube.get_variant_autoscaling("chat-70b", NS)
+        assert result.error or not crd.is_condition_true(
+            va, crd.TYPE_OPTIMIZATION_READY
+        )
+
+    def test_chip_accounting_counts_whole_slices(self):
+        """Allocation cost/chips scale in units of 16 chips per replica."""
+        system, opt = make_system(servers=[server_spec(
+            name="v:ns", model="llama-70b", service_class="Premium",
+            arrival_rpm=1200.0, in_tokens=1024, out_tokens=256,
+            accelerator="v5e-16", keep_accelerator=True,
+        )])
+        system.calculate()
+        Manager(system, Optimizer(opt)).optimize()
+        server = system.servers["v:ns"]
+        alloc = server.allocation
+        n = alloc.num_replicas
+        acc = system.accelerators["v5e-16"]
+        assert acc.spec.multi_host
+        assert acc.spec.chips == 16
+        # cost = replicas x whole-slice cost (320 = 16 chips x 20c)
+        assert alloc.cost == pytest.approx(n * 320.0)
+
+    def test_greedy_capacity_respects_chip_granularity(self):
+        """With a 32-chip v5e pool, at most 2 whole v5e-16 slices fit, no
+        matter how much load demands more (greedy capacity-aware solver)."""
+        system, opt = make_system(
+            servers=[server_spec(
+                name="v:ns", model="llama-70b", service_class="Premium",
+                arrival_rpm=60000.0, in_tokens=1024, out_tokens=256,
+                accelerator="v5e-16", keep_accelerator=True,
+            )],
+            capacity={"v5e": 32},
+            optimizer=OptimizerSpec(unlimited=False,
+                                    saturation_policy="PriorityExhaustive"),
+        )
+        system.calculate()
+        Manager(system, Optimizer(opt)).optimize()
+        alloc = system.servers["v:ns"].allocation
+        assert alloc is not None
+        assert alloc.num_replicas == 2  # 2 x 16 = 32 chips: pool exhausted
+        counts = system.allocate_by_type()
+        assert counts["v5e"].count <= 32
+
+
+class TestHeterogeneousFleetKeda:
+    """BASELINE config 5: v5e + v5p fleet, KEDA signals, ramp + idle."""
+
+    def _cluster(self):
+        return make_fleet_cluster([
+            ("chat-8b", "llama-8b", "v5e-1", "premium", [PROFILE_8B_V5E1], 1),
+            ("turbo-8b", "llama-8b", "v5p-4", "premium", [PROFILE_8B_V5P4], 1),
+        ])
+
+    def test_engine_picks_cheapest_feasible_slice_across_generations(self):
+        """Unpinned engine choice over v5e-1/v5e-4/v5p-4: cost-optimal slice
+        wins for a Premium 8B workload (v5e-1 at 20c vs v5p-4 at 340c)."""
+        system, opt = make_system(servers=[server_spec(
+            name="v:ns", arrival_rpm=1200.0, keep_accelerator=False,
+        )])
+        system.calculate()
+        Manager(system, Optimizer(opt)).optimize()
+        alloc = system.servers["v:ns"].allocation
+        assert alloc.accelerator == "v5e-1"
+
+        # same load but an SLO only the v5p profile can hold (itl < v5e
+        # alphas) must flip the choice to the expensive generation
+        from workload_variant_autoscaler_tpu.models import (
+            ModelTarget, ServiceClassSpec,
+        )
+        from helpers import PROFILES, SLICES
+        from workload_variant_autoscaler_tpu.models import SystemSpec
+        from workload_variant_autoscaler_tpu.models import System
+
+        tight = ServiceClassSpec(
+            name="Premium", priority=1,
+            model_targets=(ModelTarget(model="llama-8b", slo_itl=3.0,
+                                       slo_ttft=500.0),),
+        )
+        spec = SystemSpec(
+            accelerators=list(SLICES), profiles=list(PROFILES),
+            service_classes=[tight],
+            servers=[server_spec(name="v:ns", arrival_rpm=1200.0,
+                                 keep_accelerator=False)],
+            capacity={}, optimizer=OptimizerSpec(unlimited=True),
+        )
+        system2 = System()
+        opt2 = system2.set_from_spec(spec)
+        system2.calculate()
+        Manager(system2, Optimizer(opt2)).optimize()
+        assert system2.servers["v:ns"].allocation.accelerator == "v5p-4"
+
+    def test_scale_to_zero_and_keda_ratio_encoding(self, monkeypatch):
+        monkeypatch.setenv("WVA_SCALE_TO_ZERO", "true")
+        kube, prom, emitter, rec = self._cluster()
+
+        # phase 1: fleet idle -> both variants scale to zero
+        set_load(prom, "llama-8b", 0.0, 0.0, 0.0, ttft_s=0.0, itl_s=0.0)
+        rec.reconcile()
+        for name in ("chat-8b", "turbo-8b"):
+            va = kube.get_variant_autoscaling(name, NS)
+            assert va.status.desired_optimized_alloc.num_replicas == 0
+        assert emitter.value("inferno_desired_replicas",
+                             variant_name="chat-8b") == 0
+
+        # phase 2: load arrives while current=0 (KEDA must wake from zero):
+        # ratio gauge encodes 0 -> N as ratio = N
+        for name in ("chat-8b", "turbo-8b"):
+            kube.put_deployment(Deployment(name=name, namespace=NS,
+                                           spec_replicas=0, status_replicas=0))
+        set_load(prom, "llama-8b", 30.0, 128.0, 128.0)
+        rec.reconcile()
+        va = kube.get_variant_autoscaling("chat-8b", NS)
+        desired = va.status.desired_optimized_alloc.num_replicas
+        assert desired >= 1
+        assert emitter.value("inferno_desired_ratio",
+                             variant_name="chat-8b") == desired
+
+        # phase 3: ramp up -> desired grows on the v5e variant
+        set_load(prom, "llama-8b", 120.0, 128.0, 128.0)
+        rec.reconcile()
+        va = kube.get_variant_autoscaling("chat-8b", NS)
+        assert va.status.desired_optimized_alloc.num_replicas > desired
+
+        # phase 4: idle again -> back to zero (KEDA scale-to-zero)
+        set_load(prom, "llama-8b", 0.0, 0.0, 0.0, ttft_s=0.0, itl_s=0.0)
+        rec.reconcile()
+        va = kube.get_variant_autoscaling("chat-8b", NS)
+        assert va.status.desired_optimized_alloc.num_replicas == 0
+
+    def test_fleet_cost_sums_across_generations(self):
+        """allocate_by_type totals chips/cost per generation pool."""
+        system, opt = make_system(servers=[
+            server_spec(name="a:ns", arrival_rpm=2400.0, accelerator="v5e-1",
+                        keep_accelerator=True),
+            server_spec(name="b:ns", arrival_rpm=2400.0, accelerator="v5p-4",
+                        keep_accelerator=True),
+        ])
+        system.calculate()
+        Manager(system, Optimizer(opt)).optimize()
+        counts = system.allocate_by_type()
+        assert counts["v5e"].count >= 1
+        assert counts["v5p"].count >= 4  # whole 4-chip slices
+        assert counts["v5p"].cost >= 340.0

@@ -95,6 +95,8 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
 
+    reconcile_thread: list[threading.Thread] = []
+
     def lead() -> None:
         log.info("starting reconcile loop")
         thread = threading.Thread(
@@ -102,6 +104,16 @@ def main(argv=None) -> int:
             name="wva-reconcile",
         )
         thread.start()
+        reconcile_thread.append(thread)
+
+    def drain() -> None:
+        """Let an in-flight cycle finish before the lease is released, so
+        the next leader never overlaps our writes (controller-runtime
+        drains runnables before surrendering the lease)."""
+        for t in reconcile_thread:
+            t.join(timeout=60.0)
+            if t.is_alive():
+                log.warning("reconcile cycle did not drain within 60s")
 
     rc = 0
     if args.leader_elect:
@@ -117,6 +129,7 @@ def main(argv=None) -> int:
             pass
         finally:
             stop.set()
+            drain()
             elector.release()
     else:
         lead()
@@ -124,6 +137,7 @@ def main(argv=None) -> int:
             stop.wait()
         except KeyboardInterrupt:
             stop.set()
+        drain()
     health.stop()
     return rc
 

@@ -163,6 +163,7 @@ class Reconciler:
 
     def _prepare(self, active, accelerator_cm, service_class_cm, system_spec, result):
         prepared: list[tuple[crd.VariantAutoscaling, Deployment]] = []
+        class_by_key = translate.service_class_key_names(service_class_cm)
         for va_listed in active:
             name = va_listed.name
             key = full_name(va_listed.name, va_listed.namespace)
@@ -171,8 +172,11 @@ class Reconciler:
                 result.skipped[key] = "missing modelID"
                 continue
 
+            preferred = class_by_key.get(va_listed.spec.slo_class_ref.key, "")
             try:
-                _target, class_name = translate.find_model_slo_in_spec(system_spec, model)
+                _target, class_name = translate.find_model_slo_in_spec(
+                    system_spec, model, preferred_class=preferred
+                )
             except (KeyError, ValueError) as e:
                 log.error("no SLO for model", extra=kv(variant=name, model=model, error=str(e)))
                 result.skipped[key] = "no SLO for model"

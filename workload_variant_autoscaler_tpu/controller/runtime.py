@@ -87,6 +87,12 @@ class LeaderElector:
         self.retry_period = retry_period
         self.now = now
         self._is_leader = False
+        # Expiry is judged by LOCAL observation time, not by comparing the
+        # holder's written renewTime against our clock (client-go does the
+        # same): inter-replica clock skew must not cause takeover while the
+        # holder is still renewing.
+        self._observed_record: Optional[tuple[str, float]] = None
+        self._observed_at: float = 0.0
 
     @property
     def is_leader(self) -> bool:
@@ -116,13 +122,21 @@ class LeaderElector:
                      extra=kv(lease=self.lease_name, identity=self.identity))
             return self._win()
 
+        record = (lease.holder, lease.renew_time)
+        if record != self._observed_record:
+            self._observed_record = record
+            self._observed_at = now
+
         if lease.holder == self.identity:
             lease.renew_time = now
-        elif lease.expired(now):
-            # take over an expired lease
+            lease.duration_seconds = self.lease_duration
+        elif not lease.holder or now - self._observed_at > lease.duration_seconds:
+            # voluntarily released (empty holder), or the record has not
+            # moved for a full lease duration of OUR clock: take over
             lease.holder = self.identity
             lease.acquire_time = now
             lease.renew_time = now
+            lease.duration_seconds = self.lease_duration
             lease.transitions += 1
         else:
             return self._lose()

@@ -133,12 +133,42 @@ def create_system_data(
     )
 
 
+def service_class_key_names(service_class_cm: dict[str, str]) -> dict[str, str]:
+    """ConfigMap key -> service-class name, parsed once per cycle (the VA's
+    sloClassRef.key refers to a key of this ConfigMap). Unparseable entries
+    are omitted."""
+    out: dict[str, str] = {}
+    for key, raw in service_class_cm.items():
+        try:
+            doc = yaml.safe_load(raw)
+        except yaml.YAMLError:
+            continue
+        if isinstance(doc, dict):
+            out[key] = str(doc.get("name", key))
+    return out
+
+
 def find_model_slo_in_spec(
-    spec: SystemSpec, model: str
+    spec: SystemSpec, model: str, preferred_class: str = ""
 ) -> tuple[ModelTarget, str]:
     """Locate the SLO target + class name in already-parsed system data
     (avoids re-parsing the service-class YAML per variant). Raises KeyError
-    when absent."""
+    when absent.
+
+    The reference scans all classes for the model id (utils.go:369-383),
+    which is ambiguous when several classes target the same model; here the
+    class named by the VA's sloClassRef wins, with the scan as fallback."""
+    if preferred_class:
+        for svc in spec.service_classes:
+            if svc.name != preferred_class:
+                continue
+            for target in svc.model_targets:
+                if target.model == model:
+                    return target, svc.name
+            log.warning(
+                "model missing from referenced service class, scanning all",
+                extra=kv(model=model, service_class=preferred_class),
+            )
     for svc in spec.service_classes:
         for target in svc.model_targets:
             if target.model == model:

@@ -148,7 +148,10 @@ def zero_load_allocation(
         return None
 
     if server.min_num_replicas == 0:
-        return Allocation()  # scale to zero
+        # scale to zero: keep the slice name so the emitted series retains
+        # its accelerator_type label across the 0-replica phase (KEDA wakes
+        # the same series it slept)
+        return Allocation(accelerator=acc_name)
 
     max_batch = server.max_batch_size or profile.max_batch_size
     num_replicas = server.min_num_replicas
