@@ -1,0 +1,14 @@
+# Controller + emulator image. The engine's JAX path runs on CPU inside
+# the cluster (the batched analyzer is cheap at fleet scale); TPU devices
+# are what the *workloads* use, not the autoscaler.
+FROM python:3.12-slim
+
+RUN pip install --no-cache-dir \
+    "jax[cpu]" numpy pyyaml requests prometheus-client aiohttp
+
+WORKDIR /app
+COPY workload_variant_autoscaler_tpu /app/workload_variant_autoscaler_tpu
+
+ENV PYTHONUNBUFFERED=1
+USER 65532:65532
+ENTRYPOINT ["python", "-m", "workload_variant_autoscaler_tpu.controller"]

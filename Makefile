@@ -1,0 +1,80 @@
+# TPU-native workload variant autoscaler — developer targets.
+# (The reference's kubebuilder Makefile equivalent, Python-shaped.)
+
+PY ?= python
+CLUSTER ?= wva-tpu
+IMAGE ?= workload-variant-autoscaler-tpu:latest
+
+.PHONY: help
+help: ## Show targets
+	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | awk 'BEGIN {FS = ":.*?## "}; {printf "  %-28s %s\n", $$1, $$2}'
+
+##@ Development
+
+.PHONY: test
+test: ## Run the unit + integration test suite (CPU, 8 virtual devices)
+	$(PY) -m pytest tests/ -x -q
+
+.PHONY: test-fast
+test-fast: ## Run tests, stop at first failure, quieter
+	$(PY) -m pytest tests/ -x -q -p no:cacheprovider
+
+.PHONY: bench
+bench: ## Run the benchmark (one JSON line; uses a real TPU when present)
+	$(PY) bench.py
+
+.PHONY: lint
+lint: ## Byte-compile as a basic syntax gate
+	$(PY) -m compileall -q workload_variant_autoscaler_tpu tests
+
+.PHONY: run-emulator
+run-emulator: ## Run the TPU serving emulator locally on :8000
+	$(PY) -m workload_variant_autoscaler_tpu.emulator --port 8000 --with-prom-api
+
+.PHONY: run-controller-local
+run-controller-local: ## Run the controller against a local emulator's PromQL shim
+	PROMETHEUS_BASE_URL=http://127.0.0.1:8000 \
+	$(PY) -m workload_variant_autoscaler_tpu.controller --allow-http-prom
+
+.PHONY: experiment
+experiment: ## Offline emulator parameter-estimation sweep
+	$(PY) -m workload_variant_autoscaler_tpu.emulator.experiment
+
+##@ Build & Deploy
+
+.PHONY: docker-build
+docker-build: ## Build the controller/emulator image
+	docker build -t $(IMAGE) .
+
+.PHONY: create-kind-cluster
+create-kind-cluster: ## Create a kind cluster with fake google.com/tpu capacity
+	deploy/kind-tpu-emulator/setup.sh --name $(CLUSTER)
+
+.PHONY: deploy-wva-emulated-on-kind
+deploy-wva-emulated-on-kind: ## Install the full emulated stack on kind
+	deploy/kind-tpu-emulator/deploy-wva.sh --name $(CLUSTER) --image $(IMAGE)
+
+.PHONY: teardown-kind
+teardown-kind: ## Delete the kind cluster
+	deploy/kind-tpu-emulator/teardown.sh $(CLUSTER)
+
+.PHONY: install-crd
+install-crd: ## Apply the VariantAutoscaling CRD
+	kubectl apply -f deploy/crd/
+
+.PHONY: deploy
+deploy: install-crd ## Apply manager + config manifests
+	kubectl apply -f deploy/manager/namespace.yaml
+	kubectl apply -f deploy/config/
+	kubectl apply -f deploy/manager/rbac.yaml
+	kubectl apply -f deploy/manager/deployment.yaml
+
+.PHONY: undeploy
+undeploy: ## Remove manager + CRD
+	kubectl delete -f deploy/manager/ --ignore-not-found
+	kubectl delete -f deploy/config/ --ignore-not-found
+	kubectl delete -f deploy/crd/ --ignore-not-found
+
+.PHONY: helm-template
+helm-template: ## Render the Helm chart (requires helm)
+	helm template wva charts/workload-variant-autoscaler-tpu

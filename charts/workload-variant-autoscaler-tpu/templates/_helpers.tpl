@@ -1,0 +1,9 @@
+{{- define "wva.namespace" -}}
+workload-variant-autoscaler-system
+{{- end -}}
+
+{{- define "wva.labels" -}}
+app.kubernetes.io/name: {{ .Chart.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}

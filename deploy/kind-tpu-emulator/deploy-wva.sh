@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Build the controller image, load it into the kind cluster, and install
+# the full stack: CRD, manager, ConfigMaps, the TPU emulator variant, and
+# a sample VariantAutoscaling. Expects setup.sh to have created the
+# cluster. Prometheus (kube-prometheus-stack) is optional: pass
+# --with-prometheus to helm-install it; otherwise the controller can run
+# against the emulator's built-in PromQL shim (--allow-http-prom).
+set -euo pipefail
+
+CLUSTER_NAME="wva-tpu"
+IMAGE="workload-variant-autoscaler-tpu:latest"
+WITH_PROMETHEUS=0
+REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --name) CLUSTER_NAME="$2"; shift 2 ;;
+    --image) IMAGE="$2"; shift 2 ;;
+    --with-prometheus) WITH_PROMETHEUS=1; shift ;;
+    *) echo "unknown flag $1" >&2; exit 2 ;;
+  esac
+done
+
+echo ">> building image ${IMAGE}"
+docker build -t "${IMAGE}" "${REPO_ROOT}"
+kind load docker-image "${IMAGE}" --name "${CLUSTER_NAME}"
+
+if [[ "${WITH_PROMETHEUS}" == "1" ]]; then
+  echo ">> installing kube-prometheus-stack"
+  helm repo add prometheus-community https://prometheus-community.github.io/helm-charts >/dev/null
+  helm upgrade --install prometheus prometheus-community/kube-prometheus-stack \
+    --namespace monitoring --create-namespace \
+    --set grafana.enabled=false --wait
+fi
+
+echo ">> installing CRD + manager + config"
+kubectl apply -f "${REPO_ROOT}/deploy/crd/"
+kubectl apply -f "${REPO_ROOT}/deploy/manager/namespace.yaml"
+kubectl apply -f "${REPO_ROOT}/deploy/config/"
+kubectl apply -f "${REPO_ROOT}/deploy/manager/rbac.yaml"
+kubectl apply -f "${REPO_ROOT}/deploy/manager/deployment.yaml"
+kubectl apply -f "${REPO_ROOT}/deploy/manager/metrics-service.yaml" || true  # ServiceMonitor CRD may be absent
+
+echo ">> installing the TPU emulator variant + VariantAutoscaling"
+kubectl apply -f "${REPO_ROOT}/deploy/examples/tpu-emulator/emulator.yaml" || true
+kubectl apply -f "${REPO_ROOT}/deploy/examples/tpu-emulator/variantautoscaling.yaml"
+
+echo ">> waiting for the controller"
+kubectl -n workload-variant-autoscaler-system rollout status deploy/wva-controller --timeout=180s
+echo ">> done:"
+kubectl get variantautoscalings -A

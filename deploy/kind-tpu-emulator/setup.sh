@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# Create a kind cluster that LOOKS like a GKE TPU cluster: fake
+# google.com/tpu extended resources plus the GKE TPU node labels, with no
+# TPU anywhere. The TPU analogue of the reference's fake-GPU kind setup:
+# node labels are kubectl-applied and extended-resource capacity is
+# injected through the status subresource via `kubectl proxy` (a kubelet
+# restart would wipe plain patches; the proxy route writes node status
+# directly, which the scheduler then honors for google.com/tpu requests).
+#
+# Usage:
+#   ./setup.sh [--name wva-tpu] [--workers 3] [--chips-per-node 4] \
+#              [--accelerator tpu-v5-lite-podslice] [--topologies "1x1,2x2,2x4"]
+set -euo pipefail
+
+CLUSTER_NAME="wva-tpu"
+WORKERS=3
+CHIPS_PER_NODE=4
+ACCELERATOR="tpu-v5-lite-podslice"   # GKE accelerator name for v5e
+TOPOLOGIES="1x1,2x2,2x4"
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --name) CLUSTER_NAME="$2"; shift 2 ;;
+    --workers) WORKERS="$2"; shift 2 ;;
+    --chips-per-node) CHIPS_PER_NODE="$2"; shift 2 ;;
+    --accelerator) ACCELERATOR="$2"; shift 2 ;;
+    --topologies) TOPOLOGIES="$2"; shift 2 ;;
+    *) echo "unknown flag $1" >&2; exit 2 ;;
+  esac
+done
+
+echo ">> creating kind cluster ${CLUSTER_NAME} with ${WORKERS} workers"
+{
+  echo "kind: Cluster"
+  echo "apiVersion: kind.x-k8s.io/v1alpha4"
+  echo "nodes:"
+  echo "  - role: control-plane"
+  for _ in $(seq "${WORKERS}"); do echo "  - role: worker"; done
+} | kind create cluster --name "${CLUSTER_NAME}" --config=-
+
+WORKER_NODES=$(kubectl get nodes -o name | grep -v control-plane | sed 's|node/||')
+
+IFS=',' read -r -a TOPO_ARR <<<"${TOPOLOGIES}"
+i=0
+for node in ${WORKER_NODES}; do
+  topo="${TOPO_ARR[$((i % ${#TOPO_ARR[@]}))]}"
+  i=$((i + 1))
+  echo ">> labeling ${node} as ${ACCELERATOR} topology ${topo}"
+  kubectl label node "${node}" --overwrite \
+    "cloud.google.com/gke-tpu-accelerator=${ACCELERATOR}" \
+    "cloud.google.com/gke-tpu-topology=${topo}"
+done
+
+echo ">> starting kubectl proxy to patch node status capacity"
+kubectl proxy --port=8001 &
+PROXY_PID=$!
+trap 'kill ${PROXY_PID} 2>/dev/null || true' EXIT
+sleep 2
+
+for node in ${WORKER_NODES}; do
+  echo ">> injecting google.com/tpu=${CHIPS_PER_NODE} on ${node}"
+  curl -sf --header "Content-Type: application/json-patch+json" \
+    --request PATCH \
+    --data "[{\"op\": \"add\", \"path\": \"/status/capacity/google.com~1tpu\", \"value\": \"${CHIPS_PER_NODE}\"}]" \
+    "http://127.0.0.1:8001/api/v1/nodes/${node}/status" >/dev/null
+done
+
+kill ${PROXY_PID} 2>/dev/null || true
+trap - EXIT
+
+echo ">> fake TPU capacity:"
+kubectl get nodes -o custom-columns='NODE:.metadata.name,TPU:.status.capacity.google\.com/tpu,ACC:.metadata.labels.cloud\.google\.com/gke-tpu-accelerator,TOPO:.metadata.labels.cloud\.google\.com/gke-tpu-topology'
+echo ">> done. Next: ./deploy-wva.sh --name ${CLUSTER_NAME}"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Delete the kind TPU-emulation cluster.
+set -euo pipefail
+CLUSTER_NAME="${1:-wva-tpu}"
+kind delete cluster --name "${CLUSTER_NAME}"

@@ -121,3 +121,117 @@ class PoissonLoadGenerator:
         self.sim.submit(req)
         self.generated += 1
         self._schedule_next()
+
+
+# -- real-time HTTP mode (the in-cluster loadgen Job) -----------------------
+
+
+def parse_schedule(s: str) -> list[tuple[float, float]]:
+    """"60:600,120:3600" -> [(60, 600), (120, 3600)] (duration_s, rpm)."""
+    out = []
+    for seg in s.split(","):
+        duration, rpm = seg.split(":")
+        out.append((float(duration), float(rpm)))
+    return out
+
+
+async def run_http(url: str, model: str, schedule: RateSchedule | float,
+                   tokens: TokenDistribution, poisson: bool = True,
+                   seed: int = 1, concurrency_limit: int = 2048) -> dict:
+    """Open-loop Poisson arrivals against an OpenAI-compatible endpoint
+    (the reference loadgen's request loop, async instead of threaded).
+    Returns summary stats."""
+    import asyncio
+
+    import aiohttp
+
+    rng = random.Random(seed)
+    sem = asyncio.Semaphore(concurrency_limit)
+    stats = {"sent": 0, "ok": 0, "errors": 0, "latency_ms": []}
+    start = None
+    pending: set[asyncio.Task] = set()
+
+    async def one_request(session):
+        in_tok, out_tok = tokens.sample(rng)
+        body = {
+            "model": model,
+            "messages": [{"role": "user", "content": "x " * in_tok}],
+            "max_tokens": out_tok,
+        }
+        import time as _time
+
+        t0 = _time.monotonic()
+        try:
+            async with sem, session.post(f"{url.rstrip('/')}/v1/chat/completions",
+                                         json=body) as resp:
+                await resp.read()
+                if resp.status == 200:
+                    stats["ok"] += 1
+                else:
+                    stats["errors"] += 1
+        except Exception:  # noqa: BLE001 — load tools count, don't crash
+            stats["errors"] += 1
+        stats["latency_ms"].append((_time.monotonic() - t0) * 1000.0)
+
+    import time as _time
+
+    start = _time.monotonic()
+    async with aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=600)
+    ) as session:
+        while True:
+            elapsed = _time.monotonic() - start
+            rpm = rate_at(elapsed, schedule)
+            if rpm <= 0:
+                resume = next_active_time(elapsed, schedule)
+                if resume is None:
+                    break
+                await asyncio.sleep(resume - elapsed + 0.001)
+                continue
+            mean_s = 60.0 / rpm
+            wait = rng.expovariate(1.0 / mean_s) if poisson else mean_s
+            await asyncio.sleep(wait)
+            task = asyncio.ensure_future(one_request(session))
+            pending.add(task)
+            task.add_done_callback(pending.discard)
+            stats["sent"] += 1
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+
+    lat = sorted(stats.pop("latency_ms"))
+    if lat:
+        stats["p50_ms"] = lat[len(lat) // 2]
+        stats["p95_ms"] = lat[int(len(lat) * 0.95)]
+    return stats
+
+
+def main(argv=None) -> int:
+    import argparse
+    import asyncio
+    import json as _json
+
+    parser = argparse.ArgumentParser(description="open-loop HTTP load generator")
+    parser.add_argument("--url", required=True, help="emulator/server base URL")
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--schedule", required=True,
+                        help='piecewise "seconds:rpm,seconds:rpm" ramp')
+    parser.add_argument("--input-tokens", type=int, default=128)
+    parser.add_argument("--output-tokens", type=int, default=128)
+    parser.add_argument("--distribution", default="deterministic",
+                        choices=["deterministic", "uniform"])
+    parser.add_argument("--deterministic-arrivals", action="store_true")
+    parser.add_argument("--seed", type=int, default=1)
+    args = parser.parse_args(argv)
+
+    stats = asyncio.run(run_http(
+        args.url, args.model, parse_schedule(args.schedule),
+        TokenDistribution(args.input_tokens, args.output_tokens,
+                          args.distribution),
+        poisson=not args.deterministic_arrivals, seed=args.seed,
+    ))
+    print(_json.dumps(stats))
+    return 0 if stats.get("errors", 0) == 0 else 1
+
+
+if __name__ == "__main__":
+    main()
