@@ -27,6 +27,10 @@ bench: ## Run the benchmark (one JSON line; uses a real TPU when present)
 lint: ## Byte-compile as a basic syntax gate
 	$(PY) -m compileall -q workload_variant_autoscaler_tpu tests
 
+.PHONY: native
+native: ## Build the C++ queueing kernel (single build recipe in ops/native.py)
+	$(PY) -c "from workload_variant_autoscaler_tpu.ops import native; assert native.available(), 'native kernel build failed'; print('native kernel ready')"
+
 .PHONY: run-emulator
 run-emulator: ## Run the TPU serving emulator locally on :8000
 	$(PY) -m workload_variant_autoscaler_tpu.emulator --port 8000 --with-prom-api

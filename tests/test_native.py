@@ -1,0 +1,158 @@
+"""Native C++ kernel parity with the Python scalar analyzer."""
+
+import numpy as np
+import pytest
+from helpers import make_system, server_spec
+
+from workload_variant_autoscaler_tpu.ops import native
+from workload_variant_autoscaler_tpu.ops.analyzer import (
+    InfeasibleTargetError,
+    QueueAnalyzer,
+    QueueConfig,
+    RequestSize,
+    ServiceParms,
+    TargetPerf,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native kernel not buildable here"
+)
+
+CASES = [
+    # (alpha, beta, gamma, delta, in, out, max_batch, ttft, itl, tps)
+    (6.973, 0.027, 5.2, 0.1, 128, 128, 64, 500.0, 24.0, 0.0),
+    (6.973, 0.027, 5.2, 0.1, 128, 128, 64, 0.0, 24.0, 0.0),
+    (6.973, 0.027, 5.2, 0.1, 128, 128, 64, 500.0, 0.0, 900.0),
+    (18.0, 0.12, 14.0, 0.3, 1024, 256, 48, 4000.0, 200.0, 0.0),
+    (11.0, 0.07, 9.0, 0.18, 1024, 256, 96, 1500.0, 15.0, 0.0),
+    (2.1, 0.008, 1.5, 0.025, 128, 128, 256, 500.0, 3.0, 0.0),
+    (20.58, 0.41, 5.2, 0.1, 128, 32, 4, 600.0, 40.0, 0.0),
+]
+
+
+def make_pair(case):
+    alpha, beta, gamma, delta, in_tok, out_tok, mb, *_ = case
+    config = QueueConfig(max_batch_size=mb, max_queue_size=10 * mb,
+                         parms=ServiceParms(alpha, beta, gamma, delta))
+    size = RequestSize(in_tok, out_tok)
+    return QueueAnalyzer(config, size), native.NativeQueueAnalyzer(config, size)
+
+
+class TestParity:
+    @pytest.mark.parametrize("case", CASES)
+    def test_size_matches_python(self, case):
+        py, nat = make_pair(case)
+        target = TargetPerf(ttft=case[7], itl=case[8], tps=case[9])
+        a = py.size(target)
+        b = nat.size(target)
+        assert b.rate_ttft == pytest.approx(a.rate_ttft, rel=1e-9)
+        assert b.rate_itl == pytest.approx(a.rate_itl, rel=1e-9)
+        assert b.rate_tps == pytest.approx(a.rate_tps, rel=1e-9)
+        assert b.metrics.throughput == pytest.approx(a.metrics.throughput, rel=1e-9)
+        assert b.metrics.avg_wait_time == pytest.approx(a.metrics.avg_wait_time, rel=1e-7, abs=1e-9)
+        assert b.metrics.avg_token_time == pytest.approx(a.metrics.avg_token_time, rel=1e-9)
+        assert b.metrics.rho == pytest.approx(a.metrics.rho, rel=1e-9)
+
+    @pytest.mark.parametrize("rate_frac", [0.1, 0.5, 0.9])
+    def test_analyze_matches_python(self, rate_frac):
+        py, nat = make_pair(CASES[0])
+        rate = py.max_rate * rate_frac
+        a, b = py.analyze(rate), nat.analyze(rate)
+        assert b.throughput == pytest.approx(a.throughput, rel=1e-9)
+        assert b.avg_resp_time == pytest.approx(a.avg_resp_time, rel=1e-9)
+        assert b.avg_prefill_time == pytest.approx(a.avg_prefill_time, rel=1e-9)
+        assert b.max_rate == pytest.approx(a.max_rate, rel=1e-12)
+
+    def test_infeasible_raises_like_python(self):
+        py, nat = make_pair((18.0, 0.12, 14.0, 0.3, 1024, 256, 48, 0, 0, 0))
+        target = TargetPerf(itl=15.0)  # below the 18ms decode floor
+        with pytest.raises(InfeasibleTargetError):
+            py.size(target)
+        with pytest.raises(InfeasibleTargetError):
+            nat.size(target)
+
+    def test_rate_above_range_raises(self):
+        py, nat = make_pair(CASES[0])
+        with pytest.raises(ValueError):
+            nat.analyze(py.max_rate * 1.1)
+
+
+class TestEngineIntegration:
+    def _allocs(self, system):
+        server = system.servers["var-8b:default"]
+        return {
+            name: (a.num_replicas, round(a.cost, 9), round(a.itl, 9),
+                   round(a.ttft, 9))
+            for name, a in server.all_allocations.items()
+        }
+
+    def test_native_backend_matches_scalar(self):
+        """System.calculate(backend='native'): one FFI sizing call — must
+        agree with the numpy reference path exactly."""
+        sys_a, _ = make_system(servers=[server_spec(arrival_rpm=2400.0)])
+        sys_a.calculate(backend="scalar")
+        sys_b, _ = make_system(servers=[server_spec(arrival_rpm=2400.0)])
+        sys_b.calculate(backend="native")
+        assert self._allocs(sys_a) == self._allocs(sys_b)
+
+    def test_native_backend_zero_load_and_rejects_mesh(self):
+        import pytest as _pytest
+
+        system, _ = make_system(servers=[server_spec(arrival_rpm=0.0)])
+        system.calculate(backend="native")
+        assert system.servers["var-8b:default"].all_allocations
+        with _pytest.raises(ValueError):
+            system.calculate(backend="native", mesh=object())
+
+    def test_engine_backend_env_switch(self, monkeypatch):
+        from workload_variant_autoscaler_tpu.controller import translate
+
+        monkeypatch.delenv("WVA_NATIVE_KERNEL", raising=False)
+        assert translate.engine_backend() == "batched"
+        monkeypatch.setenv("WVA_NATIVE_KERNEL", "true")
+        assert translate.engine_backend() == "native"
+
+    def test_scalar_backend_identical_with_native_kernel(self, monkeypatch):
+        """backend='scalar' under WVA_NATIVE_KERNEL must produce the same
+        allocations as the numpy kernel."""
+
+        def allocs(env_on):
+            if env_on:
+                monkeypatch.setenv("WVA_NATIVE_KERNEL", "true")
+            else:
+                monkeypatch.delenv("WVA_NATIVE_KERNEL", raising=False)
+            system, _ = make_system(servers=[server_spec(arrival_rpm=2400.0)])
+            system.calculate(backend="scalar")
+            server = system.servers["var-8b:default"]
+            return {
+                name: (a.num_replicas, round(a.cost, 9), round(a.itl, 9),
+                       round(a.ttft, 9))
+                for name, a in server.all_allocations.items()
+            }
+
+        assert allocs(False) == allocs(True)
+
+
+class TestBatch:
+    def test_batch_matches_scalar_calls(self):
+        n = len(CASES)
+        cols = list(zip(*CASES))
+        out, feasible = native.size_batch_native(
+            cols[0], cols[1], cols[2], cols[3], cols[4], cols[5], cols[6],
+            [11 * mb for mb in cols[6]], cols[7], cols[8], cols[9],
+        )
+        assert feasible.all()
+        for i, case in enumerate(CASES):
+            py, _ = make_pair(case)
+            r = py.size(TargetPerf(ttft=case[7], itl=case[8], tps=case[9]))
+            assert out[i, 0] == pytest.approx(r.rate_ttft, rel=1e-9)
+            assert out[i, 1] == pytest.approx(r.rate_itl, rel=1e-9)
+
+    def test_batch_flags_infeasible_rows(self):
+        out, feasible = native.size_batch_native(
+            [6.973, 18.0], [0.027, 0.12], [5.2, 14.0], [0.1, 0.3],
+            [128, 1024], [128, 256], [64, 48], [704, 528],
+            [500.0, 0.0], [24.0, 15.0], [0.0, 0.0],
+        )
+        assert feasible.tolist() == [True, False]
+        assert (out[1] == 0).all()

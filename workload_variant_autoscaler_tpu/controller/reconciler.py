@@ -121,10 +121,11 @@ class Reconciler:
         if not prepared:
             return result
 
-        # analyze: ONE batched kernel call across all candidates
+        # analyze: ONE batched kernel call across all candidates (JAX by
+        # default; the C++ kernel under WVA_NATIVE_KERNEL)
         system = System()
         optimizer_spec = system.set_from_spec(system_spec)
-        system.calculate()
+        system.calculate(backend=translate.engine_backend())
 
         # optimize
         try:

@@ -222,6 +222,20 @@ def scale_to_zero_enabled() -> bool:
     return os.environ.get(SCALE_TO_ZERO_ENV, "").lower() == "true"
 
 
+def engine_backend() -> str:
+    """Analysis backend for the reconcile cycle: the batched JAX kernel by
+    default; the C++ kernel when WVA_NATIVE_KERNEL is enabled and
+    buildable (CPU-only controllers skip JAX dispatch overhead)."""
+    if os.environ.get("WVA_NATIVE_KERNEL", "").lower() in ("1", "true"):
+        from ..ops import native
+
+        if native.available():
+            return "native"
+        log.warning("WVA_NATIVE_KERNEL set but kernel unavailable; "
+                    "falling back to the batched backend")
+    return "batched"
+
+
 def add_server_info_to_system_data(
     spec: SystemSpec, va: crd.VariantAutoscaling, class_name: str
 ) -> None:

@@ -22,6 +22,21 @@ from ..ops import (
     TargetPerf,
 )
 from ..ops.analyzer import InfeasibleTargetError
+
+
+def _analyzer_class():
+    """Scalar-path analyzer implementation: the numpy reference kernel, or
+    the C++ kernel when WVA_NATIVE_KERNEL is enabled and buildable (parity
+    guaranteed by tests/test_native.py; useful for CPU-only controllers
+    where per-candidate dispatch latency matters)."""
+    import os
+
+    if os.environ.get("WVA_NATIVE_KERNEL", "").lower() in ("1", "true"):
+        from ..ops import native
+
+        if native.available():
+            return native.NativeQueueAnalyzer
+    return QueueAnalyzer
 from .spec import (
     ACCEL_PENALTY_FACTOR,
     MAX_QUEUE_TO_BATCH_RATIO,
@@ -208,7 +223,7 @@ def create_allocation(system: "System", server_name: str, acc_name: str) -> Opti
     n = effective_batch_size(profile, server.max_batch_size, out_tokens)
 
     try:
-        analyzer = QueueAnalyzer(
+        analyzer = _analyzer_class()(
             QueueConfig(
                 max_batch_size=n,
                 max_queue_size=n * MAX_QUEUE_TO_BATCH_RATIO,

@@ -118,6 +118,8 @@ class System:
         them in one `ops.batched.size_batch` + one `analyze_batch` call.
         backend="scalar": per-candidate numpy path (exact reference
         semantics; used for cross-checking).
+        backend="native": all candidates through the C++ kernel in one FFI
+        call (ops.native) — the fast host path for CPU-only controllers.
         mesh: optional 1-D jax.sharding.Mesh; shards the candidate batch
         across its devices (parallel.size_batch_sharded) for large fleets.
         """
@@ -128,6 +130,11 @@ class System:
                 raise ValueError("mesh sharding requires backend='batched'")
             for server in self.servers.values():
                 server.calculate(self)
+            return
+        if backend == "native":
+            if mesh is not None:
+                raise ValueError("mesh sharding requires backend='batched'")
+            self._calculate_native()
             return
         self._calculate_batched(mesh=mesh)
 
@@ -250,6 +257,81 @@ class System:
                 ttft=float(ttft_a[i]),
                 rho=float(rho_a[i]),
                 max_arrv_rate_per_replica=float(rate_star[i]) / 1000.0,
+            )
+            alloc.value = alloc.cost
+            self._value_and_store(server, acc_name, alloc)
+
+    def _calculate_native(self) -> None:
+        """All sized candidates through the C++ kernel: one FFI call for
+        SLO sizing, then per-replica re-analysis per feasible candidate
+        (native solves are ~0.1 ms, so the host loop is cheap)."""
+        from ..ops import native
+        from ..ops.queueing import MAX_QUEUE_TO_BATCH_RATIO
+
+        if not native.available():
+            raise RuntimeError(
+                "native queueing kernel unavailable (no g++/.so); "
+                "use backend='batched' or 'scalar'"
+            )
+        pairs = self._candidate_pairs()
+        if not pairs:
+            return
+
+        n_eff = [
+            effective_batch_size(profile, server.max_batch_size,
+                                 server.load.avg_out_tokens)
+            for server, _acc, profile, _t in pairs
+        ]
+        out, feasible = native.size_batch_native(
+            [p.alpha for _s, _a, p, _t in pairs],
+            [p.beta for _s, _a, p, _t in pairs],
+            [p.gamma for _s, _a, p, _t in pairs],
+            [p.delta for _s, _a, p, _t in pairs],
+            [s.load.avg_in_tokens for s, _a, _p, _t in pairs],
+            [s.load.avg_out_tokens for s, _a, _p, _t in pairs],
+            n_eff,
+            [(1 + MAX_QUEUE_TO_BATCH_RATIO) * n for n in n_eff],
+            [t.slo_ttft for _s, _a, _p, t in pairs],
+            [t.slo_itl for _s, _a, _p, t in pairs],
+            [t.slo_tps for _s, _a, _p, t in pairs],
+        )
+        rate_star = out[:, 3]  # throughput (req/sec) at the binding rate
+
+        from ..ops.analyzer import QueueConfig, RequestSize, ServiceParms
+
+        for i, (server, acc_name, profile, target) in enumerate(pairs):
+            if not feasible[i] or rate_star[i] <= 0:
+                continue
+            total = replica_demand(
+                server.load.arrival_rate, target.slo_tps, server.load.avg_out_tokens
+            )
+            replicas = max(math.ceil(total / rate_star[i]), server.min_num_replicas)
+            if replicas <= 0:
+                continue
+            analyzer = native.NativeQueueAnalyzer(
+                QueueConfig(
+                    max_batch_size=n_eff[i],
+                    max_queue_size=MAX_QUEUE_TO_BATCH_RATIO * n_eff[i],
+                    parms=ServiceParms(profile.alpha, profile.beta,
+                                       profile.gamma, profile.delta),
+                ),
+                RequestSize(server.load.avg_in_tokens, server.load.avg_out_tokens),
+            )
+            try:
+                m = analyzer.analyze(total / replicas)
+            except ValueError:
+                continue
+            acc = self.accelerators[acc_name]
+            model = self.models[server.model_name]
+            alloc = Allocation(
+                accelerator=acc_name,
+                num_replicas=replicas,
+                batch_size=n_eff[i],
+                cost=acc.cost * model.num_instances(acc_name) * replicas,
+                itl=m.avg_token_time,
+                ttft=m.avg_wait_time + m.avg_prefill_time,
+                rho=m.rho,
+                max_arrv_rate_per_replica=rate_star[i] / 1000.0,
             )
             alloc.value = alloc.cost
             self._value_and_store(server, acc_name, alloc)

@@ -1,0 +1,196 @@
+"""ctypes bindings for the native (C++) queueing kernel.
+
+The shared library (native/wva_queueing.cpp) mirrors the scalar analyzer's
+semantics exactly; this module compiles it on demand (g++, cached next to
+the source) and exposes `NativeQueueAnalyzer` with the same analyze/size
+surface as `ops.analyzer.QueueAnalyzer`. Falls back cleanly: `available()`
+is False when no compiler/library is present, and callers keep using the
+Python kernels. Used as the fast host path for CPU-only controller
+deployments, where per-candidate JAX dispatch overhead would dominate the
+microsecond-scale solve.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from .analyzer import (
+    AnalysisMetrics,
+    InfeasibleTargetError,
+    QueueConfig,
+    RequestSize,
+    SizeResult,
+    TargetPerf,
+)
+
+_SOURCE = Path(__file__).resolve().parent.parent.parent / "native" / "wva_queueing.cpp"
+_LIB_ENV = "WVA_NATIVE_LIB"  # pre-built .so override
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build(source: Path) -> Optional[Path]:
+    out = source.with_name("_libwvaq.so")
+    if out.exists() and out.stat().st_mtime >= source.stat().st_mtime:
+        return out
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             "-o", str(out), str(source)],
+            check=True, capture_output=True, timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return out
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        path: Optional[Path] = None
+        env = os.environ.get(_LIB_ENV)
+        if env and Path(env).exists():
+            path = Path(env)
+        elif _SOURCE.exists():
+            path = _build(_SOURCE)
+        if path is None:
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            _load_failed = True
+            return None
+
+        D, I = ctypes.c_double, ctypes.c_int32
+        PD = ctypes.POINTER(ctypes.c_double)
+        PI = ctypes.POINTER(ctypes.c_int32)
+        lib.wva_analyze.restype = ctypes.c_int
+        lib.wva_analyze.argtypes = [D, D, D, D, I, I, I, I, D, PD]
+        lib.wva_size.restype = ctypes.c_int
+        lib.wva_size.argtypes = [D, D, D, D, I, I, I, I, D, D, D, PD]
+        lib.wva_size_batch.restype = None
+        lib.wva_size_batch.argtypes = [PD, PD, PD, PD, PI, PI, PI, PI,
+                                       PD, PD, PD, I, PD, PI]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _metrics_from(buf, offset: int = 0) -> AnalysisMetrics:
+    return AnalysisMetrics(
+        throughput=buf[offset + 0],
+        avg_resp_time=buf[offset + 1],
+        avg_wait_time=buf[offset + 2],
+        avg_num_in_serv=buf[offset + 3],
+        avg_prefill_time=buf[offset + 4],
+        avg_token_time=buf[offset + 5],
+        max_rate=buf[offset + 6],
+        rho=buf[offset + 7],
+    )
+
+
+class NativeQueueAnalyzer:
+    """Drop-in analyze/size on the native kernel (same dataclasses as
+    ops.analyzer.QueueAnalyzer)."""
+
+    def __init__(self, config: QueueConfig, size: RequestSize):
+        config.validate()
+        size.validate()
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native queueing kernel unavailable")
+        self._lib = lib
+        self.config = config
+        self.request_size = size
+        self.occupancy = config.max_queue_size + config.max_batch_size
+
+    def _args(self):
+        p = self.config.parms
+        return (p.alpha, p.beta, p.gamma, p.delta,
+                self.request_size.avg_input_tokens,
+                self.request_size.avg_output_tokens,
+                self.config.max_batch_size, self.occupancy)
+
+    def analyze(self, request_rate: float) -> AnalysisMetrics:
+        buf = (ctypes.c_double * 8)()
+        rc = self._lib.wva_analyze(*self._args(), request_rate, buf)
+        if rc == -2:
+            raise ValueError(f"rate={request_rate} above max allowed rate")
+        if rc != 0:
+            raise ValueError(f"invalid analyze input (rc={rc})")
+        return _metrics_from(buf)
+
+    def size(self, target: TargetPerf) -> SizeResult:
+        target.validate()
+        buf = (ctypes.c_double * 11)()
+        rc = self._lib.wva_size(*self._args(), target.ttft, target.itl,
+                                target.tps, buf)
+        if rc == 1:
+            raise InfeasibleTargetError(
+                f"TTFT target {target.ttft} below bounded region")
+        if rc == 2:
+            raise InfeasibleTargetError(
+                f"ITL target {target.itl} below bounded region")
+        if rc != 0:
+            raise ValueError(f"invalid size input (rc={rc})")
+        metrics = _metrics_from(buf, offset=3)
+        achieved = TargetPerf(
+            ttft=metrics.avg_wait_time + metrics.avg_prefill_time,
+            itl=metrics.avg_token_time,
+            tps=metrics.throughput * self.request_size.avg_output_tokens,
+        )
+        return SizeResult(rate_ttft=buf[0], rate_itl=buf[1], rate_tps=buf[2],
+                          metrics=metrics, achieved=achieved)
+
+
+def size_batch_native(alpha, beta, gamma, delta, in_tokens, out_tokens,
+                      max_batch, occupancy, ttft, itl, tps):
+    """Vectorized sizing over n candidates via one FFI call. Returns
+    (out[n, 11], feasible[n]) — out rows are [rate_ttft, rate_itl,
+    rate_tps, 8 metric slots]."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native queueing kernel unavailable")
+
+    def as_f64(a):
+        return np.ascontiguousarray(a, dtype=np.float64)
+
+    def as_i32(a):
+        return np.ascontiguousarray(a, dtype=np.int32)
+
+    alpha, beta, gamma, delta = map(as_f64, (alpha, beta, gamma, delta))
+    ttft, itl, tps = map(as_f64, (ttft, itl, tps))
+    in_tokens, out_tokens, max_batch, occupancy = map(
+        as_i32, (in_tokens, out_tokens, max_batch, occupancy))
+    n = alpha.shape[0]
+    out = np.zeros((n, 11), dtype=np.float64)
+    feasible = np.zeros(n, dtype=np.int32)
+
+    PD = ctypes.POINTER(ctypes.c_double)
+    PI = ctypes.POINTER(ctypes.c_int32)
+    lib.wva_size_batch(
+        alpha.ctypes.data_as(PD), beta.ctypes.data_as(PD),
+        gamma.ctypes.data_as(PD), delta.ctypes.data_as(PD),
+        in_tokens.ctypes.data_as(PI), out_tokens.ctypes.data_as(PI),
+        max_batch.ctypes.data_as(PI), occupancy.ctypes.data_as(PI),
+        ttft.ctypes.data_as(PD), itl.ctypes.data_as(PD),
+        tps.ctypes.data_as(PD), n,
+        out.ctypes.data_as(PD), feasible.ctypes.data_as(PI),
+    )
+    return out, feasible.astype(bool)
