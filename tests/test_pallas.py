@@ -1,0 +1,76 @@
+"""Pallas bisection kernel equivalence with the XLA fori_loop path.
+
+Runs in interpret mode on the CPU test mesh; on TPU the same kernel
+compiles for real (exercised by bench.py's optional pallas comparison).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from workload_variant_autoscaler_tpu.ops.batched import (
+    SLOTargets,
+    k_max_for,
+    make_queue_batch,
+    size_batch,
+)
+from workload_variant_autoscaler_tpu.ops.pallas_kernel import size_batch_pallas
+
+
+def example_batch(b, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = make_queue_batch(
+        rng.uniform(2.0, 20.0, b), rng.uniform(0.005, 0.15, b),
+        rng.uniform(1.0, 15.0, b), rng.uniform(0.02, 0.3, b),
+        rng.choice([0.0, 128.0, 1024.0], b), rng.choice([32.0, 128.0, 256.0], b),
+        rng.choice([4, 48, 64, 96], b), dtype=dtype,
+    )
+    d = q.alpha.dtype
+    targets = SLOTargets(
+        ttft=jnp.asarray(rng.choice([0.0, 500.0, 2000.0], b), d),
+        itl=jnp.asarray(rng.choice([0.0, 24.0, 200.0], b), d),
+        tps=jnp.asarray(rng.choice([0.0, 900.0], b), d),
+    )
+    return q, targets, k_max_for(np.asarray(q.max_batch))
+
+
+class TestPallasEquivalence:
+    @pytest.mark.parametrize("b", [1, 8, 37, 128])
+    @pytest.mark.parametrize("dtype,rtol", [
+        # f64: both paths walk identical bisection trajectories -> tight.
+        (jnp.float64, 1e-9),
+        # f32: the kernel's masked-sum reductions order float additions
+        # differently from the cumsum formulation; near the freeze
+        # tolerance the search can stop one step apart -> loose.
+        (jnp.float32, 1e-3),
+    ])
+    def test_matches_fori_loop_path(self, b, dtype, rtol):
+        q, targets, k_max = example_batch(b, seed=b, dtype=dtype)
+        a = size_batch(q, targets, k_max)
+        p = size_batch_pallas(q, targets, k_max, interpret=True)
+        np.testing.assert_array_equal(np.asarray(a.feasible), np.asarray(p.feasible))
+        for field in ("lam_ttft", "lam_itl", "lam_star", "throughput",
+                      "token_time", "rho"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(a, field)), np.asarray(getattr(p, field)),
+                rtol=rtol, atol=1e-9, err_msg=field,
+            )
+
+    def test_infeasible_and_disabled_targets(self):
+        # ITL below the decode floor -> infeasible; all-zero targets -> lam_max
+        q = make_queue_batch(
+            [18.0, 6.973], [0.12, 0.027], [14.0, 5.2], [0.3, 0.1],
+            [1024.0, 128.0], [256.0, 128.0], [48, 64], dtype=jnp.float32,
+        )
+        d = q.alpha.dtype
+        targets = SLOTargets(ttft=jnp.zeros(2, d),
+                             itl=jnp.asarray([15.0, 0.0], d),
+                             tps=jnp.zeros(2, d))
+        k_max = k_max_for([48, 64])
+        a = size_batch(q, targets, k_max)
+        p = size_batch_pallas(q, targets, k_max, interpret=True)
+        assert not bool(p.feasible[0]) and bool(p.feasible[1])
+        np.testing.assert_array_equal(np.asarray(a.feasible), np.asarray(p.feasible))
+        np.testing.assert_allclose(np.asarray(a.lam_star), np.asarray(p.lam_star),
+                                   rtol=1e-6)

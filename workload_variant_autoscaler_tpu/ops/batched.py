@@ -169,15 +169,27 @@ def _rate_range(q: QueueBatch) -> tuple[jax.Array, jax.Array]:
     return r1 * EPSILON, rN * (1.0 - EPSILON)
 
 
-def _solve(q: QueueBatch, mu: jax.Array, lam: jax.Array, k_max: int) -> BatchStats:
+def _cum_log_mu(mu: jax.Array) -> jax.Array:
+    """Prefix sums of log service rates — the only O(K)-sequential piece of
+    the solve. It does not depend on the arrival rate, so callers hoist it
+    out of the bisection loop (each trip then costs only elementwise ops +
+    reductions)."""
+    return jnp.cumsum(jnp.log(mu), axis=1)
+
+
+def _solve(q: QueueBatch, clm: jax.Array, lam: jax.Array, k_max: int) -> BatchStats:
     """Log-space steady-state solve + statistics for all queues at rates
-    lam [B] (reference mm1modelstatedependent.go:38-116, batched)."""
-    dtype = mu.dtype
+    lam [B] (reference mm1modelstatedependent.go:38-116, batched).
+
+    clm is _cum_log_mu(mu): logp[n] = n*log(lam) - clm[n-1] replaces the
+    per-call cumsum of log(lam/mu)."""
+    dtype = clm.dtype
     lam = lam.astype(dtype)
     safe_lam = jnp.maximum(lam, jnp.finfo(dtype).tiny)
-    log_ratio = jnp.log(safe_lam)[:, None] - jnp.log(mu)          # [B, K_max]
+    n_tail = jnp.arange(1, k_max + 1, dtype=dtype)
+    logp_tail = jnp.log(safe_lam)[:, None] * n_tail[None, :] - clm  # [B, K_max]
     logp = jnp.concatenate(
-        [jnp.zeros((q.batch_size, 1), dtype), jnp.cumsum(log_ratio, axis=1)], axis=1
+        [jnp.zeros((q.batch_size, 1), dtype), logp_tail], axis=1
     )                                                             # [B, K_max+1]
     states = jnp.arange(k_max + 1)
     in_range = states[None, :] <= q.occupancy[:, None]
@@ -225,10 +237,10 @@ def _effective_concurrency(q: QueueBatch, avg_serv_time: jax.Array) -> jax.Array
     return jnp.clip(conc, 0.0, nN)
 
 
-def _ttft_itl(q: QueueBatch, mu: jax.Array, lam: jax.Array, k_max: int):
+def _ttft_itl(q: QueueBatch, clm: jax.Array, lam: jax.Array, k_max: int):
     """(TTFT, ITL, stats) at rates lam — shared solve for both evals
-    (reference queueanalyzer.go:270-290)."""
-    stats = _solve(q, mu, lam, k_max)
+    (reference queueanalyzer.go:270-290). clm = _cum_log_mu(mu)."""
+    stats = _solve(q, clm, lam, k_max)
     conc = _effective_concurrency(q, stats.avg_serv_time)
     ttft = stats.avg_wait_time + _prefill(q, conc)
     itl = _decode(q, conc)
@@ -241,22 +253,45 @@ def _within_tol(y: jax.Array, target: jax.Array) -> jax.Array:
     )
 
 
-@partial(jax.jit, static_argnames=("k_max",))
-def size_batch(q: QueueBatch, targets: SLOTargets, k_max: int) -> SizingResult:
-    """SLO-size all queues at once (reference queueanalyzer.go:185-255).
+def bisection_trips(dtype) -> int:
+    """Trip count for the vectorised bisection: the reference's 100
+    iterations for float64; in float32 the [lo, hi] interval collapses to
+    adjacent representable values within ~48 halvings (24 mantissa bits +
+    range headroom), after which mid is constant — extra trips cannot
+    change x_star, so skipping them is exact, not an approximation."""
+    return MAX_ITERATIONS if dtype == jnp.float64 else 48
 
-    Returns per-queue max stable rates for each enabled target, the binding
-    rate, feasibility, and metrics at the binding rate. The TTFT and ITL
-    bisections run fused: each trip evaluates one solve of shape
-    [2B, K_max+1] (TTFT lanes stacked on ITL lanes).
-    """
+
+class SizingProblem(NamedTuple):
+    """The stacked TTFT/ITL bisection problem shared by the fori_loop and
+    Pallas backends: boundary outcomes resolved, loop state initialised.
+    Lanes 0..B-1 are the TTFT searches, B..2B-1 the ITL searches."""
+
+    clm: jax.Array        # [B, K_max] prefix log service rates
+    q2: "QueueBatch"      # stacked [2B] queue params
+    clm2: jax.Array       # [2B, K_max]
+    is_ttft: jax.Array    # [2B] bool
+    y_targets: jax.Array  # [2B]
+    enabled: jax.Array    # [2B] bool
+    increasing: jax.Array # [2B] bool: y grows with lam
+    below: jax.Array      # [2B] bool: target below region -> infeasible
+    lo0: jax.Array        # [2B]
+    hi0: jax.Array        # [2B]
+    x0: jax.Array         # [2B]
+    done0: jax.Array      # [2B] bool
+    lam_max: jax.Array    # [B]
+
+
+def _sizing_problem(q: QueueBatch, targets: SLOTargets, k_max: int) -> SizingProblem:
+    """Prologue: stack TTFT lanes on ITL lanes and resolve the boundary/
+    region outcomes (reference utils.go:38-51): converged at a boundary ->
+    that boundary; below region -> infeasible; above -> hi."""
     dtype = q.alpha.dtype
-    mu = _transition_rates(q, k_max)
+    clm = _cum_log_mu(_transition_rates(q, k_max))
     lam_min, lam_max = _rate_range(q)
 
-    # Stack TTFT lanes and ITL lanes into one bisection problem.
     q2 = jax.tree.map(lambda a: jnp.concatenate([a, a], axis=0), q)
-    mu2 = jnp.concatenate([mu, mu], axis=0)
+    clm2 = jnp.concatenate([clm, clm], axis=0)
     is_ttft = jnp.concatenate(
         [jnp.ones(q.batch_size, bool), jnp.zeros(q.batch_size, bool)]
     )
@@ -266,7 +301,7 @@ def size_batch(q: QueueBatch, targets: SLOTargets, k_max: int) -> SizingResult:
     hi0 = jnp.concatenate([lam_max, lam_max])
 
     def eval_y(lam2):
-        ttft, itl, _, _ = _ttft_itl(q2, mu2, lam2, k_max)
+        ttft, itl, _, _ = _ttft_itl(q2, clm2, lam2, k_max)
         return jnp.where(is_ttft, ttft, itl)
 
     y_lo = eval_y(lo0)
@@ -276,39 +311,38 @@ def size_batch(q: QueueBatch, targets: SLOTargets, k_max: int) -> SizingResult:
     increasing = y_lo < y_hi
     below = jnp.where(increasing, y_targets < y_lo, y_targets > y_lo) & ~conv_lo & ~conv_hi
     above = jnp.where(increasing, y_targets > y_hi, y_targets < y_hi) & ~conv_lo & ~conv_hi
-
-    # Boundary/region outcomes (reference utils.go:38-51): converged at a
-    # boundary -> that boundary; below region -> infeasible; above -> hi.
     done0 = conv_lo | conv_hi | below | above
     x0 = jnp.where(conv_lo | below, lo0, hi0)
+    return SizingProblem(
+        clm=clm, q2=q2, clm2=clm2, is_ttft=is_ttft, y_targets=y_targets,
+        enabled=enabled, increasing=increasing, below=below,
+        lo0=lo0, hi0=hi0, x0=x0, done0=done0, lam_max=lam_max,
+    )
 
-    def body(_, carry):
-        lo, hi, x_star, done = carry
-        mid = 0.5 * (lo + hi)
-        y = eval_y(mid)
-        conv = _within_tol(y, y_targets)
-        go_down = jnp.where(increasing, y_targets < y, y_targets > y)
-        new_lo = jnp.where(done | go_down, lo, mid)
-        new_hi = jnp.where(done | ~go_down, hi, mid)
-        new_x = jnp.where(done, x_star, mid)
-        return new_lo, new_hi, new_x, done | conv
 
-    _, _, x_star, _ = jax.lax.fori_loop(0, MAX_ITERATIONS, body, (lo0, hi0, x0, done0))
-
-    lam_star2 = jnp.where(enabled, x_star, jnp.concatenate([lam_max, lam_max]))
-    infeasible2 = enabled & below
-    lam_ttft = lam_star2[: q.batch_size]
-    lam_itl = lam_star2[q.batch_size:]
-    infeasible = infeasible2[: q.batch_size] | infeasible2[q.batch_size:]
+def _sizing_result(
+    q: QueueBatch, targets: SLOTargets, prob: SizingProblem,
+    x_star2: jax.Array, k_max: int,
+) -> SizingResult:
+    """Epilogue shared by both backends: unstack the searches, apply the
+    TPS stability margin, and run the final analysis at the binding rate
+    (reference queueanalyzer.go:236-254)."""
+    dtype = q.alpha.dtype
+    b = q.batch_size
+    lam_max = prob.lam_max
+    lam_star2 = jnp.where(prob.enabled, x_star2,
+                          jnp.concatenate([lam_max, lam_max]))
+    infeasible2 = prob.enabled & prob.below
+    lam_ttft = lam_star2[:b]
+    lam_itl = lam_star2[b:]
+    infeasible = infeasible2[:b] | infeasible2[b:]
 
     lam_tps = jnp.where(
         targets.tps > 0, lam_max * (1.0 - STABILITY_SAFETY_FRACTION), lam_max
     )
-
     lam_star = jnp.minimum(jnp.minimum(lam_ttft, lam_itl), lam_tps)
 
-    # Final analysis at the binding rate (reference queueanalyzer.go:236-254).
-    ttft_f, itl_f, stats, conc = _ttft_itl(q, mu, lam_star, k_max)
+    ttft_f, itl_f, stats, conc = _ttft_itl(q, prob.clm, lam_star, k_max)
     pre_f = _prefill(q, conc)
     rho = jnp.clip(stats.avg_num_in_servers / q.max_batch.astype(dtype), 0.0, 1.0)
 
@@ -330,6 +364,39 @@ def size_batch(q: QueueBatch, targets: SLOTargets, k_max: int) -> SizingResult:
 
 
 @partial(jax.jit, static_argnames=("k_max",))
+def size_batch(q: QueueBatch, targets: SLOTargets, k_max: int) -> SizingResult:
+    """SLO-size all queues at once (reference queueanalyzer.go:185-255).
+
+    Returns per-queue max stable rates for each enabled target, the binding
+    rate, feasibility, and metrics at the binding rate. The TTFT and ITL
+    bisections run fused: each trip evaluates one solve of shape
+    [2B, K_max+1] (TTFT lanes stacked on ITL lanes).
+    """
+    prob = _sizing_problem(q, targets, k_max)
+
+    def eval_y(lam2):
+        ttft, itl, _, _ = _ttft_itl(prob.q2, prob.clm2, lam2, k_max)
+        return jnp.where(prob.is_ttft, ttft, itl)
+
+    def body(_, carry):
+        lo, hi, x_star, done = carry
+        mid = 0.5 * (lo + hi)
+        y = eval_y(mid)
+        conv = _within_tol(y, prob.y_targets)
+        go_down = jnp.where(prob.increasing, prob.y_targets < y, prob.y_targets > y)
+        new_lo = jnp.where(done | go_down, lo, mid)
+        new_hi = jnp.where(done | ~go_down, hi, mid)
+        new_x = jnp.where(done, x_star, mid)
+        return new_lo, new_hi, new_x, done | conv
+
+    _, _, x_star, _ = jax.lax.fori_loop(
+        0, bisection_trips(q.alpha.dtype), body,
+        (prob.lo0, prob.hi0, prob.x0, prob.done0),
+    )
+    return _sizing_result(q, targets, prob, x_star, k_max)
+
+
+@partial(jax.jit, static_argnames=("k_max",))
 def analyze_batch(q: QueueBatch, rates_per_sec: jax.Array, k_max: int):
     """Metrics at given request rates (req/sec) for all queues — the batched
     analogue of QueueAnalyzer.analyze (reference queueanalyzer.go:134-174).
@@ -337,10 +404,10 @@ def analyze_batch(q: QueueBatch, rates_per_sec: jax.Array, k_max: int):
     Returns a dict of [B] arrays; `valid_rate` flags rates inside (0, max].
     """
     dtype = q.alpha.dtype
-    mu = _transition_rates(q, k_max)
+    clm = _cum_log_mu(_transition_rates(q, k_max))
     _, lam_max = _rate_range(q)
     lam = jnp.asarray(rates_per_sec, dtype) / 1000.0
-    ttft, itl, stats, conc = _ttft_itl(q, mu, lam, k_max)
+    ttft, itl, stats, conc = _ttft_itl(q, clm, lam, k_max)
     rho = jnp.clip(stats.avg_num_in_servers / q.max_batch.astype(dtype), 0.0, 1.0)
     return {
         "throughput": stats.throughput * 1000.0,
