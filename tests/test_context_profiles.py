@@ -1,0 +1,230 @@
+"""Long-context support: context-bucketed profiles.
+
+Long context is a profile *dimension* (SURVEY.md section 5): per-slice
+alpha/beta/gamma/delta anchors at several average prompt lengths, with the
+engine interpolating at the observed load. Covers the resolver math, the
+engine paths (scalar + batched agreement), the CRD roundtrip, and the
+reconciler end-to-end.
+"""
+
+import pytest
+from helpers import SERVICE_CLASSES, SLICES, server_spec
+
+from workload_variant_autoscaler_tpu.controller import crd
+from workload_variant_autoscaler_tpu.models import (
+    ContextBucket,
+    ModelSliceProfile,
+    OptimizerSpec,
+    System,
+    SystemSpec,
+    resolve_for_context,
+)
+
+BASE = ModelSliceProfile(
+    model="llama-8b", accelerator="v5e-1",
+    alpha=6.973, beta=0.027, gamma=5.2, delta=0.1,
+    max_batch_size=64, at_tokens=128,
+)
+
+# KV growth: at 8k prompt tokens, decode slows and batch capacity shrinks
+BUCKETED = ModelSliceProfile(
+    model="llama-8b", accelerator="v5e-1",
+    alpha=6.973, beta=0.027, gamma=5.2, delta=0.1,
+    max_batch_size=64,
+    context_buckets=(
+        ContextBucket(context_tokens=128, alpha=6.973, beta=0.027,
+                      gamma=5.2, delta=0.1, max_batch_size=64),
+        # slower decode (KV reads), much lower per-token prefill slope
+        # (chunked prefill amortizes), smaller batch bound (KV memory)
+        ContextBucket(context_tokens=8192, alpha=9.5, beta=0.08,
+                      gamma=6.0, delta=0.012, max_batch_size=16),
+    ),
+)
+
+
+class TestResolver:
+    def test_no_buckets_is_identity(self):
+        assert resolve_for_context(BASE, 4096) is BASE
+
+    def test_clamps_below_first_anchor(self):
+        p = resolve_for_context(BUCKETED, 10)
+        assert p.alpha == 6.973 and p.max_batch_size == 64
+        assert p.context_buckets == () and p.at_tokens == 0
+
+    def test_clamps_above_last_anchor(self):
+        p = resolve_for_context(BUCKETED, 32768)
+        assert p.alpha == 9.5 and p.beta == 0.08 and p.max_batch_size == 16
+
+    def test_midpoint_interpolation(self):
+        mid = (128 + 8192) / 2
+        p = resolve_for_context(BUCKETED, mid)
+        assert p.alpha == pytest.approx((6.973 + 9.5) / 2)
+        assert p.beta == pytest.approx((0.027 + 0.08) / 2)
+        assert p.delta == pytest.approx((0.1 + 0.012) / 2)
+        # batch bound comes from the anchor at-or-above (conservative)
+        assert p.max_batch_size == 16
+
+    def test_bucket_zero_batch_inherits_base(self):
+        prof = ModelSliceProfile(
+            model="m", accelerator="a", alpha=1.0, beta=0.1, gamma=1.0,
+            delta=0.01, max_batch_size=32,
+            context_buckets=(
+                ContextBucket(context_tokens=100, alpha=1.0, beta=0.1,
+                              gamma=1.0, delta=0.01),
+            ),
+        )
+        assert resolve_for_context(prof, 50).max_batch_size == 32
+
+    def test_unsorted_buckets_are_sorted(self):
+        prof = ModelSliceProfile(
+            model="m", accelerator="a", alpha=0, beta=0, gamma=0, delta=0,
+            max_batch_size=8,
+            context_buckets=(
+                ContextBucket(context_tokens=1000, alpha=2.0, beta=0.2,
+                              gamma=2.0, delta=0.02),
+                ContextBucket(context_tokens=100, alpha=1.0, beta=0.1,
+                              gamma=1.0, delta=0.01),
+            ),
+        )
+        assert resolve_for_context(prof, 100).alpha == 1.0
+        assert resolve_for_context(prof, 1000).alpha == 2.0
+
+
+def make_bucketed_system(in_tokens, backend="batched"):
+    spec = SystemSpec(
+        accelerators=list(SLICES), profiles=[BUCKETED],
+        service_classes=list(SERVICE_CLASSES),
+        servers=[server_spec(arrival_rpm=600.0, in_tokens=in_tokens,
+                             out_tokens=128, keep_accelerator=True)],
+        capacity={}, optimizer=OptimizerSpec(unlimited=True),
+    )
+    system = System()
+    system.set_from_spec(spec)
+    system.calculate(backend=backend)
+    return system
+
+
+def candidate(system):
+    return system.servers["var-8b:default"].all_allocations.get("v5e-1")
+
+
+class TestEngine:
+    def test_long_context_needs_more_replicas(self):
+        short = candidate(make_bucketed_system(128))
+        long = candidate(make_bucketed_system(8192))
+        assert short is not None and long is not None
+        # same arrival rate, but at 8k context the slower profile + smaller
+        # batch bound force more replicas and a higher per-replica ITL
+        assert long.num_replicas > short.num_replicas
+        assert long.batch_size == 16 and short.batch_size == 64
+
+    @pytest.mark.parametrize("in_tokens", [128, 2048, 8192])
+    def test_scalar_and_batched_agree(self, in_tokens):
+        a = candidate(make_bucketed_system(in_tokens, "scalar"))
+        b = candidate(make_bucketed_system(in_tokens, "batched"))
+        assert a is not None and b is not None
+        assert a.num_replicas == b.num_replicas
+        assert a.batch_size == b.batch_size
+        assert a.cost == pytest.approx(b.cost)
+
+
+class TestReconciler:
+    def _cluster(self):
+        from test_scenarios import make_fleet_cluster
+
+        variants = [("chat-8b", "llama-8b", "v5e-1", "premium", [], 1)]
+        kube, prom, emitter, rec = make_fleet_cluster(variants)
+        va = kube.get_variant_autoscaling("chat-8b", "default")
+        va.spec.model_profile.accelerators = [
+            crd.AcceleratorProfile(
+                acc="v5e-1", acc_count=1, max_batch_size=64,
+                perf_parms=crd.PerfParms(
+                    decode_parms={"alpha": "6.973", "beta": "0.027"},
+                    prefill_parms={"gamma": "5.2", "delta": "0.1"},
+                ),
+                context_profiles=[
+                    crd.ContextProfile(
+                        at_context=128, max_batch_size=64,
+                        perf_parms=crd.PerfParms(
+                            decode_parms={"alpha": "6.973", "beta": "0.027"},
+                            prefill_parms={"gamma": "5.2", "delta": "0.1"},
+                        ),
+                    ),
+                    crd.ContextProfile(
+                        at_context=8192, max_batch_size=16,
+                        perf_parms=crd.PerfParms(
+                            decode_parms={"alpha": "9.5", "beta": "0.08"},
+                            prefill_parms={"gamma": "6.0", "delta": "0.012"},
+                        ),
+                    ),
+                ],
+            ),
+        ]
+        kube.put_variant_autoscaling(va)
+        return kube, prom, emitter, rec
+
+    def test_long_prompts_scale_out_harder(self):
+        from test_scenarios import set_load
+
+        kube, prom, _e, rec = self._cluster()
+        set_load(prom, "llama-8b", 10.0, 128.0, 128.0)
+        rec.reconcile()
+        short_desired = kube.get_variant_autoscaling(
+            "chat-8b", "default").status.desired_optimized_alloc.num_replicas
+
+        set_load(prom, "llama-8b", 10.0, 8192.0, 128.0, ttft_s=0.3, itl_s=0.011)
+        rec.reconcile()
+        long_desired = kube.get_variant_autoscaling(
+            "chat-8b", "default").status.desired_optimized_alloc.num_replicas
+
+        assert short_desired >= 1
+        assert long_desired > short_desired
+
+
+class TestCRDRoundtrip:
+    def test_context_profiles_survive_serialization(self):
+        va = crd.VariantAutoscaling(
+            metadata=crd.ObjectMeta(name="v", namespace="ns"),
+            spec=crd.VariantAutoscalingSpec(
+                model_id="llama-8b",
+                slo_class_ref=crd.ConfigMapKeyRef(name="sc", key="premium"),
+                model_profile=crd.ModelProfile(accelerators=[
+                    crd.AcceleratorProfile(
+                        acc="v5e-1", acc_count=1, max_batch_size=64,
+                        perf_parms=crd.PerfParms(
+                            decode_parms={"alpha": "6.973", "beta": "0.027"},
+                            prefill_parms={"gamma": "5.2", "delta": "0.1"},
+                        ),
+                        context_profiles=[
+                            crd.ContextProfile(
+                                at_context=8192, max_batch_size=16,
+                                perf_parms=crd.PerfParms(
+                                    decode_parms={"alpha": "9.5", "beta": "0.08"},
+                                    prefill_parms={"gamma": "6.0", "delta": "0.012"},
+                                ),
+                            ),
+                        ],
+                    ),
+                ]),
+            ),
+        )
+        back = crd.va_from_dict(crd.va_to_dict(va))
+        cps = back.spec.model_profile.accelerators[0].context_profiles
+        assert len(cps) == 1
+        assert cps[0].at_context == 8192
+        assert cps[0].max_batch_size == 16
+        assert cps[0].perf_parms.decode_parms["alpha"] == "9.5"
+
+    def test_no_context_profiles_omitted_from_dict(self):
+        va = crd.VariantAutoscaling(
+            metadata=crd.ObjectMeta(name="v", namespace="ns"),
+            spec=crd.VariantAutoscalingSpec(
+                model_id="m",
+                slo_class_ref=crd.ConfigMapKeyRef(name="sc", key="k"),
+                model_profile=crd.ModelProfile(accelerators=[
+                    crd.AcceleratorProfile(acc="v5e-1"),
+                ]),
+            ),
+        )
+        d = crd.va_to_dict(va)
+        assert "contextProfiles" not in d["spec"]["modelProfile"]["accelerators"][0]

@@ -152,6 +152,12 @@ class TestReconcileHappyPath:
         va = kube.get_variant_autoscaling(VARIANT, NS)
         assert va.is_controlled_by(f"uid-{NS}-{VARIANT}")
 
+    def test_solution_time_gauge_emitted(self):
+        _kube, _prom, emitter, rec = make_cluster()
+        rec.reconcile()
+        t = emitter.value("inferno_solution_time_msec")
+        assert t is not None and t >= 0.0
+
     def test_emitted_ratio(self):
         _kube, _p, emitter, rec = make_cluster(arrival_rps=60.0, replicas=2)
         rec.reconcile()

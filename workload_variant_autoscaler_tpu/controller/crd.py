@@ -89,11 +89,23 @@ class PerfParms:
 
 
 @dataclass
+class ContextProfile:
+    """Perf parameters fitted at one average context length (long-context
+    support: the engine interpolates between these anchors at the observed
+    prompt length)."""
+
+    at_context: int = 0    # avg prompt tokens this anchor was fit at
+    perf_parms: PerfParms = field(default_factory=PerfParms)
+    max_batch_size: int = 0
+
+
+@dataclass
 class AcceleratorProfile:
     acc: str = ""          # slice shape, e.g. v5e-8
     acc_count: int = 1     # slice units per replica
     perf_parms: PerfParms = field(default_factory=PerfParms)
     max_batch_size: int = 0
+    context_profiles: list[ContextProfile] = field(default_factory=list)
 
 
 @dataclass
@@ -258,11 +270,21 @@ def va_to_dict(va: VariantAutoscaling) -> dict[str, Any]:
                     {
                         "acc": ap.acc,
                         "accCount": ap.acc_count,
-                        "perfParms": {
-                            "decodeParms": dict(ap.perf_parms.decode_parms),
-                            "prefillParms": dict(ap.perf_parms.prefill_parms),
-                        },
+                        "perfParms": _perf_parms_to_dict(ap.perf_parms),
                         "maxBatchSize": ap.max_batch_size,
+                        **(
+                            {
+                                "contextProfiles": [
+                                    {
+                                        "atContext": cp.at_context,
+                                        "perfParms": _perf_parms_to_dict(cp.perf_parms),
+                                        "maxBatchSize": cp.max_batch_size,
+                                    }
+                                    for cp in ap.context_profiles
+                                ]
+                            }
+                            if ap.context_profiles else {}
+                        ),
                     }
                     for ap in va.spec.model_profile.accelerators
                 ],
@@ -291,6 +313,20 @@ def va_to_dict(va: VariantAutoscaling) -> dict[str, Any]:
             "conditions": [c.to_dict() for c in va.status.conditions],
         },
     }
+
+
+def _perf_parms_to_dict(pp: PerfParms) -> dict[str, Any]:
+    return {
+        "decodeParms": dict(pp.decode_parms),
+        "prefillParms": dict(pp.prefill_parms),
+    }
+
+
+def _perf_parms_from_dict(d: dict[str, Any]) -> PerfParms:
+    return PerfParms(
+        decode_parms=dict(d.get("decodeParms", {})),
+        prefill_parms=dict(d.get("prefillParms", {})),
+    )
 
 
 def va_from_dict(obj: dict[str, Any]) -> VariantAutoscaling:
@@ -325,15 +361,16 @@ def va_from_dict(obj: dict[str, Any]) -> VariantAutoscaling:
                     AcceleratorProfile(
                         acc=ap.get("acc", ""),
                         acc_count=ap.get("accCount", 1),
-                        perf_parms=PerfParms(
-                            decode_parms=dict(
-                                ap.get("perfParms", {}).get("decodeParms", {})
-                            ),
-                            prefill_parms=dict(
-                                ap.get("perfParms", {}).get("prefillParms", {})
-                            ),
-                        ),
+                        perf_parms=_perf_parms_from_dict(ap.get("perfParms", {})),
                         max_batch_size=ap.get("maxBatchSize", 0),
+                        context_profiles=[
+                            ContextProfile(
+                                at_context=cp.get("atContext", 0),
+                                perf_parms=_perf_parms_from_dict(cp.get("perfParms", {})),
+                                max_batch_size=cp.get("maxBatchSize", 0),
+                            )
+                            for cp in ap.get("contextProfiles", [])
+                        ],
                     )
                     for ap in profile.get("accelerators", [])
                 ],

@@ -129,8 +129,10 @@ class Reconciler:
 
         # optimize
         try:
-            manager = Manager(system, Optimizer(optimizer_spec))
+            optimizer = Optimizer(optimizer_spec)
+            manager = Manager(system, optimizer)
             manager.optimize()
+            self.emitter.emit_solution_time(optimizer.solution_time_msec)
             solution = system.generate_solution()
             if not solution.allocations:
                 raise RuntimeError("no feasible allocations found for any variant")

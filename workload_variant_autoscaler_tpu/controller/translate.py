@@ -19,6 +19,7 @@ import yaml
 
 from ..models import (
     AcceleratorSpec,
+    ContextBucket,
     AllocationData,
     ModelSliceProfile,
     ModelTarget,
@@ -192,19 +193,18 @@ def add_profile_to_system_data(
     """Parse the CR's string-typed alpha/beta/gamma/delta into a
     ModelSliceProfile (reference utils.go:185-234). Raises ValueError on
     missing/invalid parameters."""
-    decode = profile.perf_parms.decode_parms
-    prefill = profile.perf_parms.prefill_parms
-    if len(decode) < 2:
-        raise ValueError("decodeParms must contain alpha and beta")
-    if len(prefill) < 2:
-        raise ValueError("prefillParms must contain gamma and delta")
-    try:
-        alpha = float(decode["alpha"])
-        beta = float(decode["beta"])
-        gamma = float(prefill["gamma"])
-        delta = float(prefill["delta"])
-    except (KeyError, ValueError) as e:
-        raise ValueError(f"bad perf parameters: {e}") from e
+    alpha, beta, gamma, delta = _parse_perf_parms(profile.perf_parms)
+
+    buckets = []
+    for cp in profile.context_profiles:
+        if cp.at_context <= 0:
+            raise ValueError("contextProfiles entries need atContext > 0")
+        c_alpha, c_beta, c_gamma, c_delta = _parse_perf_parms(cp.perf_parms)
+        buckets.append(ContextBucket(
+            context_tokens=cp.at_context,
+            alpha=c_alpha, beta=c_beta, gamma=c_gamma, delta=c_delta,
+            max_batch_size=cp.max_batch_size,
+        ))
 
     spec.profiles.append(
         ModelSliceProfile(
@@ -214,8 +214,23 @@ def add_profile_to_system_data(
             max_batch_size=profile.max_batch_size,
             at_tokens=0,
             slices_per_replica=max(profile.acc_count, 1),
+            context_buckets=tuple(buckets),
         )
     )
+
+
+def _parse_perf_parms(parms: crd.PerfParms) -> tuple[float, float, float, float]:
+    decode = parms.decode_parms
+    prefill = parms.prefill_parms
+    if len(decode) < 2:
+        raise ValueError("decodeParms must contain alpha and beta")
+    if len(prefill) < 2:
+        raise ValueError("prefillParms must contain gamma and delta")
+    try:
+        return (float(decode["alpha"]), float(decode["beta"]),
+                float(prefill["gamma"]), float(prefill["delta"]))
+    except (KeyError, ValueError) as e:
+        raise ValueError(f"bad perf parameters: {e}") from e
 
 
 def scale_to_zero_enabled() -> bool:

@@ -20,6 +20,7 @@ INFERNO_REPLICA_SCALING_TOTAL = "inferno_replica_scaling_total"
 INFERNO_DESIRED_REPLICAS = "inferno_desired_replicas"
 INFERNO_CURRENT_REPLICAS = "inferno_current_replicas"
 INFERNO_DESIRED_RATIO = "inferno_desired_ratio"
+INFERNO_SOLUTION_TIME_MSEC = "inferno_solution_time_msec"
 
 LABEL_VARIANT_NAME = "variant_name"
 LABEL_NAMESPACE = "namespace"
@@ -60,6 +61,17 @@ class MetricsEmitter:
             [LABEL_VARIANT_NAME, LABEL_NAMESPACE, LABEL_ACCELERATOR_TYPE],
             registry=self.registry,
         )
+        # solver wall time (the reference measures SolutionTimeMsec but
+        # never exports it, pkg/solver/optimizer.go:30-38 — here it's a
+        # first-class observability signal)
+        self.solution_time = Gauge(
+            INFERNO_SOLUTION_TIME_MSEC,
+            "Wall-clock time of the last optimization solve",
+            registry=self.registry,
+        )
+
+    def emit_solution_time(self, msec: float) -> None:
+        self.solution_time.set(msec)
 
     def emit_replica_metrics(
         self,

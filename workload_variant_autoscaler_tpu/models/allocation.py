@@ -43,6 +43,7 @@ from .spec import (
     AllocationData,
     ModelSliceProfile,
     ServerLoadSpec,
+    resolve_for_context,
 )
 
 if TYPE_CHECKING:
@@ -133,9 +134,12 @@ def allocation_diff(a: Optional[Allocation], b: Optional[Allocation]) -> Optiona
 def effective_batch_size(profile: ModelSliceProfile, server_max_batch: int, out_tokens: int) -> int:
     """Max batch N: the server override, or the profile's max batch scaled
     by token length (longer requests shrink the usable batch; reference
-    allocation.go:77-86)."""
+    allocation.go:77-86). A profile without an at_tokens anchor (CRD
+    profiles, context-resolved profiles) uses its batch bound verbatim."""
     if server_max_batch > 0:
         return server_max_batch
+    if profile.at_tokens <= 0:
+        return max(profile.max_batch_size, 1)
     return max(profile.max_batch_size * profile.at_tokens // max(out_tokens, 1), 1)
 
 
@@ -161,6 +165,11 @@ def zero_load_allocation(
     profile = model.profile(acc_name) if model else None
     if profile is None:
         return None
+    # resolve at the observed context so the published batch bound and
+    # max rate stay consistent with the sized paths
+    profile = resolve_for_context(
+        profile, server.load.avg_in_tokens if server.load else 0
+    )
 
     if server.min_num_replicas == 0:
         # scale to zero: keep the slice name so the emitted series retains
@@ -209,6 +218,9 @@ def create_allocation(system: "System", server_name: str, acc_name: str) -> Opti
     profile = model.profile(acc_name)
     if profile is None:
         return None
+    # long context is a profile dimension: pick the coefficients fitted at
+    # the observed average prompt length
+    profile = resolve_for_context(profile, load.avg_in_tokens)
     svc = system.service_class(server.service_class_name)
     if svc is None:
         return None

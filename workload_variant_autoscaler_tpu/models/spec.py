@@ -82,6 +82,21 @@ class AcceleratorSpec:
 
 
 @dataclass(frozen=True)
+class ContextBucket:
+    """Profile anchor at one average context length: long context is a
+    profile *dimension*, not a runtime mechanism — KV growth shows up as
+    larger decode/prefill coefficients and a smaller feasible batch at the
+    measured context (SURVEY.md section 5 long-context mapping)."""
+
+    context_tokens: int        # avg prompt length this anchor was fit at
+    alpha: float
+    beta: float
+    gamma: float
+    delta: float
+    max_batch_size: int = 0    # 0: inherit the profile's base bound
+
+
+@dataclass(frozen=True)
 class ModelSliceProfile:
     """Fitted perf of (model x slice shape): decode itl = alpha + beta*b,
     prefill ttft = gamma + delta*tokens*b (msec), plus batch capacity.
@@ -89,6 +104,11 @@ class ModelSliceProfile:
     `slices_per_replica` is the number of slice units one model instance
     occupies (reference accCount, pkg/core/model.go:45-54); for multi-host
     serving a replica may span several slice units.
+
+    `context_buckets`, when non-empty, fully describe the context-length
+    dependence: the engine linearly interpolates alpha/beta/gamma/delta
+    between anchors at the observed average prompt length and takes the
+    batch bound from the anchor at-or-above it (see resolve_for_context).
     """
 
     model: str
@@ -100,6 +120,52 @@ class ModelSliceProfile:
     max_batch_size: int
     at_tokens: int = 0         # token count at which max_batch_size holds
     slices_per_replica: int = 1
+    context_buckets: tuple[ContextBucket, ...] = ()
+
+
+def resolve_for_context(
+    profile: ModelSliceProfile, context_tokens: float
+) -> ModelSliceProfile:
+    """Effective profile at an observed average prompt length.
+
+    Without buckets this is the identity. With buckets: clamp to the
+    anchor range, linearly interpolate the four coefficients between the
+    surrounding anchors, and take the batch bound from the anchor at or
+    above the context (the conservative side: longer context = less KV
+    headroom). The resolved profile carries no further context dependence
+    (buckets dropped, at_tokens cleared so the bucket's batch bound is
+    used verbatim)."""
+    buckets = sorted(profile.context_buckets, key=lambda b: b.context_tokens)
+    if not buckets:
+        return profile
+    c = max(float(context_tokens), 0.0)
+
+    def batch_of(b: ContextBucket) -> int:
+        return b.max_batch_size or profile.max_batch_size
+
+    if c <= buckets[0].context_tokens:
+        lo = hi = buckets[0]
+        w = 0.0
+    elif c >= buckets[-1].context_tokens:
+        lo = hi = buckets[-1]
+        w = 0.0
+    else:
+        for lo, hi in zip(buckets, buckets[1:]):
+            if lo.context_tokens <= c <= hi.context_tokens:
+                break
+        w = (c - lo.context_tokens) / (hi.context_tokens - lo.context_tokens)
+
+    lerp = lambda a, b: a + (b - a) * w
+    return dc_replace(
+        profile,
+        alpha=lerp(lo.alpha, hi.alpha),
+        beta=lerp(lo.beta, hi.beta),
+        gamma=lerp(lo.gamma, hi.gamma),
+        delta=lerp(lo.delta, hi.delta),
+        max_batch_size=batch_of(hi),
+        at_tokens=0,
+        context_buckets=(),
+    )
 
 
 @dataclass(frozen=True)

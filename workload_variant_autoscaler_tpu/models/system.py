@@ -32,6 +32,7 @@ from .spec import (
     ServerSpec,
     ServiceClassSpec,
     SystemSpec,
+    resolve_for_context,
 )
 
 
@@ -166,6 +167,9 @@ class System:
                     if alloc is not None:
                         self._value_and_store(server, acc_name, alloc)
                     continue
+                # context-resolved coefficients (long context is a profile
+                # dimension; see spec.resolve_for_context)
+                profile = resolve_for_context(profile, load.avg_in_tokens)
                 sized_pairs.append((server, acc_name, profile, target))
         return sized_pairs
 
