@@ -19,6 +19,7 @@ from .collector import (
     avg_itl_query,
     avg_prompt_tokens_query,
     avg_ttft_query,
+    collect_inventory_k8s,
     collect_load,
     collect_tpu_utilization,
     validate_metrics_availability,
@@ -39,6 +40,7 @@ __all__ = [
     "avg_itl_query",
     "avg_prompt_tokens_query",
     "avg_ttft_query",
+    "collect_inventory_k8s",
     "collect_load",
     "collect_tpu_utilization",
     "validate_metrics_availability",

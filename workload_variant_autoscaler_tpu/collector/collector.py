@@ -184,6 +184,35 @@ def collect_load(prom: PromAPI, model: str, namespace: str) -> CollectedLoad:
     )
 
 
+# GKE TPU accelerator label values -> chip generation (the TPU analogue of
+# the reference's GPU vendor list, collector.go:31-35; realizes its
+# CollectInventoryK8S stub, collector.go:37-42, for the limited mode).
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+TPU_ACCELERATOR_GENERATIONS = {
+    "tpu-v5-lite-podslice": "v5e",
+    "tpu-v5-lite-device": "v5e",
+    "tpu-v5p-slice": "v5p",
+    "tpu-v6e-slice": "v6e",
+}
+
+
+def collect_inventory_k8s(kube) -> dict[str, int]:
+    """Total TPU chips per generation from node labels + google.com/tpu
+    capacity — the capacity map the greedy (limited-mode) solver allocates
+    against. Nodes without a recognised accelerator label or with zero
+    capacity are skipped."""
+    capacity: dict[str, int] = {}
+    for node in kube.list_nodes():
+        if node.tpu_capacity <= 0:
+            continue
+        accel = node.labels.get(GKE_TPU_ACCELERATOR_LABEL, "")
+        generation = TPU_ACCELERATOR_GENERATIONS.get(accel)
+        if generation is None:
+            continue
+        capacity[generation] = capacity.get(generation, 0) + node.tpu_capacity
+    return capacity
+
+
 def collect_tpu_utilization(prom: PromAPI, namespace: str) -> dict[str, float]:
     """Opportunistic TPU runtime gauges; absent series yield {} (these are
     observability-only, never gating)."""

@@ -65,6 +65,16 @@ class ConfigMap:
     data: dict[str, str] = field(default_factory=dict)
 
 
+@dataclass
+class Node:
+    """Cluster node as the inventory collector sees it: TPU labels +
+    google.com/tpu extended-resource capacity."""
+
+    name: str
+    labels: dict[str, str] = field(default_factory=dict)
+    tpu_capacity: int = 0
+
+
 class KubeClient(Protocol):
     def get_configmap(self, name: str, namespace: str) -> ConfigMap: ...
     def get_deployment(self, name: str, namespace: str) -> Deployment: ...
@@ -76,6 +86,8 @@ class KubeClient(Protocol):
     def get_lease(self, name: str, namespace: str): ...
     def create_lease(self, lease) -> None: ...
     def update_lease(self, lease) -> None: ...
+    # node inventory (limited mode, collector.collect_inventory_k8s)
+    def list_nodes(self) -> list[Node]: ...
 
 
 class InMemoryKube:
@@ -87,6 +99,7 @@ class InMemoryKube:
         self.deployments: dict[tuple[str, str], Deployment] = {}
         self.vas: dict[tuple[str, str], VariantAutoscaling] = {}
         self.leases: dict[tuple[str, str], Any] = {}
+        self.nodes: dict[str, Node] = {}
         # (verb, kind) -> callable raising the injected error; removed after
         # `count` trips when count > 0
         self._faults: dict[tuple[str, str], tuple[Callable[[], None], int]] = {}
@@ -184,6 +197,14 @@ class InMemoryKube:
             stored = self.vas[key]
             stored.metadata.owner_references = [ref]
             va.metadata.owner_references = [ref]
+
+    def put_node(self, node: Node) -> None:
+        self.nodes[node.name] = node
+
+    def list_nodes(self) -> list[Node]:
+        with self._lock:
+            self._trip("list", "Node")
+            return [copy.deepcopy(n) for n in self.nodes.values()]
 
     # -- Leases (leader election) ----------------------------------------
 
@@ -334,6 +355,23 @@ class RestKube:
             body=patch,
             content_type="application/merge-patch+json",
         )
+
+    def list_nodes(self) -> list[Node]:
+        obj = self._request("GET", "/api/v1/nodes")
+        out = []
+        for item in obj.get("items", []):
+            meta = item.get("metadata", {})
+            capacity = item.get("status", {}).get("capacity", {})
+            try:
+                tpus = int(capacity.get("google.com/tpu", "0"))
+            except ValueError:
+                tpus = 0
+            out.append(Node(
+                name=meta.get("name", ""),
+                labels=dict(meta.get("labels", {})),
+                tpu_capacity=tpus,
+            ))
+        return out
 
     # -- Leases (coordination.k8s.io/v1) ---------------------------------
 

@@ -18,9 +18,15 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..actuator import Actuator
-from ..collector import PromAPI, collect_load, validate_metrics_availability
+from ..collector import (
+    PromAPI,
+    collect_inventory_k8s,
+    collect_load,
+    validate_metrics_availability,
+)
 from ..metrics import MetricsEmitter
 from ..models import System
+from ..models.spec import SaturationPolicy
 from ..solver import Manager, Optimizer
 from ..utils import (
     STANDARD_BACKOFF,
@@ -72,12 +78,16 @@ class Reconciler:
 
     # -- config reading (reference controller.go:490-594) ----------------
 
-    def read_optimization_interval(self) -> float:
+    def read_operator_config(self) -> dict[str, str]:
         cm = with_backoff(
             lambda: self.kube.get_configmap(CONFIG_MAP_NAME, self.config_namespace),
             backoff=STANDARD_BACKOFF, sleep=self.sleep,
         )
-        interval = cm.data.get("GLOBAL_OPT_INTERVAL", "")
+        return cm.data
+
+    def read_optimization_interval(self, operator_cm=None) -> float:
+        data = self.read_operator_config() if operator_cm is None else operator_cm
+        interval = data.get("GLOBAL_OPT_INTERVAL", "")
         if not interval:
             return DEFAULT_INTERVAL_SECONDS
         return translate.parse_duration(interval)
@@ -99,7 +109,8 @@ class Reconciler:
     # -- the cycle (reference controller.go:86-202) ----------------------
 
     def reconcile(self) -> ReconcileResult:
-        interval = self.read_optimization_interval()
+        operator_cm = self.read_operator_config()
+        interval = self.read_optimization_interval(operator_cm)
         result = ReconcileResult(requeue_after=interval)
 
         accelerator_cm = self.read_accelerator_config()
@@ -114,7 +125,49 @@ class Reconciler:
             log.info("no active VariantAutoscalings, skipping optimization")
             return result
 
-        system_spec = translate.create_system_data(accelerator_cm, service_class_cm)
+        # limited mode (realizes the reference's dead greedy path +
+        # CollectInventoryK8S stub, collector.go:37-42): allocate against
+        # the cluster's actual per-generation chip inventory
+        limited = operator_cm.get("WVA_LIMITED_MODE", "").lower() == "true"
+        capacity: dict[str, int] = {}
+        if limited:
+            try:
+                capacity = with_backoff(
+                    lambda: collect_inventory_k8s(self.kube),
+                    backoff=STANDARD_BACKOFF, sleep=self.sleep,
+                )
+            except Exception as e:  # noqa: BLE001
+                log.error("node inventory failed; falling back to unlimited",
+                          extra=kv(error=str(e)))
+                limited = False
+            else:
+                if not capacity:
+                    # no recognised TPU nodes: zero pools would starve the
+                    # whole fleet, which is indistinguishable from genuine
+                    # saturation — fail open instead
+                    log.warning(
+                        "limited mode found no TPU inventory (no nodes with "
+                        "google.com/tpu capacity and a known "
+                        "gke-tpu-accelerator label); falling back to unlimited"
+                    )
+                    limited = False
+                else:
+                    log.info("limited mode capacity", extra=kv(**capacity))
+
+        policy = operator_cm.get("WVA_SATURATION_POLICY", "None")
+        if SaturationPolicy.parse(policy).value != policy:
+            log.warning(
+                "unrecognised WVA_SATURATION_POLICY, using None",
+                extra=kv(value=policy,
+                         valid=[p.value for p in SaturationPolicy]),
+            )
+
+        system_spec = translate.create_system_data(
+            accelerator_cm, service_class_cm,
+            capacity=capacity,
+            unlimited=not limited,
+            saturation_policy=policy,
+        )
 
         prepared = self._prepare(active, accelerator_cm, service_class_cm,
                                  system_spec, result)
