@@ -47,6 +47,19 @@ class TestInventory:
         kube.put_node(tpu_node("zero", "tpu-v6e-slice", 0))
         assert collect_inventory_k8s(kube) == {}
 
+    def test_skips_cordoned_and_not_ready_nodes(self):
+        """Chips on unschedulable/NotReady nodes cannot host pods — they
+        must not count as capacity (else limited mode over-commits)."""
+        kube = InMemoryKube()
+        kube.put_node(tpu_node("ok", "tpu-v5-lite-podslice", 4))
+        cordoned = tpu_node("cordoned", "tpu-v5-lite-podslice", 4)
+        cordoned.unschedulable = True
+        kube.put_node(cordoned)
+        down = tpu_node("down", "tpu-v5-lite-podslice", 4)
+        down.ready = False
+        kube.put_node(down)
+        assert collect_inventory_k8s(kube) == {"v5e": 4}
+
 
 def limited_cluster(chips, policy="PriorityExhaustive", variants=None):
     variants = variants or [

@@ -1,0 +1,221 @@
+"""RestKube wire-level tests against a local fake API server.
+
+The in-memory kube covers controller logic; this covers the REST client
+itself — paths, verbs, content types, error mapping (404/409/422), bearer
+auth, and the Lease/Node payload shapes a real API server exchanges.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from workload_variant_autoscaler_tpu.controller import crd
+from workload_variant_autoscaler_tpu.controller.kube import (
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+    RestKube,
+)
+from workload_variant_autoscaler_tpu.controller.runtime import Lease
+
+
+class FakeAPIServer:
+    """Programmable route -> (status, body) map, recording requests."""
+
+    def __init__(self):
+        self.routes: dict[tuple[str, str], tuple[int, dict]] = {}
+        self.requests: list[dict] = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _handle(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length).decode() if length else ""
+                outer.requests.append({
+                    "method": self.command,
+                    "path": self.path,
+                    "headers": dict(self.headers),
+                    "body": json.loads(body) if body else None,
+                })
+                status, payload = outer.routes.get(
+                    (self.command, self.path), (404, {"reason": "NotFound"})
+                )
+                data = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_GET = do_PUT = do_POST = do_PATCH = _handle
+
+            def log_message(self, *a):
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self._server.server_address[1]}"
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    def last(self) -> dict:
+        return self.requests[-1]
+
+
+@pytest.fixture
+def api():
+    server = FakeAPIServer()
+    yield server
+    server.stop()
+
+
+@pytest.fixture
+def kube(api):
+    return RestKube(base_url=api.url, token="tok-123", verify=False)
+
+
+class TestCoreVerbs:
+    def test_get_configmap(self, api, kube):
+        api.routes[("GET", "/api/v1/namespaces/ns/configmaps/cm")] = (
+            200, {"data": {"k": "v"}})
+        cm = kube.get_configmap("cm", "ns")
+        assert cm.data == {"k": "v"}
+        assert api.last()["headers"]["Authorization"] == "Bearer tok-123"
+
+    def test_get_deployment_maps_fields(self, api, kube):
+        api.routes[("GET", "/apis/apps/v1/namespaces/ns/deployments/d")] = (
+            200, {"metadata": {"uid": "u1", "labels": {"a": "b"}},
+                  "spec": {"replicas": 3}, "status": {"replicas": 2}})
+        d = kube.get_deployment("d", "ns")
+        assert d.spec_replicas == 3 and d.status_replicas == 2
+        assert d.uid == "u1" and d.current_replicas() == 2
+
+    def test_list_and_get_variant_autoscaling(self, api, kube):
+        va_obj = {
+            "metadata": {"name": "v", "namespace": "ns", "resourceVersion": "7"},
+            "spec": {"modelID": "m",
+                     "sloClassRef": {"name": "sc", "key": "k"},
+                     "modelProfile": {"accelerators": []}},
+        }
+        api.routes[("GET", "/apis/llmd.ai/v1alpha1/variantautoscalings")] = (
+            200, {"items": [va_obj]})
+        api.routes[
+            ("GET", "/apis/llmd.ai/v1alpha1/namespaces/ns/variantautoscalings/v")
+        ] = (200, va_obj)
+        vas = kube.list_variant_autoscalings()
+        assert len(vas) == 1 and vas[0].spec.model_id == "m"
+        va = kube.get_variant_autoscaling("v", "ns")
+        assert va.metadata.resource_version == "7"
+
+    def test_status_update_put_with_resource_version(self, api, kube):
+        path = "/apis/llmd.ai/v1alpha1/namespaces/ns/variantautoscalings/v/status"
+        api.routes[("PUT", path)] = (200, {})
+        va = crd.VariantAutoscaling(
+            metadata=crd.ObjectMeta(name="v", namespace="ns",
+                                    resource_version="7"))
+        kube.update_variant_autoscaling_status(va)
+        sent = api.last()["body"]
+        assert sent["metadata"]["resourceVersion"] == "7"
+        assert sent["apiVersion"] == "llmd.ai/v1alpha1"
+
+    def test_owner_reference_merge_patch(self, api, kube):
+        from workload_variant_autoscaler_tpu.controller.kube import Deployment
+
+        path = "/apis/llmd.ai/v1alpha1/namespaces/ns/variantautoscalings/v"
+        api.routes[("PATCH", path)] = (200, {})
+        va = crd.VariantAutoscaling(metadata=crd.ObjectMeta(name="v", namespace="ns"))
+        kube.patch_owner_reference(va, Deployment(name="d", namespace="ns", uid="u9"))
+        req = api.last()
+        assert req["headers"]["Content-Type"] == "application/merge-patch+json"
+        ref = req["body"]["metadata"]["ownerReferences"][0]
+        assert ref["uid"] == "u9" and ref["controller"] is True
+
+
+class TestErrorMapping:
+    def test_404_is_not_found(self, api, kube):
+        with pytest.raises(NotFoundError):
+            kube.get_configmap("absent", "ns")
+
+    def test_409_is_conflict(self, api, kube):
+        path = "/apis/llmd.ai/v1alpha1/namespaces/ns/variantautoscalings/v/status"
+        api.routes[("PUT", path)] = (409, {"reason": "Conflict"})
+        va = crd.VariantAutoscaling(metadata=crd.ObjectMeta(name="v", namespace="ns"))
+        with pytest.raises(ConflictError):
+            kube.update_variant_autoscaling_status(va)
+
+    def test_422_is_invalid(self, api, kube):
+        path = "/apis/llmd.ai/v1alpha1/namespaces/ns/variantautoscalings/v/status"
+        api.routes[("PUT", path)] = (422, {"reason": "Invalid"})
+        va = crd.VariantAutoscaling(metadata=crd.ObjectMeta(name="v", namespace="ns"))
+        with pytest.raises(InvalidError):
+            kube.update_variant_autoscaling_status(va)
+
+
+class TestLeases:
+    def test_create_get_update_roundtrip(self, api, kube):
+        base = "/apis/coordination.k8s.io/v1/namespaces/ns/leases"
+        api.routes[("POST", base)] = (201, {})
+        lease = Lease(name="l", namespace="ns", holder="me",
+                      acquire_time=1753788600.5, renew_time=1753788600.5,
+                      duration_seconds=15.0)
+        kube.create_lease(lease)
+        sent = api.last()["body"]
+        assert sent["spec"]["holderIdentity"] == "me"
+        assert sent["spec"]["leaseDurationSeconds"] == 15
+        assert sent["spec"]["renewTime"].endswith("Z")
+
+        api.routes[("GET", f"{base}/l")] = (200, {
+            "metadata": {"name": "l", "namespace": "ns", "resourceVersion": "3"},
+            "spec": {"holderIdentity": "me",
+                     "acquireTime": sent["spec"]["acquireTime"],
+                     "renewTime": sent["spec"]["renewTime"],
+                     "leaseDurationSeconds": 15, "leaseTransitions": 2},
+        })
+        got = kube.get_lease("l", "ns")
+        assert got.holder == "me" and got.transitions == 2
+        assert got.renew_time == pytest.approx(1753788600.5, abs=1e-5)
+
+        api.routes[("PUT", f"{base}/l")] = (200, {})
+        got.renew_time += 2.0
+        kube.update_lease(got)
+        assert api.last()["body"]["metadata"]["resourceVersion"] == "3"
+
+
+class TestNodes:
+    NODES_PATH = "/api/v1/nodes?labelSelector=cloud.google.com%2Fgke-tpu-accelerator"
+
+    def test_list_nodes_parses_allocatable_and_readiness(self, api, kube):
+        ready = [{"type": "Ready", "status": "True"}]
+        api.routes[("GET", self.NODES_PATH)] = (200, {"items": [
+            {"metadata": {"name": "n1", "labels": {
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"}},
+             "status": {"allocatable": {"google.com/tpu": "4", "cpu": "8"},
+                        "capacity": {"google.com/tpu": "8"},
+                        "conditions": ready}},
+            {"metadata": {"name": "cordoned"},
+             "spec": {"unschedulable": True},
+             "status": {"allocatable": {"google.com/tpu": "4"},
+                        "conditions": ready}},
+            {"metadata": {"name": "down"},
+             "status": {"allocatable": {"google.com/tpu": "4"},
+                        "conditions": [{"type": "Ready", "status": "False"}]}},
+            {"metadata": {"name": "bad"},
+             "status": {"allocatable": {"google.com/tpu": "junk"},
+                        "conditions": ready}},
+        ]})
+        nodes = kube.list_nodes()
+        # allocatable wins over capacity; schedulability is surfaced
+        assert [(n.name, n.tpu_capacity, n.schedulable()) for n in nodes] == [
+            ("n1", 4, True), ("cordoned", 4, False),
+            ("down", 4, False), ("bad", 0, True)]
+        # the apiserver filters by the TPU label, not the client
+        assert api.last()["path"] == self.NODES_PATH

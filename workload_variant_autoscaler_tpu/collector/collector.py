@@ -203,7 +203,7 @@ def collect_inventory_k8s(kube) -> dict[str, int]:
     capacity are skipped."""
     capacity: dict[str, int] = {}
     for node in kube.list_nodes():
-        if node.tpu_capacity <= 0:
+        if node.tpu_capacity <= 0 or not node.schedulable():
             continue
         accel = node.labels.get(GKE_TPU_ACCELERATOR_LABEL, "")
         generation = TPU_ACCELERATOR_GENERATIONS.get(accel)

@@ -67,12 +67,17 @@ class ConfigMap:
 
 @dataclass
 class Node:
-    """Cluster node as the inventory collector sees it: TPU labels +
-    google.com/tpu extended-resource capacity."""
+    """Cluster node as the inventory collector sees it: TPU labels,
+    google.com/tpu allocatable chips, and schedulability."""
 
     name: str
     labels: dict[str, str] = field(default_factory=dict)
-    tpu_capacity: int = 0
+    tpu_capacity: int = 0      # allocatable google.com/tpu chips
+    unschedulable: bool = False
+    ready: bool = True
+
+    def schedulable(self) -> bool:
+        return self.ready and not self.unschedulable
 
 
 class KubeClient(Protocol):
@@ -356,20 +361,33 @@ class RestKube:
             content_type="application/merge-patch+json",
         )
 
+    # only TPU nodes: the apiserver filters, not the client
+    _TPU_NODE_SELECTOR = "cloud.google.com%2Fgke-tpu-accelerator"
+
     def list_nodes(self) -> list[Node]:
-        obj = self._request("GET", "/api/v1/nodes")
+        obj = self._request(
+            "GET", f"/api/v1/nodes?labelSelector={self._TPU_NODE_SELECTOR}"
+        )
         out = []
         for item in obj.get("items", []):
             meta = item.get("metadata", {})
-            capacity = item.get("status", {}).get("capacity", {})
+            status = item.get("status", {})
+            # allocatable (what pods can actually request), capacity fallback
+            resources = status.get("allocatable") or status.get("capacity", {})
             try:
-                tpus = int(capacity.get("google.com/tpu", "0"))
+                tpus = int(resources.get("google.com/tpu", "0"))
             except ValueError:
                 tpus = 0
+            ready = any(
+                c.get("type") == "Ready" and c.get("status") == "True"
+                for c in status.get("conditions", [])
+            )
             out.append(Node(
                 name=meta.get("name", ""),
                 labels=dict(meta.get("labels", {})),
                 tpu_capacity=tpus,
+                unschedulable=bool(item.get("spec", {}).get("unschedulable")),
+                ready=ready,
             ))
         return out
 

@@ -25,8 +25,7 @@ from ..collector import (
     validate_metrics_availability,
 )
 from ..metrics import MetricsEmitter
-from ..models import System
-from ..models.spec import SaturationPolicy
+from ..models import SaturationPolicy, System
 from ..solver import Manager, Optimizer
 from ..utils import (
     STANDARD_BACKOFF,
