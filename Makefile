@@ -44,6 +44,11 @@ run-controller-local: ## Run the controller against a local emulator's PromQL sh
 experiment: ## Offline emulator parameter-estimation sweep
 	$(PY) -m workload_variant_autoscaler_tpu.emulator.experiment
 
+.PHONY: plan
+plan: ## Offline capacity planner (PROFILES=..., RATE=...; optional SLO_TTFT/SLO_ITL msec)
+	$(PY) -m workload_variant_autoscaler_tpu.planner --profiles $(PROFILES) \
+		--rate $(RATE) --slo-ttft $(or $(SLO_TTFT),0) --slo-itl $(or $(SLO_ITL),0)
+
 ##@ Build & Deploy
 
 .PHONY: docker-build

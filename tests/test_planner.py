@@ -1,0 +1,112 @@
+"""Offline capacity planner: sizing table correctness + CLI."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from workload_variant_autoscaler_tpu.ops.analyzer import TargetPerf
+from workload_variant_autoscaler_tpu.planner import (
+    PlanRow,
+    SliceOption,
+    format_table,
+    load_options,
+    plan,
+)
+
+OPTIONS = [
+    SliceOption(acc="v5e-1", cost=20.0, alpha=6.973, beta=0.027,
+                gamma=5.2, delta=0.1, max_batch=64),
+    SliceOption(acc="v5e-4", cost=80.0, alpha=3.2, beta=0.012,
+                gamma=2.4, delta=0.04, max_batch=192),
+    # decode floor (18ms) above a 15ms ITL target -> infeasible
+    SliceOption(acc="v5e-8-70b", cost=160.0, alpha=18.0, beta=0.12,
+                gamma=14.0, delta=0.3, max_batch=48),
+]
+
+PREMIUM = TargetPerf(ttft=500.0, itl=15.0)
+
+
+class TestPlan:
+    def test_rows_sorted_by_cost_and_sized_correctly(self):
+        rows = plan(OPTIONS, TargetPerf(ttft=500.0, itl=24.0),
+                    rate_rps=50.0, in_tokens=128, out_tokens=128)
+        feasible = [r for r in rows if r.feasible]
+        assert [r.acc for r in feasible][0] == "v5e-1"  # cheapest fleet first
+        v5e1 = feasible[0]
+        # ~24.8 req/s per replica at the Premium SLO -> 3 replicas for 50
+        assert v5e1.max_rate_per_replica == pytest.approx(24.8, abs=0.3)
+        assert v5e1.replicas == 3
+        assert v5e1.cost_per_hour == pytest.approx(60.0)
+        assert 0 < v5e1.utilization <= 1.0
+        assert v5e1.itl_ms <= 24.0 + 1e-6
+        # cost per Mtok: 60 c/hr over 50*128*3600 tokens/hr
+        assert v5e1.cost_per_million_tokens == pytest.approx(
+            60.0 / (50 * 128 * 3600 / 1e6))
+
+    def test_infeasible_profile_reported_last_with_reason(self):
+        rows = plan(OPTIONS, PREMIUM, 10.0, 1024, 256)
+        assert rows[-1].acc == "v5e-8-70b"
+        assert not rows[-1].feasible
+        assert "ITL" in rows[-1].reason
+
+    def test_zero_rate_plans_one_replica(self):
+        rows = plan(OPTIONS[:1], TargetPerf(itl=24.0), 0.0, 128, 128)
+        assert rows[0].replicas == 1
+        assert rows[0].cost_per_million_tokens == 0.0
+
+    def test_tps_target_drives_demand_like_the_controller(self):
+        """A TPS SLO overrides the observed rate (replica_demand): 12800
+        tok/s at 128 out-tokens = 100 req/s of demand, not --rate's 1."""
+        rows = plan(OPTIONS[:1], TargetPerf(itl=24.0, tps=12800.0),
+                    rate_rps=1.0, in_tokens=128, out_tokens=128)
+        r = rows[0]
+        assert r.feasible
+        # 100 req/s at a TPS-margined per-replica rate -> several replicas
+        assert r.replicas == pytest.approx(
+            -(-100.0 // r.max_rate_per_replica), abs=0)
+        assert r.replicas > 1
+
+    def test_malformed_profile_entries_report_index(self, tmp_path):
+        bad = tmp_path / "p.yaml"
+        bad.write_text("- {acc: v5e-1, alpha: 1, beta: 0, gamma: 1, delta: 0}\n")
+        with pytest.raises(ValueError, match="entry 0.*cost"):
+            load_options(str(bad))
+
+    def test_format_table_renders_all_rows(self):
+        rows = plan(OPTIONS, TargetPerf(ttft=500.0, itl=24.0), 50.0, 128, 128)
+        table = format_table(rows)
+        assert "v5e-1" in table and "v5e-4" in table
+        assert "infeasible" not in table.split("v5e-1")[1].split("\n")[0]
+
+
+class TestCLI:
+    def test_end_to_end_json(self, tmp_path):
+        profiles = tmp_path / "profiles.yaml"
+        profiles.write_text(
+            "- {acc: v5e-1, cost: 20.0, alpha: 6.973, beta: 0.027, "
+            "gamma: 5.2, delta: 0.1, maxBatch: 64}\n"
+            "- {acc: v5e-4, cost: 80.0, alpha: 3.2, beta: 0.012, "
+            "gamma: 2.4, delta: 0.04, maxBatch: 192, accCount: 1}\n"
+        )
+        import os
+
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}  # never dial the TPU tunnel
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [sys.executable, "-m", "workload_variant_autoscaler_tpu.planner",
+             "--profiles", str(profiles), "--rate", "50",
+             "--slo-ttft", "500", "--slo-itl", "24", "--json"],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert out.returncode == 0, out.stderr
+        rows = json.loads(out.stdout)
+        assert rows[0]["acc"] == "v5e-1" and rows[0]["replicas"] == 3
+
+    def test_load_options_validates_shape(self, tmp_path):
+        bad = tmp_path / "bad.yaml"
+        bad.write_text("notalist: true\n")
+        with pytest.raises(ValueError):
+            load_options(str(bad))
