@@ -1,0 +1,195 @@
+"""Closed-loop e2e with long-context traffic.
+
+The emulator is the independent ground truth: its KV accounting caps how
+many 8k-token requests fit concurrently, regardless of what the profile
+claims. A context-bucketed VA whose 8k anchor encodes the KV-limited
+batch bound must size the fleet so the (relaxed) long-context TTFT SLO
+holds — the profile dimension validated against a mechanism it does not
+share.
+"""
+
+import json
+
+from workload_variant_autoscaler_tpu.controller import (
+    ACCELERATOR_CM_NAME,
+    CONFIG_MAP_NAME,
+    CONFIG_MAP_NAMESPACE,
+    SERVICE_CLASS_CM_NAME,
+    ConfigMap,
+    Deployment,
+    InMemoryKube,
+    Reconciler,
+    crd,
+)
+from workload_variant_autoscaler_tpu.emulator import (
+    Fleet,
+    PoissonLoadGenerator,
+    PrometheusSink,
+    Simulation,
+    SimPromAPI,
+    SliceModelConfig,
+    TokenDistribution,
+)
+from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
+
+from test_e2e_loop import CompositeSink, TTFTLog
+
+MODEL = "llama-8b"
+NS = "default"
+VARIANT = "doc-8b"
+IN_TOKENS = 8192
+OUT_TOKENS = 64
+
+# emulated hardware truth: same linear models at any context; KV memory is
+# what actually limits long-context concurrency
+CFG = SliceModelConfig(
+    model_name=MODEL, slice_name="v5e-1",
+    alpha=6.973, beta=0.027, gamma=5.2, delta=0.1,
+    max_batch_size=64, hbm_gb=16.0, model_size_gb=8.0, kv_mb_per_token=0.25,
+)
+
+# Relaxed vs the 500ms chat SLO (8k prefills are seconds long) but tight
+# enough that the SLO-holding rate sits below raw capacity — the
+# completions-measured arrival rate (reference parity: arrival is the
+# success-counter rate, collector.go:170) then drives progressive
+# scale-out under saturation.
+SLO_TTFT_MS = 6_000
+SLO_ITL_MS = 24
+
+
+def kv_limited_batch() -> int:
+    """Concurrent 8k-token requests the emulator can actually hold."""
+    per_request_mb = (IN_TOKENS + OUT_TOKENS) * CFG.kv_mb_per_token
+    return max(int(CFG.kv_budget_mb // per_request_mb), 1)
+
+
+def build_long_context_loop():
+    prom_sink = PrometheusSink(MODEL, NS)
+    ttft_log = TTFTLog()
+    fleet = Fleet(CFG, CompositeSink(prom_sink, ttft_log), replicas=1)
+    sim = Simulation(fleet, seed=5)
+    prom = SimPromAPI(prom_sink, MODEL, NS)
+
+    kube = InMemoryKube()
+    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
+                                 {"GLOBAL_OPT_INTERVAL": "30s"}))
+    kube.put_configmap(ConfigMap(
+        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"v5e-1": json.dumps({"chip": "v5e", "chips": "1", "cost": "20.0"})},
+    ))
+    kube.put_configmap(ConfigMap(
+        SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"longdoc": (
+            "name: LongDoc\npriority: 5\ndata:\n"
+            f"  - model: {MODEL}\n    slo-tpot: {SLO_ITL_MS}\n"
+            f"    slo-ttft: {SLO_TTFT_MS}\n"
+        )},
+    ))
+    kube.put_deployment(Deployment(name=VARIANT, namespace=NS,
+                                   spec_replicas=1, status_replicas=1))
+
+    base_parms = crd.PerfParms(
+        decode_parms={"alpha": str(CFG.alpha), "beta": str(CFG.beta)},
+        prefill_parms={"gamma": str(CFG.gamma), "delta": str(CFG.delta)},
+    )
+    va = crd.VariantAutoscaling(
+        metadata=crd.ObjectMeta(name=VARIANT, namespace=NS,
+                                labels={crd.ACCELERATOR_LABEL: "v5e-1"}),
+        spec=crd.VariantAutoscalingSpec(
+            model_id=MODEL,
+            slo_class_ref=crd.ConfigMapKeyRef(name=SERVICE_CLASS_CM_NAME,
+                                              key="longdoc"),
+            model_profile=crd.ModelProfile(accelerators=[
+                crd.AcceleratorProfile(
+                    acc="v5e-1", acc_count=1, max_batch_size=CFG.max_batch_size,
+                    perf_parms=base_parms,
+                    context_profiles=[
+                        # short context: full configured batch
+                        crd.ContextProfile(at_context=128,
+                                           max_batch_size=CFG.max_batch_size,
+                                           perf_parms=base_parms),
+                        # long context: same coefficients, KV-limited batch
+                        crd.ContextProfile(at_context=IN_TOKENS,
+                                           max_batch_size=kv_limited_batch(),
+                                           perf_parms=base_parms),
+                    ],
+                ),
+            ]),
+        ),
+    )
+    kube.put_variant_autoscaling(va)
+
+    emitter = MetricsEmitter()
+    rec = Reconciler(kube=kube, prom=prom, emitter=emitter,
+                     now=lambda: sim.now_ms / 1000.0, sleep=lambda _s: None)
+    return sim, fleet, prom, kube, emitter, rec, ttft_log
+
+
+class TestLongContextClosedLoop:
+    def test_holds_relaxed_ttft_slo_on_8k_prompts(self):
+        sim, fleet, prom, kube, _emitter, rec, ttft_log = build_long_context_loop()
+        assert kv_limited_batch() < CFG.max_batch_size  # KV is the binding limit
+
+        gen = PoissonLoadGenerator(
+            sim, schedule=[(600, 120)],  # 2 req/s of 8k-token docs
+            tokens=TokenDistribution(avg_input_tokens=IN_TOKENS,
+                                     avg_output_tokens=OUT_TOKENS,
+                                     distribution="deterministic"),
+            seed=5,
+        )
+        gen.start()
+
+        history = []
+        next_reconcile = 30_000.0
+
+        def on_tick(now_ms):
+            nonlocal next_reconcile
+            prom.scrape(now_ms)
+            if now_ms >= next_reconcile:
+                next_reconcile += 30_000.0
+                rec.reconcile()
+                va = kube.get_variant_autoscaling(VARIANT, NS)
+                desired = va.status.desired_optimized_alloc.num_replicas
+                history.append((now_ms, desired))
+                kube.put_deployment(Deployment(name=VARIANT, namespace=NS,
+                                               spec_replicas=desired,
+                                               status_replicas=desired))
+                fleet.set_replicas(max(desired, 0), now_ms)
+                sim.kick()
+
+        sim.run_until(600_000.0, on_tick=on_tick, tick_ms=5000.0)
+
+        # long-context sizing kicked in: well beyond one replica
+        final_desired = history[-1][1]
+        assert final_desired > 1, history
+
+        # SLO held in the converged second half
+        ttfts = ttft_log.ttfts_between(300_000.0, 600_000.0)
+        assert ttfts, "no completed requests in assertion window"
+        ttfts.sort()
+        p95 = ttfts[int(len(ttfts) * 0.95)]
+        assert p95 < SLO_TTFT_MS, f"p95 TTFT {p95:.0f}ms violates the SLO"
+
+    def test_short_context_same_rate_needs_fewer_replicas(self):
+        """The same 2 req/s of short prompts sizes far smaller — the gap is
+        the context dimension, not the rate."""
+        sim, fleet, prom, kube, _e, rec, _t = build_long_context_loop()
+        gen = PoissonLoadGenerator(
+            sim, schedule=[(300, 120)],
+            tokens=TokenDistribution(avg_input_tokens=128,
+                                     avg_output_tokens=OUT_TOKENS,
+                                     distribution="deterministic"),
+            seed=7,
+        )
+        gen.start()
+        desired = []
+
+        def on_tick(now_ms):
+            prom.scrape(now_ms)
+            if now_ms % 30_000.0 == 0:
+                rec.reconcile()
+                va = kube.get_variant_autoscaling(VARIANT, NS)
+                desired.append(va.status.desired_optimized_alloc.num_replicas)
+
+        sim.run_until(300_000.0, on_tick=on_tick, tick_ms=5000.0)
+        assert desired and max(desired) == 1
