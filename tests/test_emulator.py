@@ -102,11 +102,96 @@ class TestFleet:
             fleet.dispatch(Request(req_id=i, in_tokens=10, out_tokens=4, arrival_ms=0.0), 0.0)
         fleet.set_replicas(1, 0.0)
         assert fleet.size() == 1
-        # work from retired replicas was re-dispatched, none lost
-        r = fleet.replicas[0]
-        assert len(r.running) + len(r.waiting) == 6
+        # retired replicas drain their running work in place; queued work
+        # moves to survivors — nothing lost, nothing recomputed
+        total = sum(len(r.running) + len(r.waiting) for r in fleet.all_replicas())
+        assert total == 6
+        assert fleet.draining_replicas and all(
+            r.draining for r in fleet.draining_replicas
+        )
         # re-dispatch must not re-fire the arrival hook
         assert sink.arrivals == 6
+
+    def test_mid_flight_drain_preserves_decode_progress(self):
+        """Scale-down must not restart prefill for requests mid-decode
+        (the round-1 re-dispatch recomputed full prefill while keeping
+        tokens_out — mixed semantics)."""
+        sink = RecordingSink()
+        fleet = Fleet(CFG, sink, replicas=2)
+        sim = Simulation(fleet, seed=3)
+        for i in range(2):
+            sim.submit(Request(req_id=i, in_tokens=10, out_tokens=50, arrival_ms=0.0))
+        # run until both are well into decode
+        sim.run_until(10 * CFG.decode_ms(1))
+        victims = [r for rep in fleet.replicas for r in rep.running]
+        assert victims and all(v.tokens_out > 1 for v in victims)
+        progress = {v.req_id: (v.tokens_out, v.prefill_remaining_ms) for v in victims}
+
+        fleet.set_replicas(1, sim.now_ms)
+        sim.kick()
+        for rep in fleet.all_replicas():
+            for r in rep.running:
+                toks, prefill_left = progress[r.req_id]
+                assert r.tokens_out >= toks
+                assert r.prefill_remaining_ms <= max(prefill_left, 0.0)
+
+        # drained replicas finish their requests and are reaped
+        sim.run_until(sim.now_ms + 200 * CFG.decode_ms(2))
+        assert len(sink.finished) == 2
+        assert fleet.draining_replicas == []
+        assert fleet.size() == 1
+
+    def test_eviction_on_draining_replica_reroutes_to_fleet(self):
+        """A KV-evicted request on a draining replica must not strand in a
+        queue nobody serves — it reroutes through the fleet and finishes."""
+        cfg = SliceModelConfig(
+            model_name="m", alpha=5.0, beta=0.1, gamma=1.0, delta=0.01,
+            max_batch_size=8, hbm_gb=16.0, model_size_gb=8.0,
+            # tight KV: two long-output requests overflow mid-decode
+            kv_mb_per_token=8.0, usable_ratio=0.8,
+        )
+        sink = RecordingSink()
+        fleet = Fleet(cfg, sink, replicas=2)
+        sim = Simulation(fleet, seed=9)
+        # both admit up front and each fits alone to completion, but their
+        # combined KV growth cannot coexist to the end
+        out_tokens = 500
+        final_kv = (10 + out_tokens + 1) * cfg.kv_mb_per_token
+        assert final_kv < cfg.kv_budget_mb < 2 * final_kv
+        drainer, survivor = fleet.replicas
+        for i in range(2):
+            drainer.enqueue(
+                Request(req_id=i, in_tokens=10, out_tokens=out_tokens,
+                        arrival_ms=0.0), 0.0, fresh=False)
+        assert len(drainer.running) == 2
+        # retire the loaded replica (drain it in place, like set_replicas
+        # does for the emptiest; forced here to hit the eviction-under-
+        # drain path deterministically)
+        fleet.replicas = [survivor]
+        drainer.draining = True
+        fleet.draining_replicas.append(drainer)
+        sim.kick()
+        sim.run_until(8 * out_tokens * cfg.decode_ms(2))
+        # KV overflow mid-drain evicted one request; it rerouted to the
+        # surviving replica instead of stranding — both finish
+        assert len(sink.finished) == 2
+        assert fleet.draining_replicas == []
+        assert survivor.running == [] and survivor.waiting == []
+
+    def test_scale_to_zero_holds_queue_until_scale_up(self):
+        """With no capacity, queued work waits (llm-d gateway semantics)
+        and is served once replicas return."""
+        sink = RecordingSink()
+        fleet = Fleet(CFG, sink, replicas=1)
+        sim = Simulation(fleet, seed=4)
+        fleet.set_replicas(0, 0.0)
+        sim.submit(Request(req_id=0, in_tokens=10, out_tokens=4, arrival_ms=0.0))
+        sim.run_until(1000.0)
+        assert not sink.finished
+        fleet.set_replicas(1, sim.now_ms)
+        sim.kick()
+        sim.run_until(sim.now_ms + 100 * CFG.decode_ms(1))
+        assert len(sink.finished) == 1
 
     def test_gauges_aggregate_across_replicas(self):
         class GaugeSink(RecordingSink):

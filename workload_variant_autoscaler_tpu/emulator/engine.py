@@ -92,12 +92,16 @@ class Replica:
     """One serving replica on one slice: continuous batching over a running
     set bounded by max batch and KV memory, FIFO waiting queue."""
 
-    def __init__(self, config: SliceModelConfig, sink: "MetricsSink"):
+    def __init__(self, config: SliceModelConfig, sink: "MetricsSink",
+                 reroute: Optional[Callable[["Request", float], None]] = None):
         self.config = config
         self.sink = sink
         self.running: list[Request] = []
         self.waiting: list[Request] = []
         self.draining = False
+        # where evicted work goes when this replica is draining and will
+        # never re-admit it (Fleet.dispatch); None = standalone replica
+        self.reroute = reroute
 
     # -- memory ----------------------------------------------------------
 
@@ -132,9 +136,11 @@ class Replica:
         while self.waiting and self._fits(self.waiting[0]):
             self._admit(self.waiting.pop(0), now_ms)
 
-    def evict_if_needed(self) -> None:
+    def evict_if_needed(self, now_ms: float = 0.0) -> None:
         """KV pressure: move the newest running request back to the queue
-        head (mirrors the reference's tail eviction, vllm_model.py:402-413)."""
+        head (mirrors the reference's tail eviction, vllm_model.py:402-413).
+        A draining replica will never re-admit, so its victims reroute to
+        the fleet instead of stranding in a queue nobody serves."""
         while (
             self.running
             and self.kv_used_mb() + len(self.running) * self.config.kv_mb_per_token
@@ -142,7 +148,10 @@ class Replica:
         ):
             victim = self.running.pop()
             victim.prefill_remaining_ms = 0.0
-            self.waiting.insert(0, victim)
+            if self.draining and self.reroute is not None:
+                self.reroute(victim, now_ms)
+            else:
+                self.waiting.insert(0, victim)
 
     # -- the decode iteration --------------------------------------------
 
@@ -177,7 +186,7 @@ class Replica:
             self.sink.on_finish(req)
             if req.on_finish is not None:
                 req.on_finish(req)
-        self.evict_if_needed()
+        self.evict_if_needed(now_ms + dt)
         if not self.draining:
             self._admit_waiting(now_ms + dt)
         self.sink.set_queue_sizes(len(self.running), len(self.waiting))
@@ -219,15 +228,17 @@ class _FleetSink(MetricsSink):
 
     def set_queue_sizes(self, running: int, waiting: int) -> None:
         f = self._fleet
+        everyone = f.all_replicas()
         f.sink.set_queue_sizes(
-            sum(len(r.running) for r in f.replicas),
-            sum(len(r.waiting) for r in f.replicas),
+            sum(len(r.running) for r in everyone),
+            sum(len(r.waiting) for r in everyone) + len(f.gateway_backlog),
         )
 
     def set_kv_usage(self, frac: float) -> None:
         f = self._fleet
-        budget = len(f.replicas) * f.config.kv_budget_mb
-        used = sum(r.kv_used_mb() for r in f.replicas)
+        everyone = f.all_replicas()
+        budget = len(everyone) * f.config.kv_budget_mb
+        used = sum(r.kv_used_mb() for r in everyone)
         f.sink.set_kv_usage(used / budget if budget > 0 else 0.0)
 
 
@@ -239,37 +250,67 @@ class Fleet:
         self.config = config
         self.sink = sink
         self._replica_sink = _FleetSink(self)
+        self._reroute = lambda req, now_ms: self.dispatch(req, now_ms, fresh=False)
         self.replicas: list[Replica] = [
-            Replica(config, self._replica_sink) for _ in range(replicas)
+            Replica(config, self._replica_sink, self._reroute)
+            for _ in range(replicas)
         ]
+        self.draining_replicas: list[Replica] = []
+        # requests that arrived while scaled to zero: held at the "gateway"
+        # (llm-d queues ahead of the backends; arrivals must stay visible
+        # to the autoscaler or scale-from-zero has no trigger)
+        self.gateway_backlog: list[Request] = []
 
     def size(self) -> int:
         return len(self.replicas)
 
+    def all_replicas(self) -> list[Replica]:
+        """Active + draining — everything that still needs decode steps."""
+        return self.replicas + self.draining_replicas
+
     def set_replicas(self, n: int, now_ms: float) -> None:
         n = max(n, 0)
         if n > len(self.replicas):
+            # scale-up can reuse a draining replica's weights immediately
+            # (pod not gone yet) — reactivate before creating fresh ones
+            while self.draining_replicas and len(self.replicas) < n:
+                r = self.draining_replicas.pop()
+                r.draining = False
+                self.replicas.append(r)
             while len(self.replicas) < n:
-                self.replicas.append(Replica(self.config, self._replica_sink))
+                self.replicas.append(
+                    Replica(self.config, self._replica_sink, self._reroute)
+                )
             self._rebalance_waiting(now_ms)
         if n < len(self.replicas):
-            # keep the busiest replicas; retire the emptiest and
-            # re-dispatch their work (progress preserved)
+            # graceful drain, like a terminating vLLM pod behind llm-d:
+            # retire the emptiest replicas; their running requests finish
+            # in place (decode progress is never recomputed), their queued
+            # requests move to the survivors
             self.replicas.sort(
                 key=lambda r: len(r.running) + len(r.waiting), reverse=True
             )
             retire = self.replicas[n:]
             self.replicas = self.replicas[:n]
             for r in retire:
-                for req in r.running + r.waiting:
-                    if self.replicas:
-                        self.dispatch(req, now_ms, fresh=False)
+                r.draining = True
+                backlog, r.waiting = r.waiting, []
+                if r.running:
+                    self.draining_replicas.append(r)
+                for req in backlog:
+                    self.dispatch(req, now_ms, fresh=False)
+
+    def reap_drained(self) -> None:
+        """Forget draining replicas that have finished their work."""
+        self.draining_replicas = [
+            r for r in self.draining_replicas if r.running or r.waiting
+        ]
 
     def _rebalance_waiting(self, now_ms: float) -> None:
         """Spread not-yet-admitted (waiting) requests across all replicas.
         Models llm-d's shared gateway queue: queued work hasn't started
         anywhere, so new replicas take their share immediately."""
-        backlog: list[Request] = []
+        backlog, self.gateway_backlog = self.gateway_backlog, []
         for r in self.replicas:
             backlog.extend(r.waiting)
             r.waiting = []
@@ -278,10 +319,15 @@ class Fleet:
             self.dispatch(req, now_ms, fresh=False)
 
     def dispatch(self, req: Request, now_ms: float, *, fresh: bool = True) -> None:
+        if fresh:
+            self.sink.on_arrival(req)
         if not self.replicas:
-            return  # scaled to zero: drop (no serving capacity)
+            # scaled to zero: hold at the gateway until capacity returns
+            self.gateway_backlog.append(req)
+            self._replica_sink.set_queue_sizes(0, 0)
+            return
         target = min(self.replicas, key=lambda r: len(r.running) + len(r.waiting))
-        target.enqueue(req, now_ms, fresh=fresh)
+        target.enqueue(req, now_ms, fresh=False)
 
 
 @dataclass(order=True)
@@ -302,7 +348,7 @@ class Simulation:
         self._heap: list[_Event] = []
         self._seq = itertools.count()
         self.rng = random.Random(seed)
-        self._replica_busy: set[int] = set()
+        self._replica_busy: set[int] = set()  # id(replica)
 
     def schedule(self, delay_ms: float, kind: str, payload=None) -> None:
         heapq.heappush(
@@ -319,10 +365,10 @@ class Simulation:
         self._kick_replicas()
 
     def _kick_replicas(self) -> None:
-        for idx, replica in enumerate(self.fleet.replicas):
-            if replica.busy() and idx not in self._replica_busy:
-                self._replica_busy.add(idx)
-                self.schedule(0.0, "step", idx)
+        for replica in self.fleet.all_replicas():
+            if replica.busy() and id(replica) not in self._replica_busy:
+                self._replica_busy.add(id(replica))
+                self.schedule(0.0, "step", replica)
 
     def run_until(self, t_ms: float, on_tick=None, tick_ms: float = 1000.0) -> None:
         next_tick = (self.now_ms // tick_ms + 1) * tick_ms
@@ -335,16 +381,20 @@ class Simulation:
             ev = heapq.heappop(self._heap)
             self.now_ms = ev.at_ms
             if ev.kind == "step":
-                idx = ev.payload
-                if idx >= len(self.fleet.replicas):
-                    self._replica_busy.discard(idx)
+                replica = ev.payload
+                if replica not in self.fleet.all_replicas():
+                    self._replica_busy.discard(id(replica))
                     continue
-                replica = self.fleet.replicas[idx]
                 dt = replica.step(self.now_ms)
                 if replica.busy():
-                    self.schedule(dt, "step", idx)
+                    self.schedule(dt, "step", replica)
                 else:
-                    self._replica_busy.discard(idx)
+                    self._replica_busy.discard(id(replica))
+                    self.fleet.reap_drained()
+                if replica.draining:
+                    # eviction under drain reroutes work to replicas that
+                    # may be idle — make sure they get a step event
+                    self._kick_replicas()
             elif ev.kind == "arrival":
                 self.submit(ev.payload)
             elif ev.kind == "call":
