@@ -6,9 +6,12 @@ import math
 import pytest
 
 from workload_variant_autoscaler_tpu.collector import (
+    CollectedLoad,
     FakePromAPI,
+    IncompleteMetricsError,
     PrometheusConfig,
     arrival_rate_query,
+    true_arrival_rate_query,
     availability_query,
     avg_generation_tokens_query,
     avg_itl_query,
@@ -18,6 +21,10 @@ from workload_variant_autoscaler_tpu.collector import (
     validate_metrics_availability,
     validate_prometheus_api,
     validate_tls_config,
+)
+from workload_variant_autoscaler_tpu.collector.collector import (
+    DEFAULT_AVG_INPUT_TOKENS,
+    DEFAULT_AVG_OUTPUT_TOKENS,
 )
 from workload_variant_autoscaler_tpu.collector.prometheus import Sample
 from workload_variant_autoscaler_tpu.controller import crd
@@ -94,14 +101,19 @@ class TestValidateMetricsAvailability:
         assert res.reason == crd.REASON_PROMETHEUS_ERROR
 
 
+def _set_full_load(prom, rps=2.0):
+    prom.set_result(true_arrival_rate_query("m", "ns"), rps)
+    prom.set_result(arrival_rate_query("m", "ns"), rps)
+    prom.set_result(avg_prompt_tokens_query("m", "ns"), 128.0)
+    prom.set_result(avg_generation_tokens_query("m", "ns"), 256.0)
+    prom.set_result(avg_ttft_query("m", "ns"), 0.120)          # seconds
+    prom.set_result(avg_itl_query("m", "ns"), 0.015)
+
+
 class TestCollectLoad:
     def test_unit_conversions(self):
         prom = FakePromAPI()
-        prom.set_result(arrival_rate_query("m", "ns"), 2.0)        # req/s
-        prom.set_result(avg_prompt_tokens_query("m", "ns"), 128.0)
-        prom.set_result(avg_generation_tokens_query("m", "ns"), 256.0)
-        prom.set_result(avg_ttft_query("m", "ns"), 0.120)          # seconds
-        prom.set_result(avg_itl_query("m", "ns"), 0.015)
+        _set_full_load(prom, rps=2.0)
         load = collect_load(prom, "m", "ns")
         assert load.arrival_rate_rpm == pytest.approx(120.0)  # req/min
         assert load.avg_input_tokens == 128.0
@@ -109,10 +121,27 @@ class TestCollectLoad:
         assert load.avg_ttft_ms == pytest.approx(120.0)
         assert load.avg_itl_ms == pytest.approx(15.0)
 
-    def test_nan_scrubbed(self):
-        """NaN from 0/0 PromQL ratios must not poison the engine
-        (reference collector.go:281-285)."""
+    def test_true_arrivals_preferred_over_success_rate(self):
+        """Saturation visibility: a replica completing 1 req/s while 4 req/s
+        arrive must report demand 4, not delivered throughput."""
         prom = FakePromAPI()
+        _set_full_load(prom, rps=1.0)
+        prom.set_result(true_arrival_rate_query("m", "ns"), 4.0)
+        load = collect_load(prom, "m", "ns")
+        assert load.arrival_rate_rpm == pytest.approx(240.0)
+
+    def test_success_rate_fallback_when_arrival_series_absent(self):
+        prom = FakePromAPI()
+        _set_full_load(prom, rps=2.0)
+        prom.set_empty(true_arrival_rate_query("m", "ns"))
+        load = collect_load(prom, "m", "ns")
+        assert load.arrival_rate_rpm == pytest.approx(120.0)
+
+    def test_nan_ratio_with_zero_load_is_zero(self):
+        """NaN from 0/0 PromQL ratios must not poison the engine when the
+        variant is actually idle (reference collector.go:281-285)."""
+        prom = FakePromAPI()
+        _set_full_load(prom, rps=0.0)
         prom.query_results[avg_prompt_tokens_query("m", "ns")] = [
             Sample(labels={}, value=math.nan, timestamp=0)
         ]
@@ -121,8 +150,67 @@ class TestCollectLoad:
 
     def test_empty_vector_is_zero(self):
         prom = FakePromAPI()
+        prom.set_empty(true_arrival_rate_query("m", "ns"))
         prom.set_empty(arrival_rate_query("m", "ns"))
         assert collect_load(prom, "m", "ns").arrival_rate_rpm == 0.0
+
+    def test_nonzero_arrivals_with_missing_series_raises(self):
+        """The hardening the reference lacks (collector.go:51-76 zero-fills):
+        a loaded variant with an absent generation-tokens series must NOT
+        be fed out_tokens=0 (which reads as idle and scales it down)."""
+        prom = FakePromAPI()
+        _set_full_load(prom, rps=2.0)
+        prom.set_empty(avg_generation_tokens_query("m", "ns"))
+        with pytest.raises(IncompleteMetricsError) as ei:
+            collect_load(prom, "m", "ns")
+        assert "avg_generation_tokens" in str(ei.value)
+
+    def test_nonzero_arrivals_with_nan_latency_raises(self):
+        """0/0 latency ratio while completions also flow is a partial
+        scrape: 'unknown', not 'zero'."""
+        prom = FakePromAPI()
+        _set_full_load(prom, rps=2.0)
+        prom.query_results[avg_itl_query("m", "ns")] = [
+            Sample(labels={}, value=math.nan, timestamp=0)
+        ]
+        with pytest.raises(IncompleteMetricsError):
+            collect_load(prom, "m", "ns")
+
+    def test_scale_from_zero_uses_fallback_token_stats(self):
+        """Arrivals with ZERO completions in the window (scaled to zero /
+        cold start / hard saturation): 0/0 aggregates are expected — the
+        variant must still be sized from demand + last-known token stats,
+        or it can never scale back up."""
+        prom = FakePromAPI()
+        prom.set_result(true_arrival_rate_query("m", "ns"), 3.0)
+        prom.set_result(arrival_rate_query("m", "ns"), 0.0)  # nothing completes
+        nan = [Sample(labels={}, value=math.nan, timestamp=0)]
+        for q in (avg_prompt_tokens_query, avg_generation_tokens_query,
+                  avg_ttft_query, avg_itl_query):
+            prom.query_results[q("m", "ns")] = list(nan)
+        last_known = CollectedLoad(
+            arrival_rate_rpm=0.0, avg_input_tokens=1024.0,
+            avg_output_tokens=256.0, avg_ttft_ms=0.0, avg_itl_ms=0.0,
+        )
+        load = collect_load(prom, "m", "ns", fallback=last_known)
+        assert load.arrival_rate_rpm == pytest.approx(180.0)
+        assert load.avg_input_tokens == 1024.0
+        assert load.avg_output_tokens == 256.0
+
+    def test_scale_from_zero_defaults_without_history(self):
+        """Brand-new VA, first-ever burst, nothing completed yet and no
+        status history: generic defaults, not zeros (zero out-tokens would
+        read as idle)."""
+        prom = FakePromAPI()
+        prom.set_result(true_arrival_rate_query("m", "ns"), 3.0)
+        prom.set_empty(arrival_rate_query("m", "ns"))
+        for q in (avg_prompt_tokens_query, avg_generation_tokens_query,
+                  avg_ttft_query, avg_itl_query):
+            prom.set_empty(q("m", "ns"))
+        load = collect_load(prom, "m", "ns")
+        assert load.avg_input_tokens == DEFAULT_AVG_INPUT_TOKENS
+        assert load.avg_output_tokens == DEFAULT_AVG_OUTPUT_TOKENS
+        assert load.arrival_rate_rpm > 0.0
 
 
 class TestTLSValidation:

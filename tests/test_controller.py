@@ -9,6 +9,7 @@ import pytest
 from workload_variant_autoscaler_tpu.collector import (
     FakePromAPI,
     arrival_rate_query,
+    true_arrival_rate_query,
     availability_query,
     avg_generation_tokens_query,
     avg_itl_query,
@@ -98,6 +99,7 @@ def make_cluster(arrival_rps=2.0, interval="30s", replicas=2):
     kube.put_variant_autoscaling(make_va())
 
     prom = FakePromAPI()
+    prom.set_result(true_arrival_rate_query(MODEL, NS), arrival_rps)
     prom.set_result(arrival_rate_query(MODEL, NS), arrival_rps)
     prom.set_result(avg_prompt_tokens_query(MODEL, NS), 128.0)
     prom.set_result(avg_generation_tokens_query(MODEL, NS), 128.0)
@@ -227,6 +229,65 @@ class TestDegradedPaths:
         result = rec.reconcile()
         assert result.skipped.get(FULL) == crd.REASON_METRICS_MISSING
         va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert va.status.desired_optimized_alloc.num_replicas == 0
+
+    def test_scale_down_stabilization_window(self):
+        """With WVA_SCALE_DOWN_STABILIZATION set, a lower recommendation is
+        published only after it has held for the whole window; scale-up
+        stays immediate."""
+        def set_rate(prom, rps):
+            prom.set_result(true_arrival_rate_query(MODEL, NS), rps)
+            prom.set_result(arrival_rate_query(MODEL, NS), rps)
+
+        clock = {"t": 0.0}
+        kube, prom, _e, rec = make_cluster(arrival_rps=50.0)
+        rec.now = lambda: clock["t"]
+        kube.put_configmap(ConfigMap(
+            name=CONFIG_MAP_NAME, namespace=CONFIG_MAP_NAMESPACE,
+            data={"GLOBAL_OPT_INTERVAL": "30s",
+                  "WVA_SCALE_DOWN_STABILIZATION": "90s"},
+        ))
+
+        def desired():
+            rec.reconcile()
+            va = kube.get_variant_autoscaling(VARIANT, NS)
+            return va.status.desired_optimized_alloc.num_replicas
+
+        high = desired()
+        assert high >= 2
+        # demand drops: recommendation falls, publication holds
+        set_rate(prom, 2.0)
+        clock["t"] += 30.0
+        assert desired() == high
+        clock["t"] += 30.0
+        assert desired() == high
+        # window elapsed with the low recommendation sustained
+        clock["t"] += 61.0
+        low = desired()
+        assert low < high
+        # scale-up is immediate, no window
+        set_rate(prom, 50.0)
+        clock["t"] += 30.0
+        assert desired() == high
+
+    def test_incomplete_metrics_skip_with_condition(self):
+        """Arrivals flow but the generation-tokens series is gone: the VA
+        must be skipped with MetricsIncomplete on the CR, never scaled on
+        zero-filled load (the reference zero-fills, collector.go:51-76)."""
+        from workload_variant_autoscaler_tpu.collector import (
+            avg_generation_tokens_query,
+        )
+
+        kube, prom, _e, rec = make_cluster(arrival_rps=2.0)
+        prom.set_empty(avg_generation_tokens_query(MODEL, NS))
+        result = rec.reconcile()
+        assert result.skipped.get(FULL) == crd.REASON_METRICS_INCOMPLETE
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert crd.is_condition_false(va, crd.TYPE_METRICS_AVAILABLE)
+        cond = crd.get_condition(va, crd.TYPE_METRICS_AVAILABLE)
+        assert cond.reason == crd.REASON_METRICS_INCOMPLETE
+        assert "avg_generation_tokens" in cond.message
+        # desired allocation untouched (no scale-down to min replicas)
         assert va.status.desired_optimized_alloc.num_replicas == 0
 
     def test_stale_metrics_skip(self):

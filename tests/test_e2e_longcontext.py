@@ -50,9 +50,8 @@ CFG = SliceModelConfig(
 
 # Relaxed vs the 500ms chat SLO (8k prefills are seconds long) but tight
 # enough that the SLO-holding rate sits below raw capacity — the
-# completions-measured arrival rate (reference parity: arrival is the
-# success-counter rate, collector.go:170) then drives progressive
-# scale-out under saturation.
+# admission-measured arrival rate (vllm:request_arrival_total; the
+# success-rate fallback is saturation-blind) then drives scale-out.
 SLO_TTFT_MS = 6_000
 SLO_ITL_MS = 24
 
@@ -71,8 +70,13 @@ def build_long_context_loop():
     prom = SimPromAPI(prom_sink, MODEL, NS)
 
     kube = InMemoryKube()
+    # 120s stabilization: noisy 1m-window arrival estimates dip below the
+    # 2-vs-3-replica boundary for a cycle or two; scaling down into nearly
+    # saturated capacity (rho -> 1) blows the TTFT tail far more than the
+    # brief over-provision costs
     kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
-                                 {"GLOBAL_OPT_INTERVAL": "30s"}))
+                                 {"GLOBAL_OPT_INTERVAL": "30s",
+                                  "WVA_SCALE_DOWN_STABILIZATION": "120s"}))
     kube.put_configmap(ConfigMap(
         ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
         {"v5e-1": json.dumps({"chip": "v5e", "chips": "1", "cost": "20.0"})},
@@ -183,13 +187,17 @@ class TestLongContextClosedLoop:
         )
         gen.start()
         desired = []
+        next_reconcile = 30_000.0
 
         def on_tick(now_ms):
+            nonlocal next_reconcile
             prom.scrape(now_ms)
-            if now_ms % 30_000.0 == 0:
+            if now_ms >= next_reconcile:
+                next_reconcile += 30_000.0
                 rec.reconcile()
                 va = kube.get_variant_autoscaling(VARIANT, NS)
                 desired.append(va.status.desired_optimized_alloc.num_replicas)
 
         sim.run_until(300_000.0, on_tick=on_tick, tick_ms=5000.0)
-        assert desired and max(desired) == 1
+        assert len(desired) >= 9, "reconciler must actually have run"
+        assert max(desired) == 1

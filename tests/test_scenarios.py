@@ -20,6 +20,7 @@ import pytest
 from workload_variant_autoscaler_tpu.collector import (
     FakePromAPI,
     arrival_rate_query,
+    true_arrival_rate_query,
     avg_generation_tokens_query,
     avg_itl_query,
     avg_prompt_tokens_query,
@@ -123,6 +124,7 @@ def make_fleet_cluster(variants):
 
 
 def set_load(prom, model, rps, in_tok, out_tok, ttft_s=0.05, itl_s=0.009):
+    prom.set_result(true_arrival_rate_query(model, NS), rps)
     prom.set_result(arrival_rate_query(model, NS), rps)
     prom.set_result(avg_prompt_tokens_query(model, NS), in_tok)
     prom.set_result(avg_generation_tokens_query(model, NS), out_tok)

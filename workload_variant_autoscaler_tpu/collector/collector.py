@@ -18,6 +18,7 @@ from .prometheus import PromAPI
 log = get_logger("wva.collector")
 
 # -- scraped input series (vLLM-TPU exports the same vllm:* family) --------
+VLLM_REQUEST_ARRIVAL_TOTAL = "vllm:request_arrival_total"
 VLLM_REQUEST_SUCCESS_TOTAL = "vllm:request_success_total"
 VLLM_REQUEST_PROMPT_TOKENS_SUM = "vllm:request_prompt_tokens_sum"
 VLLM_REQUEST_PROMPT_TOKENS_COUNT = "vllm:request_prompt_tokens_count"
@@ -50,7 +51,17 @@ def _ratio(num: str, den: str, model: str, namespace: str) -> str:
     return f"{_rate_sum(num, model, namespace)}/{_rate_sum(den, model, namespace)}"
 
 
+def true_arrival_rate_query(model: str, namespace: str) -> str:
+    """Demand measured at admission. Under saturation the success rate caps
+    at delivered throughput, hiding excess load; the arrival counter does
+    not (reference emulator exports it, metrics.py:29-38, but the reference
+    collector never reads it — collector.go:170. We prefer it)."""
+    return _rate_sum(VLLM_REQUEST_ARRIVAL_TOTAL, model, namespace)
+
+
 def arrival_rate_query(model: str, namespace: str) -> str:
+    """Completion-rate fallback for endpoints that lack the arrival counter
+    (reference parity, collector.go:170)."""
     return _rate_sum(VLLM_REQUEST_SUCCESS_TOTAL, model, namespace)
 
 
@@ -107,11 +118,33 @@ class CollectedLoad:
     avg_itl_ms: float
 
 
-def _first_value(prom: PromAPI, promql: str) -> float:
+class IncompleteMetricsError(Exception):
+    """Load exists but the series needed to model it do not.
+
+    Raised when arrivals are nonzero while a token/latency aggregate is
+    absent (or NaN, i.e. 0/0: no completions in the rate window). Feeding
+    the resulting 0.0 into the engine would misread a loaded variant as
+    idle and take the zero-load path (the reference zero-fills here,
+    collector.go:51-76 — a flaw we deliberately do not reproduce)."""
+
+    def __init__(self, model: str, namespace: str, missing: list[str]):
+        self.missing = missing
+        super().__init__(
+            f"model '{model}' in '{namespace}' shows nonzero arrivals but "
+            f"no usable data for: {', '.join(missing)}; the scrape may be "
+            "partial or no request has completed within the rate window"
+        )
+
+
+def _value_or_none(prom: PromAPI, promql: str) -> float | None:
+    """One aggregate value; None when the series is absent or the sample is
+    NaN/Inf (PromQL 0/0 or overflow) — 'unknown' must stay distinguishable
+    from a genuine 0.0."""
     samples = prom.query(promql)
     if not samples:
-        return 0.0
-    return fix_value(samples[0].value)
+        return None
+    v = samples[0].value
+    return v if fix_value(v) == v else None
 
 
 def validate_metrics_availability(
@@ -167,20 +200,84 @@ def validate_metrics_availability(
     )
 
 
-def collect_load(prom: PromAPI, model: str, namespace: str) -> CollectedLoad:
-    """Run the 5 aggregate queries (reference collector.go:158-278) and
-    convert units: arrival req/s -> req/min, latencies sec -> msec."""
-    arrival = _first_value(prom, arrival_rate_query(model, namespace)) * 60.0
-    in_tok = _first_value(prom, avg_prompt_tokens_query(model, namespace))
-    out_tok = _first_value(prom, avg_generation_tokens_query(model, namespace))
-    ttft_ms = _first_value(prom, avg_ttft_query(model, namespace)) * 1000.0
-    itl_ms = _first_value(prom, avg_itl_query(model, namespace)) * 1000.0
+# Token-stat defaults for a cold start with no history anywhere (a fresh
+# VA whose first-ever requests haven't completed): a generic chat mix.
+DEFAULT_AVG_INPUT_TOKENS = 128.0
+DEFAULT_AVG_OUTPUT_TOKENS = 128.0
+
+
+def collect_load(
+    prom: PromAPI,
+    model: str,
+    namespace: str,
+    fallback: CollectedLoad | None = None,
+) -> CollectedLoad:
+    """Run the aggregate queries (reference collector.go:158-278) and
+    convert units: arrival req/s -> req/min, latencies sec -> msec.
+
+    Demand is the admission-side arrival rate when the endpoint exports it,
+    falling back to the completion rate otherwise (see
+    true_arrival_rate_query). When arrivals are nonzero but a modeling
+    series is unusable, two states are distinguished:
+
+    - completions ARE flowing (success rate > 0) yet an aggregate is
+      absent: the scrape is genuinely partial -> IncompleteMetricsError
+      (never zero-fill; the reference's zero-fill at collector.go:51-76
+      misreads a loaded variant as idle).
+    - nothing has completed in the rate window (scaled to zero, cold
+      start, or hard saturation): 0/0 aggregates are *expected*, and the
+      variant must still be sized or it can never scale up — token stats
+      fall back to the caller-provided last-known values (CR status), then
+      to defaults.
+    """
+    success_rps: float | None = None
+    success_fetched = False
+    arrival_rps = _value_or_none(prom, true_arrival_rate_query(model, namespace))
+    if arrival_rps is None:
+        success_rps = _value_or_none(prom, arrival_rate_query(model, namespace))
+        success_fetched = True
+        arrival_rps = success_rps
+        if arrival_rps is None:
+            log.warning("no arrival or success rate observable; treating as idle",
+                        extra=kv(model=model, namespace=namespace))
+            arrival_rps = 0.0
+
+    in_tok = _value_or_none(prom, avg_prompt_tokens_query(model, namespace))
+    out_tok = _value_or_none(prom, avg_generation_tokens_query(model, namespace))
+    ttft_s = _value_or_none(prom, avg_ttft_query(model, namespace))
+    itl_s = _value_or_none(prom, avg_itl_query(model, namespace))
+
+    missing = [name for name, v in (
+        ("avg_prompt_tokens", in_tok),
+        ("avg_generation_tokens", out_tok),
+        ("avg_ttft", ttft_s),
+        ("avg_itl", itl_s),
+    ) if v is None]
+    if arrival_rps > 0.0 and missing:
+        if not success_fetched:
+            success_rps = _value_or_none(prom, arrival_rate_query(model, namespace))
+        if success_rps is not None and success_rps > 0.0:
+            raise IncompleteMetricsError(model, namespace, missing)
+        # no completions in the window: size from demand + best-known
+        # token stats so scale-from-zero / cold-start can proceed
+        if in_tok is None:
+            in_tok = (fallback.avg_input_tokens if fallback else 0.0) \
+                or DEFAULT_AVG_INPUT_TOKENS
+        if out_tok is None:
+            out_tok = (fallback.avg_output_tokens if fallback else 0.0) \
+                or DEFAULT_AVG_OUTPUT_TOKENS
+        log.info(
+            "arrivals without completions in window; using fallback token stats",
+            extra=kv(model=model, namespace=namespace,
+                     avg_input_tokens=in_tok, avg_output_tokens=out_tok),
+        )
+
     return CollectedLoad(
-        arrival_rate_rpm=arrival,
-        avg_input_tokens=in_tok,
-        avg_output_tokens=out_tok,
-        avg_ttft_ms=ttft_ms,
-        avg_itl_ms=itl_ms,
+        arrival_rate_rpm=arrival_rps * 60.0,
+        avg_input_tokens=in_tok or 0.0,
+        avg_output_tokens=out_tok or 0.0,
+        avg_ttft_ms=(ttft_s or 0.0) * 1000.0,
+        avg_itl_ms=(itl_s or 0.0) * 1000.0,
     )
 
 

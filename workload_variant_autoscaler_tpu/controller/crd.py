@@ -47,6 +47,7 @@ TYPE_OPTIMIZATION_READY = "OptimizationReady"
 REASON_METRICS_FOUND = "MetricsFound"
 REASON_METRICS_MISSING = "MetricsMissing"
 REASON_METRICS_STALE = "MetricsStale"
+REASON_METRICS_INCOMPLETE = "MetricsIncomplete"
 REASON_PROMETHEUS_ERROR = "PrometheusError"
 REASON_OPTIMIZATION_SUCCEEDED = "OptimizationSucceeded"
 REASON_OPTIMIZATION_FAILED = "OptimizationFailed"

@@ -19,6 +19,7 @@ from typing import Optional
 
 from ..actuator import Actuator
 from ..collector import (
+    IncompleteMetricsError,
     PromAPI,
     collect_inventory_k8s,
     collect_load,
@@ -74,6 +75,10 @@ class Reconciler:
         self.config_namespace = config_namespace
         self.now = now
         self.sleep = sleep
+        # recommendation history per VA for scale-down stabilization
+        # (in-memory like HPA's window; a controller restart just delays
+        # one scale-down, the fail-safe direction)
+        self._recommendations: dict[str, list[tuple[float, int]]] = {}
 
     # -- config reading (reference controller.go:490-594) ----------------
 
@@ -120,6 +125,11 @@ class Reconciler:
         for va in vas:
             if not va.is_active():
                 result.skipped[full_name(va.name, va.namespace)] = "deleted"
+        # drop stabilization history for VAs that no longer exist (bounds
+        # memory; a recreated namesake starts with a clean window)
+        active_keys = {full_name(va.name, va.namespace) for va in active}
+        for stale in [k for k in self._recommendations if k not in active_keys]:
+            del self._recommendations[stale]
         if not active:
             log.info("no active VariantAutoscalings, skipping optimization")
             return result
@@ -202,17 +212,66 @@ class Reconciler:
 
         # publish (keyed by full name: same-named VAs in different
         # namespaces must not collide)
+        stabilization_s = self._stabilization_window(operator_cm)
         optimized: dict[str, crd.OptimizedAlloc] = {}
         for va, _deploy in prepared:
+            key = full_name(va.name, va.namespace)
             try:
-                optimized[full_name(va.name, va.namespace)] = translate.create_optimized_alloc(
+                alloc = translate.create_optimized_alloc(
                     va.name, va.namespace, solution, now=self.now()
                 )
             except KeyError:
                 log.info("no optimized allocation for variant", extra=kv(variant=va.name))
+                continue
+            alloc.num_replicas = self._stabilize_scale_down(
+                key, alloc.num_replicas, stabilization_s,
+                prev_published=va.status.desired_optimized_alloc.num_replicas,
+            )
+            optimized[key] = alloc
 
         self._apply(prepared, optimized, result)
         return result
+
+    # -- scale-down stabilization (beyond-reference; HPA-style) -----------
+
+    def _stabilization_window(self, operator_cm: dict[str, str]) -> float:
+        """WVA_SCALE_DOWN_STABILIZATION duration from the operator
+        ConfigMap; 0 (the default) preserves the reference's immediate
+        scale-down behavior."""
+        raw = operator_cm.get("WVA_SCALE_DOWN_STABILIZATION", "")
+        if not raw:
+            return 0.0
+        try:
+            return translate.parse_duration(raw)
+        except ValueError:
+            log.warning("bad WVA_SCALE_DOWN_STABILIZATION, ignoring",
+                        extra=kv(value=raw))
+            return 0.0
+
+    def _stabilize_scale_down(self, key: str, desired: int, window_s: float,
+                              prev_published: int = 0) -> int:
+        """Publish max(recommendations over the last window_s): scale-up is
+        immediate, scale-down waits until the lower recommendation has held
+        for the whole window. Kills replica-count flapping under noisy
+        rate-window arrival estimates, which otherwise causes drain churn
+        and tail-latency spikes exactly when the system is near
+        saturation."""
+        t = self.now()
+        history = self._recommendations.setdefault(key, [])
+        if window_s <= 0.0:
+            history[:] = [(t, desired)]
+            return desired
+        cutoff = t - window_s
+        while history and history[0][0] < cutoff:
+            history.pop(0)
+        if not history and prev_published > desired:
+            # gap in the window (controller restart, or cycles skipped
+            # longer than window_s): re-seed from the value on the CR
+            # status so the published allocation is held one full window
+            # instead of dropping instantly — the fail-safe direction
+            history.append((t, prev_published))
+        history.append((t, desired))
+        return max(r for _t, r in history)
 
     # -- preparation (reference controller.go:218-335) -------------------
 
@@ -307,7 +366,21 @@ class Reconciler:
                 continue
 
             try:
-                load = collect_load(self.prom, model, deploy.namespace)
+                load = collect_load(self.prom, model, deploy.namespace,
+                                    fallback=self._last_known_load(va))
+            except IncompleteMetricsError as e:
+                # loaded variant with unusable modeling series: scaling it
+                # on zero-filled data would tear it down to min replicas —
+                # skip and say why on the CR instead
+                log.warning("metrics incomplete, skipping variant",
+                            extra=kv(variant=name, missing=e.missing))
+                crd.set_condition(
+                    va, crd.TYPE_METRICS_AVAILABLE, "False",
+                    crd.REASON_METRICS_INCOMPLETE, str(e), now=self.now(),
+                )
+                self._update_status(va)
+                result.skipped[key] = crd.REASON_METRICS_INCOMPLETE
+                continue
             except Exception as e:  # noqa: BLE001
                 log.error("failed to collect metrics", extra=kv(variant=name, error=str(e)))
                 result.skipped[key] = "metric collection failed"
@@ -331,6 +404,22 @@ class Reconciler:
             prepared.append((va, deploy))
             result.processed.append(key)
         return prepared
+
+    @staticmethod
+    def _last_known_load(va: crd.VariantAutoscaling):
+        """Token stats last published to the CR status — the checkpoint
+        collect_load falls back to when arrivals resume after a quiet
+        window (scale-from-zero) and no completion aggregates exist yet."""
+        from ..collector import CollectedLoad
+
+        prev = va.status.current_alloc.load
+        return CollectedLoad(
+            arrival_rate_rpm=parse_float_or(prev.arrival_rate, 0.0),
+            avg_input_tokens=parse_float_or(prev.avg_input_tokens, 0.0),
+            avg_output_tokens=parse_float_or(prev.avg_output_tokens, 0.0),
+            avg_ttft_ms=0.0,
+            avg_itl_ms=0.0,
+        )
 
     @staticmethod
     def _configured_max_batch(va: crd.VariantAutoscaling, acc_name: str) -> int:

@@ -30,6 +30,8 @@ class PrometheusSink(MetricsSink):
             self._labels["namespace"] = namespace
 
         r = self.registry
+        self.request_arrival = Counter(
+            "vllm:request_arrival", "Requests received", labelnames, registry=r)
         self.request_success = Counter(
             "vllm:request_success", "Requests completed", labelnames, registry=r)
         self.prompt_tokens = Histogram(
@@ -53,7 +55,10 @@ class PrometheusSink(MetricsSink):
             labelnames, registry=r)
 
     def on_arrival(self, req: Request) -> None:
-        pass  # arrivals counted on success (collector keys off success rate)
+        # True demand signal: counted at admission to the fleet, not at
+        # completion, so the collector can see load a saturated replica
+        # cannot deliver (reference tools/vllm-emulator/metrics.py:29-35).
+        self.request_arrival.labels(**self._labels).inc()
 
     def on_first_token(self, req: Request) -> None:
         self.ttft_seconds.labels(**self._labels).observe(max(req.ttft_ms, 0.0) / 1000.0)

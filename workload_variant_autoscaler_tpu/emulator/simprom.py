@@ -21,6 +21,7 @@ from ..collector import (
     avg_itl_query,
     avg_prompt_tokens_query,
     avg_ttft_query,
+    true_arrival_rate_query,
 )
 from ..collector.prometheus import Sample
 from .metrics import PrometheusSink
@@ -43,6 +44,7 @@ class SimPromAPI:
     def _register_queries(self) -> None:
         m, ns = self.model, self.namespace
         self._queries = {
+            true_arrival_rate_query(m, ns): ("rate", "vllm:request_arrival_total"),
             arrival_rate_query(m, ns): ("rate", "vllm:request_success_total"),
             avg_prompt_tokens_query(m, ns): (
                 "ratio", ("vllm:request_prompt_tokens_sum",
@@ -65,6 +67,12 @@ class SimPromAPI:
         self.history.append((self.now_s, self.sink.counters()))
 
     # -- PromAPI ---------------------------------------------------------
+
+    def _present(self, series: str) -> bool:
+        """A series 'exists' once the emulator has ever emitted it — like a
+        real Prometheus, where rate() over an absent series returns an
+        empty vector, not zero."""
+        return bool(self.history) and series in self.history[-1][1]
 
     def _rate(self, series: str) -> float:
         if len(self.history) < 2:
@@ -98,8 +106,14 @@ class SimPromAPI:
             return []
         kind, payload = spec
         if kind == "rate":
+            if not self._present(payload):
+                return []
             return [Sample(labels=labels, value=self._rate(payload), timestamp=self.now_s)]
         num, den = payload
+        if not (self._present(num) and self._present(den)):
+            return []
         den_rate = self._rate(den)
-        value = self._rate(num) / den_rate if den_rate > 0 else 0.0
+        # 0/0 is NaN in PromQL: both series exist but nothing completed in
+        # the window — 'unknown', which the collector must not read as 0
+        value = self._rate(num) / den_rate if den_rate > 0 else float("nan")
         return [Sample(labels=labels, value=value, timestamp=self.now_s)]
