@@ -20,12 +20,41 @@ test-fast: ## Run tests, stop at first failure, quieter
 	$(PY) -m pytest tests/ -x -q -p no:cacheprovider
 
 .PHONY: bench
-bench: ## Run the benchmark (one JSON line; uses a real TPU when present)
+bench: ## Run the kernel benchmark (one JSON line; uses a real TPU when present)
 	$(PY) bench.py
+
+.PHONY: bench-loop
+bench-loop: ## North-star closed-loop benchmark: chip-hours to hold p95-ITL SLO (sim-time, CPU, ~2 min)
+	$(PY) bench_loop.py
 
 .PHONY: lint
 lint: ## Byte-compile as a basic syntax gate
 	$(PY) -m compileall -q workload_variant_autoscaler_tpu tests
+
+.PHONY: crd-docs
+crd-docs: ## Regenerate docs/reference/variantautoscaling.md from the CRD manifest
+	$(PY) docs/gen_crd_docs.py
+
+ENVTEST_K8S_VERSION ?= 1.31.0
+ENVTEST_DIR ?= $(HOME)/.local/share/kubebuilder-envtest
+
+.PHONY: setup-envtest
+setup-envtest: ## Download kube-apiserver+etcd for the real-apiserver test tier
+	rm -rf $(ENVTEST_DIR)/tmp $(ENVTEST_DIR)/k8s/$(ENVTEST_K8S_VERSION)
+	mkdir -p $(ENVTEST_DIR)/tmp $(ENVTEST_DIR)/k8s
+	curl -fsSL "https://github.com/kubernetes-sigs/controller-tools/releases/download/envtest-v$(ENVTEST_K8S_VERSION)/envtest-v$(ENVTEST_K8S_VERSION)-linux-amd64.tar.gz" \
+		| tar -xz -C $(ENVTEST_DIR)/tmp
+	mv $(ENVTEST_DIR)/tmp/controller-tools/envtest $(ENVTEST_DIR)/k8s/$(ENVTEST_K8S_VERSION)
+	rm -rf $(ENVTEST_DIR)/tmp
+	test -x $(ENVTEST_DIR)/k8s/$(ENVTEST_K8S_VERSION)/kube-apiserver
+	ls $(ENVTEST_DIR)/k8s/$(ENVTEST_K8S_VERSION)
+
+.PHONY: test-envtest
+test-envtest: ## Integration tests against a real kube-apiserver (skips if binaries absent)
+	KUBEBUILDER_ASSETS=$$(ls -d $(ENVTEST_DIR)/k8s/*/ 2>/dev/null \
+			| while read -r d; do test -x "$$d/kube-apiserver" && echo "$$d"; done \
+			| sort -V | tail -1) \
+		$(PY) -m pytest tests/test_envtest.py -v
 
 .PHONY: native
 native: ## Build the C++ queueing kernel (single build recipe in ops/native.py)
@@ -66,6 +95,10 @@ deploy-wva-emulated-on-kind: ## Install the full emulated stack on kind
 .PHONY: teardown-kind
 teardown-kind: ## Delete the kind cluster
 	deploy/kind-tpu-emulator/teardown.sh $(CLUSTER)
+
+.PHONY: test-e2e-kind
+test-e2e-kind: ## Full kind e2e: fake-TPU cluster, controller, loadgen, scale-out assertion (needs docker+kind)
+	deploy/kind-tpu-emulator/e2e.sh
 
 .PHONY: install-crd
 install-crd: ## Apply the VariantAutoscaling CRD

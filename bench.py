@@ -45,7 +45,7 @@ def build_candidates(b: int, seed: int = 0):
     }
 
 
-def bench_tpu(c, iters: int = 20) -> float:
+def bench_tpu(c, iters: int = 20):
     import jax
     import jax.numpy as jnp
 
@@ -78,8 +78,59 @@ def bench_tpu(c, iters: int = 20) -> float:
         return len(c["alpha"]) * iters / (time.perf_counter() - t0)
 
     # best of 3: the TPU is reached over a tunnel whose latency varies
-    # run-to-run; the max is the robust estimate of device throughput
-    return max(once() for _ in range(3))
+    # run-to-run; the max is the robust estimate of device throughput.
+    # All runs are returned so the recorded result carries the variance.
+    runs = [once() for _ in range(3)]
+    return max(runs), runs
+
+
+_XLA_STAGE = r"""
+import json
+import jax
+from bench import bench_tpu, build_candidates
+platform = jax.devices()[0].platform
+rate, runs = bench_tpu(build_candidates(4096))
+print(json.dumps({"rate": rate, "runs": runs, "platform": platform}))
+"""
+
+
+def run_xla_stage(timeout_s: float = 540.0) -> dict:
+    """Run the batched-kernel measurement in a subprocess with a hard
+    timeout, because the dev tunnel to the TPU can wedge indefinitely
+    (observed: block_until_ready never returning). One retry on a fresh
+    process (fresh tunnel session), then a clearly-labeled CPU fallback so
+    a wedged tunnel still yields a recorded number instead of a hang."""
+    import os
+    import subprocess
+    import sys
+
+    def attempt(env) -> dict | None:
+        try:
+            r = subprocess.run([sys.executable, "-c", _XLA_STAGE],
+                               capture_output=True, text=True,
+                               timeout=timeout_s, env=env,
+                               cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            return None
+        if r.returncode != 0:
+            return None
+        try:
+            return json.loads(r.stdout.strip().splitlines()[-1])
+        except (json.JSONDecodeError, IndexError):
+            return None
+
+    for _ in range(2):  # ambient backend (TPU when the tunnel works), one retry
+        out = attempt(dict(os.environ))
+        if out is not None:
+            return out  # platform reported by the subprocess itself
+    cpu_env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    out = attempt(cpu_env)
+    if out is not None:
+        out["platform"] = "cpu-fallback (TPU stage hung or failed)"
+        return out
+    return {"rate": 0.0, "runs": [], "platform": "error: all stages failed"}
 
 
 def bench_sequential(c) -> float:
@@ -116,14 +167,77 @@ def bench_sequential(c) -> float:
     return b / (time.perf_counter() - t0)
 
 
+_PALLAS_PROBE = r"""
+import json, time
+import jax, numpy as np, jax.numpy as jnp
+from workload_variant_autoscaler_tpu.ops.pallas_kernel import size_batch_pallas
+from workload_variant_autoscaler_tpu.ops.batched import (
+    SLOTargets, k_max_for, make_queue_batch)
+rng = np.random.default_rng(0); b = 4096
+q = make_queue_batch(
+    rng.uniform(4, 8, b), rng.uniform(.01, .05, b), rng.uniform(2, 6, b),
+    rng.uniform(.05, .15, b), np.full(b, 128.0), np.full(b, 128.0),
+    np.full(b, 64, dtype=np.int64), dtype=jnp.float32)
+t = SLOTargets(ttft=jnp.full(b, 500., jnp.float32),
+               itl=jnp.full(b, 24., jnp.float32),
+               tps=jnp.zeros(b, jnp.float32))
+k = k_max_for(np.full(b, 64))
+out = size_batch_pallas(q, t, k, interpret=False)
+jax.block_until_ready(out.lam_star)
+t0 = time.perf_counter()
+for _ in range(20):
+    out = size_batch_pallas(q, t, k, interpret=False)
+jax.block_until_ready(out.lam_star)
+print(json.dumps({"rate": b * 20 / (time.perf_counter() - t0)}))
+"""
+
+
+def probe_pallas_compile(timeout_s: float = 180.0) -> dict:
+    """Attempt a real Mosaic compile+run of the Pallas sizing kernel on the
+    ambient TPU, in a subprocess with a hard timeout: through the dev
+    tunnel the AOT helper is known to hang rather than fail (it lacks TPU
+    topology hints), and a hung probe must not wedge the whole bench."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run([sys.executable, "-c", _PALLAS_PROBE],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"status": "timeout",
+                "detail": f"Mosaic compile hung >{timeout_s:.0f}s (axon "
+                          "tunnel AOT helper lacks TPU topology hints); "
+                          "kernel is exact-parity validated in interpret "
+                          "mode (tests/test_pallas.py) and compiles on "
+                          "directly-attached TPUs"}
+    if r.returncode == 0:
+        try:
+            rate = json.loads(r.stdout.strip().splitlines()[-1])["rate"]
+        except (json.JSONDecodeError, KeyError, IndexError):
+            return {"status": "error", "detail": r.stdout[-300:]}
+        return {"status": "compiled", "sizings_per_sec": round(rate, 1)}
+    tail = (r.stderr or r.stdout).strip().splitlines()
+    return {"status": "error", "detail": " | ".join(tail[-3:])[:400]}
+
+
 def main() -> None:
-    tpu_rate = bench_tpu(build_candidates(4096))
+    xla = run_xla_stage()
     sequential_rate = bench_sequential(build_candidates(256))
+    on_accelerator = not (xla["platform"] == "cpu"
+                          or xla["platform"].startswith(("cpu-fallback",
+                                                         "error")))
+    pallas = (probe_pallas_compile() if on_accelerator
+              else {"status": "skipped",
+                    "detail": f"no accelerator ({xla['platform']})"})
     print(json.dumps({
         "metric": "candidate_sizings_per_sec",
-        "value": round(tpu_rate, 1),
+        "value": round(xla["rate"], 1),
         "unit": "candidates/s",
-        "vs_baseline": round(tpu_rate / sequential_rate, 2),
+        "vs_baseline": round(xla["rate"] / sequential_rate, 2),
+        "platform": xla["platform"],
+        # tunnel variance: the three raw rates behind the best-of-3 value
+        "runs": [round(r, 1) for r in xla["runs"]],
+        "pallas": pallas,
     }))
 
 

@@ -1,0 +1,264 @@
+"""North-star benchmark: chip-hours to hold the p95-ITL SLO under a
+ShareGPT-style load ramp (BASELINE.json metric).
+
+Runs the full closed loop — emulator fleet -> sim-time Prometheus ->
+reconciler -> (emulated) HPA actuation -> fleet replicas — entirely in
+simulated time on CPU, with the Llama-3.1-8B v5e-1 profile and the
+Premium service class (slo-tpot 24ms, slo-ttft 500ms; reference fixtures
+test/utils/unitutils.go:95-103). The emulator's decode/prefill physics
+follow the same fitted linear models the analyzer uses, so the measured
+ITL distribution is the ground truth the SLO is judged against.
+
+Scenario (committed; the reproducible config VERDICT r1 item 3 asked for):
+  - ShareGPT-like token mix: uniform lengths averaging 221 in / 179 out
+    (ShareGPT_V3 corpus means, rounded).
+  - 30-minute ramp, req/s: 10 -> 25 -> 45 -> 60 -> 25 -> 10 (300s each).
+  - Reconcile every 60s (reference default), WVA_SCALE_DOWN_STABILIZATION
+    180s, scale-to-zero off.
+
+Metric: chip-hours actually provisioned (active + draining replica-time,
+1 chip per v5e-1 replica) while p95 ITL (post-warmup) meets the SLO.
+Baseline: static peak provisioning — the replicas the sizer needs at the
+peak rate, held for the whole scenario (what you deploy without an
+autoscaler). vs_baseline = static chip-hours / autoscaled chip-hours.
+
+Prints ONE JSON line; exits nonzero if the SLO did not hold (a cheap
+answer that violates the SLO is not an answer).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time as _time
+from collections import Counter
+
+# CPU, always: this is a control-loop benchmark, not a kernel benchmark.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+# keep stdout clean for the single JSON result line
+os.environ.setdefault("LOG_LEVEL", "error")
+
+from workload_variant_autoscaler_tpu.controller import (  # noqa: E402
+    ACCELERATOR_CM_NAME,
+    CONFIG_MAP_NAME,
+    CONFIG_MAP_NAMESPACE,
+    SERVICE_CLASS_CM_NAME,
+    ConfigMap,
+    Deployment,
+    InMemoryKube,
+    Reconciler,
+    crd,
+)
+from workload_variant_autoscaler_tpu.emulator import (  # noqa: E402
+    Fleet,
+    PoissonLoadGenerator,
+    PrometheusSink,
+    SimPromAPI,
+    Simulation,
+    SliceModelConfig,
+    TokenDistribution,
+)
+from workload_variant_autoscaler_tpu.emulator.engine import MetricsSink  # noqa: E402
+from workload_variant_autoscaler_tpu.metrics import MetricsEmitter  # noqa: E402
+
+MODEL = "llama-8b"
+NS = "default"
+VARIANT = "chat-8b"
+
+# Llama-3.1-8B fitted profile (reference parameter-estimation.md:265 for
+# alpha/beta; emulator truth == analyzer model)
+CFG = SliceModelConfig(
+    model_name=MODEL, slice_name="v5e-1",
+    alpha=6.973, beta=0.027, gamma=5.2, delta=0.1,
+    max_batch_size=64, hbm_gb=16.0, model_size_gb=8.0, kv_mb_per_token=0.25,
+)
+SLO_ITL_MS = 24.0
+SLO_TTFT_MS = 500.0
+
+# ShareGPT-like mix and the ramp (see module docstring)
+TOKENS = TokenDistribution(avg_input_tokens=221, avg_output_tokens=179,
+                           distribution="uniform")
+RAMP = [(300, 600), (300, 1500), (300, 2700), (300, 3600), (300, 1500),
+        (300, 600)]  # (seconds, rpm)
+DURATION_MS = sum(d for d, _ in RAMP) * 1000.0
+WARMUP_MS = 120_000.0  # first reconcile periods: cold start, not steady state
+RECONCILE_MS = 60_000.0
+CHIPS_PER_REPLICA = 1  # v5e-1
+SEED = 20260729
+
+
+class LatencySink(MetricsSink):
+    """Compact ITL/TTFT percentile recorder: decode steps take few distinct
+    values (alpha + beta*batch), so a Counter stays tiny at millions of
+    tokens."""
+
+    def __init__(self, from_ms: float):
+        self.from_ms = from_ms
+        self.now_ms = 0.0
+        self.itl_counts: Counter[float] = Counter()
+        self.ttfts: list[tuple[float, float]] = []
+
+    def on_token(self, dt_ms: float) -> None:
+        if self.now_ms >= self.from_ms:
+            self.itl_counts[round(dt_ms, 3)] += 1
+
+    def on_first_token(self, req) -> None:
+        self.ttfts.append((req.first_token_ms, req.ttft_ms))
+
+    def p95_itl(self) -> float:
+        total = sum(self.itl_counts.values())
+        if total == 0:
+            return float("nan")
+        seen = 0
+        for dt in sorted(self.itl_counts):
+            seen += self.itl_counts[dt]
+            if seen >= 0.95 * total:
+                return dt
+        return max(self.itl_counts)
+
+    def p95_ttft(self, from_ms: float) -> float:
+        vals = sorted(v for t, v in self.ttfts if t >= from_ms)
+        if not vals:
+            return float("nan")
+        return vals[int(len(vals) * 0.95)]
+
+
+class _Composite:
+    def __init__(self, *sinks):
+        self.sinks = sinks
+
+    def __getattr__(self, name):
+        targets = [getattr(s, name) for s in self.sinks]
+
+        def fan_out(*args, **kwargs):
+            for t in targets:
+                t(*args, **kwargs)
+        return fan_out
+
+
+def build_loop():
+    prom_sink = PrometheusSink(MODEL, NS)
+    lat = LatencySink(from_ms=WARMUP_MS)
+    fleet = Fleet(CFG, _Composite(prom_sink, lat), replicas=1)
+    sim = Simulation(fleet, seed=SEED)
+    prom = SimPromAPI(prom_sink, MODEL, NS)
+
+    kube = InMemoryKube()
+    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE, {
+        "GLOBAL_OPT_INTERVAL": "60s",
+        "WVA_SCALE_DOWN_STABILIZATION": "180s",
+    }))
+    kube.put_configmap(ConfigMap(
+        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"v5e-1": json.dumps({"chip": "v5e", "chips": "1", "cost": "20.0"})},
+    ))
+    kube.put_configmap(ConfigMap(
+        SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"premium": (
+            "name: Premium\npriority: 1\ndata:\n"
+            f"  - model: {MODEL}\n    slo-tpot: {SLO_ITL_MS:.0f}\n"
+            f"    slo-ttft: {SLO_TTFT_MS:.0f}\n"
+        )},
+    ))
+    kube.put_deployment(Deployment(name=VARIANT, namespace=NS,
+                                   spec_replicas=1, status_replicas=1))
+    va = crd.VariantAutoscaling(
+        metadata=crd.ObjectMeta(name=VARIANT, namespace=NS,
+                                labels={crd.ACCELERATOR_LABEL: "v5e-1"}),
+        spec=crd.VariantAutoscalingSpec(
+            model_id=MODEL,
+            slo_class_ref=crd.ConfigMapKeyRef(name=SERVICE_CLASS_CM_NAME,
+                                              key="premium"),
+            model_profile=crd.ModelProfile(accelerators=[
+                crd.AcceleratorProfile(
+                    acc="v5e-1", acc_count=1,
+                    perf_parms=crd.PerfParms(
+                        decode_parms={"alpha": str(CFG.alpha),
+                                      "beta": str(CFG.beta)},
+                        prefill_parms={"gamma": str(CFG.gamma),
+                                       "delta": str(CFG.delta)},
+                    ),
+                    max_batch_size=CFG.max_batch_size,
+                ),
+            ]),
+        ),
+    )
+    kube.put_variant_autoscaling(va)
+
+    rec = Reconciler(kube=kube, prom=prom, emitter=MetricsEmitter(),
+                     now=lambda: sim.now_ms / 1000.0, sleep=lambda _s: None)
+    return sim, fleet, prom, kube, rec, lat
+
+
+def run(ramp=None, warmup_ms: float = WARMUP_MS,
+        reconcile_ms: float = RECONCILE_MS) -> dict:
+    ramp = RAMP if ramp is None else ramp
+    duration_ms = sum(d for d, _ in ramp) * 1000.0
+    sim, fleet, prom, kube, rec, lat = build_loop()
+    lat.from_ms = warmup_ms
+    gen = PoissonLoadGenerator(sim, schedule=ramp, tokens=TOKENS, seed=SEED)
+    gen.start()
+
+    chip_ms = 0.0
+    last_sample_ms = 0.0
+    history: list[tuple[float, int]] = []
+    reconcile_wall_ms: list[float] = []
+    next_reconcile = reconcile_ms
+
+    def on_tick(now_ms):
+        nonlocal chip_ms, last_sample_ms, next_reconcile
+        lat.now_ms = now_ms
+        # chip-time integral: pay for every live pod, draining included
+        provisioned = len(fleet.all_replicas()) * CHIPS_PER_REPLICA
+        chip_ms += provisioned * (now_ms - last_sample_ms)
+        last_sample_ms = now_ms
+        prom.scrape(now_ms)
+        if now_ms >= next_reconcile:
+            next_reconcile += reconcile_ms
+            t0 = _time.perf_counter()
+            rec.reconcile()
+            reconcile_wall_ms.append((_time.perf_counter() - t0) * 1000.0)
+            va = kube.get_variant_autoscaling(VARIANT, NS)
+            desired = va.status.desired_optimized_alloc.num_replicas
+            history.append((now_ms, desired))
+            kube.put_deployment(Deployment(name=VARIANT, namespace=NS,
+                                           spec_replicas=desired,
+                                           status_replicas=desired))
+            fleet.set_replicas(max(desired, 0), now_ms)
+            sim.kick()
+
+    sim.run_until(duration_ms, on_tick=on_tick, tick_ms=5000.0)
+
+    chip_hours = chip_ms / 3_600_000.0
+    peak_replicas = max(d for _t, d in history)
+    static_chip_hours = (peak_replicas * CHIPS_PER_REPLICA
+                         * duration_ms / 3_600_000.0)
+    p95_itl = lat.p95_itl()
+    p95_ttft = lat.p95_ttft(warmup_ms)
+    return {
+        "metric": "chip_hours_to_hold_p95_itl_slo",
+        "value": round(chip_hours, 3),
+        "unit": "chip-hours",
+        "vs_baseline": round(static_chip_hours / chip_hours, 3),
+        "slo_held": bool(p95_itl <= SLO_ITL_MS),
+        "p95_itl_ms": round(p95_itl, 3),
+        "slo_itl_ms": SLO_ITL_MS,
+        "p95_ttft_ms": round(p95_ttft, 1),
+        "static_peak_chip_hours": round(static_chip_hours, 3),
+        "peak_replicas": peak_replicas,
+        "requests": gen.generated,
+        # wall-clock of one full collect->analyze->optimize->publish cycle
+        # (the reference never publishes this; its SolutionTimeMsec is the
+        # solver step only)
+        "reconcile_wall_ms_p50": round(sorted(reconcile_wall_ms)[len(reconcile_wall_ms) // 2], 2),
+        "reconcile_wall_ms_max": round(max(reconcile_wall_ms), 2),
+        "scenario": "sharegpt-ramp-30min-v5e1-llama8b-premium",
+    }
+
+
+if __name__ == "__main__":
+    result = run()
+    print(json.dumps(result))
+    sys.exit(0 if result["slo_held"] else 1)

@@ -10,6 +10,8 @@ set -euo pipefail
 CLUSTER_NAME="wva-tpu"
 IMAGE="workload-variant-autoscaler-tpu:latest"
 WITH_PROMETHEUS=0
+PROM_URL=""
+ALLOW_HTTP_PROM=0
 REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
 
 while [[ $# -gt 0 ]]; do
@@ -17,6 +19,12 @@ while [[ $# -gt 0 ]]; do
     --name) CLUSTER_NAME="$2"; shift 2 ;;
     --image) IMAGE="$2"; shift 2 ;;
     --with-prometheus) WITH_PROMETHEUS=1; shift ;;
+    # Point the controller at an alternative PromQL endpoint (e.g. the
+    # emulator's --with-prom-api shim) BEFORE it first starts: the
+    # controller hard-fails without reachable Prometheus, so patching
+    # after the rollout wait would deadlock on a crash-looping pod.
+    --prom-url) PROM_URL="$2"; shift 2 ;;
+    --allow-http-prom) ALLOW_HTTP_PROM=1; shift ;;
     *) echo "unknown flag $1" >&2; exit 2 ;;
   esac
 done
@@ -37,9 +45,22 @@ echo ">> installing CRD + manager + config"
 kubectl apply -f "${REPO_ROOT}/deploy/crd/"
 kubectl apply -f "${REPO_ROOT}/deploy/manager/namespace.yaml"
 kubectl apply -f "${REPO_ROOT}/deploy/config/"
+if [[ -n "${PROM_URL}" ]]; then
+  kubectl -n workload-variant-autoscaler-system patch configmap \
+    workload-variant-autoscaler-variantautoscaling-config \
+    --type merge -p "{\"data\":{\"PROMETHEUS_BASE_URL\":\"${PROM_URL}\"}}"
+fi
 kubectl apply -f "${REPO_ROOT}/deploy/manager/rbac.yaml"
 kubectl apply -f "${REPO_ROOT}/deploy/manager/deployment.yaml"
+if [[ "${ALLOW_HTTP_PROM}" == "1" ]]; then
+  kubectl -n workload-variant-autoscaler-system patch deployment wva-controller \
+    --type json -p '[{"op": "add",
+      "path": "/spec/template/spec/containers/0/args/-",
+      "value": "--allow-http-prom"}]'
+fi
 kubectl apply -f "${REPO_ROOT}/deploy/manager/metrics-service.yaml" || true  # ServiceMonitor CRD may be absent
+kubectl apply -f "${REPO_ROOT}/deploy/network-policy/" || true  # no-op without a CNI enforcing policies
+kubectl apply -f "${REPO_ROOT}/deploy/prometheus/" || true      # requires prometheus-operator CRDs
 
 echo ">> installing the TPU emulator variant + VariantAutoscaling"
 kubectl apply -f "${REPO_ROOT}/deploy/examples/tpu-emulator/emulator.yaml" || true

@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# End-to-end scale-out assertion on a kind cluster (the runnable analogue
+# of the reference's kind e2e, test/e2e/e2e_test.go:358-444):
+#
+#   fake-TPU kind cluster -> controller + emulator -> loadgen Job
+#   -> assert the VariantAutoscaling status recommends > 1 replica
+#   -> assert the controller's /metrics agrees (inferno_desired_replicas)
+#
+# Self-contained: no helm/Prometheus required — the emulator serves a
+# PromQL shim (--with-prom-api) and the controller is pointed at it over
+# HTTP (--allow-http-prom; emulation-only escape hatch).
+#
+# Requires: docker, kind, kubectl. Run via `make test-e2e-kind`.
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-wva-tpu-e2e}"
+IMAGE="${IMAGE:-workload-variant-autoscaler-tpu:latest}"
+TIMEOUT_S="${TIMEOUT_S:-600}"
+KEEP_CLUSTER="${KEEP_CLUSTER:-0}"
+SCRIPT_DIR="$(cd "$(dirname "$0")" && pwd)"
+NS_SYS="workload-variant-autoscaler-system"
+
+cleanup() {
+  if [[ "${KEEP_CLUSTER}" != "1" ]]; then
+    "${SCRIPT_DIR}/teardown.sh" "${CLUSTER_NAME}" || true
+  fi
+}
+trap cleanup EXIT
+
+"${SCRIPT_DIR}/setup.sh" --name "${CLUSTER_NAME}"
+# the egress network policy opens scrape-target ports only toward
+# namespaces labeled metrics:enabled; the emulator shim lives in default
+kubectl label namespace default metrics=enabled --overwrite
+# the controller hard-fails without a reachable PromQL endpoint, so it
+# must be born pointed at the emulator's shim (patching afterwards would
+# deadlock on a crash-looping rollout wait)
+"${SCRIPT_DIR}/deploy-wva.sh" --name "${CLUSTER_NAME}" --image "${IMAGE}" \
+  --prom-url "http://chat-8b.default.svc.cluster.local:8000" \
+  --allow-http-prom
+
+echo ">> starting the load ramp"
+kubectl delete job chat-8b-loadgen --ignore-not-found
+kubectl apply -f "${SCRIPT_DIR}/../examples/tpu-emulator/loadgen-job.yaml"
+
+echo ">> waiting (up to ${TIMEOUT_S}s) for scale-out past 1 replica"
+deadline=$((SECONDS + TIMEOUT_S))
+desired=0
+while ((SECONDS < deadline)); do
+  desired="$(kubectl get variantautoscaling chat-8b -n default \
+    -o jsonpath='{.status.desiredOptimizedAlloc.numReplicas}' 2>/dev/null || echo 0)"
+  desired="${desired:-0}"
+  echo "   t+${SECONDS}s desiredOptimizedAlloc.numReplicas=${desired}"
+  if ((desired > 1)); then break; fi
+  sleep 15
+done
+if ((desired <= 1)); then
+  echo "FAIL: controller never recommended > 1 replica" >&2
+  kubectl -n "${NS_SYS}" logs deploy/wva-controller --tail=100 >&2 || true
+  exit 1
+fi
+
+echo ">> asserting the emitted series agrees with the CR status"
+kubectl -n "${NS_SYS}" port-forward deploy/wva-controller 18443:8443 &
+PF_PID=$!
+sleep 3
+metric_line="$(curl -ks https://127.0.0.1:18443/metrics http://127.0.0.1:18443/metrics 2>/dev/null \
+  | grep '^inferno_desired_replicas' | grep 'chat-8b' || true)"
+kill "${PF_PID}" 2>/dev/null || true
+echo "   ${metric_line:-<no sample>}"
+if [[ -z "${metric_line}" ]]; then
+  echo "FAIL: inferno_desired_replicas for chat-8b not exposed" >&2
+  exit 1
+fi
+emitted="$(echo "${metric_line}" | awk '{printf "%d", $NF}')"
+if ((emitted != desired)); then
+  echo "FAIL: emitted ${emitted} != CR status ${desired}" >&2
+  exit 1
+fi
+
+echo "PASS: kind e2e — desired=${desired}, emitted series agrees"

@@ -1,0 +1,87 @@
+"""Generate CRD reference docs from the CRD manifest (`make crd-docs`).
+
+The reference uses elastic/crd-ref-docs against its Go types (Makefile
+crd-ref-docs target); here the OpenAPI v3 schema in
+deploy/crd/variantautoscaling-crd.yaml is the single source of truth, so
+docs are generated from it directly — no annotations to drift.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import yaml
+
+REPO = Path(__file__).resolve().parent.parent
+CRD = REPO / "deploy" / "crd" / "variantautoscaling-crd.yaml"
+OUT = REPO / "docs" / "reference" / "variantautoscaling.md"
+
+
+def walk(name: str, schema: dict, required: bool, depth: int, rows: list) -> None:
+    typ = schema.get("type", "object")
+    extras = []
+    if "minimum" in schema:
+        extras.append(f"min {schema['minimum']}")
+    if "maximum" in schema:
+        extras.append(f"max {schema['maximum']}")
+    if schema.get("enum"):
+        extras.append("one of: " + ", ".join(map(str, schema["enum"])))
+    if typ == "array":
+        items = schema.get("items", {})
+        typ = f"[]{items.get('type', 'object')}"
+    desc = " ".join(schema.get("description", "").split())
+    indent = "&nbsp;&nbsp;" * depth
+    rows.append(
+        f"| {indent}`{name}` | {typ} | {'yes' if required else 'no'} "
+        f"| {desc}{(' (' + '; '.join(extras) + ')') if extras else ''} |"
+    )
+    props = schema.get("properties")
+    if typ.startswith("[]"):
+        props = schema.get("items", {}).get("properties")
+        schema = schema.get("items", {})
+    if props:
+        req = set(schema.get("required", []))
+        for child, child_schema in props.items():
+            walk(child, child_schema, child in req, depth + 1, rows)
+
+
+def main() -> int:
+    crd = yaml.safe_load(CRD.read_text())
+    version = crd["spec"]["versions"][0]
+    schema = version["schema"]["openAPIV3Schema"]
+    group = crd["spec"]["group"]
+    kind = crd["spec"]["names"]["kind"]
+
+    lines = [
+        f"# {kind} CRD reference",
+        "",
+        f"`apiVersion: {group}/{version['name']}` — generated from",
+        f"`deploy/crd/variantautoscaling-crd.yaml` by `make crd-docs`;",
+        "do not edit by hand.",
+        "",
+        "| Field | Type | Required | Description |",
+        "|---|---|---|---|",
+    ]
+    rows: list[str] = []
+    props = schema.get("properties", {})
+    req = set(schema.get("required", []))
+    for top in ("spec", "status"):
+        if top in props:
+            walk(top, props[top], top in req, 0, rows)
+    lines += rows
+
+    cols = version.get("additionalPrinterColumns", [])
+    if cols:
+        lines += ["", "## kubectl printer columns", "",
+                  "| Column | JSONPath |", "|---|---|"]
+        lines += [f"| {c['name']} | `{c['jsonPath']}` |" for c in cols]
+
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    OUT.write_text("\n".join(lines) + "\n")
+    print(f"wrote {OUT.relative_to(REPO)} ({len(rows)} fields)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

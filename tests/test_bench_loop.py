@@ -1,0 +1,29 @@
+"""Smoke test for the north-star benchmark harness (bench_loop.py).
+
+Runs a shrunk ramp through the identical measurement path so the committed
+BASELINE numbers stay reproducible: if this breaks, the published
+chip-hours figure can no longer be regenerated.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench_loop  # noqa: E402
+
+
+def test_mini_ramp_holds_slo_and_beats_static():
+    r = bench_loop.run(
+        ramp=[(60, 600), (120, 2700), (60, 600)],
+        warmup_ms=60_000.0,
+        reconcile_ms=30_000.0,
+    )
+    # the measurement contract bench_loop publishes
+    assert r["metric"] == "chip_hours_to_hold_p95_itl_slo"
+    assert r["unit"] == "chip-hours"
+    assert r["slo_held"] and r["p95_itl_ms"] <= r["slo_itl_ms"]
+    assert 0.0 < r["value"] < r["static_peak_chip_hours"]
+    assert r["vs_baseline"] > 1.0  # autoscaling must beat static peak
+    assert r["peak_replicas"] > 1
+    assert r["requests"] > 1000

@@ -236,44 +236,46 @@ class TestHealthServer:
             hs.stop()
 
 
+def make_certpair(certfile, keyfile, cn: str = "localhost"):
+    """Write a self-signed cert/key pair (cryptography package)."""
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=1))
+        .not_valid_after(now + datetime.timedelta(hours=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost")]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    certfile.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    keyfile.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(certfile), str(keyfile)
+
+
 class TestMetricsTLS:
     @pytest.fixture
     def certpair(self, tmp_path):
-        """Self-signed localhost cert via the cryptography package."""
-        import datetime
-
-        from cryptography import x509
-        from cryptography.hazmat.primitives import hashes, serialization
-        from cryptography.hazmat.primitives.asymmetric import rsa
-        from cryptography.x509.oid import NameOID
-
-        key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
-        name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
-        now = datetime.datetime.now(datetime.timezone.utc)
-        cert = (
-            x509.CertificateBuilder()
-            .subject_name(name).issuer_name(name)
-            .public_key(key.public_key())
-            .serial_number(x509.random_serial_number())
-            .not_valid_before(now - datetime.timedelta(minutes=1))
-            .not_valid_after(now + datetime.timedelta(hours=1))
-            .add_extension(
-                x509.SubjectAlternativeName([x509.DNSName("localhost")]),
-                critical=False,
-            )
-            .sign(key, hashes.SHA256())
-        )
-        certfile = tmp_path / "tls.crt"
-        keyfile = tmp_path / "tls.key"
-        certfile.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
-        keyfile.write_bytes(
-            key.private_bytes(
-                serialization.Encoding.PEM,
-                serialization.PrivateFormat.TraditionalOpenSSL,
-                serialization.NoEncryption(),
-            )
-        )
-        return str(certfile), str(keyfile)
+        return make_certpair(tmp_path / "tls.crt", tmp_path / "tls.key")
 
     def test_serves_https_when_cert_given(self, certpair):
         import ssl
@@ -282,8 +284,8 @@ class TestMetricsTLS:
         emitter = MetricsEmitter()
         emitter.emit_replica_metrics("v", "ns", current=1, desired=3,
                                      accelerator_type="v5e-8")
-        server, _thread = emitter.serve(0, addr="127.0.0.1",
-                                        certfile=certfile, keyfile=keyfile)
+        server, _thread, reloader = emitter.serve(
+            0, addr="127.0.0.1", certfile=certfile, keyfile=keyfile)
         try:
             port = server.server_address[1]
             ctx = ssl.create_default_context(cafile=certfile)
@@ -295,6 +297,72 @@ class TestMetricsTLS:
             assert "inferno_desired_replicas" in body
             assert 'variant_name="v"' in body
         finally:
+            reloader.stop()
+            server.shutdown()
+
+    def test_tls_cert_hot_reload_without_dropping_listener(self, tmp_path):
+        """Rotate the serving pair on disk mid-serve: new handshakes get the
+        new cert on the same listener (reference certwatcher behavior,
+        cmd/main.go:122-199; a load-once server breaks scrapes until
+        restart)."""
+        import ssl
+
+        from cryptography import x509
+
+        def served_cn(port):
+            pem = ssl.get_server_certificate(("127.0.0.1", port))
+            cert = x509.load_pem_x509_certificate(pem.encode())
+            return cert.subject.rfc4514_string()
+
+        certfile, keyfile = make_certpair(
+            tmp_path / "tls.crt", tmp_path / "tls.key", cn="before-rotation")
+        emitter = MetricsEmitter()
+        emitter.emit_replica_metrics("v", "ns", current=1, desired=2,
+                                     accelerator_type="v5e-1")
+        server, _thread, reloader = emitter.serve(
+            0, addr="127.0.0.1", certfile=certfile, keyfile=keyfile,
+            cert_poll_seconds=3600.0)  # poll manually below
+        try:
+            port = server.server_address[1]
+            assert "before-rotation" in served_cn(port)
+
+            make_certpair(tmp_path / "tls.crt", tmp_path / "tls.key",
+                          cn="after-rotation")
+            ctx_before = reloader.context
+            assert reloader.check_now() is True
+            assert "after-rotation" in served_cn(port)  # same listener
+            # a FRESH context was swapped in (mutating the old one could
+            # only add client-CA trust, never revoke a rotated-out CA)
+            assert reloader.context is not ctx_before
+
+            # scrape still works against the new cert
+            ctx = ssl.create_default_context(cafile=certfile)
+            ctx.check_hostname = False
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{port}/metrics", timeout=5, context=ctx
+            ) as r:
+                assert "inferno_desired_replicas" in r.read().decode()
+        finally:
+            reloader.stop()
+            server.shutdown()
+
+    def test_cert_reload_skips_unchanged_and_survives_bad_pair(self, tmp_path):
+        certfile, keyfile = make_certpair(
+            tmp_path / "tls.crt", tmp_path / "tls.key")
+        emitter = MetricsEmitter()
+        server, _thread, reloader = emitter.serve(
+            0, addr="127.0.0.1", certfile=certfile, keyfile=keyfile,
+            cert_poll_seconds=3600.0)
+        try:
+            assert reloader.check_now() is False  # unchanged
+            # half-written rotation: garbage cert must not kill serving
+            (tmp_path / "tls.crt").write_text("not a pem")
+            assert reloader.check_now() is False
+            port = server.server_address[1]
+            import ssl
+            assert ssl.get_server_certificate(("127.0.0.1", port))
+        finally:
+            reloader.stop()
             server.shutdown()
 
     def test_cert_without_key_rejected(self):
@@ -309,7 +377,8 @@ class TestMetricsTLS:
         emitter = MetricsEmitter()
         emitter.emit_replica_metrics("v", "ns", current=2, desired=2,
                                      accelerator_type="v5e-1")
-        server, _thread = emitter.serve(0, addr="127.0.0.1")
+        server, _thread, reloader = emitter.serve(0, addr="127.0.0.1")
+        assert reloader is None  # plain HTTP: nothing to hot-reload
         try:
             port = server.server_address[1]
             with urllib.request.urlopen(

@@ -7,6 +7,8 @@ metric rules and KEDA ScaledObjects work unchanged against this controller.
 
 from __future__ import annotations
 
+import os
+import ssl
 import threading
 from typing import Optional
 
@@ -15,6 +17,89 @@ from prometheus_client import CollectorRegistry, Counter, Gauge, start_http_serv
 from ..utils import get_logger, kv
 
 log = get_logger("wva.metrics")
+
+
+def _build_server_context(certfile: str, keyfile: str,
+                          client_cafile: Optional[str]) -> ssl.SSLContext:
+    context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    context.minimum_version = ssl.TLSVersion.TLSv1_2
+    context.load_cert_chain(certfile, keyfile)
+    if client_cafile:
+        context.load_verify_locations(client_cafile)
+        context.verify_mode = ssl.CERT_REQUIRED
+    return context
+
+
+class CertReloader:
+    """Holds the CURRENT server TLS context and replaces it when
+    cert/key/CA files change on disk.
+
+    In-cluster, cert-manager rotates the serving pair behind a mounted
+    Secret; the reference watches it live (cmd/main.go:122-199 certwatcher)
+    while a load-once server breaks every scrape until restart. The
+    listener stays plain TCP and every accepted connection is wrapped with
+    `self.context` at accept time, so a rotation is one attribute swap. A
+    FRESH context is built per rotation — mutating the old one in place
+    could only ever *add* client-CA trust, never revoke a rotated-out CA.
+    """
+
+    def __init__(self, certfile: str, keyfile: str,
+                 client_cafile: Optional[str] = None,
+                 poll_seconds: float = 10.0):
+        self.certfile = certfile
+        self.keyfile = keyfile
+        self.client_cafile = client_cafile
+        self.poll_seconds = poll_seconds
+        self.context = _build_server_context(certfile, keyfile, client_cafile)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._mtimes = self._stat()
+
+    def _paths(self):
+        return [p for p in (self.certfile, self.keyfile, self.client_cafile) if p]
+
+    def _stat(self):
+        out = []
+        for p in self._paths():
+            try:
+                out.append(os.stat(p).st_mtime_ns)
+            except OSError:
+                out.append(None)  # transient: secret remount swaps symlinks
+        return tuple(out)
+
+    def check_now(self) -> bool:
+        """Swap in a fresh context if the files changed; returns True when
+        a swap happened. Safe against half-written pairs: a build failure
+        keeps the previous context serving and retries on the next poll."""
+        mtimes = self._stat()
+        if mtimes == self._mtimes or None in mtimes:
+            return False
+        try:
+            fresh = _build_server_context(self.certfile, self.keyfile,
+                                          self.client_cafile)
+        except (OSError, ssl.SSLError) as e:
+            log.error("metrics TLS reload failed; keeping previous certs",
+                      extra=kv(error=str(e)))
+            return False
+        self.context = fresh
+        self._mtimes = mtimes
+        log.info("metrics TLS certificates reloaded",
+                 extra=kv(certfile=self.certfile))
+        return True
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.poll_seconds):
+                self.check_now()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="wva-metrics-cert-reload")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
 
 INFERNO_REPLICA_SCALING_TOTAL = "inferno_replica_scaling_total"
 INFERNO_DESIRED_REPLICAS = "inferno_desired_replicas"
@@ -120,23 +205,62 @@ class MetricsEmitter:
 
     def serve(self, port: int, addr: str = "0.0.0.0",
               certfile: Optional[str] = None, keyfile: Optional[str] = None,
-              client_cafile: Optional[str] = None):
+              client_cafile: Optional[str] = None,
+              cert_poll_seconds: float = 10.0):
         """Expose /metrics for Prometheus to scrape — plain HTTP, or HTTPS
         when a cert/key pair is supplied, with optional required client-CA
         verification (reference cmd/main.go:122-199: TLS-capable metrics
-        endpoint with authn/authz). Returns (server, thread)."""
+        endpoint with authn/authz). HTTPS serving hot-reloads rotated
+        certs without dropping the listener (reference certwatcher parity).
+        Returns (server, thread, reloader); reloader is None for plain
+        HTTP."""
         if bool(certfile) != bool(keyfile):
             raise ValueError("metrics TLS requires both certfile and keyfile")
         if client_cafile and not certfile:
             raise ValueError("metrics client-CA verification requires a server "
                              "certfile/keyfile pair")
-        kwargs = {}
-        if certfile:
-            kwargs = dict(certfile=certfile, keyfile=keyfile)
-            if client_cafile:
-                kwargs.update(client_cafile=client_cafile, client_auth_required=True)
-        server, thread = start_http_server(port, addr=addr,
-                                           registry=self.registry, **kwargs)
+        if not certfile:
+            server, thread = start_http_server(port, addr=addr,
+                                               registry=self.registry)
+            log.info("metrics server started",
+                     extra=kv(port=server.server_address[1], tls=False))
+            return server, thread, None
+
+        from wsgiref.simple_server import WSGIRequestHandler
+
+        from prometheus_client.exposition import (
+            ThreadingWSGIServer,
+            make_server,
+            make_wsgi_app,
+        )
+
+        class _QuietHandler(WSGIRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: ARG002
+                pass  # scrapes every 10s would spam stderr
+
+        reloader = CertReloader(certfile, keyfile, client_cafile,
+                                poll_seconds=cert_poll_seconds)
+
+        class _TLSPerConnServer(ThreadingWSGIServer):
+            """Plain TCP listener; each accepted connection handshakes
+            with the reloader's *current* context (rotation = attribute
+            swap, no listener restart)."""
+
+            def get_request(self):
+                sock, addr2 = super().get_request()
+                return (reloader.context.wrap_socket(sock, server_side=True),
+                        addr2)
+
+            def handle_error(self, request, client_address):  # noqa: ARG002
+                pass  # TLS handshake failures from probes/rotation races
+
+        server = make_server(addr, port, make_wsgi_app(self.registry),
+                             _TLSPerConnServer, handler_class=_QuietHandler)
+        reloader.start()
+        thread = threading.Thread(target=server.serve_forever, daemon=True,
+                                  name="wva-metrics-server")
+        thread.start()
         log.info("metrics server started",
-                 extra=kv(port=server.server_address[1], tls=bool(certfile)))
-        return server, thread
+                 extra=kv(port=server.server_address[1], tls=True,
+                          cert_hot_reload=True))
+        return server, thread, reloader
