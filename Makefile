@@ -35,6 +35,11 @@ lint: ## Byte-compile as a basic syntax gate
 crd-docs: ## Regenerate docs/reference/variantautoscaling.md from the CRD manifest
 	$(PY) docs/gen_crd_docs.py
 
+.PHONY: validate-manifests
+validate-manifests: ## Validate shipped VariantAutoscaling manifests against the CRD schema (offline dry-run)
+	$(PY) -c "from workload_variant_autoscaler_tpu.controller.schema import main; \
+		raise SystemExit(main(['deploy/examples/tpu-emulator/variantautoscaling.yaml']))"
+
 ENVTEST_K8S_VERSION ?= 1.31.0
 ENVTEST_DIR ?= $(HOME)/.local/share/kubebuilder-envtest
 

@@ -197,12 +197,14 @@ def probe_pallas_compile(timeout_s: float = 180.0) -> dict:
     ambient TPU, in a subprocess with a hard timeout: through the dev
     tunnel the AOT helper is known to hang rather than fail (it lacks TPU
     topology hints), and a hung probe must not wedge the whole bench."""
+    import os
     import subprocess
     import sys
 
     try:
         r = subprocess.run([sys.executable, "-c", _PALLAS_PROBE],
-                           capture_output=True, text=True, timeout=timeout_s)
+                           capture_output=True, text=True, timeout=timeout_s,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
         return {"status": "timeout",
                 "detail": f"Mosaic compile hung >{timeout_s:.0f}s (axon "

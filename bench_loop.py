@@ -135,6 +135,9 @@ class _Composite:
         def fan_out(*args, **kwargs):
             for t in targets:
                 t(*args, **kwargs)
+        # cache: on_token fires per generated token (~10M/run); dispatch
+        # must not rebuild the closure every call
+        setattr(self, name, fan_out)
         return fan_out
 
 
@@ -196,6 +199,12 @@ def run(ramp=None, warmup_ms: float = WARMUP_MS,
         reconcile_ms: float = RECONCILE_MS) -> dict:
     ramp = RAMP if ramp is None else ramp
     duration_ms = sum(d for d, _ in ramp) * 1000.0
+    if duration_ms < reconcile_ms:
+        raise ValueError(
+            f"scenario too short: ramp lasts {duration_ms / 1000.0:.0f}s but "
+            f"the first reconcile fires at {reconcile_ms / 1000.0:.0f}s; "
+            "no autoscaling would be measured"
+        )
     sim, fleet, prom, kube, rec, lat = build_loop()
     lat.from_ms = warmup_ms
     gen = PoissonLoadGenerator(sim, schedule=ramp, tokens=TOKENS, seed=SEED)

@@ -1,0 +1,233 @@
+"""CRD structural-schema validation (controller/schema.py).
+
+The reference relies on a real apiserver applying config/crd/bases for
+admission (internal/controller/suite_test.go:56-93). Here the same
+structural schema — loaded from the shipped CRD manifest, not
+re-declared — is enforced in-process, so InMemoryKube admission matches
+what kube-apiserver would do with deploy/crd/variantautoscaling-crd.yaml.
+"""
+
+from __future__ import annotations
+
+import copy
+from pathlib import Path
+
+import pytest
+import yaml
+
+from workload_variant_autoscaler_tpu.controller import crd
+from workload_variant_autoscaler_tpu.controller.kube import (
+    InMemoryKube,
+    InvalidError,
+)
+from workload_variant_autoscaler_tpu.controller.schema import (
+    load_crd_schema,
+    main,
+    prune,
+    validate,
+    validate_manifest_file,
+    validate_va_dict,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+EXAMPLE_VA = REPO_ROOT / "deploy" / "examples" / "tpu-emulator" / "variantautoscaling.yaml"
+
+
+def example_va_dict() -> dict:
+    with open(EXAMPLE_VA) as f:
+        return yaml.safe_load(f)
+
+
+def make_va(**meta) -> crd.VariantAutoscaling:
+    return crd.VariantAutoscaling(
+        metadata=crd.ObjectMeta(name="v", namespace="ns", **meta),
+        spec=crd.VariantAutoscalingSpec(
+            model_id="m",
+            slo_class_ref=crd.ConfigMapKeyRef(name="sc", key="premium"),
+            model_profile=crd.ModelProfile(accelerators=[
+                crd.AcceleratorProfile(
+                    acc="v5e-1",
+                    perf_parms=crd.PerfParms(
+                        decode_parms={"alpha": "6.9", "beta": "0.03"},
+                        prefill_parms={"gamma": "5.2", "delta": "0.1"},
+                    ),
+                ),
+            ]),
+        ),
+    )
+
+
+class TestSchemaLoad:
+    def test_loads_storage_version_schema(self):
+        schema = load_crd_schema()
+        assert schema["type"] == "object"
+        assert "spec" in schema["properties"]
+        assert "status" in schema["properties"]
+
+    def test_cached_instance(self):
+        assert load_crd_schema() is load_crd_schema()
+
+
+class TestValidate:
+    def test_shipped_example_manifest_is_valid(self):
+        assert validate_va_dict(example_va_dict()) == []
+
+    def test_missing_required_spec_fields(self):
+        obj = example_va_dict()
+        del obj["spec"]["modelID"]
+        del obj["spec"]["sloClassRef"]["key"]
+        errs = validate_va_dict(obj)
+        assert "spec.modelID: Required value" in errs
+        assert "spec.sloClassRef.key: Required value" in errs
+
+    def test_missing_name(self):
+        obj = example_va_dict()
+        obj["metadata"] = {}
+        assert "metadata.name: Required value" in validate_va_dict(obj)
+
+    def test_wrong_type_reports_path_and_types(self):
+        obj = example_va_dict()
+        obj["spec"]["modelProfile"]["accelerators"] = "v5e-1"
+        (err,) = validate_va_dict(obj)
+        assert err.startswith("spec.modelProfile.accelerators: Invalid value")
+        assert "must be of type array, not string" in err
+
+    def test_minimum_violated_with_array_index_in_path(self):
+        obj = example_va_dict()
+        obj["spec"]["modelProfile"]["accelerators"][1]["accCount"] = 0
+        (err,) = validate_va_dict(obj)
+        assert err == (
+            "spec.modelProfile.accelerators[1].accCount: Invalid value: 0: "
+            "should be greater than or equal to 1"
+        )
+
+    def test_null_for_typed_field_is_invalid(self):
+        obj = example_va_dict()
+        obj["spec"]["modelID"] = None
+        (err,) = validate_va_dict(obj)
+        assert "must be of type string" in err
+
+    def test_integral_float_accepted_for_integer(self):
+        obj = example_va_dict()
+        obj["spec"]["modelProfile"]["accelerators"][0]["maxBatchSize"] = 64.0
+        assert validate_va_dict(obj) == []
+        obj["spec"]["modelProfile"]["accelerators"][0]["maxBatchSize"] = 64.5
+        assert len(validate_va_dict(obj)) == 1
+
+    def test_boolean_is_not_integer(self):
+        obj = example_va_dict()
+        obj["spec"]["modelProfile"]["accelerators"][0]["maxBatchSize"] = True
+        (err,) = validate_va_dict(obj)
+        assert "must be of type integer" in err
+
+    def test_unknown_fields_are_not_errors(self):
+        # structural pruning semantics: unknown fields are dropped silently
+        obj = example_va_dict()
+        obj["spec"]["futureKnob"] = {"x": 1}
+        assert validate_va_dict(obj) == []
+
+    def test_additional_properties_value_types_enforced(self):
+        obj = example_va_dict()
+        # decodeParms: additionalProperties {type: string}
+        obj["spec"]["modelProfile"]["accelerators"][0]["perfParms"][
+            "decodeParms"]["alpha"] = 6.973
+        (err,) = validate_va_dict(obj)
+        assert err.startswith(
+            "spec.modelProfile.accelerators[0].perfParms.decodeParms.alpha"
+        )
+
+    def test_status_condition_requires_type_and_status(self):
+        obj = example_va_dict()
+        obj["status"] = {"conditions": [{"reason": "x"}]}
+        errs = validate_va_dict(obj)
+        assert "status.conditions[0].type: Required value" in errs
+        assert "status.conditions[0].status: Required value" in errs
+
+    def test_enum_and_pattern_keywords(self):
+        schema = {"type": "object", "properties": {
+            "mode": {"type": "string", "enum": ["on", "off"]},
+            "shape": {"type": "string", "pattern": r"^v5e-\d+$"},
+        }}
+        assert validate({"mode": "on", "shape": "v5e-8"}, schema) == []
+        errs = validate({"mode": "auto", "shape": "h100"}, schema)
+        assert any("Unsupported value" in e for e in errs)
+        assert any("must match pattern" in e for e in errs)
+
+
+class TestPrune:
+    def test_prunes_unknown_fields_recursively(self):
+        obj = example_va_dict()
+        obj["spec"]["futureKnob"] = 1
+        obj["spec"]["modelProfile"]["accelerators"][0]["vendor"] = "x"
+        body = {k: v for k, v in obj.items()
+                if k not in ("apiVersion", "kind", "metadata")}
+        pruned = prune(body, load_crd_schema())
+        assert "futureKnob" not in pruned["spec"]
+        assert "vendor" not in pruned["spec"]["modelProfile"]["accelerators"][0]
+        # declared fields survive untouched
+        assert pruned["spec"]["modelID"] == obj["spec"]["modelID"]
+
+    def test_additional_properties_maps_survive(self):
+        body = {"spec": example_va_dict()["spec"]}
+        pruned = prune(body, load_crd_schema())
+        parms = pruned["spec"]["modelProfile"]["accelerators"][0]["perfParms"]
+        assert parms["decodeParms"] == {"alpha": "6.973", "beta": "0.027"}
+
+
+class TestInMemoryKubeAdmission:
+    def test_valid_va_admitted(self):
+        kube = InMemoryKube()
+        kube.put_variant_autoscaling(make_va())
+        assert kube.get_variant_autoscaling("v", "ns").spec.model_id == "m"
+
+    def test_invalid_acc_count_rejected_as_invalid(self):
+        kube = InMemoryKube()
+        va = make_va()
+        va.spec.model_profile.accelerators[0].acc_count = 0
+        with pytest.raises(InvalidError, match="accCount"):
+            kube.put_variant_autoscaling(va)
+
+    def test_unnamed_va_rejected(self):
+        kube = InMemoryKube()
+        va = make_va()
+        va.metadata.name = ""
+        with pytest.raises(InvalidError, match="metadata.name"):
+            kube.put_variant_autoscaling(va)
+
+    def test_status_update_revalidates_merged_object(self):
+        kube = InMemoryKube()
+        kube.put_variant_autoscaling(make_va())
+        update = copy.deepcopy(kube.get_variant_autoscaling("v", "ns"))
+        update.status.conditions.append(
+            crd.Condition(type="OptimizationReady", status="True")
+        )
+        kube.update_variant_autoscaling_status(update)  # valid: ok
+
+        bad = copy.deepcopy(kube.get_variant_autoscaling("v", "ns"))
+        bad.status.desired_optimized_alloc.num_replicas = "three"  # type: ignore[assignment]
+        with pytest.raises(InvalidError, match="numReplicas"):
+            kube.update_variant_autoscaling_status(bad)
+        # stored object unchanged by the rejected write
+        stored = kube.get_variant_autoscaling("v", "ns")
+        assert stored.status.desired_optimized_alloc.num_replicas == 0
+
+    def test_validation_can_be_disabled(self):
+        kube = InMemoryKube(validate_schema=False)
+        va = make_va()
+        va.spec.model_profile.accelerators[0].acc_count = 0
+        kube.put_variant_autoscaling(va)  # no apiserver would admit this
+
+
+class TestManifestCLI:
+    def test_all_shipped_va_manifests_valid(self):
+        results = validate_manifest_file(EXAMPLE_VA)
+        assert results == {"chat-8b": []}
+
+    def test_cli_exit_codes(self, tmp_path):
+        assert main([str(EXAMPLE_VA)]) == 0
+        bad = tmp_path / "bad.yaml"
+        obj = example_va_dict()
+        del obj["spec"]["modelProfile"]
+        bad.write_text(yaml.safe_dump(obj))
+        assert main([str(bad)]) == 1
+        assert main([]) == 2

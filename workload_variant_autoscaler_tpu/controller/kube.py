@@ -24,6 +24,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Protocol
 
 from ..utils import TerminalError
+from . import schema
 from .crd import GROUP, PLURAL, VERSION, VariantAutoscaling, va_from_dict, va_to_dict
 
 
@@ -96,9 +97,16 @@ class KubeClient(Protocol):
 
 
 class InMemoryKube:
-    """Dict-backed fake API server with optional fault injection."""
+    """Dict-backed fake API server with optional fault injection.
 
-    def __init__(self) -> None:
+    Admission enforces the shipped CRD's structural schema (see schema.py)
+    so unit/e2e tests exercise the same validation a real apiserver
+    applies in the reference's envtest tier (suite_test.go:56-93)."""
+
+    def __init__(self, validate_schema: Optional[bool] = None) -> None:
+        if validate_schema is None:
+            validate_schema = schema.DEFAULT_CRD_PATH.is_file()
+        self._validate_schema = validate_schema
         self._lock = threading.RLock()
         self.configmaps: dict[tuple[str, str], ConfigMap] = {}
         self.deployments: dict[tuple[str, str], Deployment] = {}
@@ -121,7 +129,19 @@ class InMemoryKube:
         self.deployments[(d.namespace, d.name)] = d
 
     def put_variant_autoscaling(self, va: VariantAutoscaling) -> None:
+        self._admit(va)
         self.vas[(va.namespace, va.name)] = copy.deepcopy(va)
+
+    def _admit(self, va: VariantAutoscaling) -> None:
+        """CRD structural-schema admission (apiserver 422 -> InvalidError)."""
+        if not self._validate_schema:
+            return
+        errors = schema.validate_va_dict(va_to_dict(va))
+        if errors:
+            raise InvalidError(
+                f"VariantAutoscaling.{GROUP} \"{va.name}\" is invalid: "
+                + "; ".join(errors)
+            )
 
     def inject_fault(self, verb: str, kind: str, exc: Exception, count: int = 0) -> None:
         def raiser() -> None:
@@ -179,6 +199,11 @@ class InMemoryKube:
             if key not in self.vas:
                 raise NotFoundError(f"variantautoscaling {key} not found")
             stored = self.vas[key]
+            # status subresource: spec comes from storage, status from the
+            # request — revalidate the merged object like the apiserver does
+            merged = copy.deepcopy(stored)
+            merged.status = va.status
+            self._admit(merged)
             stored.status = copy.deepcopy(va.status)
             stored.metadata.resource_version = str(
                 int(stored.metadata.resource_version or "0") + 1
