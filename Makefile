@@ -27,6 +27,11 @@ bench: ## Run the kernel benchmark (one JSON line; uses a real TPU when present)
 bench-loop: ## North-star closed-loop benchmark: chip-hours to hold p95-ITL SLO (sim-time, CPU, ~2 min)
 	$(PY) bench_loop.py
 
+.PHONY: bench-scenarios
+bench-scenarios: ## Multi-variant closed-loop benchmarks (BASELINE configs 2 and 5)
+	$(PY) bench_loop.py multi-model-mix
+	$(PY) bench_loop.py hetero-fleet
+
 .PHONY: lint
 lint: ## Byte-compile as a basic syntax gate
 	$(PY) -m compileall -q workload_variant_autoscaler_tpu tests

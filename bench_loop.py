@@ -267,7 +267,272 @@ def run(ramp=None, warmup_ms: float = WARMUP_MS,
     }
 
 
-if __name__ == "__main__":
-    result = run()
+# ---------------------------------------------------------------------------
+# Multi-variant scenarios (BASELINE configs 2 and 5)
+# ---------------------------------------------------------------------------
+# Config 1 stays in run() above, byte-for-byte, so the published number in
+# BASELINE.json remains reproducible. The generic machinery below drives
+# several (variant, fleet, loadgen) triples through ONE reconciler against
+# ONE sim-time Prometheus — the same measurement contract, summed over a
+# heterogeneous fleet.
+
+from dataclasses import dataclass, field as _field  # noqa: E402
+
+from workload_variant_autoscaler_tpu.emulator import MultiPromAPI  # noqa: E402
+
+
+@dataclass
+class VariantScenario:
+    name: str                   # VA / Deployment name
+    model: str                  # model_id + model_name label
+    sc_key: str                 # key in the service-classes ConfigMap
+    accelerator: str            # slice shape (matches accelerator CM entry)
+    chips_per_replica: int
+    cfg: SliceModelConfig       # emulator ground-truth physics
+    ramp: list                  # [(seconds, rpm)]
+    tokens: TokenDistribution
+    slo_itl_ms: float
+    slo_ttft_ms: float
+
+
+@dataclass
+class Scenario:
+    key: str
+    title: str
+    accelerators: dict          # name -> {"chip": .., "chips": .., "cost": ..}
+    service_classes: dict       # cm key -> service-class YAML
+    variants: list = _field(default_factory=list)
+    warmup_ms: float = WARMUP_MS
+    reconcile_ms: float = RECONCILE_MS
+    stabilization: str = "180s"
+
+
+def _make_va(v: VariantScenario) -> crd.VariantAutoscaling:
+    return crd.VariantAutoscaling(
+        metadata=crd.ObjectMeta(name=v.name, namespace=NS,
+                                labels={crd.ACCELERATOR_LABEL: v.accelerator}),
+        spec=crd.VariantAutoscalingSpec(
+            model_id=v.model,
+            slo_class_ref=crd.ConfigMapKeyRef(name=SERVICE_CLASS_CM_NAME,
+                                              key=v.sc_key),
+            model_profile=crd.ModelProfile(accelerators=[
+                crd.AcceleratorProfile(
+                    acc=v.accelerator, acc_count=1,
+                    perf_parms=crd.PerfParms(
+                        decode_parms={"alpha": str(v.cfg.alpha),
+                                      "beta": str(v.cfg.beta)},
+                        prefill_parms={"gamma": str(v.cfg.gamma),
+                                       "delta": str(v.cfg.delta)},
+                    ),
+                    max_batch_size=v.cfg.max_batch_size,
+                ),
+            ]),
+        ),
+    )
+
+
+def run_scenario(sc: Scenario) -> dict:
+    durations = {sum(d for d, _ in v.ramp) for v in sc.variants}
+    if len(durations) != 1:
+        raise ValueError("all variant ramps must cover the same duration")
+    duration_ms = durations.pop() * 1000.0
+    if duration_ms < sc.reconcile_ms:
+        raise ValueError("scenario shorter than one reconcile interval")
+
+    # one (sink, fleet, prom, latency) triple per variant; one sim over all
+    lats, fleets, proms = {}, {}, []
+    for v in sc.variants:
+        prom_sink = PrometheusSink(v.model, NS)
+        lat = LatencySink(from_ms=sc.warmup_ms)
+        fleet = Fleet(v.cfg, _Composite(prom_sink, lat), replicas=1)
+        lats[v.name], fleets[v.name] = lat, fleet
+        proms.append((v, prom_sink))
+    sim = Simulation([fleets[v.name] for v in sc.variants], seed=SEED)
+    prom = MultiPromAPI([SimPromAPI(sink, v.model, NS) for v, sink in proms])
+
+    kube = InMemoryKube()
+    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE, {
+        "GLOBAL_OPT_INTERVAL": f"{sc.reconcile_ms / 1000.0:.0f}s",
+        "WVA_SCALE_DOWN_STABILIZATION": sc.stabilization,
+    }))
+    kube.put_configmap(ConfigMap(
+        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {name: json.dumps(spec) for name, spec in sc.accelerators.items()},
+    ))
+    kube.put_configmap(ConfigMap(SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+                                 dict(sc.service_classes)))
+    for v in sc.variants:
+        kube.put_deployment(Deployment(name=v.name, namespace=NS,
+                                       spec_replicas=1, status_replicas=1))
+        kube.put_variant_autoscaling(_make_va(v))
+
+    rec = Reconciler(kube=kube, prom=prom, emitter=MetricsEmitter(),
+                     now=lambda: sim.now_ms / 1000.0, sleep=lambda _s: None)
+    gens = {}
+    for i, v in enumerate(sc.variants):
+        gen = PoissonLoadGenerator(sim, schedule=v.ramp, tokens=v.tokens,
+                                   seed=SEED + i, fleet=fleets[v.name])
+        gen.start()
+        gens[v.name] = gen
+
+    chip_ms = {v.name: 0.0 for v in sc.variants}
+    peak_desired = {v.name: 1 for v in sc.variants}
+    last_sample_ms = 0.0
+    next_reconcile = sc.reconcile_ms
+
+    def on_tick(now_ms):
+        nonlocal last_sample_ms, next_reconcile
+        dt = now_ms - last_sample_ms
+        last_sample_ms = now_ms
+        for v in sc.variants:
+            lats[v.name].now_ms = now_ms
+            chip_ms[v.name] += (len(fleets[v.name].all_replicas())
+                                * v.chips_per_replica * dt)
+        prom.scrape(now_ms)
+        if now_ms >= next_reconcile:
+            next_reconcile += sc.reconcile_ms
+            rec.reconcile()
+            for v in sc.variants:
+                va = kube.get_variant_autoscaling(v.name, NS)
+                desired = va.status.desired_optimized_alloc.num_replicas
+                peak_desired[v.name] = max(peak_desired[v.name], desired)
+                kube.put_deployment(Deployment(
+                    name=v.name, namespace=NS,
+                    spec_replicas=desired, status_replicas=desired))
+                fleets[v.name].set_replicas(max(desired, 0), now_ms)
+            sim.kick()
+
+    sim.run_until(duration_ms, on_tick=on_tick, tick_ms=5000.0)
+
+    total_chip_hours = sum(chip_ms.values()) / 3_600_000.0
+    static_chip_hours = sum(
+        peak_desired[v.name] * v.chips_per_replica * duration_ms / 3_600_000.0
+        for v in sc.variants
+    )
+    per_variant = {}
+    all_held = True
+    for v in sc.variants:
+        p95 = lats[v.name].p95_itl()
+        p95_ttft = lats[v.name].p95_ttft(sc.warmup_ms)
+        # the judged SLO is p95 ITL (the north-star metric, BASELINE.json);
+        # TTFT is reported with its own held flag but does not gate the
+        # headline — sizing is mean-based and ramp transitions dominate the
+        # TTFT tail (same caveat as the config-1 contract in run())
+        held = bool(p95 <= v.slo_itl_ms)
+        all_held = all_held and held
+        per_variant[v.name] = {
+            "model": v.model, "accelerator": v.accelerator,
+            "p95_itl_ms": round(p95, 3), "slo_itl_ms": v.slo_itl_ms,
+            "p95_ttft_ms": round(p95_ttft, 1), "slo_ttft_ms": v.slo_ttft_ms,
+            "ttft_held": bool(p95_ttft <= v.slo_ttft_ms),
+            "slo_held": held, "peak_replicas": peak_desired[v.name],
+            "chip_hours": round(chip_ms[v.name] / 3_600_000.0, 3),
+            "requests": gens[v.name].generated,
+        }
+    return {
+        "metric": "chip_hours_to_hold_p95_itl_slo",
+        "value": round(total_chip_hours, 3),
+        "unit": "chip-hours",
+        "vs_baseline": round(static_chip_hours / total_chip_hours, 3),
+        "slo_held": all_held,
+        "static_peak_chip_hours": round(static_chip_hours, 3),
+        "scenario": sc.key,
+        "variants": per_variant,
+    }
+
+
+_PREMIUM_YAML = (
+    "name: Premium\npriority: 1\ndata:\n"
+    "  - model: llama-8b\n    slo-tpot: 24\n    slo-ttft: 500\n"
+)
+_FREEMIUM_YAML = (
+    "name: Freemium\npriority: 10\ndata:\n"
+    "  - model: llama-70b\n    slo-tpot: 200\n    slo-ttft: 4000\n"
+)
+
+_CHAT_8B = VariantScenario(
+    name=VARIANT, model=MODEL, sc_key="premium", accelerator="v5e-1",
+    chips_per_replica=1, cfg=CFG, ramp=[list(seg) for seg in RAMP],
+    tokens=TOKENS, slo_itl_ms=SLO_ITL_MS, slo_ttft_ms=SLO_TTFT_MS,
+)
+
+# Llama-70B on a v5e-8 slice (8-chip TP): slower per-token than v5p but
+# cheap; weights ~70 GB int8 over 8x16 GB HBM
+_CFG_70B_V5E8 = SliceModelConfig(
+    model_name="llama-70b", slice_name="v5e-8",
+    alpha=20.0, beta=0.1, gamma=15.0, delta=0.15,
+    max_batch_size=32, hbm_gb=128.0, model_size_gb=70.0, kv_mb_per_token=0.8,
+)
+# Llama-70B on a v5p-4 slice: fewer, beefier chips (95 GB HBM each),
+# bf16 weights fit; faster decode, higher $/hr
+_CFG_70B_V5P4 = SliceModelConfig(
+    model_name="llama-70b", slice_name="v5p-4",
+    alpha=14.0, beta=0.06, gamma=10.0, delta=0.08,
+    max_batch_size=48, hbm_gb=380.0, model_size_gb=140.0, kv_mb_per_token=0.8,
+)
+
+SCENARIOS: dict[str, Scenario] = {
+    # BASELINE config 2: two models, two service classes, one optimizer run
+    "multi-model-mix": Scenario(
+        key="multi-model-mix",
+        title="8B Premium (v5e-1) + 70B Freemium (v5e-8), one optimizer",
+        accelerators={
+            "v5e-1": {"chip": "v5e", "chips": "1", "cost": "20.0"},
+            "v5e-8": {"chip": "v5e", "chips": "8", "cost": "160.0"},
+        },
+        service_classes={"premium": _PREMIUM_YAML, "freemium": _FREEMIUM_YAML},
+        variants=[
+            _CHAT_8B,
+            VariantScenario(
+                name="chat-70b", model="llama-70b", sc_key="freemium",
+                accelerator="v5e-8", chips_per_replica=8, cfg=_CFG_70B_V5E8,
+                ramp=[(300, 120), (300, 300), (300, 480), (300, 600),
+                      (300, 300), (300, 120)],
+                tokens=TOKENS, slo_itl_ms=200.0, slo_ttft_ms=4000.0,
+            ),
+        ],
+    ),
+    # BASELINE config 5: heterogeneous chip generations in one fleet
+    "hetero-fleet": Scenario(
+        key="hetero-fleet",
+        title="v5e + v5p fleet under load-ramp SLO stress",
+        accelerators={
+            "v5e-1": {"chip": "v5e", "chips": "1", "cost": "20.0"},
+            "v5p-4": {"chip": "v5p", "chips": "4", "cost": "180.0"},
+        },
+        service_classes={"premium": _PREMIUM_YAML, "freemium": _FREEMIUM_YAML},
+        variants=[
+            _CHAT_8B,
+            VariantScenario(
+                name="summarize-70b", model="llama-70b", sc_key="freemium",
+                accelerator="v5p-4", chips_per_replica=4, cfg=_CFG_70B_V5P4,
+                ramp=[(300, 300), (300, 600), (300, 1200), (300, 1500),
+                      (300, 600), (300, 120)],
+                tokens=TOKENS, slo_itl_ms=200.0, slo_ttft_ms=4000.0,
+            ),
+        ],
+    ),
+}
+
+
+def main(argv=None) -> int:
+    args = sys.argv[1:] if argv is None else argv
+    key = args[0] if args else "sharegpt-ramp"
+    if key in ("-h", "--help", "list"):
+        print("scenarios: sharegpt-ramp (default), "
+              + ", ".join(SCENARIOS), file=sys.stderr)
+        return 0
+    if key == "sharegpt-ramp":
+        result = run()
+    elif key in SCENARIOS:
+        result = run_scenario(SCENARIOS[key])
+    else:
+        print(f"unknown scenario {key!r}; try: sharegpt-ramp, "
+              + ", ".join(SCENARIOS), file=sys.stderr)
+        return 2
     print(json.dumps(result))
-    sys.exit(0 if result["slo_held"] else 1)
+    return 0 if result["slo_held"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,49 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import bench_loop  # noqa: E402
 
 
+def _mini(v, ramp):
+    import dataclasses
+
+    return dataclasses.replace(v, ramp=ramp)
+
+
+def test_multi_model_mix_mini_ramp():
+    # shrunk config-2: both variants, same measurement contract
+    sc = bench_loop.SCENARIOS["multi-model-mix"]
+    mini = bench_loop.Scenario(
+        key=sc.key, title=sc.title, accelerators=sc.accelerators,
+        service_classes=sc.service_classes,
+        variants=[
+            _mini(sc.variants[0], [(60, 600), (120, 2700), (60, 600)]),
+            _mini(sc.variants[1], [(60, 120), (120, 480), (60, 120)]),
+        ],
+        warmup_ms=60_000.0, reconcile_ms=30_000.0,
+    )
+    r = bench_loop.run_scenario(mini)
+    assert r["slo_held"]
+    assert set(r["variants"]) == {"chat-8b", "chat-70b"}
+    assert r["variants"]["chat-8b"]["peak_replicas"] > 1
+    # chip accounting is slice-granular: 70B pays 8 chips per replica
+    assert r["variants"]["chat-70b"]["chip_hours"] > 0
+    assert r["value"] <= r["static_peak_chip_hours"]
+
+
+def test_scenario_rejects_mismatched_ramp_durations():
+    import pytest
+
+    sc = bench_loop.SCENARIOS["hetero-fleet"]
+    bad = bench_loop.Scenario(
+        key=sc.key, title=sc.title, accelerators=sc.accelerators,
+        service_classes=sc.service_classes,
+        variants=[
+            _mini(sc.variants[0], [(60, 600)]),
+            _mini(sc.variants[1], [(120, 600)]),
+        ],
+    )
+    with pytest.raises(ValueError, match="same duration"):
+        bench_loop.run_scenario(bad)
+
+
 def test_mini_ramp_holds_slo_and_beats_static():
     r = bench_loop.run(
         ramp=[(60, 600), (120, 2700), (60, 600)],

@@ -1,0 +1,110 @@
+"""Multi-fleet Simulation + MultiPromAPI (the substrate for multi-variant
+closed loops, BASELINE configs 2/5).
+
+The reference never simulates two models at once — each vllm-emulator
+Deployment is a separate process scraped by one Prometheus. Here one
+sim-time event loop drives several fleets and one PromAPI answers
+per-model queries, so a single reconciler can optimize a heterogeneous
+fleet deterministically.
+"""
+
+from __future__ import annotations
+
+from workload_variant_autoscaler_tpu.collector import (
+    arrival_rate_query,
+    true_arrival_rate_query,
+)
+from workload_variant_autoscaler_tpu.emulator import (
+    Fleet,
+    MultiPromAPI,
+    PoissonLoadGenerator,
+    PrometheusSink,
+    SimPromAPI,
+    Simulation,
+    SliceModelConfig,
+    TokenDistribution,
+)
+
+CFG_A = SliceModelConfig(model_name="m-a", alpha=5.0, beta=0.02,
+                         gamma=3.0, delta=0.05, max_batch_size=16)
+CFG_B = SliceModelConfig(model_name="m-b", alpha=20.0, beta=0.1,
+                         gamma=10.0, delta=0.1, max_batch_size=8)
+
+
+def build_two_fleet_sim():
+    sink_a, sink_b = PrometheusSink("m-a", "ns"), PrometheusSink("m-b", "ns")
+    fleet_a = Fleet(CFG_A, sink_a, replicas=1)
+    fleet_b = Fleet(CFG_B, sink_b, replicas=1)
+    sim = Simulation([fleet_a, fleet_b], seed=7)
+    prom = MultiPromAPI([SimPromAPI(sink_a, "m-a", "ns"),
+                         SimPromAPI(sink_b, "m-b", "ns")])
+    return sim, fleet_a, fleet_b, sink_a, sink_b, prom
+
+
+class TestMultiFleetSimulation:
+    def test_generators_route_to_their_own_fleet(self):
+        sim, fleet_a, fleet_b, sink_a, sink_b, _ = build_two_fleet_sim()
+        tokens = TokenDistribution(32, 16)
+        gen_a = PoissonLoadGenerator(sim, schedule=600.0, tokens=tokens,
+                                     seed=1, fleet=fleet_a)
+        gen_b = PoissonLoadGenerator(sim, schedule=60.0, tokens=tokens,
+                                     seed=2, fleet=fleet_b)
+        gen_a.start()
+        gen_b.start()
+        sim.run_until(60_000.0)
+        # each fleet saw only its own generator's traffic
+        assert sink_a.counters()["vllm:request_arrival_total"] == gen_a.generated
+        assert sink_b.counters()["vllm:request_arrival_total"] == gen_b.generated
+        assert gen_a.generated > gen_b.generated > 0
+
+    def test_both_fleets_make_progress_in_one_event_loop(self):
+        sim, fleet_a, fleet_b, sink_a, sink_b, _ = build_two_fleet_sim()
+        tokens = TokenDistribution(32, 16)
+        for fleet, seed in ((fleet_a, 1), (fleet_b, 2)):
+            PoissonLoadGenerator(sim, schedule=300.0, tokens=tokens,
+                                 seed=seed, fleet=fleet).start()
+        sim.run_until(120_000.0)
+        assert sink_a.counters().get("vllm:request_success_total", 0) > 0
+        assert sink_b.counters().get("vllm:request_success_total", 0) > 0
+
+    def test_resizing_one_fleet_leaves_the_other_alone(self):
+        sim, fleet_a, fleet_b, *_ = build_two_fleet_sim()
+        fleet_a.set_replicas(3, sim.now_ms)
+        sim.kick()
+        assert fleet_a.size() == 3 and fleet_b.size() == 1
+
+    def test_single_fleet_compat(self):
+        sink = PrometheusSink("m-a", "ns")
+        fleet = Fleet(CFG_A, sink, replicas=1)
+        sim = Simulation(fleet, seed=1)  # non-list form still works
+        assert sim.fleet is fleet and sim.fleets == [fleet]
+
+
+class TestMultiPromAPI:
+    def test_queries_dispatch_by_model(self):
+        sim, fleet_a, fleet_b, _sa, _sb, prom = build_two_fleet_sim()
+        tokens = TokenDistribution(32, 16)
+        PoissonLoadGenerator(sim, schedule=600.0, tokens=tokens, seed=1,
+                             fleet=fleet_a).start()
+
+        def tick(now_ms):
+            prom.scrape(now_ms)
+
+        sim.run_until(90_000.0, on_tick=tick, tick_ms=5000.0)
+        (sample,) = prom.query(true_arrival_rate_query("m-a", "ns"))
+        assert sample.labels["model_name"] == "m-a"
+        assert sample.value > 0
+        # m-b had no generator: its arrival series never appeared
+        assert prom.query(arrival_rate_query("m-b", "ns")) == []
+
+    def test_up_answers_once(self):
+        *_, prom = build_two_fleet_sim()
+        assert len(prom.query("up")) == 1
+
+    def test_duplicate_model_backends_rejected(self):
+        import pytest
+
+        sink = PrometheusSink("m-a", "ns")
+        with pytest.raises(ValueError, match="duplicate"):
+            MultiPromAPI([SimPromAPI(sink, "m-a", "ns"),
+                          SimPromAPI(sink, "m-a", "ns")])

@@ -8,7 +8,7 @@ real time over HTTP (`python -m workload_variant_autoscaler_tpu.emulator`).
 from .engine import Fleet, MetricsSink, Replica, Request, Simulation, SliceModelConfig
 from .loadgen import PoissonLoadGenerator, TokenDistribution, rate_at, total_duration_s
 from .metrics import PrometheusSink, RecordingSink
-from .simprom import SimPromAPI
+from .simprom import MultiPromAPI, SimPromAPI
 
 __all__ = [
     "Fleet",
@@ -18,6 +18,7 @@ __all__ = [
     "RecordingSink",
     "Replica",
     "Request",
+    "MultiPromAPI",
     "SimPromAPI",
     "Simulation",
     "SliceModelConfig",

@@ -340,23 +340,36 @@ class _Event:
 
 class Simulation:
     """Event loop in simulated time: arrivals (from a load generator) and
-    per-replica decode iterations."""
+    per-replica decode iterations.
 
-    def __init__(self, fleet: Fleet, seed: int = 0):
-        self.fleet = fleet
+    Drives one fleet or several (multi-variant closed loops, BASELINE
+    configs 2/5): pass a list of fleets and give each load generator its
+    own target via `submit(req, fleet=...)`."""
+
+    def __init__(self, fleet: Fleet | list[Fleet], seed: int = 0):
+        self.fleets: list[Fleet] = (
+            list(fleet) if isinstance(fleet, (list, tuple)) else [fleet]
+        )
+        if not self.fleets:
+            raise ValueError("Simulation needs at least one fleet")
         self.now_ms = 0.0
         self._heap: list[_Event] = []
         self._seq = itertools.count()
         self.rng = random.Random(seed)
         self._replica_busy: set[int] = set()  # id(replica)
 
+    @property
+    def fleet(self) -> Fleet:
+        """The single-fleet view (first fleet) for existing callers."""
+        return self.fleets[0]
+
     def schedule(self, delay_ms: float, kind: str, payload=None) -> None:
         heapq.heappush(
             self._heap, _Event(self.now_ms + delay_ms, next(self._seq), kind, payload)
         )
 
-    def submit(self, req: Request) -> None:
-        self.fleet.dispatch(req, self.now_ms)
+    def submit(self, req: Request, fleet: Optional[Fleet] = None) -> None:
+        (fleet or self.fleets[0]).dispatch(req, self.now_ms)
         self.kick()
 
     def kick(self) -> None:
@@ -364,8 +377,13 @@ class Simulation:
         after externally resizing/rebalancing the fleet)."""
         self._kick_replicas()
 
+    def _all_replicas(self) -> list[Replica]:
+        if len(self.fleets) == 1:
+            return self.fleets[0].all_replicas()
+        return [r for f in self.fleets for r in f.all_replicas()]
+
     def _kick_replicas(self) -> None:
-        for replica in self.fleet.all_replicas():
+        for replica in self._all_replicas():
             if replica.busy() and id(replica) not in self._replica_busy:
                 self._replica_busy.add(id(replica))
                 self.schedule(0.0, "step", replica)
@@ -382,7 +400,7 @@ class Simulation:
             self.now_ms = ev.at_ms
             if ev.kind == "step":
                 replica = ev.payload
-                if replica not in self.fleet.all_replicas():
+                if replica not in self._all_replicas():
                     self._replica_busy.discard(id(replica))
                     continue
                 dt = replica.step(self.now_ms)
@@ -390,7 +408,8 @@ class Simulation:
                     self.schedule(dt, "step", replica)
                 else:
                     self._replica_busy.discard(id(replica))
-                    self.fleet.reap_drained()
+                    for f in self.fleets:
+                        f.reap_drained()
                 if replica.draining:
                     # eviction under drain reroutes work to replicas that
                     # may be idle — make sure they get a step event

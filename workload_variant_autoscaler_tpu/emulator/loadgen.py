@@ -78,6 +78,7 @@ class PoissonLoadGenerator:
         tokens: TokenDistribution | None = None,
         poisson: bool = True,
         seed: int = 1,
+        fleet=None,  # target fleet in a multi-fleet Simulation
     ):
         self.sim = sim
         self.schedule = schedule
@@ -87,6 +88,7 @@ class PoissonLoadGenerator:
         self._ids = itertools.count()
         self.start_ms = sim.now_ms
         self.generated = 0
+        self.fleet = fleet
 
     def _next_interval_ms(self, rpm: float) -> float:
         mean_ms = 60000.0 / rpm
@@ -118,7 +120,7 @@ class PoissonLoadGenerator:
             out_tokens=out_tok,
             arrival_ms=now_ms,
         )
-        self.sim.submit(req)
+        self.sim.submit(req, self.fleet)
         self.generated += 1
         self._schedule_next()
 

@@ -117,3 +117,32 @@ class SimPromAPI:
         # the window — 'unknown', which the collector must not read as 0
         value = self._rate(num) / den_rate if den_rate > 0 else float("nan")
         return [Sample(labels=labels, value=value, timestamp=self.now_s)]
+
+
+class MultiPromAPI:
+    """One Prometheus over several emulated variants (multi-model closed
+    loops, BASELINE configs 2/5): each backend answers only its own
+    model's queries, so dispatch is concatenation — exactly how a real
+    Prometheus serves per-model aggregations from one TSDB."""
+
+    def __init__(self, backends: list[SimPromAPI]):
+        if not backends:
+            raise ValueError("MultiPromAPI needs at least one backend")
+        keys = [(b.model, b.namespace) for b in backends]
+        if len(set(keys)) != len(keys):
+            # two backends for one (model, ns) would both answer that
+            # model's queries and silently double-count its rates
+            raise ValueError(f"duplicate (model, namespace) backends: {keys}")
+        self.backends = list(backends)
+
+    def scrape(self, now_ms: float) -> None:
+        for b in self.backends:
+            b.scrape(now_ms)
+
+    def query(self, promql: str) -> list[Sample]:
+        if promql == "up":
+            return self.backends[0].query(promql)
+        out: list[Sample] = []
+        for b in self.backends:
+            out.extend(b.query(promql))
+        return out
