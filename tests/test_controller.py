@@ -382,3 +382,70 @@ class TestMetricsOutageCondition:
         assert crd.is_condition_false(va, crd.TYPE_METRICS_AVAILABLE)
         cond = crd.get_condition(va, crd.TYPE_METRICS_AVAILABLE)
         assert cond.reason == crd.REASON_METRICS_MISSING
+
+
+class TestCycleTiming:
+    """Per-stage reconcile timing (beyond-reference observability: the
+    reference times only the solver and never exports it)."""
+
+    STAGES = ("config", "prepare", "analyze", "optimize", "publish")
+
+    def test_all_stages_timed_on_success(self):
+        _kube, _p, emitter, rec = make_cluster()
+        rec.reconcile()
+        for stage in self.STAGES:
+            v = emitter.value("inferno_reconcile_stage_duration_msec",
+                              stage=stage)
+            assert v is not None and v >= 0.0, stage
+        total = emitter.value("inferno_reconcile_duration_msec")
+        assert total is not None
+        assert total == pytest.approx(sum(
+            emitter.value("inferno_reconcile_stage_duration_msec", stage=s)
+            for s in self.STAGES
+        ))
+
+    def test_partial_stages_on_early_exit(self):
+        # no VAs at all: cycle ends after the config stage; unreached
+        # stages read 0, not absent
+        _kube, _p, emitter, rec = make_cluster()
+        _kube.vas.clear()
+        rec.reconcile()
+        assert emitter.value("inferno_reconcile_stage_duration_msec",
+                             stage="config") > 0.0
+        assert emitter.value("inferno_reconcile_stage_duration_msec",
+                             stage="optimize") == 0.0
+
+    def test_partial_cycle_zeroes_stale_stage_values(self):
+        # a full cycle then an early-exit cycle: the gauges must describe
+        # the LAST cycle only (sum(stages) == total), not leak cycle N's
+        # analyze time into cycle N+1
+        kube, _p, emitter, rec = make_cluster()
+        rec.reconcile()
+        assert emitter.value("inferno_reconcile_stage_duration_msec",
+                             stage="analyze") > 0.0
+        kube.vas.clear()
+        rec.reconcile()
+        assert emitter.value("inferno_reconcile_stage_duration_msec",
+                             stage="analyze") == 0.0
+        total = emitter.value("inferno_reconcile_duration_msec")
+        assert total == pytest.approx(sum(
+            emitter.value("inferno_reconcile_stage_duration_msec", stage=s)
+            for s in self.STAGES
+        ))
+
+    def test_failing_solve_lands_in_optimize_stage(self, monkeypatch):
+        from workload_variant_autoscaler_tpu.solver import Optimizer
+
+        def boom(self, *a, **k):
+            raise RuntimeError("solver exploded")
+
+        monkeypatch.setattr(Optimizer, "optimize", boom)
+        _kube, _p, emitter, rec = make_cluster()
+        result = rec.reconcile()
+        assert result.error is not None
+        # the failed solve is attributed to optimize; the failure-condition
+        # status writes are attributed to publish
+        assert emitter.value("inferno_reconcile_stage_duration_msec",
+                             stage="optimize") > 0.0
+        assert emitter.value("inferno_reconcile_stage_duration_msec",
+                             stage="publish") > 0.0

@@ -113,6 +113,25 @@ class Reconciler:
     # -- the cycle (reference controller.go:86-202) ----------------------
 
     def reconcile(self) -> ReconcileResult:
+        """One cycle, with per-stage wall-clock timing published as
+        inferno_reconcile_stage_duration_msec{stage=...} — whichever
+        dependency stalls (apiserver config reads, Prometheus scrapes, the
+        sizing kernel, status writes) shows up as its stage."""
+        stages: dict[str, float] = {}
+        t0 = time.perf_counter()
+
+        def mark(stage: str) -> None:
+            nonlocal t0
+            t1 = time.perf_counter()
+            stages[stage] = (t1 - t0) * 1000.0
+            t0 = t1
+
+        try:
+            return self._reconcile_timed(mark)
+        finally:
+            self.emitter.emit_cycle_timing(stages)
+
+    def _reconcile_timed(self, mark) -> ReconcileResult:
         operator_cm = self.read_operator_config()
         interval = self.read_optimization_interval(operator_cm)
         result = ReconcileResult(requeue_after=interval)
@@ -121,6 +140,7 @@ class Reconciler:
         service_class_cm = self.read_service_class_config()
 
         vas = self.kube.list_variant_autoscalings()
+        mark("config")
         active = [va for va in vas if va.is_active()]
         for va in vas:
             if not va.is_active():
@@ -180,6 +200,7 @@ class Reconciler:
 
         prepared = self._prepare(active, accelerator_cm, service_class_cm,
                                  system_spec, result)
+        mark("prepare")
         if not prepared:
             return result
 
@@ -188,16 +209,21 @@ class Reconciler:
         system = System()
         optimizer_spec = system.set_from_spec(system_spec)
         system.calculate(backend=translate.engine_backend())
+        mark("analyze")
 
-        # optimize
+        # optimize (the stage mark is in a finally: a slow FAILING solve is
+        # exactly the stall the stage series exists to expose)
         try:
-            optimizer = Optimizer(optimizer_spec)
-            manager = Manager(system, optimizer)
-            manager.optimize()
-            self.emitter.emit_solution_time(optimizer.solution_time_msec)
-            solution = system.generate_solution()
-            if not solution.allocations:
-                raise RuntimeError("no feasible allocations found for any variant")
+            try:
+                optimizer = Optimizer(optimizer_spec)
+                manager = Manager(system, optimizer)
+                manager.optimize()
+                self.emitter.emit_solution_time(optimizer.solution_time_msec)
+                solution = system.generate_solution()
+                if not solution.allocations:
+                    raise RuntimeError("no feasible allocations found for any variant")
+            finally:
+                mark("optimize")
         except Exception as e:  # noqa: BLE001
             log.error("optimization failed, retrying next cycle", extra=kv(error=str(e)))
             result.error = str(e)
@@ -208,6 +234,7 @@ class Reconciler:
                     now=self.now(),
                 )
                 self._update_status(va)
+            mark("publish")  # the failure-condition status writes
             return result
 
         # publish (keyed by full name: same-named VAs in different
@@ -230,6 +257,7 @@ class Reconciler:
             optimized[key] = alloc
 
         self._apply(prepared, optimized, result)
+        mark("publish")
         return result
 
     # -- scale-down stabilization (beyond-reference; HPA-style) -----------

@@ -106,6 +106,11 @@ INFERNO_DESIRED_REPLICAS = "inferno_desired_replicas"
 INFERNO_CURRENT_REPLICAS = "inferno_current_replicas"
 INFERNO_DESIRED_RATIO = "inferno_desired_ratio"
 INFERNO_SOLUTION_TIME_MSEC = "inferno_solution_time_msec"
+INFERNO_RECONCILE_DURATION_MSEC = "inferno_reconcile_duration_msec"
+INFERNO_RECONCILE_STAGE_DURATION_MSEC = "inferno_reconcile_stage_duration_msec"
+
+LABEL_STAGE = "stage"
+RECONCILE_STAGES = ("config", "prepare", "analyze", "optimize", "publish")
 
 LABEL_VARIANT_NAME = "variant_name"
 LABEL_NAMESPACE = "namespace"
@@ -154,9 +159,35 @@ class MetricsEmitter:
             "Wall-clock time of the last optimization solve",
             registry=self.registry,
         )
+        # per-stage cycle timing (beyond-reference: the reference times the
+        # solver internally and exports nothing; here every stage of
+        # collect->analyze->optimize->publish is a scrapeable series, so a
+        # slow Prometheus or apiserver is visible as the stage that stalls)
+        self.reconcile_duration = Gauge(
+            INFERNO_RECONCILE_DURATION_MSEC,
+            "Wall-clock time of the last full reconcile cycle",
+            registry=self.registry,
+        )
+        self.reconcile_stage_duration = Gauge(
+            INFERNO_RECONCILE_STAGE_DURATION_MSEC,
+            "Wall-clock time of each stage of the last reconcile cycle",
+            [LABEL_STAGE],
+            registry=self.registry,
+        )
 
     def emit_solution_time(self, msec: float) -> None:
         self.solution_time.set(msec)
+
+    def emit_cycle_timing(self, stage_msec: dict[str, float]) -> None:
+        """Publish per-stage durations + their total for the last cycle.
+        Stages a partial cycle never reached are zeroed, not left holding
+        the previous cycle's value — the series always describes ONE
+        cycle, so sum(stages) == total."""
+        with self._lock:
+            for stage in RECONCILE_STAGES:
+                self.reconcile_stage_duration.labels(
+                    **{LABEL_STAGE: stage}).set(stage_msec.get(stage, 0.0))
+            self.reconcile_duration.set(sum(stage_msec.values()))
 
     def emit_replica_metrics(
         self,
