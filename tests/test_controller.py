@@ -449,3 +449,55 @@ class TestCycleTiming:
                              stage="optimize") > 0.0
         assert emitter.value("inferno_reconcile_stage_duration_msec",
                              stage="publish") > 0.0
+
+
+class TestMeshShardedReconcile:
+    """WVA_MESH_DEVICES wires parallel.size_batch_sharded into the cycle:
+    the fleet's candidate batch shards over the local devices (8 virtual
+    CPU devices here; real chips on a TPU host)."""
+
+    def test_mesh_cycle_matches_single_device_result(self, monkeypatch):
+        _k1, _p1, _e1, rec_plain = make_cluster(arrival_rps=60.0)
+        baseline = rec_plain.reconcile()
+        kube1 = _k1.get_variant_autoscaling(VARIANT, NS)
+
+        monkeypatch.setenv("WVA_MESH_DEVICES", "all")
+        _k2, _p2, _e2, rec_mesh = make_cluster(arrival_rps=60.0)
+        meshed = rec_mesh.reconcile()
+        kube2 = _k2.get_variant_autoscaling(VARIANT, NS)
+
+        assert meshed.processed == baseline.processed
+        assert (kube2.status.desired_optimized_alloc.num_replicas
+                == kube1.status.desired_optimized_alloc.num_replicas)
+        assert (kube2.status.desired_optimized_alloc.accelerator
+                == kube1.status.desired_optimized_alloc.accelerator)
+
+    def test_mesh_device_count_subset(self, monkeypatch):
+        monkeypatch.setenv("WVA_MESH_DEVICES", "2")
+        _kube, _p, _e, rec = make_cluster(arrival_rps=60.0)
+        result = rec.reconcile()
+        assert result.error is None and result.processed == [FULL]
+
+    def test_bad_mesh_values_fall_back_to_single_device(self, monkeypatch):
+        from workload_variant_autoscaler_tpu.controller import translate
+
+        for bad in ("banana", "0", "-3"):
+            monkeypatch.setenv("WVA_MESH_DEVICES", bad)
+            assert translate.engine_mesh("batched") is None
+        monkeypatch.setenv("WVA_MESH_DEVICES", "all")
+        assert translate.engine_mesh("native") is None  # backend mismatch
+        monkeypatch.delenv("WVA_MESH_DEVICES")
+        assert translate.engine_mesh("batched") is None
+
+
+    def test_raising_cycle_attributes_time_to_failing_stage(self):
+        # apiserver outage mid-config: the elapsed (backoff) time must land
+        # in the config stage, not vanish into an all-zero cycle
+        kube, _p, emitter, rec = make_cluster()
+        kube.inject_fault("get", "ConfigMap", NotFoundError("gone"))
+        with pytest.raises(NotFoundError):
+            rec.reconcile()
+        config_ms = emitter.value("inferno_reconcile_stage_duration_msec",
+                                  stage="config")
+        total = emitter.value("inferno_reconcile_duration_msec")
+        assert config_ms > 0.0 and total == pytest.approx(config_ms)

@@ -25,7 +25,7 @@ from ..collector import (
     collect_load,
     validate_metrics_availability,
 )
-from ..metrics import MetricsEmitter
+from ..metrics import RECONCILE_STAGES, MetricsEmitter
 from ..models import SaturationPolicy, System
 from ..solver import Manager, Optimizer
 from ..utils import (
@@ -128,6 +128,16 @@ class Reconciler:
 
         try:
             return self._reconcile_timed(mark)
+        except BaseException:
+            # attribute in-flight time to the stage that raised (the first
+            # unmarked one): a 30s apiserver backoff that ends in an
+            # exception must read as 30s of config/prepare, not as an
+            # instant healthy-looking cycle
+            for stage in RECONCILE_STAGES:
+                if stage not in stages:
+                    mark(stage)
+                    break
+            raise
         finally:
             self.emitter.emit_cycle_timing(stages)
 
@@ -208,7 +218,9 @@ class Reconciler:
         # default; the C++ kernel under WVA_NATIVE_KERNEL)
         system = System()
         optimizer_spec = system.set_from_spec(system_spec)
-        system.calculate(backend=translate.engine_backend())
+        engine_backend = translate.engine_backend()
+        system.calculate(backend=engine_backend,
+                         mesh=translate.engine_mesh(engine_backend))
         mark("analyze")
 
         # optimize (the stage mark is in a finally: a slow FAILING solve is

@@ -251,6 +251,35 @@ def engine_backend() -> str:
     return "batched"
 
 
+def engine_mesh(backend: str):
+    """Optional candidate-axis device mesh from WVA_MESH_DEVICES ("all" or
+    a device count): shards the fleet's candidate batch over the local
+    TPU devices (parallel.size_batch_sharded) for fleet-scale what-if
+    analysis. None (the default) keeps the single-device path. Only
+    meaningful for the batched backend; ignored (with a warning)
+    otherwise."""
+    raw = os.environ.get("WVA_MESH_DEVICES", "").strip()
+    if not raw:
+        return None
+    if backend != "batched":
+        log.warning("WVA_MESH_DEVICES ignored: mesh sharding requires the "
+                    "batched backend", extra=kv(backend=backend))
+        return None
+    from ..parallel import candidate_mesh
+
+    if raw.lower() == "all":
+        return candidate_mesh()
+    try:
+        n = int(raw)
+    except ValueError:
+        log.warning("bad WVA_MESH_DEVICES, ignoring", extra=kv(value=raw))
+        return None
+    if n <= 0:
+        log.warning("bad WVA_MESH_DEVICES, ignoring", extra=kv(value=raw))
+        return None
+    return candidate_mesh(n)
+
+
 def add_server_info_to_system_data(
     spec: SystemSpec, va: crd.VariantAutoscaling, class_name: str
 ) -> None:

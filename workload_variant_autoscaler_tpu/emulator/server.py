@@ -77,8 +77,15 @@ class RealtimeEmulator:
             dt = self.replica.step(now_ms)
             await asyncio.sleep(dt / 1000.0)
 
-    async def handle_request(self, in_tokens: int) -> Request:
+    async def handle_request(self, in_tokens: int,
+                             max_tokens: int = 0) -> Request:
+        # sampled from the configured distribution, capped by the request's
+        # max_tokens when given — so an HTTP loadgen's TokenDistribution
+        # actually controls output lengths (the reference emulator ignores
+        # max_tokens entirely, server.py:92)
         out_tokens = self.tokens.sample(self.rng)[1]
+        if max_tokens > 0:
+            out_tokens = min(out_tokens, max_tokens)
         done = asyncio.Event()
         req = Request(
             req_id=next(self._ids),
@@ -107,6 +114,9 @@ def build_app(config: SliceModelConfig | None = None, with_prom_api: bool = Fals
             body = await request.json()
         except Exception:  # noqa: BLE001 - malformed body is a client error
             return web.json_response({"error": "invalid JSON body"}, status=400)
+        if not isinstance(body, dict):
+            return web.json_response({"error": "body must be a JSON object"},
+                                     status=400)
         messages = body.get("messages", [])
         if not isinstance(messages, list) or any(
             not isinstance(m, dict) for m in messages
@@ -116,7 +126,12 @@ def build_app(config: SliceModelConfig | None = None, with_prom_api: bool = Fals
         content = messages[-1].get("content", "") if messages else ""
         if not isinstance(content, str):
             content = str(content)
-        req = await emulator.handle_request(in_tokens=max(len(content), 1))
+        try:
+            max_tokens = int(body.get("max_tokens", 0))
+        except (TypeError, ValueError):
+            max_tokens = 0
+        req = await emulator.handle_request(in_tokens=max(len(content), 1),
+                                            max_tokens=max_tokens)
         return web.json_response({
             "id": str(req.req_id),
             "object": "chat.completion",
@@ -157,17 +172,20 @@ def build_app(config: SliceModelConfig | None = None, with_prom_api: bool = Fals
             },
         })
 
+    engine_task_key = web.AppKey("engine_task", asyncio.Task)
+    scrape_task_key = web.AppKey("scrape_task", asyncio.Task)
+
     async def start_background(app):
-        app["engine_task"] = asyncio.create_task(emulator.run())
+        app[engine_task_key] = asyncio.create_task(emulator.run())
         if prom_shim is not None:
             async def scraper():
                 while True:
                     prom_shim.scrape(time.time() * 1000.0)
                     await asyncio.sleep(5.0)
-            app["scrape_task"] = asyncio.create_task(scraper())
+            app[scrape_task_key] = asyncio.create_task(scraper())
 
     async def stop_background(app):
-        for key in ("engine_task", "scrape_task"):
+        for key in (engine_task_key, scrape_task_key):
             task = app.get(key)
             if task is not None:
                 task.cancel()
