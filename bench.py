@@ -218,8 +218,15 @@ def probe_pallas_compile(timeout_s: float = 180.0) -> dict:
         except (json.JSONDecodeError, KeyError, IndexError):
             return {"status": "error", "detail": r.stdout[-300:]}
         return {"status": "compiled", "sizings_per_sec": round(rate, 1)}
-    tail = (r.stderr or r.stdout).strip().splitlines()
-    return {"status": "error", "detail": " | ".join(tail[-3:])[:400]}
+    lines = (r.stderr or r.stdout).strip().splitlines()
+    # surface the actual exception, not the traceback boilerplate JAX
+    # appends after it ("For simplicity, JAX has removed...")
+    informative = [ln for ln in lines
+                   if ("Error" in ln or "error" in ln)
+                   and "JAX_TRACEBACK_FILTERING" not in ln
+                   and not ln.lstrip().startswith(("File ", "raise "))]
+    tail = informative[-2:] if informative else lines[-3:]
+    return {"status": "error", "detail": " | ".join(tail)[:400]}
 
 
 def main() -> None:

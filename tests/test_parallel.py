@@ -8,11 +8,13 @@ import pytest
 
 from workload_variant_autoscaler_tpu.ops.batched import (
     SLOTargets,
+    analyze_batch,
     k_max_for,
     make_queue_batch,
     size_batch,
 )
 from workload_variant_autoscaler_tpu.parallel import (
+    analyze_batch_sharded,
     candidate_mesh,
     pad_to_multiple,
     size_batch_sharded,
@@ -64,6 +66,22 @@ class TestMesh:
             np.asarray(sharded.feasible), np.asarray(local.feasible)
         )
         assert sharded.lam_star.shape == (b,)
+
+    @pytest.mark.parametrize("b", [8, 11])
+    def test_sharded_analyze_matches_single_device(self, b):
+        q, _t, k_max = _random_batch(b)
+        rng = np.random.default_rng(1)
+        rates = rng.uniform(1.0, 20.0, b)  # req/sec
+        mesh = candidate_mesh()
+        sharded = analyze_batch_sharded(q, rates, k_max, mesh)
+        local = analyze_batch(q, jnp.asarray(rates, q.alpha.dtype), k_max)
+        assert set(sharded) == set(local)
+        for name in ("throughput", "avg_token_time", "ttft", "rho"):
+            np.testing.assert_allclose(np.asarray(sharded[name]),
+                                       np.asarray(local[name]), rtol=1e-12)
+        np.testing.assert_array_equal(np.asarray(sharded["valid_rate"]),
+                                      np.asarray(local["valid_rate"]))
+        assert sharded["ttft"].shape == (b,)
 
 
 class TestSystemWithMesh:

@@ -28,7 +28,7 @@ FAST = SliceModelConfig(model_name="m", alpha=1.0, beta=0.01,
 
 
 def run_async(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    return asyncio.run(coro)
 
 
 async def _client(with_prom_api=False) -> TestClient:

@@ -239,7 +239,13 @@ class System:
             )
             per_replica_rate[i] = total / num_replicas[i]
 
-        per_rep = analyze_batch(q, jnp.asarray(per_replica_rate, dtype), k_max)
+        if mesh is not None:
+            from ..parallel import analyze_batch_sharded
+
+            per_rep = analyze_batch_sharded(
+                q, jnp.asarray(per_replica_rate, dtype), k_max, mesh)
+        else:
+            per_rep = analyze_batch(q, jnp.asarray(per_replica_rate, dtype), k_max)
         itl_a = np.asarray(per_rep["avg_token_time"])
         ttft_a = np.asarray(per_rep["ttft"])
         rho_a = np.asarray(per_rep["rho"])

@@ -11,6 +11,7 @@ rides ICI only for result gathering).
 """
 
 from .mesh import (
+    analyze_batch_sharded,
     candidate_mesh,
     pad_to_multiple,
     shard_batch,
@@ -18,6 +19,7 @@ from .mesh import (
 )
 
 __all__ = [
+    "analyze_batch_sharded",
     "candidate_mesh",
     "pad_to_multiple",
     "shard_batch",

@@ -17,7 +17,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.batched import QueueBatch, SizingResult, SLOTargets, size_batch
+from ..ops.batched import (
+    QueueBatch,
+    SizingResult,
+    SLOTargets,
+    analyze_batch,
+    size_batch,
+)
 
 AXIS = "candidates"
 
@@ -90,5 +96,33 @@ def _sharded_size_fn(k_max: int, mesh: Mesh):
     (Mesh hashes by device assignment + axis names)."""
     return jax.jit(
         partial(size_batch, k_max=k_max),
+        out_shardings=NamedSharding(mesh, P(AXIS)),
+    )
+
+
+def analyze_batch_sharded(q: QueueBatch, rates_per_sec, k_max: int,
+                          mesh: Mesh) -> dict:
+    """analyze_batch with the candidate axis sharded over `mesh` — the
+    per-replica re-analysis pass stays on the same devices the sizing pass
+    ran on (no gather-to-one-chip between the two kernel calls)."""
+    n = mesh.devices.size
+    b = q.batch_size
+    rates = jnp.asarray(rates_per_sec, q.alpha.dtype)
+    # ride pad_to_multiple for the rates too (ttft's pad fill is 0.0, and
+    # rate 0 on padded lanes is flagged by valid_rate downstream)
+    q, padded, _b = pad_to_multiple(
+        q, SLOTargets(ttft=rates, itl=rates, tps=rates), n
+    )
+    rates = padded.ttft
+    q = shard_batch(q, mesh)
+    rates = jax.device_put(rates, NamedSharding(mesh, P(AXIS)))
+    out = _sharded_analyze_fn(k_max, mesh)(q, rates)
+    return jax.tree.map(lambda a: a[:b], out)
+
+
+@lru_cache(maxsize=32)
+def _sharded_analyze_fn(k_max: int, mesh: Mesh):
+    return jax.jit(
+        partial(analyze_batch, k_max=k_max),
         out_shardings=NamedSharding(mesh, P(AXIS)),
     )
