@@ -12,12 +12,12 @@ help: ## Show targets
 ##@ Development
 
 .PHONY: test
-test: ## Run the unit + integration test suite (CPU, 8 virtual devices)
-	$(PY) -m pytest tests/ -x -q
+test: ## Run the full suite incl. slow multi-process e2e (CPU, 8 virtual devices)
+	$(PY) -m pytest tests/ -q
 
 .PHONY: test-fast
-test-fast: ## Run tests, stop at first failure, quieter
-	$(PY) -m pytest tests/ -x -q -p no:cacheprovider
+test-fast: ## Unit/integration only (no slow e2e), stop at first failure
+	$(PY) -m pytest tests/ -x -q -m "not slow" -p no:cacheprovider
 
 .PHONY: bench
 bench: ## Run the kernel benchmark (one JSON line; uses a real TPU when present)
@@ -75,9 +75,10 @@ run-emulator: ## Run the TPU serving emulator locally on :8000
 	$(PY) -m workload_variant_autoscaler_tpu.emulator --port 8000 --with-prom-api
 
 .PHONY: run-controller-local
-run-controller-local: ## Run the controller against a local emulator's PromQL shim
+run-controller-local: ## Run the controller against a local emulator, no cluster (see deploy/examples/local/)
 	PROMETHEUS_BASE_URL=http://127.0.0.1:8000 \
-	$(PY) -m workload_variant_autoscaler_tpu.controller --allow-http-prom
+	$(PY) -m workload_variant_autoscaler_tpu.controller --allow-http-prom \
+		--kube-manifests deploy/examples/local
 
 .PHONY: experiment
 experiment: ## Offline emulator parameter-estimation sweep

@@ -25,3 +25,9 @@ jax.config.update("jax_platforms", "cpu")
 # reference kernel; the batched kernel is dtype-polymorphic and is also
 # exercised at float32 explicitly.
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / wall-clock-paced e2e tests"
+    )

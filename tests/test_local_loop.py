@@ -1,0 +1,194 @@
+"""Zero-cluster local loop: two real processes, full controller path.
+
+Spawns the emulator (OpenAI endpoint + PromQL shim) and the controller
+binary in dev mode (--kube-manifests: in-memory apiserver preloaded from
+deploy/examples/local/), drives HTTP load, and asserts the controller
+publishes scaling signals on its own /metrics endpoint. This is the
+process-level equivalent of the reference's kind e2e scale-out assertion
+(test/e2e/e2e_test.go:358-444) with no cluster anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+MANIFESTS = REPO_ROOT / "deploy" / "examples" / "local"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_http(url: str, deadline_s: float = 30.0) -> None:
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            urllib.request.urlopen(url, timeout=1.0)
+            return
+        except OSError:
+            if time.time() > deadline:
+                pytest.fail(f"{url} never came up")
+            time.sleep(0.25)
+
+
+def _cpu_env(**extra) -> dict:
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env.update({"JAX_PLATFORMS": "cpu", "LOG_LEVEL": "error"})
+    env.update(extra)
+    return env
+
+
+@pytest.mark.slow
+def test_two_process_loop_publishes_scaling_signals():
+    emu_port, metrics_port, health_port = _free_port(), _free_port(), _free_port()
+    emu = subprocess.Popen(
+        [sys.executable, "-m", "workload_variant_autoscaler_tpu.emulator",
+         "--port", str(emu_port), "--host", "127.0.0.1", "--with-prom-api"],
+        env=_cpu_env(MODEL_NAME="default"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    ctrl = None
+    try:
+        base = f"http://127.0.0.1:{emu_port}"
+        _wait_http(base + "/metrics")
+
+        # traffic first, so the controller's first cycles see live series
+        for _ in range(10):
+            req = urllib.request.Request(
+                base + "/v1/chat/completions",
+                data=json.dumps({"model": "default",
+                                 "messages": [{"role": "user",
+                                               "content": "x " * 64}],
+                                 "max_tokens": 16}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=30.0)
+        time.sleep(6.0)  # shim scrapes every 5s; give rate() two points
+
+        ctrl = subprocess.Popen(
+            [sys.executable, "-m", "workload_variant_autoscaler_tpu.controller",
+             "--allow-http-prom", "--kube-manifests", str(MANIFESTS),
+             "--metrics-port", str(metrics_port),
+             "--health-port", str(health_port),
+             "--metrics-addr", "127.0.0.1"],
+            env=_cpu_env(PROMETHEUS_BASE_URL=base),
+            cwd=REPO_ROOT, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        _wait_http(f"http://127.0.0.1:{health_port}/readyz")
+
+        # the reconcile loop publishes within its first cycles (15s cadence,
+        # first cycle immediate; JAX compile makes it slow once)
+        deadline = time.time() + 90.0
+        desired = None
+        while time.time() < deadline:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics_port}/metrics", timeout=5.0
+            ).read().decode()
+            lines = [ln for ln in body.splitlines()
+                     if ln.startswith("inferno_desired_replicas")
+                     and 'variant_name="tpu-emulator"' in ln]
+            if lines:
+                desired = float(lines[0].rsplit(" ", 1)[1])
+                break
+            time.sleep(2.0)
+        assert desired is not None, "controller never published a recommendation"
+        assert desired >= 1.0
+        # stage timing series ride the same endpoint
+        assert "inferno_reconcile_stage_duration_msec" in body
+    finally:
+        for proc in (ctrl, emu):
+            if proc is not None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in (ctrl, emu):
+            if proc is not None:
+                try:
+                    proc.wait(timeout=15.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+
+class TestManifestLoader:
+    """Unit coverage for the dev-mode in-memory apiserver loader."""
+
+    _seq = 0
+
+    def _load(self, tmp_path, text):
+        from workload_variant_autoscaler_tpu.controller.kube import (
+            in_memory_kube_from_manifests,
+        )
+
+        TestManifestLoader._seq += 1
+        d = tmp_path / f"load{TestManifestLoader._seq}"
+        d.mkdir()
+        (d / "m.yaml").write_text(text)
+        return in_memory_kube_from_manifests(str(d))
+
+    def test_shipped_local_manifests_load(self):
+        from workload_variant_autoscaler_tpu.controller.kube import (
+            in_memory_kube_from_manifests,
+        )
+
+        kube = in_memory_kube_from_manifests(str(MANIFESTS))
+        assert kube.get_configmap(
+            "accelerator-unit-costs", "workload-variant-autoscaler-system"
+        ).data["v5e-1"]
+        assert kube.get_deployment("tpu-emulator", "default").spec_replicas == 1
+        va = kube.get_variant_autoscaling("tpu-emulator", "default")
+        assert va.spec.model_id == "default"
+
+    def test_empty_dir_rejected(self, tmp_path):
+        from workload_variant_autoscaler_tpu.controller.kube import (
+            InvalidError,
+            in_memory_kube_from_manifests,
+        )
+
+        with pytest.raises(InvalidError, match="no YAML manifests"):
+            in_memory_kube_from_manifests(str(tmp_path))
+
+    def test_null_metadata_and_spec_handled(self, tmp_path):
+        from workload_variant_autoscaler_tpu.controller.kube import InvalidError
+
+        # explicit empty metadata: parses to None -> named error, not a crash
+        with pytest.raises(InvalidError, match="without metadata.name"):
+            self._load(tmp_path, "kind: ConfigMap\nmetadata:\n")
+        # empty spec on a Deployment defaults replicas to 1
+        kube = self._load(
+            tmp_path, "kind: Deployment\nmetadata:\n  name: d\nspec:\n"
+        )
+        assert kube.get_deployment("d", "default").spec_replicas == 1
+
+    def test_invalid_va_rejected_by_admission(self, tmp_path):
+        from workload_variant_autoscaler_tpu.controller.kube import InvalidError
+
+        bad_va = (
+            "apiVersion: llmd.ai/v1alpha1\nkind: VariantAutoscaling\n"
+            "metadata:\n  name: v\nspec:\n  modelID: m\n"
+        )  # missing sloClassRef/modelProfile
+        with pytest.raises(InvalidError, match="Required value"):
+            self._load(tmp_path, bad_va)
+
+    def test_cli_exits_1_on_bad_manifest_dir(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, "-m", "workload_variant_autoscaler_tpu.controller",
+             "--allow-http-prom", "--kube-manifests", str(tmp_path / "nope"),
+             "--metrics-port", "0", "--health-port", "0"],
+            env=_cpu_env(PROMETHEUS_BASE_URL="http://127.0.0.1:1"),
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1
+        # config errors fail fast with a structured error (no traceback),
+        # before the minutes-long Prometheus connectivity backoff
+        assert "Traceback" not in proc.stderr
+        assert "failed to load dev-mode manifests" in (proc.stderr + proc.stdout)

@@ -22,7 +22,7 @@ import threading
 from ..collector import HTTPPromAPI, PrometheusConfig, validate_prometheus_api
 from ..metrics import MetricsEmitter
 from ..utils import get_logger, kv
-from .kube import RestKube
+from .kube import RestKube, in_memory_kube_from_manifests
 from .reconciler import CONFIG_MAP_NAMESPACE, Reconciler
 from .runtime import HealthServer, LeaderElector
 
@@ -46,6 +46,11 @@ def main(argv=None) -> int:
                         help="API server URL (default: in-cluster)")
     parser.add_argument("--allow-http-prom", action="store_true",
                         help="permit plain-http Prometheus (emulation only)")
+    parser.add_argument("--kube-manifests", default=None, metavar="DIR",
+                        help="dev mode: serve from an in-memory apiserver "
+                             "preloaded with the YAML manifests in DIR "
+                             "(no cluster needed; pairs with the emulator's "
+                             "--with-prom-api shim)")
     args = parser.parse_args(argv)
 
     log = get_logger("wva.main")
@@ -55,6 +60,20 @@ def main(argv=None) -> int:
         log.error("no Prometheus configuration found; set PROMETHEUS_BASE_URL")
         return 1
     prom = HTTPPromAPI(prom_config, allow_http=args.allow_http_prom)
+
+    # local config errors fail fast, BEFORE the minutes-long Prometheus
+    # connectivity backoff
+    if args.kube_manifests:
+        log.info("dev mode: in-memory apiserver from manifests",
+                 extra=kv(dir=args.kube_manifests))
+        try:
+            kube = in_memory_kube_from_manifests(args.kube_manifests)
+        except Exception as e:  # noqa: BLE001 — startup config error
+            log.error("failed to load dev-mode manifests",
+                      extra=kv(dir=args.kube_manifests, error=str(e)))
+            return 1
+    else:
+        kube = RestKube(base_url=args.kube_url)
 
     ready = threading.Event()
     health = HealthServer(args.health_port, ready_check=ready.is_set).start()
@@ -66,8 +85,6 @@ def main(argv=None) -> int:
         log.error("CRITICAL: cannot reach Prometheus; autoscaling requires it",
                   extra=kv(error=str(e)))
         return 1
-
-    kube = RestKube(base_url=args.kube_url)
     emitter = MetricsEmitter()
     try:
         emitter.serve(

@@ -278,6 +278,65 @@ class InMemoryKube:
                 del self.vas[key]
 
 
+def in_memory_kube_from_manifests(path: str) -> InMemoryKube:
+    """Dev-mode apiserver: an InMemoryKube preloaded from the YAML
+    manifests in a directory (ConfigMaps, Deployments, VariantAutoscalings;
+    other kinds ignored). Powers `--kube-manifests`, which runs the full
+    controller process against the local emulator with no cluster at all —
+    the reference has no equivalent (its smallest loop is kind)."""
+    import glob as _glob
+
+    import yaml
+
+    kube = InMemoryKube()
+    files = sorted(
+        _glob.glob(os.path.join(path, "*.yaml"))
+        + _glob.glob(os.path.join(path, "*.yml"))
+    )
+    if not files:
+        raise InvalidError(f"no YAML manifests found in {path!r}")
+    loadable = ("ConfigMap", "Deployment", "VariantAutoscaling")
+    for fp in files:
+        with open(fp) as f:
+            for doc in yaml.safe_load_all(f):
+                if not isinstance(doc, dict):
+                    continue
+                kind = doc.get("kind", "")
+                if kind not in loadable:
+                    continue
+                # hand-edited manifests: an explicit empty `metadata:` or
+                # `spec:` parses to None, not {}
+                meta = doc.get("metadata") or {}
+                name = meta.get("name", "")
+                ns = meta.get("namespace", "default")
+                if not name:
+                    raise InvalidError(f"{fp}: {kind} without metadata.name")
+                if kind == "ConfigMap":
+                    kube.put_configmap(ConfigMap(
+                        name=name, namespace=ns,
+                        data={k: str(v) for k, v in (doc.get("data") or {}).items()},
+                    ))
+                elif kind == "Deployment":
+                    replicas = int((doc.get("spec") or {}).get("replicas", 1))
+                    kube.put_deployment(Deployment(
+                        name=name, namespace=ns,
+                        spec_replicas=replicas, status_replicas=replicas,
+                        labels=dict(meta.get("labels") or {}),
+                    ))
+                else:
+                    # validate the RAW document: round-tripping through the
+                    # dataclasses first would fill defaults and mask missing
+                    # required fields (kubectl validates what you submitted)
+                    errors = schema.validate_va_dict(doc)
+                    if errors:
+                        raise InvalidError(
+                            f"{fp}: VariantAutoscaling {name!r} is invalid: "
+                            + "; ".join(errors)
+                        )
+                    kube.put_variant_autoscaling(va_from_dict(doc))
+    return kube
+
+
 class RestKube:
     """Minimal REST client for a real API server.
 

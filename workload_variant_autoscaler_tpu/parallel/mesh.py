@@ -36,6 +36,10 @@ def candidate_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.asarray(devices), (AXIS,))
 
 
+def _pad_1d(a, fill, pad: int):
+    return jnp.concatenate([a, jnp.full((pad,), fill, a.dtype)])
+
+
 def pad_to_multiple(q: QueueBatch, targets: SLOTargets, m: int):
     """Pad the candidate batch to a multiple of m with invalid benign lanes
     (alpha=1, max_batch=1, valid=False). Returns (q, targets, original_b)."""
@@ -45,7 +49,7 @@ def pad_to_multiple(q: QueueBatch, targets: SLOTargets, m: int):
         return q, targets, b
 
     def pad_with(a, fill):
-        return jnp.concatenate([a, jnp.full((pad,), fill, a.dtype)])
+        return _pad_1d(a, fill, pad)
 
     q = QueueBatch(
         alpha=pad_with(q.alpha, 1.0),
@@ -108,12 +112,14 @@ def analyze_batch_sharded(q: QueueBatch, rates_per_sec, k_max: int,
     n = mesh.devices.size
     b = q.batch_size
     rates = jnp.asarray(rates_per_sec, q.alpha.dtype)
-    # ride pad_to_multiple for the rates too (ttft's pad fill is 0.0, and
-    # rate 0 on padded lanes is flagged by valid_rate downstream)
-    q, padded, _b = pad_to_multiple(
-        q, SLOTargets(ttft=rates, itl=rates, tps=rates), n
-    )
-    rates = padded.ttft
+    pad = (-b) % n
+    if pad:
+        # zero-padded lanes ride the benign invalid queues and are flagged
+        # by valid_rate downstream
+        zeros = jnp.zeros((b,), rates.dtype)
+        q, _t, _b = pad_to_multiple(
+            q, SLOTargets(ttft=zeros, itl=zeros, tps=zeros), n)
+        rates = _pad_1d(rates, 0.0, pad)
     q = shard_batch(q, mesh)
     rates = jax.device_put(rates, NamedSharding(mesh, P(AXIS)))
     out = _sharded_analyze_fn(k_max, mesh)(q, rates)
