@@ -472,6 +472,26 @@ _CFG_70B_V5P4 = SliceModelConfig(
 )
 
 SCENARIOS: dict[str, Scenario] = {
+    # config-1 ramp with heavy-tailed (lognormal, sigma=1) lengths: real
+    # ShareGPT histograms, not the uniform mix — stresses KV admission and
+    # the TTFT tail far harder at the same mean load
+    "sharegpt-lognormal": Scenario(
+        key="sharegpt-lognormal",
+        title="config-1 ramp, lognormal token lengths (tail stress)",
+        accelerators={"v5e-1": {"chip": "v5e", "chips": "1", "cost": "20.0"}},
+        service_classes={"premium": _PREMIUM_YAML},
+        variants=[
+            VariantScenario(
+                name=VARIANT, model=MODEL, sc_key="premium",
+                accelerator="v5e-1", chips_per_replica=1, cfg=CFG,
+                ramp=[list(seg) for seg in RAMP],
+                tokens=TokenDistribution(avg_input_tokens=221,
+                                         avg_output_tokens=179,
+                                         distribution="lognormal"),
+                slo_itl_ms=SLO_ITL_MS, slo_ttft_ms=SLO_TTFT_MS,
+            ),
+        ],
+    ),
     # BASELINE config 2: two models, two service classes, one optimizer run
     "multi-model-mix": Scenario(
         key="multi-model-mix",

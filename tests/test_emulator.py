@@ -318,3 +318,23 @@ class TestFleetScaleDownKeepsBusy:
         fleet.set_replicas(1, 0.0)
         # the busy replica survived; its requests kept their progress
         assert len(fleet.replicas[0].running) == 3
+
+
+class TestLognormalTokens:
+    """Heavy-tailed length sampling (ShareGPT-shaped histograms)."""
+
+    def test_mean_matched_and_bounded(self):
+        import random
+
+        from workload_variant_autoscaler_tpu.emulator import TokenDistribution
+
+        d = TokenDistribution(221, 179, distribution="lognormal")
+        rng = random.Random(7)
+        ins, outs = zip(*(d.sample(rng) for _ in range(20_000)))
+        # mean-matched within tolerance (cap trims a little tail mass)
+        assert 0.85 * 221 < sum(ins) / len(ins) < 1.05 * 221
+        assert 0.85 * 179 < sum(outs) / len(outs) < 1.05 * 179
+        assert min(ins) >= 1 and max(ins) <= 16 * 221
+        # genuinely heavy-tailed: p99 well above the uniform maximum
+        p99 = sorted(ins)[int(len(ins) * 0.99)]
+        assert p99 > 2 * 221

@@ -169,6 +169,48 @@ class TestManifestLoader:
         )
         assert kube.get_deployment("d", "default").spec_replicas == 1
 
+    def test_null_scalar_values_handled(self, tmp_path):
+        # explicit-null replicas defaults like an absent key
+        kube = self._load(
+            tmp_path, "kind: Deployment\nmetadata:\n  name: d\nspec:\n  replicas:\n"
+        )
+        assert kube.get_deployment("d", "default").spec_replicas == 1
+        # explicit-null namespace files under default, where the
+        # reconciler will actually find it
+        kube = self._load(
+            tmp_path,
+            "kind: Deployment\nmetadata:\n  name: d\n  namespace:\nspec:\n",
+        )
+        assert kube.get_deployment("d", "default").spec_replicas == 1
+
+    def test_non_integer_replicas_named_error(self, tmp_path):
+        from workload_variant_autoscaler_tpu.controller.kube import InvalidError
+
+        with pytest.raises(InvalidError, match="not an integer"):
+            self._load(
+                tmp_path,
+                "kind: Deployment\nmetadata:\n  name: d\n"
+                "spec:\n  replicas: [1]\n",
+            )
+
+    def test_non_scalar_configmap_data_rejected(self, tmp_path):
+        from workload_variant_autoscaler_tpu.controller.kube import InvalidError
+
+        # unquoted JSON parses as a dict: a real apiserver rejects it, and
+        # str() coercion would break json.loads at reconcile time
+        with pytest.raises(InvalidError, match="must be strings"):
+            self._load(
+                tmp_path,
+                "kind: ConfigMap\nmetadata:\n  name: c\n"
+                "data:\n  v5e-1: {chip: v5e}\n",
+            )
+        # plain scalars are coerced the way kubectl users expect
+        kube = self._load(
+            tmp_path,
+            "kind: ConfigMap\nmetadata:\n  name: c\ndata:\n  K: 60\n",
+        )
+        assert kube.get_configmap("c", "default").data["K"] == "60"
+
     def test_invalid_va_rejected_by_admission(self, tmp_path):
         from workload_variant_autoscaler_tpu.controller.kube import InvalidError
 

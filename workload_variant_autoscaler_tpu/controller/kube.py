@@ -304,20 +304,40 @@ def in_memory_kube_from_manifests(path: str) -> InMemoryKube:
                 kind = doc.get("kind", "")
                 if kind not in loadable:
                     continue
-                # hand-edited manifests: an explicit empty `metadata:` or
-                # `spec:` parses to None, not {}
+                # hand-edited manifests: an explicit empty `metadata:`,
+                # `spec:`, or scalar (`replicas:`) parses to None, not to
+                # the absent-key default
                 meta = doc.get("metadata") or {}
-                name = meta.get("name", "")
-                ns = meta.get("namespace", "default")
+                name = meta.get("name") or ""
+                ns = meta.get("namespace") or "default"
                 if not name:
                     raise InvalidError(f"{fp}: {kind} without metadata.name")
                 if kind == "ConfigMap":
+                    data = doc.get("data") or {}
+                    bad = [k for k, v in data.items()
+                           if v is not None and not isinstance(v, (str, int, float, bool))]
+                    if bad:
+                        # a real apiserver rejects non-string ConfigMap data;
+                        # coercing a dict to its Python repr would just fail
+                        # confusingly at reconcile time
+                        raise InvalidError(
+                            f"{fp}: ConfigMap {name!r} data values must be "
+                            f"strings (offending keys: {bad}; quote them in YAML)"
+                        )
                     kube.put_configmap(ConfigMap(
                         name=name, namespace=ns,
-                        data={k: str(v) for k, v in (doc.get("data") or {}).items()},
+                        data={k: "" if v is None else str(v)
+                              for k, v in data.items()},
                     ))
                 elif kind == "Deployment":
-                    replicas = int((doc.get("spec") or {}).get("replicas", 1))
+                    raw = (doc.get("spec") or {}).get("replicas")
+                    try:
+                        replicas = 1 if raw is None else int(raw)
+                    except (TypeError, ValueError):
+                        raise InvalidError(
+                            f"{fp}: Deployment {name!r} spec.replicas is not "
+                            f"an integer: {raw!r}"
+                        ) from None
                     kube.put_deployment(Deployment(
                         name=name, namespace=ns,
                         spec_replicas=replicas, status_replicas=replicas,
@@ -326,13 +346,16 @@ def in_memory_kube_from_manifests(path: str) -> InMemoryKube:
                 else:
                     # validate the RAW document: round-tripping through the
                     # dataclasses first would fill defaults and mask missing
-                    # required fields (kubectl validates what you submitted)
-                    errors = schema.validate_va_dict(doc)
-                    if errors:
-                        raise InvalidError(
-                            f"{fp}: VariantAutoscaling {name!r} is invalid: "
-                            + "; ".join(errors)
-                        )
+                    # required fields (kubectl validates what you submitted).
+                    # Same CRD-file guard as InMemoryKube._admit (installed
+                    # packages may not carry the manifest).
+                    if schema.DEFAULT_CRD_PATH.is_file():
+                        errors = schema.validate_va_dict(doc)
+                        if errors:
+                            raise InvalidError(
+                                f"{fp}: VariantAutoscaling {name!r} is invalid: "
+                                + "; ".join(errors)
+                            )
                     kube.put_variant_autoscaling(va_from_dict(doc))
     return kube
 

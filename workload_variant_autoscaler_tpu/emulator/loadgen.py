@@ -55,15 +55,35 @@ def total_duration_s(schedule: RateSchedule | float) -> float:
 
 @dataclass
 class TokenDistribution:
+    """deterministic | uniform (U[1, 2*avg]) | lognormal (heavy-tailed,
+    sigma=1, mean-matched — the shape of real ShareGPT length histograms;
+    capped at 16*avg, the context-window stand-in)."""
+
     avg_input_tokens: int = 128
     avg_output_tokens: int = 128
-    distribution: str = "deterministic"  # or "uniform": U[1, 2*avg]
+    distribution: str = "deterministic"
+
+    LOGNORMAL_SIGMA = 1.0
+    LOGNORMAL_CAP = 16
+
+    def _lognormal(self, rng: random.Random, avg: int) -> int:
+        import math
+
+        sigma = self.LOGNORMAL_SIGMA
+        mu = math.log(max(avg, 1)) - sigma * sigma / 2.0
+        v = rng.lognormvariate(mu, sigma)
+        return max(1, min(int(round(v)), self.LOGNORMAL_CAP * avg))
 
     def sample(self, rng: random.Random) -> tuple[int, int]:
         if self.distribution == "uniform":
             return (
                 max(rng.randint(1, 2 * self.avg_input_tokens), 1),
                 max(rng.randint(1, 2 * self.avg_output_tokens), 1),
+            )
+        if self.distribution == "lognormal":
+            return (
+                self._lognormal(rng, self.avg_input_tokens),
+                self._lognormal(rng, self.avg_output_tokens),
             )
         return self.avg_input_tokens, self.avg_output_tokens
 
@@ -220,7 +240,7 @@ def main(argv=None) -> int:
     parser.add_argument("--input-tokens", type=int, default=128)
     parser.add_argument("--output-tokens", type=int, default=128)
     parser.add_argument("--distribution", default="deterministic",
-                        choices=["deterministic", "uniform"])
+                        choices=["deterministic", "uniform", "lognormal"])
     parser.add_argument("--deterministic-arrivals", action="store_true")
     parser.add_argument("--seed", type=int, default=1)
     args = parser.parse_args(argv)
