@@ -338,3 +338,11 @@ class TestLognormalTokens:
         # genuinely heavy-tailed: p99 well above the uniform maximum
         p99 = sorted(ins)[int(len(ins) * 0.99)]
         assert p99 > 2 * 221
+
+    def test_unknown_distribution_rejected(self):
+        import pytest
+
+        from workload_variant_autoscaler_tpu.emulator import TokenDistribution
+
+        with pytest.raises(ValueError, match="unknown token distribution"):
+            TokenDistribution(128, 128, distribution="lognorm")

@@ -186,11 +186,28 @@ class TestManifestLoader:
     def test_non_integer_replicas_named_error(self, tmp_path):
         from workload_variant_autoscaler_tpu.controller.kube import InvalidError
 
-        with pytest.raises(InvalidError, match="not an integer"):
+        # lists, truncating floats, bools, negatives: all rejected like a
+        # real apiserver, never silently coerced
+        for bad in ("[1]", "2.9", "true", "-3"):
+            with pytest.raises(InvalidError, match="replicas"):
+                self._load(
+                    tmp_path,
+                    "kind: Deployment\nmetadata:\n  name: d\n"
+                    f"spec:\n  replicas: {bad}\n",
+                )
+
+    def test_list_valued_sections_named_error(self, tmp_path):
+        from workload_variant_autoscaler_tpu.controller.kube import InvalidError
+
+        with pytest.raises(InvalidError, match="must be a mapping"):
             self._load(
                 tmp_path,
-                "kind: Deployment\nmetadata:\n  name: d\n"
-                "spec:\n  replicas: [1]\n",
+                "kind: ConfigMap\nmetadata:\n  name: c\ndata: [a, b]\n",
+            )
+        with pytest.raises(InvalidError, match="must be a mapping"):
+            self._load(
+                tmp_path,
+                "kind: Deployment\nmetadata:\n  name: d\nspec: [x]\n",
             )
 
     def test_non_scalar_configmap_data_rejected(self, tmp_path):
@@ -207,9 +224,12 @@ class TestManifestLoader:
         # plain scalars are coerced the way kubectl users expect
         kube = self._load(
             tmp_path,
-            "kind: ConfigMap\nmetadata:\n  name: c\ndata:\n  K: 60\n",
+            "kind: ConfigMap\nmetadata:\n  name: c\n"
+            "data:\n  K: 60\n  FLAG: true\n",
         )
-        assert kube.get_configmap("c", "default").data["K"] == "60"
+        cm = kube.get_configmap("c", "default")
+        # scalars coerce the way their YAML author wrote them
+        assert cm.data["K"] == "60" and cm.data["FLAG"] == "true"
 
     def test_invalid_va_rejected_by_admission(self, tmp_path):
         from workload_variant_autoscaler_tpu.controller.kube import InvalidError

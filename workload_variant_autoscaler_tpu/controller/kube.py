@@ -278,6 +278,16 @@ class InMemoryKube:
                 del self.vas[key]
 
 
+def _yaml_scalar_str(v) -> str:
+    """Coerce a YAML scalar the way its author wrote it: booleans as
+    true/false (str(True) would yield Python-style 'True'), None as ''."""
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
 def in_memory_kube_from_manifests(path: str) -> InMemoryKube:
     """Dev-mode apiserver: an InMemoryKube preloaded from the YAML
     manifests in a directory (ConfigMaps, Deployments, VariantAutoscalings;
@@ -314,6 +324,10 @@ def in_memory_kube_from_manifests(path: str) -> InMemoryKube:
                     raise InvalidError(f"{fp}: {kind} without metadata.name")
                 if kind == "ConfigMap":
                     data = doc.get("data") or {}
+                    if not isinstance(data, dict):
+                        raise InvalidError(
+                            f"{fp}: ConfigMap {name!r} data must be a mapping"
+                        )
                     bad = [k for k, v in data.items()
                            if v is not None and not isinstance(v, (str, int, float, bool))]
                     if bad:
@@ -326,18 +340,27 @@ def in_memory_kube_from_manifests(path: str) -> InMemoryKube:
                         )
                     kube.put_configmap(ConfigMap(
                         name=name, namespace=ns,
-                        data={k: "" if v is None else str(v)
-                              for k, v in data.items()},
+                        data={k: _yaml_scalar_str(v) for k, v in data.items()},
                     ))
                 elif kind == "Deployment":
-                    raw = (doc.get("spec") or {}).get("replicas")
-                    try:
-                        replicas = 1 if raw is None else int(raw)
-                    except (TypeError, ValueError):
+                    spec = doc.get("spec") or {}
+                    if not isinstance(spec, dict):
                         raise InvalidError(
-                            f"{fp}: Deployment {name!r} spec.replicas is not "
-                            f"an integer: {raw!r}"
-                        ) from None
+                            f"{fp}: Deployment {name!r} spec must be a mapping"
+                        )
+                    raw = spec.get("replicas")
+                    # strict, like the apiserver: integer >= 0 only (no
+                    # bools, no truncated floats)
+                    if raw is None:
+                        replicas = 1
+                    elif (isinstance(raw, bool) or not isinstance(raw, int)
+                          or raw < 0):
+                        raise InvalidError(
+                            f"{fp}: Deployment {name!r} spec.replicas must be "
+                            f"a non-negative integer, got {raw!r}"
+                        )
+                    else:
+                        replicas = raw
                     kube.put_deployment(Deployment(
                         name=name, namespace=ns,
                         spec_replicas=replicas, status_replicas=replicas,

@@ -74,6 +74,15 @@ class TokenDistribution:
         v = rng.lognormvariate(mu, sigma)
         return max(1, min(int(round(v)), self.LOGNORMAL_CAP * avg))
 
+    def __post_init__(self) -> None:
+        if self.distribution not in ("deterministic", "uniform", "lognormal"):
+            # a typo must not silently degrade a tail-stress benchmark to
+            # deterministic lengths
+            raise ValueError(
+                f"unknown token distribution {self.distribution!r}; expected "
+                "deterministic, uniform, or lognormal"
+            )
+
     def sample(self, rng: random.Random) -> tuple[int, int]:
         if self.distribution == "uniform":
             return (

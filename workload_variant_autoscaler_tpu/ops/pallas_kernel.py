@@ -17,11 +17,14 @@ are masked lane reductions, so no in-kernel cumsum is required.
 Equivalence with `size_batch` is exact up to float associativity and is
 enforced by tests/test_pallas.py (interpret mode on CPU, compiled on TPU).
 
-Status: the XLA fori_loop path is the production default — at fleet batch
-sizes it sustains ~80-90M sizings/s on one v5e chip, and the development
-tunnel's AOT compile helper cannot compile Mosaic kernels (its
-environment lacks the TPU topology hints), so this kernel is validated in
-interpret mode here and compiles on directly-attached TPUs.
+Status: compiles via Mosaic and runs on a real v5e chip at ~97M
+sizings/s (b=4096, float32) — parity with the XLA fori_loop path, which
+remains the production default (XLA's fusion already keeps this solve
+VMEM-resident; the kernel is the hand-scheduled proof and the substrate
+for layouts XLA won't pick). Exact-parity-validated against size_batch in
+interpret mode on CPU (tests/test_pallas.py) and compiled on TPU.
+Mosaic gotcha encoded below: never use bool vectors as select *values*
+(i8 storage -> mask reuse needs an unsupported i8->i1 trunci).
 """
 
 from __future__ import annotations
@@ -120,20 +123,27 @@ def _bisect_kernel(
         return jnp.where(is_ttft, ttft, itl)
 
     def body(_, carry):
-        lo, hi, x_star, done = carry
+        # `done` rides the carry as int32: a carried bool vector would be
+        # materialized as i8 between trips and truncated back to i1 each
+        # iteration — an arith.trunci Mosaic does not support
+        lo, hi, x_star, done_i = carry
+        done = done_i > 0
         mid = 0.5 * (lo + hi)
         y = eval_y(mid)
         conv = _within_tol(y, target)
-        go_down = jnp.where(increasing, target < y, target > y)
+        # logical form, NOT jnp.where over bool branches: a select whose
+        # *values* are bools works on their i8 storage, and using that
+        # result as a mask again needs an i8->i1 trunci Mosaic rejects
+        go_down = (increasing & (target < y)) | (~increasing & (target > y))
         new_lo = jnp.where(done | go_down, lo, mid)
         new_hi = jnp.where(done | ~go_down, hi, mid)
         new_x = jnp.where(done, x_star, mid)
-        return new_lo, new_hi, new_x, done | conv
+        return new_lo, new_hi, new_x, (done | conv).astype(jnp.int32)
 
     lo0 = lo_ref[:, :]
     hi0 = hi_ref[:, :]
     x0 = x0_ref[:, :]
-    done0 = done_ref[:, :] > 0
+    done0 = done_ref[:, :]  # already int32
     _, _, x_star, _ = jax.lax.fori_loop(0, trips, body, (lo0, hi0, x0, done0))
     x_star_ref[:, :] = x_star
 
