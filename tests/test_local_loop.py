@@ -209,6 +209,13 @@ class TestManifestLoader:
                 tmp_path,
                 "kind: Deployment\nmetadata:\n  name: d\nspec: [x]\n",
             )
+        with pytest.raises(InvalidError, match="metadata must be a mapping"):
+            self._load(tmp_path, "kind: ConfigMap\nmetadata: [a]\n")
+        with pytest.raises(InvalidError, match="labels must be a mapping"):
+            self._load(
+                tmp_path,
+                "kind: Deployment\nmetadata:\n  name: d\n  labels: [a]\n",
+            )
 
     def test_non_scalar_configmap_data_rejected(self, tmp_path):
         from workload_variant_autoscaler_tpu.controller.kube import InvalidError

@@ -1,0 +1,163 @@
+"""Property-based tests (hypothesis) for the math kernel.
+
+The reference validates its queueing math against hand-computed expected
+values (pkg/analyzer/*_test.go); those cross-checks exist here too
+(tests/test_analyzer.py, test_queueing.py, test_batched.py). This module
+adds what example-based tests cannot: invariants that must hold for EVERY
+profile, searched over the whole parameter space —
+
+- the sized rate actually meets the SLO it was sized for,
+- sizing is monotone in the SLO target,
+- the steady-state solve conserves probability and never exceeds capacity,
+- the batched XLA kernel agrees with the scalar reference path on
+  arbitrary profiles, not just the committed fixtures.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from workload_variant_autoscaler_tpu.ops.analyzer import (
+    QueueAnalyzer,
+    QueueConfig,
+    RequestSize,
+    ServiceParms,
+    TargetPerf,
+)
+from workload_variant_autoscaler_tpu.ops.batched import (
+    SLOTargets,
+    k_max_for,
+    make_queue_batch,
+    size_batch,
+)
+
+# realistic profile space: decode 1-50ms base, prefill up to ~30ms/token
+ALPHAS = st.floats(1.0, 50.0)
+BETAS = st.floats(0.001, 0.5)
+GAMMAS = st.floats(0.5, 30.0)
+DELTAS = st.floats(0.001, 0.5)
+BATCHES = st.integers(2, 128)
+TOKENS = st.integers(8, 1024)
+
+
+def make_analyzer(alpha, beta, gamma, delta, max_batch, in_tok, out_tok):
+    return QueueAnalyzer(
+        QueueConfig(
+            max_batch_size=max_batch,
+            max_queue_size=10 * max_batch,
+            parms=ServiceParms(alpha=alpha, beta=beta, gamma=gamma, delta=delta),
+        ),
+        RequestSize(avg_input_tokens=in_tok, avg_output_tokens=out_tok),
+    )
+
+
+def slo_for(analyzer: QueueAnalyzer, slack_itl: float,
+            slack_ttft: float) -> TargetPerf:
+    """SLO targets placed inside the achievable envelope: between the
+    batch-1 floor and the full-batch ceiling (TTFT gets generous headroom
+    so the ITL leg usually binds, as in the committed fixtures)."""
+    p = analyzer.config.parms
+    n = analyzer.config.max_batch_size
+    itl_lo, itl_hi = p.alpha + p.beta, p.alpha + p.beta * n
+    in_tok = analyzer.request_size.avg_input_tokens
+    ttft_lo = p.gamma + p.delta * in_tok
+    ttft_hi = p.gamma + p.delta * in_tok * n
+    return TargetPerf(
+        itl=itl_lo + slack_itl * (itl_hi - itl_lo),
+        ttft=(ttft_lo + slack_ttft * (ttft_hi - ttft_lo)) * 4.0 + 50.0,
+    )
+
+
+def binding_rate(sized) -> float:
+    return min((r for r in (sized.rate_ttft, sized.rate_itl) if r > 0),
+               default=0.0)
+
+
+class TestSizingInvariants:
+    @settings(max_examples=60, deadline=None)
+    @given(ALPHAS, BETAS, GAMMAS, DELTAS, BATCHES, TOKENS, TOKENS,
+           st.floats(0.2, 0.9), st.floats(0.2, 0.9))
+    def test_sized_rate_meets_its_slo(self, alpha, beta, gamma, delta,
+                                      max_batch, in_tok, out_tok,
+                                      slack_itl, slack_ttft):
+        qa = make_analyzer(alpha, beta, gamma, delta, max_batch, in_tok, out_tok)
+        target = slo_for(qa, slack_itl, slack_ttft)
+        sized = qa.size(target)
+        rate = binding_rate(sized)
+        if rate <= 0:
+            return  # infeasible target: nothing to check
+        m = qa.analyze(rate)
+        ttft = m.avg_wait_time + m.avg_prefill_time
+        # achieved latencies at the sized rate respect the targets (binary
+        # search tolerance is relative 1e-6; allow a hair of slack)
+        assert m.avg_token_time <= target.itl * (1.0 + 1e-4)
+        assert ttft <= target.ttft * (1.0 + 1e-4)
+
+    @settings(max_examples=40, deadline=None)
+    @given(ALPHAS, BETAS, GAMMAS, DELTAS, BATCHES, TOKENS, TOKENS,
+           st.floats(0.2, 0.6))
+    def test_sizing_monotone_in_itl_target(self, alpha, beta, gamma, delta,
+                                           max_batch, in_tok, out_tok, slack):
+        qa = make_analyzer(alpha, beta, gamma, delta, max_batch, in_tok, out_tok)
+        loose = slo_for(qa, slack + 0.3, 0.9)
+        tight = slo_for(qa, slack, 0.9)
+        r_loose = qa.size(TargetPerf(itl=loose.itl, ttft=0.0)).rate_itl
+        r_tight = qa.size(TargetPerf(itl=tight.itl, ttft=0.0)).rate_itl
+        if r_loose > 0 and r_tight > 0:
+            assert r_tight <= r_loose * (1.0 + 1e-6)
+
+    @settings(max_examples=60, deadline=None)
+    @given(ALPHAS, BETAS, GAMMAS, DELTAS, BATCHES, TOKENS, TOKENS,
+           st.floats(0.05, 0.95))
+    def test_steady_state_is_physical(self, alpha, beta, gamma, delta,
+                                      max_batch, in_tok, out_tok, load_frac):
+        qa = make_analyzer(alpha, beta, gamma, delta, max_batch, in_tok, out_tok)
+        lam = qa.min_rate + load_frac * (qa.max_rate - qa.min_rate)
+        m = qa.analyze(lam)
+        # conservation + capacity: delivered throughput never exceeds the
+        # offered load; occupancy within machine bounds; times non-negative
+        assert 0.0 <= m.throughput <= lam * (1.0 + 1e-9)
+        assert 0.0 <= m.avg_num_in_serv <= max_batch * (1.0 + 1e-9)
+        assert m.avg_wait_time >= -1e-9
+        assert m.avg_prefill_time >= -1e-9
+        assert m.avg_token_time >= alpha * (1.0 - 1e-9)  # >= batch-1 floor
+        assert 0.0 <= m.rho <= 1.0 + 1e-9
+        assert m.avg_resp_time >= m.avg_wait_time - 1e-9
+
+    @settings(max_examples=30, deadline=None)
+    @given(st.lists(st.tuples(ALPHAS, BETAS, GAMMAS, DELTAS,
+                              BATCHES, TOKENS, TOKENS),
+                    min_size=1, max_size=16),
+           st.floats(0.3, 0.9), st.floats(0.3, 0.9))
+    def test_batched_kernel_agrees_with_scalar(self, profiles,
+                                               slack_itl, slack_ttft):
+        rows = []
+        targets_itl, targets_ttft = [], []
+        for alpha, beta, gamma, delta, n, in_tok, out_tok in profiles:
+            qa = make_analyzer(alpha, beta, gamma, delta, n, in_tok, out_tok)
+            t = slo_for(qa, slack_itl, slack_ttft)
+            rows.append((alpha, beta, gamma, delta, in_tok, out_tok, n, qa, t))
+            targets_itl.append(t.itl)
+            targets_ttft.append(t.ttft)
+        q = make_queue_batch(
+            [r[0] for r in rows], [r[1] for r in rows], [r[2] for r in rows],
+            [r[3] for r in rows], [float(r[4]) for r in rows],
+            [float(r[5]) for r in rows], [r[6] for r in rows],
+        )
+        import jax.numpy as jnp
+
+        d = q.alpha.dtype
+        slo = SLOTargets(ttft=jnp.asarray(targets_ttft, d),
+                         itl=jnp.asarray(targets_itl, d),
+                         tps=jnp.zeros(len(rows), d))
+        out = size_batch(q, slo, k_max_for(np.asarray([r[6] for r in rows])))
+        lam = np.asarray(out.lam_star) * 1000.0  # req/msec -> req/sec
+        for i, row in enumerate(rows):
+            qa, t = row[7], row[8]
+            scalar = binding_rate(qa.size(t))
+            if scalar <= 0:
+                assert not bool(out.feasible[i])
+            else:
+                np.testing.assert_allclose(lam[i], scalar, rtol=1e-6,
+                                           err_msg=f"lane {i}: {row[:7]}")

@@ -318,6 +318,15 @@ def in_memory_kube_from_manifests(path: str) -> InMemoryKube:
                 # `spec:`, or scalar (`replicas:`) parses to None, not to
                 # the absent-key default
                 meta = doc.get("metadata") or {}
+                if not isinstance(meta, dict):
+                    raise InvalidError(
+                        f"{fp}: {kind} metadata must be a mapping"
+                    )
+                labels = meta.get("labels") or {}
+                if not isinstance(labels, dict):
+                    raise InvalidError(
+                        f"{fp}: {kind} metadata.labels must be a mapping"
+                    )
                 name = meta.get("name") or ""
                 ns = meta.get("namespace") or "default"
                 if not name:
@@ -364,7 +373,7 @@ def in_memory_kube_from_manifests(path: str) -> InMemoryKube:
                     kube.put_deployment(Deployment(
                         name=name, namespace=ns,
                         spec_replicas=replicas, status_replicas=replicas,
-                        labels=dict(meta.get("labels") or {}),
+                        labels=dict(labels),
                     ))
                 else:
                     # validate the RAW document: round-tripping through the
