@@ -255,8 +255,10 @@ class TestDegradedPaths:
 
         high = desired()
         assert high >= 2
-        # demand drops: recommendation falls, publication holds
-        set_rate(prom, 2.0)
+        # demand dips INSIDE the noise band (~12%): the recommendation
+        # falls but the demand guard cannot prove the drop is real, so
+        # publication holds for the window
+        set_rate(prom, 44.0)
         clock["t"] += 30.0
         assert desired() == high
         clock["t"] += 30.0
@@ -267,6 +269,122 @@ class TestDegradedPaths:
         assert low < high
         # scale-up is immediate, no window
         set_rate(prom, 50.0)
+        clock["t"] += 30.0
+        assert desired() == high
+
+    def test_demand_guard_releases_provably_excess_capacity(self):
+        """A genuine ramp-down far outside the noise band bypasses the
+        window: capacity that even 20%-inflated demand cannot use is
+        insurance against nothing (beyond-reference; blanket max-over-
+        window pays a full window of chip-hours on every ramp-down)."""
+        def set_rate(prom, rps):
+            prom.set_result(true_arrival_rate_query(MODEL, NS), rps)
+            prom.set_result(arrival_rate_query(MODEL, NS), rps)
+
+        clock = {"t": 0.0}
+        kube, prom, _e, rec = make_cluster(arrival_rps=50.0)
+        rec.now = lambda: clock["t"]
+        kube.put_configmap(ConfigMap(
+            name=CONFIG_MAP_NAME, namespace=CONFIG_MAP_NAMESPACE,
+            data={"GLOBAL_OPT_INTERVAL": "30s",
+                  "WVA_SCALE_DOWN_STABILIZATION": "90s"},
+        ))
+
+        def desired():
+            rec.reconcile()
+            va = kube.get_variant_autoscaling(VARIANT, NS)
+            return va.status.desired_optimized_alloc.num_replicas
+
+        high = desired()
+        assert high >= 3
+        # demand collapses 25x: guard = ceil(2 * 1.2 / ~24.8) = 1 —
+        # published immediately, no 90s of held insurance
+        set_rate(prom, 2.0)
+        clock["t"] += 30.0
+        assert desired() == 1
+
+    def test_zero_demand_reading_does_not_bypass_window(self):
+        """A transient zero/absent measurement must NOT trigger the guard:
+        scale-down to idle still waits out the window (fail-safe)."""
+        def set_rate(prom, rps):
+            prom.set_result(true_arrival_rate_query(MODEL, NS), rps)
+            prom.set_result(arrival_rate_query(MODEL, NS), rps)
+
+        clock = {"t": 0.0}
+        kube, prom, _e, rec = make_cluster(arrival_rps=50.0)
+        rec.now = lambda: clock["t"]
+        kube.put_configmap(ConfigMap(
+            name=CONFIG_MAP_NAME, namespace=CONFIG_MAP_NAMESPACE,
+            data={"GLOBAL_OPT_INTERVAL": "30s",
+                  "WVA_SCALE_DOWN_STABILIZATION": "90s"},
+        ))
+
+        def desired():
+            rec.reconcile()
+            va = kube.get_variant_autoscaling(VARIANT, NS)
+            return va.status.desired_optimized_alloc.num_replicas
+
+        high = desired()
+        assert high >= 2
+        set_rate(prom, 0.0)
+        clock["t"] += 30.0
+        assert desired() == high  # held: zero reading can't prove anything
+
+    def test_guard_release_lowers_window_watermark(self):
+        """After the guard releases capacity, a transient guard-unavailable
+        cycle (empty scrape -> zero demand) must NOT re-publish the stale
+        pre-release high watermark from the window history."""
+        def set_rate(prom, rps):
+            prom.set_result(true_arrival_rate_query(MODEL, NS), rps)
+            prom.set_result(arrival_rate_query(MODEL, NS), rps)
+
+        clock = {"t": 0.0}
+        kube, prom, _e, rec = make_cluster(arrival_rps=50.0)
+        rec.now = lambda: clock["t"]
+        kube.put_configmap(ConfigMap(
+            name=CONFIG_MAP_NAME, namespace=CONFIG_MAP_NAMESPACE,
+            data={"GLOBAL_OPT_INTERVAL": "30s",
+                  "WVA_SCALE_DOWN_STABILIZATION": "300s"},
+        ))
+
+        def desired():
+            rec.reconcile()
+            va = kube.get_variant_autoscaling(VARIANT, NS)
+            return va.status.desired_optimized_alloc.num_replicas
+
+        high = desired()
+        assert high >= 3
+        set_rate(prom, 2.0)        # genuine collapse: guard releases
+        clock["t"] += 30.0
+        assert desired() == 1
+        set_rate(prom, 0.0)        # transient empty scrape: guard is None
+        clock["t"] += 30.0
+        assert desired() == 1      # must NOT bounce back to the old high
+
+    def test_noise_margin_zero_disables_guard(self):
+        """WVA_SCALE_DOWN_NOISE_MARGIN=0 restores pure window semantics:
+        even a huge drop holds for the window."""
+        def set_rate(prom, rps):
+            prom.set_result(true_arrival_rate_query(MODEL, NS), rps)
+            prom.set_result(arrival_rate_query(MODEL, NS), rps)
+
+        clock = {"t": 0.0}
+        kube, prom, _e, rec = make_cluster(arrival_rps=50.0)
+        rec.now = lambda: clock["t"]
+        kube.put_configmap(ConfigMap(
+            name=CONFIG_MAP_NAME, namespace=CONFIG_MAP_NAMESPACE,
+            data={"GLOBAL_OPT_INTERVAL": "30s",
+                  "WVA_SCALE_DOWN_STABILIZATION": "90s",
+                  "WVA_SCALE_DOWN_NOISE_MARGIN": "0"},
+        ))
+
+        def desired():
+            rec.reconcile()
+            va = kube.get_variant_autoscaling(VARIANT, NS)
+            return va.status.desired_optimized_alloc.num_replicas
+
+        high = desired()
+        set_rate(prom, 2.0)
         clock["t"] += 30.0
         assert desired() == high
 

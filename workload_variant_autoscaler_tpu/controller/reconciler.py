@@ -12,6 +12,7 @@ call (System.calculate), instead of the reference's per-variant loop.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from dataclasses import dataclass, field
@@ -252,6 +253,7 @@ class Reconciler:
         # publish (keyed by full name: same-named VAs in different
         # namespaces must not collide)
         stabilization_s = self._stabilization_window(operator_cm)
+        noise_margin = self._noise_margin(operator_cm)
         optimized: dict[str, crd.OptimizedAlloc] = {}
         for va, _deploy in prepared:
             key = full_name(va.name, va.namespace)
@@ -265,6 +267,7 @@ class Reconciler:
             alloc.num_replicas = self._stabilize_scale_down(
                 key, alloc.num_replicas, stabilization_s,
                 prev_published=va.status.desired_optimized_alloc.num_replicas,
+                guard=self._demand_guard(system, key, noise_margin),
             )
             optimized[key] = alloc
 
@@ -288,14 +291,51 @@ class Reconciler:
                         extra=kv(value=raw))
             return 0.0
 
+    def _noise_margin(self, operator_cm: dict[str, str]) -> float:
+        """WVA_SCALE_DOWN_NOISE_MARGIN: relative headroom assumed on the
+        measured arrival rate when deciding whether a scale-down is
+        provably safe (default 0.2 — the observed band of 1m-rate
+        estimates). 0 disables the guard (pure window stabilization)."""
+        raw = operator_cm.get("WVA_SCALE_DOWN_NOISE_MARGIN", "")
+        if not raw:
+            return 0.2
+        val = parse_float_or(raw, default=float("nan"))
+        if val != val or val < 0.0:
+            log.warning("bad WVA_SCALE_DOWN_NOISE_MARGIN, using 0.2",
+                        extra=kv(value=raw))
+            return 0.2
+        return val
+
+    @staticmethod
+    def _demand_guard(system, key: str,
+                      noise_margin: float) -> Optional[int]:
+        """Replica count provably sufficient even if demand is
+        noise_margin higher than measured: ceil(rate*(1+m)/rate*). Above
+        this, held capacity is insurance against nothing — the window
+        need not apply. None (no guard) when the margin is disabled,
+        demand reads zero (a transient empty scrape must not bypass the
+        window), or the solve carries no per-replica rate."""
+        if noise_margin <= 0.0:
+            return None
+        server = system.servers.get(key)
+        if server is None or server.allocation is None or server.load is None:
+            return None
+        rate_star = server.allocation.max_arrv_rate_per_replica * 1000.0
+        demand = server.load.arrival_rate / 60.0  # req/min -> req/sec
+        if rate_star <= 0.0 or demand <= 0.0:
+            return None
+        return int(math.ceil(demand * (1.0 + noise_margin) / rate_star))
+
     def _stabilize_scale_down(self, key: str, desired: int, window_s: float,
-                              prev_published: int = 0) -> int:
-        """Publish max(recommendations over the last window_s): scale-up is
-        immediate, scale-down waits until the lower recommendation has held
-        for the whole window. Kills replica-count flapping under noisy
-        rate-window arrival estimates, which otherwise causes drain churn
-        and tail-latency spikes exactly when the system is near
-        saturation."""
+                              prev_published: int = 0,
+                              guard: Optional[int] = None) -> int:
+        """Publish max(recommendations over the last window_s), capped by
+        the demand guard: scale-up is immediate; a scale-down inside the
+        measurement-noise band waits out the whole window; capacity the
+        guard proves unnecessary even under noise_margin-inflated demand
+        is released immediately. Kills replica-count flapping under noisy
+        rate-window arrival estimates without paying a full window of
+        chip-hours on every genuine ramp-down."""
         t = self.now()
         history = self._recommendations.setdefault(key, [])
         if window_s <= 0.0:
@@ -311,7 +351,18 @@ class Reconciler:
             # instead of dropping instantly — the fail-safe direction
             history.append((t, prev_published))
         history.append((t, desired))
-        return max(r for _t, r in history)
+        stabilized = max(r for _t, r in history)
+        if guard is not None:
+            capped = max(guard, desired)
+            if capped < stabilized:
+                # the guard has proven the higher window entries obsolete:
+                # lower the watermark in the history too, or one
+                # guard-unavailable cycle (a transient empty scrape makes
+                # _demand_guard return None) would re-publish the stale
+                # high value and flap replicas right back up
+                history[:] = [(t0, min(r, capped)) for t0, r in history]
+                stabilized = capped
+        return stabilized
 
     # -- preparation (reference controller.go:218-335) -------------------
 
