@@ -305,6 +305,8 @@ class Scenario:
     warmup_ms: float = WARMUP_MS
     reconcile_ms: float = RECONCILE_MS
     stabilization: str = "180s"
+    operator_extra: dict = _field(default_factory=dict)  # extra operator-CM keys
+    judge_ttft: bool = False  # strict mode: slo_held requires the TTFT tail too
 
 
 def _make_va(v: VariantScenario) -> crd.VariantAutoscaling:
@@ -354,6 +356,7 @@ def run_scenario(sc: Scenario) -> dict:
     kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE, {
         "GLOBAL_OPT_INTERVAL": f"{sc.reconcile_ms / 1000.0:.0f}s",
         "WVA_SCALE_DOWN_STABILIZATION": sc.stabilization,
+        **sc.operator_extra,
     }))
     kube.put_configmap(ConfigMap(
         ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
@@ -415,16 +418,18 @@ def run_scenario(sc: Scenario) -> dict:
         p95 = lats[v.name].p95_itl()
         p95_ttft = lats[v.name].p95_ttft(sc.warmup_ms)
         # the judged SLO is p95 ITL (the north-star metric, BASELINE.json);
-        # TTFT is reported with its own held flag but does not gate the
-        # headline — sizing is mean-based and ramp transitions dominate the
-        # TTFT tail (same caveat as the config-1 contract in run())
-        held = bool(p95 <= v.slo_itl_ms)
+        # TTFT is reported with its own held flag and gates the headline
+        # only in strict scenarios (judge_ttft) — mean-based sizing leaves
+        # the TTFT tail to ramp transitions unless demand headroom is
+        # provisioned (WVA_DEMAND_HEADROOM)
+        ttft_ok = bool(p95_ttft <= v.slo_ttft_ms)
+        held = bool(p95 <= v.slo_itl_ms) and (ttft_ok or not sc.judge_ttft)
         all_held = all_held and held
         per_variant[v.name] = {
             "model": v.model, "accelerator": v.accelerator,
             "p95_itl_ms": round(p95, 3), "slo_itl_ms": v.slo_itl_ms,
             "p95_ttft_ms": round(p95_ttft, 1), "slo_ttft_ms": v.slo_ttft_ms,
-            "ttft_held": bool(p95_ttft <= v.slo_ttft_ms),
+            "ttft_held": ttft_ok,
             "slo_held": held, "peak_replicas": peak_desired[v.name],
             "chip_hours": round(chip_ms[v.name] / 3_600_000.0, 3),
             "requests": gens[v.name].generated,
@@ -472,6 +477,21 @@ _CFG_70B_V5P4 = SliceModelConfig(
 )
 
 SCENARIOS: dict[str, Scenario] = {
+    # strict mode: hold the FULL Premium SLO — p95 TTFT (500ms) AND p95
+    # ITL (24ms) — through every ramp step. Demand headroom (0.75) plus a
+    # 30s cadence absorbs the 80% rate jumps that mean-based sizing lets
+    # pile into the TTFT tail. The reference cannot express this at all
+    # (no headroom knob, 60s fixed sizing-to-measured-mean).
+    "sharegpt-strict-slo": Scenario(
+        key="sharegpt-strict-slo",
+        title="config-1 ramp, BOTH p95 tails held (headroom 0.75, 30s cadence)",
+        accelerators={"v5e-1": {"chip": "v5e", "chips": "1", "cost": "20.0"}},
+        service_classes={"premium": _PREMIUM_YAML},
+        variants=[_CHAT_8B],
+        reconcile_ms=30_000.0,
+        operator_extra={"WVA_DEMAND_HEADROOM": "0.75"},
+        judge_ttft=True,
+    ),
     # config-1 ramp with heavy-tailed (lognormal, sigma=1) lengths: real
     # ShareGPT histograms, not the uniform mix — stresses KV admission and
     # the TTFT tail far harder at the same mean load

@@ -619,3 +619,34 @@ class TestMeshShardedReconcile:
                                   stage="config")
         total = emitter.value("inferno_reconcile_duration_msec")
         assert config_ms > 0.0 and total == pytest.approx(config_ms)
+
+
+class TestDemandHeadroom:
+    """WVA_DEMAND_HEADROOM: engine-only overprovisioning (the TTFT-tail
+    knob; reference behavior at 0)."""
+
+    def _desired_with(self, headroom):
+        kube, _p, _e, rec = make_cluster(arrival_rps=50.0)
+        cm = kube.get_configmap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE)
+        if headroom is not None:
+            cm.data["WVA_DEMAND_HEADROOM"] = headroom
+            kube.put_configmap(cm)
+        rec.reconcile()
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        return va
+
+    def test_headroom_inflates_sizing_only(self):
+        base = self._desired_with(None)
+        padded = self._desired_with("1.0")
+        assert (padded.status.desired_optimized_alloc.num_replicas
+                > base.status.desired_optimized_alloc.num_replicas)
+        # the CR status still reports the truthful observed load
+        assert (padded.status.current_alloc.load.arrival_rate
+                == base.status.current_alloc.load.arrival_rate)
+
+    def test_bad_headroom_ignored(self):
+        for bad in ("nan", "-1", "banana"):
+            va = self._desired_with(bad)
+            ref = self._desired_with(None)
+            assert (va.status.desired_optimized_alloc.num_replicas
+                    == ref.status.desired_optimized_alloc.num_replicas)

@@ -411,18 +411,20 @@ class TestElectionConcurrencyStress:
         ev_lock = threading.Lock()
         orig_update, orig_create = kube.update_lease, kube.create_lease
 
-        def record(lease):
+        # ev_lock spans write+record so the recorded order IS the commit
+        # order (a preemption between them could misorder the stream and
+        # flake the safety scan on a perfectly safe run)
+        def update(lease):
             with ev_lock:
+                orig_update(lease)   # raises ConflictError on races
                 events.append((_t.perf_counter(), lease.holder,
                                lease.renew_time, lease.transitions))
 
-        def update(lease):
-            orig_update(lease)   # raises ConflictError on races
-            record(lease)
-
         def create(lease):
-            orig_create(lease)
-            record(lease)
+            with ev_lock:
+                orig_create(lease)
+                events.append((_t.perf_counter(), lease.holder,
+                               lease.renew_time, lease.transitions))
 
         kube.update_lease, kube.create_lease = update, create
 

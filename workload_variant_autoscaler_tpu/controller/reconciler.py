@@ -210,7 +210,8 @@ class Reconciler:
         )
 
         prepared = self._prepare(active, accelerator_cm, service_class_cm,
-                                 system_spec, result)
+                                 system_spec, result,
+                                 demand_headroom=self._demand_headroom(operator_cm))
         mark("prepare")
         if not prepared:
             return result
@@ -291,30 +292,43 @@ class Reconciler:
                         extra=kv(value=raw))
             return 0.0
 
-    def _noise_margin(self, operator_cm: dict[str, str]) -> float:
-        """WVA_SCALE_DOWN_NOISE_MARGIN: relative headroom assumed on the
-        measured arrival rate when deciding whether a scale-down is
-        provably safe (default 0.2 — the observed band of 1m-rate
-        estimates). 0 disables the guard (pure window stabilization)."""
-        raw = operator_cm.get("WVA_SCALE_DOWN_NOISE_MARGIN", "")
+    @staticmethod
+    def _cm_float(operator_cm: dict[str, str], key: str,
+                  default: float) -> float:
+        """Non-negative float knob from the operator ConfigMap; bad values
+        warn and fall back to the default."""
+        raw = operator_cm.get(key, "")
         if not raw:
-            return 0.2
+            return default
         val = parse_float_or(raw, default=float("nan"))
         if val != val or val < 0.0:
-            log.warning("bad WVA_SCALE_DOWN_NOISE_MARGIN, using 0.2",
-                        extra=kv(value=raw))
-            return 0.2
+            log.warning("bad operator config value, using default",
+                        extra=kv(key=key, value=raw, default=default))
+            return default
         return val
+
+    def _noise_margin(self, operator_cm: dict[str, str]) -> float:
+        """WVA_SCALE_DOWN_NOISE_MARGIN: relative noise band assumed on the
+        demand the engine sizes for when deciding whether a scale-down is
+        provably safe (default 0.2 — the observed band of 1m-rate
+        estimates). 0 disables the guard (pure window stabilization)."""
+        return self._cm_float(operator_cm, "WVA_SCALE_DOWN_NOISE_MARGIN", 0.2)
 
     @staticmethod
     def _demand_guard(system, key: str,
                       noise_margin: float) -> Optional[int]:
         """Replica count provably sufficient even if demand is
-        noise_margin higher than measured: ceil(rate*(1+m)/rate*). Above
-        this, held capacity is insurance against nothing — the window
-        need not apply. None (no guard) when the margin is disabled,
-        demand reads zero (a transient empty scrape must not bypass the
-        window), or the solve carries no per-replica rate."""
+        noise_margin higher than sized-for: ceil(rate*(1+m)/rate*).
+        Above this, held capacity is insurance against nothing — the
+        window need not apply. `server.load.arrival_rate` is the demand
+        the ENGINE sizes for, i.e. WVA_DEMAND_HEADROOM-inflated when that
+        knob is set; the margin deliberately compounds on top — a guard
+        computed from the raw measured rate would undercut the desired
+        count whenever headroom > margin and bypass the window entirely
+        (max(guard, desired) would collapse to desired). None (no guard)
+        when the margin is disabled, demand reads zero (a transient empty
+        scrape must not bypass the window), or the solve carries no
+        per-replica rate."""
         if noise_margin <= 0.0:
             return None
         server = system.servers.get(key)
@@ -366,7 +380,16 @@ class Reconciler:
 
     # -- preparation (reference controller.go:218-335) -------------------
 
-    def _prepare(self, active, accelerator_cm, service_class_cm, system_spec, result):
+    def _demand_headroom(self, operator_cm: dict[str, str]) -> float:
+        """WVA_DEMAND_HEADROOM: relative overprovisioning factor on the
+        demand the engine sizes for (0, the default and the reference's
+        behavior, sizes for exactly the measured rate). Positive values
+        absorb ramp steps between reconcile cycles — the TTFT-tail knob;
+        chip-hours rise accordingly."""
+        return self._cm_float(operator_cm, "WVA_DEMAND_HEADROOM", 0.0)
+
+    def _prepare(self, active, accelerator_cm, service_class_cm, system_spec,
+                 result, demand_headroom: float = 0.0):
         prepared: list[tuple[crd.VariantAutoscaling, Deployment]] = []
         class_by_key = translate.service_class_key_names(service_class_cm)
         for va_listed in active:
@@ -491,7 +514,8 @@ class Reconciler:
                 ),
             )
 
-            translate.add_server_info_to_system_data(system_spec, va, class_name)
+            translate.add_server_info_to_system_data(
+                system_spec, va, class_name, demand_headroom=demand_headroom)
             prepared.append((va, deploy))
             result.processed.append(key)
         return prepared

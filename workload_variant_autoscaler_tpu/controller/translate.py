@@ -281,14 +281,21 @@ def engine_mesh(backend: str):
 
 
 def add_server_info_to_system_data(
-    spec: SystemSpec, va: crd.VariantAutoscaling, class_name: str
+    spec: SystemSpec, va: crd.VariantAutoscaling, class_name: str,
+    demand_headroom: float = 0.0,
 ) -> None:
     """CR status -> ServerSpec (reference utils.go:237-311): pinned to its
     current slice shape, min replicas 1 unless scale-to-zero is enabled,
-    NaN-scrubbed load."""
+    NaN-scrubbed load.
+
+    demand_headroom (WVA_DEMAND_HEADROOM) inflates the arrival rate the
+    ENGINE sizes for by a relative factor — overprovisioning that absorbs
+    ramp steps between reconcile cycles (the TTFT-tail knob). Applied
+    here only: the CR status keeps the truthful observed load."""
     cur = va.status.current_alloc
     load = ServerLoadSpec(
-        arrival_rate=parse_float_or(cur.load.arrival_rate),
+        arrival_rate=parse_float_or(cur.load.arrival_rate)
+        * (1.0 + max(demand_headroom, 0.0)),
         avg_in_tokens=int(parse_float_or(cur.load.avg_input_tokens)),
         avg_out_tokens=int(parse_float_or(cur.load.avg_output_tokens)),
     )
