@@ -28,9 +28,11 @@ bench-loop: ## North-star closed-loop benchmark: chip-hours to hold p95-ITL SLO 
 	$(PY) bench_loop.py
 
 .PHONY: bench-scenarios
-bench-scenarios: ## Multi-variant closed-loop benchmarks (BASELINE configs 2 and 5)
+bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/5, tail stress, strict SLO)
 	$(PY) bench_loop.py multi-model-mix
 	$(PY) bench_loop.py hetero-fleet
+	$(PY) bench_loop.py sharegpt-lognormal
+	$(PY) bench_loop.py sharegpt-strict-slo
 
 .PHONY: lint
 lint: ## Byte-compile as a basic syntax gate

@@ -650,3 +650,31 @@ class TestDemandHeadroom:
             ref = self._desired_with(None)
             assert (va.status.desired_optimized_alloc.num_replicas
                     == ref.status.desired_optimized_alloc.num_replicas)
+
+
+class TestScalingEventCounter:
+    """inferno_replica_scaling_total is LIVE here (the reference registers
+    it but ships no caller, metrics.go:84-100)."""
+
+    def test_scaling_decisions_counted_with_direction(self):
+        _kube, _p, emitter, rec = make_cluster(arrival_rps=60.0, replicas=1)
+        rec.reconcile()  # desired > current=1 -> scale-up event
+        up = emitter.value("inferno_replica_scaling_total",
+                           variant_name=VARIANT, direction="up",
+                           reason="optimization")
+        assert up == 1.0
+        assert emitter.value("inferno_replica_scaling_total",
+                             variant_name=VARIANT, direction="down") is None
+
+    def test_pending_actuation_not_recounted(self):
+        """One decision, slow external actuation: repeated cycles with the
+        same published recommendation must not re-increment the counter
+        (it counts decisions, not desired!=current cycles)."""
+        _kube, _p, emitter, rec = make_cluster(arrival_rps=60.0, replicas=1)
+        rec.reconcile()   # decision: 1 -> N
+        rec.reconcile()   # deployment still at 1; same decision
+        rec.reconcile()
+        up = emitter.value("inferno_replica_scaling_total",
+                           variant_name=VARIANT, direction="up",
+                           reason="optimization")
+        assert up == 1.0

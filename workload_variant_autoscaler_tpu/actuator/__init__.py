@@ -34,10 +34,17 @@ class Actuator:
             return va.status.current_alloc.num_replicas
         return deploy.current_replicas()
 
-    def emit_metrics(self, va: VariantAutoscaling) -> bool:
+    def emit_metrics(self, va: VariantAutoscaling,
+                     prev_desired: int | None = None) -> bool:
         """Push current/desired/ratio for external autoscalers (reference
         actuator.go:50-84). Returns True when signals were emitted; metric
-        emission failures never fail reconciliation."""
+        emission failures never fail reconciliation.
+
+        prev_desired: the previously PUBLISHED recommendation — a change
+        increments inferno_replica_scaling_total (the reference registers
+        that counter but never increments it, metrics.go:84-100). Counting
+        decision changes, not desired!=current cycles, keeps the churn
+        rate honest while slow external actuation catches up."""
         desired = va.status.desired_optimized_alloc.num_replicas
         if desired < 0:
             log.info("skipping metric emission, negative desired replicas",
@@ -52,6 +59,12 @@ class Actuator:
                 desired=desired,
                 accelerator_type=va.status.desired_optimized_alloc.accelerator,
             )
+            if prev_desired is not None and desired != prev_desired:
+                self.emitter.emit_scaling_event(
+                    variant_name=va.name, namespace=va.namespace,
+                    direction="up" if desired > prev_desired else "down",
+                    reason="optimization",
+                )
         except Exception as e:  # noqa: BLE001
             log.error("failed to emit scaling signals", extra=kv(variant=va.name, error=str(e)))
             return False

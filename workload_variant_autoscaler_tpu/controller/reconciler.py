@@ -561,6 +561,9 @@ class Reconciler:
                 continue
 
             fresh.status.current_alloc = va.status.current_alloc
+            # the previously PUBLISHED recommendation, for the scaling-
+            # decision counter (captured before it is overwritten)
+            prev_desired = fresh.status.desired_optimized_alloc.num_replicas
             fresh.status.desired_optimized_alloc = optimized[key]
             fresh.status.actuation.applied = False
             # carry conditions set during preparation across the fresh get
@@ -575,7 +578,7 @@ class Reconciler:
                 now=self.now(),
             )
 
-            if self.actuator.emit_metrics(fresh):
+            if self.actuator.emit_metrics(fresh, prev_desired=prev_desired):
                 fresh.status.actuation.applied = True
 
             self._update_status(fresh)
