@@ -678,3 +678,48 @@ class TestScalingEventCounter:
                            variant_name=VARIANT, direction="up",
                            reason="optimization")
         assert up == 1.0
+
+
+class TestPowerGauges:
+    """Modeled power draw (the reference computes Power(util) but consumes
+    it nowhere, accelerator.go:35-41)."""
+
+    def test_power_emitted_for_published_allocation(self):
+        _kube, _p, emitter, rec = make_cluster(arrival_rps=60.0)
+        rec.reconcile()
+        watts = emitter.value("inferno_variant_power_watts",
+                              variant_name=VARIANT, namespace=NS)
+        fleet = emitter.value("inferno_fleet_power_watts")
+        # v5e: idle 60W..full 200W per chip; N replicas of a 1-chip slice
+        desired = emitter.value("inferno_desired_replicas",
+                                variant_name=VARIANT)
+        assert watts is not None and fleet == watts
+        assert 60.0 * desired <= watts <= 200.0 * desired
+
+    def test_stale_power_series_cleared(self):
+        """A removed variant's power series must not linger: the fleet
+        gauge is the sum of the per-variant series by construction."""
+        kube, _p, emitter, rec = make_cluster(arrival_rps=60.0)
+        rec.reconcile()
+        assert emitter.value("inferno_variant_power_watts",
+                             variant_name=VARIANT) is not None
+        kube.vas.clear()
+        kube.put_variant_autoscaling(make_va(name="other"))
+        kube.put_deployment(Deployment(name="other", namespace=NS,
+                                       spec_replicas=1, status_replicas=1))
+        rec.reconcile()
+        assert emitter.value("inferno_variant_power_watts",
+                             variant_name=VARIANT) is None
+        other = emitter.value("inferno_variant_power_watts",
+                              variant_name="other")
+        assert other == emitter.value("inferno_fleet_power_watts")
+
+    def test_power_scales_with_load(self):
+        # higher arrival rate -> more replicas and higher utilisation ->
+        # strictly more modeled fleet power
+        def watts_at(rps):
+            _k, _p, emitter, rec = make_cluster(arrival_rps=rps)
+            rec.reconcile()
+            return emitter.value("inferno_fleet_power_watts")
+
+        assert watts_at(60.0) > watts_at(2.0) > 0.0

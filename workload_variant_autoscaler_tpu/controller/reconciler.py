@@ -272,7 +272,7 @@ class Reconciler:
             )
             optimized[key] = alloc
 
-        self._apply(prepared, optimized, result)
+        self._apply(prepared, optimized, result, system)
         mark("publish")
         return result
 
@@ -546,7 +546,8 @@ class Reconciler:
 
     # -- application (reference controller.go:338-407) -------------------
 
-    def _apply(self, prepared, optimized, result) -> None:
+    def _apply(self, prepared, optimized, result, system) -> None:
+        power: dict[tuple[str, str, str], float] = {}
         for va, _deploy in prepared:
             key = full_name(va.name, va.namespace)
             if key not in optimized:
@@ -580,8 +581,14 @@ class Reconciler:
 
             if self.actuator.emit_metrics(fresh, prev_desired=prev_desired):
                 fresh.status.actuation.applied = True
+            # modeled power of the PUBLISHED allocation (beyond-reference
+            # observability; chips x power(rho at published count) x count)
+            power[(va.name, va.namespace, optimized[key].accelerator)] = (
+                system.variant_power_watts(
+                    key, replicas=optimized[key].num_replicas))
 
             self._update_status(fresh)
+        self.emitter.emit_power_metrics(power)
 
     def _update_status(self, va: crd.VariantAutoscaling) -> None:
         from .kube import ConflictError

@@ -24,11 +24,13 @@ from ..models import (
     ModelSliceProfile,
     ModelTarget,
     OptimizerSpec,
+    PowerSpec,
     ServerLoadSpec,
     ServerSpec,
     ServiceClassSpec,
     SystemSpec,
 )
+from ..models.chips import CHIP_CATALOG
 from ..models.spec import AllocationSolution
 from ..utils import full_name, get_logger, kv, parse_float_or
 from . import crd
@@ -90,10 +92,19 @@ def create_system_data(
             continue
         chip = info.get("chip") or info.get("device") or name.split("-")[0]
         chips = int(parse_float_or(info.get("chips"), _chips_from_name(name)))
+        # known chip generations bring their catalog power curve and HBM
+        # (the admin CM only carries name/chips/cost, reference
+        # utils.go:499-514; power feeds the inferno_*_power_watts gauges)
+        catalog = CHIP_CATALOG.get(chip)
         accelerators.append(
             AcceleratorSpec(
                 name=name, chip=chip, chips=max(chips, 1),
-                mem_gb=parse_float_or(info.get("memGB"), 0.0), cost=cost,
+                mem_gb=parse_float_or(
+                    info.get("memGB"),
+                    catalog.hbm_gb * max(chips, 1) if catalog else 0.0,
+                ),
+                power=catalog.power if catalog else PowerSpec(),
+                cost=cost,
             )
         )
 

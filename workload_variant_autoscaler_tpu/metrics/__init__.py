@@ -108,6 +108,8 @@ INFERNO_DESIRED_RATIO = "inferno_desired_ratio"
 INFERNO_SOLUTION_TIME_MSEC = "inferno_solution_time_msec"
 INFERNO_RECONCILE_DURATION_MSEC = "inferno_reconcile_duration_msec"
 INFERNO_RECONCILE_STAGE_DURATION_MSEC = "inferno_reconcile_stage_duration_msec"
+INFERNO_VARIANT_POWER_WATTS = "inferno_variant_power_watts"
+INFERNO_FLEET_POWER_WATTS = "inferno_fleet_power_watts"
 
 LABEL_STAGE = "stage"
 RECONCILE_STAGES = ("config", "prepare", "analyze", "optimize", "publish")
@@ -174,9 +176,42 @@ class MetricsEmitter:
             [LABEL_STAGE],
             registry=self.registry,
         )
+        # modeled power draw (beyond-reference: the reference's Power(util)
+        # curve is computed but consumed nowhere, accelerator.go:35-41)
+        self.variant_power = Gauge(
+            INFERNO_VARIANT_POWER_WATTS,
+            "Modeled power draw of the variant's desired allocation",
+            [LABEL_VARIANT_NAME, LABEL_NAMESPACE, LABEL_ACCELERATOR_TYPE],
+            registry=self.registry,
+        )
+        self.fleet_power = Gauge(
+            INFERNO_FLEET_POWER_WATTS,
+            "Modeled power draw of the whole optimized fleet",
+            registry=self.registry,
+        )
 
     def emit_solution_time(self, msec: float) -> None:
         self.solution_time.set(msec)
+
+    def emit_power_metrics(
+        self, per_variant: dict[tuple[str, str, str], float]
+    ) -> None:
+        """Replace the power series wholesale each cycle: per-variant
+        gauges carry exactly this cycle's published allocations (label
+        sets from removed variants or switched accelerators are cleared,
+        not left stale) and the fleet gauge is their sum by
+        construction. Keys: (variant_name, namespace, accelerator_type)."""
+        with self._lock:
+            self.variant_power.clear()
+            total = 0.0
+            for (variant_name, namespace, acc_type), watts in per_variant.items():
+                self.variant_power.labels(**{
+                    LABEL_VARIANT_NAME: variant_name,
+                    LABEL_NAMESPACE: namespace,
+                    LABEL_ACCELERATOR_TYPE: acc_type,
+                }).set(watts)
+                total += watts
+            self.fleet_power.set(total)
 
     def emit_cycle_timing(self, stage_msec: dict[str, float]) -> None:
         """Publish per-stage durations + their total for the last cycle.
