@@ -211,17 +211,20 @@ def run(ramp=None, warmup_ms: float = WARMUP_MS,
     gen.start()
 
     chip_ms = 0.0
+    watt_ms = 0.0
+    power = _power_curve("v5e")
     last_sample_ms = 0.0
     history: list[tuple[float, int]] = []
     reconcile_wall_ms: list[float] = []
     next_reconcile = reconcile_ms
 
     def on_tick(now_ms):
-        nonlocal chip_ms, last_sample_ms, next_reconcile
+        nonlocal chip_ms, watt_ms, last_sample_ms, next_reconcile
         lat.now_ms = now_ms
         # chip-time integral: pay for every live pod, draining included
         provisioned = len(fleet.all_replicas()) * CHIPS_PER_REPLICA
         chip_ms += provisioned * (now_ms - last_sample_ms)
+        watt_ms += fleet_watts(fleet, CHIPS_PER_REPLICA, power) * (now_ms - last_sample_ms)
         last_sample_ms = now_ms
         prom.scrape(now_ms)
         if now_ms >= next_reconcile:
@@ -256,6 +259,9 @@ def run(ramp=None, warmup_ms: float = WARMUP_MS,
         "slo_itl_ms": SLO_ITL_MS,
         "p95_ttft_ms": round(p95_ttft, 1),
         "static_peak_chip_hours": round(static_chip_hours, 3),
+        # MEASURED energy: emulator batch occupancy through the catalog
+        # power curve (idle draw included for provisioned-but-idle pods)
+        "energy_wh": round(watt_ms / 3_600_000.0, 1),
         "peak_replicas": peak_replicas,
         "requests": gen.generated,
         # wall-clock of one full collect->analyze->optimize->publish cycle
@@ -293,6 +299,29 @@ class VariantScenario:
     tokens: TokenDistribution
     slo_itl_ms: float
     slo_ttft_ms: float
+    chip: str = "v5e"           # chip generation (power curve lookup)
+
+
+def _power_curve(chip: str):
+    """Per-chip piecewise power model from the catalog (the same curve
+    the controller's inferno_*_power_watts gauges use)."""
+    from workload_variant_autoscaler_tpu.models.chips import make_slice
+    from workload_variant_autoscaler_tpu.models.entities import Accelerator
+
+    acc = Accelerator(make_slice(chip, 1, cost_per_chip=0.0))
+    acc.calculate()
+    return acc.power
+
+
+def fleet_watts(fleet, chips_per_replica: int, power) -> float:
+    """MEASURED power draw: per-replica utilisation from the emulator's
+    actual running batch (not the analyzer's model), idle draw included
+    for provisioned-but-empty replicas and draining pods."""
+    watts = 0.0
+    for replica in fleet.all_replicas():
+        util = min(len(replica.running) / replica.config.max_batch_size, 1.0)
+        watts += power(util) * chips_per_replica
+    return watts
 
 
 @dataclass
@@ -379,6 +408,8 @@ def run_scenario(sc: Scenario) -> dict:
         gens[v.name] = gen
 
     chip_ms = {v.name: 0.0 for v in sc.variants}
+    watt_ms = {v.name: 0.0 for v in sc.variants}
+    curves = {v.name: _power_curve(v.chip) for v in sc.variants}
     peak_desired = {v.name: 1 for v in sc.variants}
     last_sample_ms = 0.0
     next_reconcile = sc.reconcile_ms
@@ -391,6 +422,8 @@ def run_scenario(sc: Scenario) -> dict:
             lats[v.name].now_ms = now_ms
             chip_ms[v.name] += (len(fleets[v.name].all_replicas())
                                 * v.chips_per_replica * dt)
+            watt_ms[v.name] += fleet_watts(
+                fleets[v.name], v.chips_per_replica, curves[v.name]) * dt
         prom.scrape(now_ms)
         if now_ms >= next_reconcile:
             next_reconcile += sc.reconcile_ms
@@ -432,6 +465,9 @@ def run_scenario(sc: Scenario) -> dict:
             "ttft_held": ttft_ok,
             "slo_held": held, "peak_replicas": peak_desired[v.name],
             "chip_hours": round(chip_ms[v.name] / 3_600_000.0, 3),
+            # MEASURED energy: emulator batch occupancy through the same
+            # piecewise power curve the controller's gauges use
+            "energy_wh": round(watt_ms[v.name] / 3_600_000.0, 1),
             "requests": gens[v.name].generated,
         }
     return {
@@ -441,6 +477,7 @@ def run_scenario(sc: Scenario) -> dict:
         "vs_baseline": round(static_chip_hours / total_chip_hours, 3),
         "slo_held": all_held,
         "static_peak_chip_hours": round(static_chip_hours, 3),
+        "energy_wh": round(sum(watt_ms.values()) / 3_600_000.0, 1),
         "scenario": sc.key,
         "variants": per_variant,
     }
@@ -546,6 +583,7 @@ SCENARIOS: dict[str, Scenario] = {
             VariantScenario(
                 name="summarize-70b", model="llama-70b", sc_key="freemium",
                 accelerator="v5p-4", chips_per_replica=4, cfg=_CFG_70B_V5P4,
+                chip="v5p",
                 ramp=[(300, 300), (300, 600), (300, 1200), (300, 1500),
                       (300, 600), (300, 120)],
                 tokens=TOKENS, slo_itl_ms=200.0, slo_ttft_ms=4000.0,

@@ -10,6 +10,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+import pytest  # noqa: E402
+
 import bench_loop  # noqa: E402
 
 
@@ -37,6 +39,9 @@ def test_multi_model_mix_mini_ramp():
     assert r["variants"]["chat-8b"]["peak_replicas"] > 1
     # chip accounting is slice-granular: 70B pays 8 chips per replica
     assert r["variants"]["chat-70b"]["chip_hours"] > 0
+    assert r["variants"]["chat-70b"]["energy_wh"] > 0
+    assert r["energy_wh"] == pytest.approx(sum(
+        v["energy_wh"] for v in r["variants"].values()), abs=0.2)
     assert r["value"] <= r["static_peak_chip_hours"]
 
 
@@ -70,3 +75,6 @@ def test_mini_ramp_holds_slo_and_beats_static():
     assert r["vs_baseline"] > 1.0  # autoscaling must beat static peak
     assert r["peak_replicas"] > 1
     assert r["requests"] > 1000
+    # measured energy: bounded by idle/full draw of the provisioned chips
+    chip_hours = r["value"]
+    assert 60.0 * chip_hours <= r["energy_wh"] <= 200.0 * chip_hours

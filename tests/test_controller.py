@@ -714,6 +714,40 @@ class TestPowerGauges:
                               variant_name="other")
         assert other == emitter.value("inferno_fleet_power_watts")
 
+    def test_power_cleared_when_fleet_empties(self):
+        kube, _p, emitter, rec = make_cluster(arrival_rps=60.0)
+        rec.reconcile()
+        assert emitter.value("inferno_fleet_power_watts") > 0
+        kube.vas.clear()
+        rec.reconcile()  # no active variants: series must read empty/zero
+        assert emitter.value("inferno_fleet_power_watts") == 0.0
+        assert emitter.value("inferno_variant_power_watts",
+                             variant_name=VARIANT) is None
+
+    def test_reget_flake_keeps_power_series(self):
+        """A transient apiserver failure on the publish re-get must not
+        erase the variant's power series for the cycle."""
+        kube, _p, emitter, rec = make_cluster(arrival_rps=60.0)
+        rec.reconcile()
+        # fail only the SECOND per-cycle get (the publish re-get); the
+        # prepare-stage get must still succeed
+        calls = {"n": 0}
+        orig = kube.get_variant_autoscaling
+
+        def flaky(name, ns):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise NotFoundError("flake")
+            return orig(name, ns)
+
+        kube.get_variant_autoscaling = flaky
+        rec.reconcile()
+        kube.get_variant_autoscaling = orig
+        after = emitter.value("inferno_variant_power_watts",
+                              variant_name=VARIANT)
+        assert after is not None and after > 0
+        assert emitter.value("inferno_fleet_power_watts") == after
+
     def test_power_scales_with_load(self):
         # higher arrival rate -> more replicas and higher utilisation ->
         # strictly more modeled fleet power

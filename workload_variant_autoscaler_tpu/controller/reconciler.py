@@ -163,6 +163,9 @@ class Reconciler:
             del self._recommendations[stale]
         if not active:
             log.info("no active VariantAutoscalings, skipping optimization")
+            # no fleet: the power series must read empty, not hold the
+            # last nonzero wattage forever
+            self.emitter.emit_power_metrics({})
             return result
 
         # limited mode (realizes the reference's dead greedy path +
@@ -214,6 +217,7 @@ class Reconciler:
                                  demand_headroom=self._demand_headroom(operator_cm))
         mark("prepare")
         if not prepared:
+            self.emitter.emit_power_metrics({})
             return result
 
         # analyze: ONE batched kernel call across all candidates (JAX by
@@ -552,6 +556,13 @@ class Reconciler:
             key = full_name(va.name, va.namespace)
             if key not in optimized:
                 continue
+            # power is derived from the solve + the published count, not
+            # from the fresh CR — record it before the re-get so a
+            # transient apiserver failure can't erase a live variant's
+            # series from the wholesale-replaced gauge
+            power[(va.name, va.namespace, optimized[key].accelerator)] = (
+                system.variant_power_watts(
+                    key, replicas=optimized[key].num_replicas))
             try:
                 fresh = with_backoff(
                     lambda: self.kube.get_variant_autoscaling(va.name, va.namespace),
@@ -581,11 +592,6 @@ class Reconciler:
 
             if self.actuator.emit_metrics(fresh, prev_desired=prev_desired):
                 fresh.status.actuation.applied = True
-            # modeled power of the PUBLISHED allocation (beyond-reference
-            # observability; chips x power(rho at published count) x count)
-            power[(va.name, va.namespace, optimized[key].accelerator)] = (
-                system.variant_power_watts(
-                    key, replicas=optimized[key].num_replicas))
 
             self._update_status(fresh)
         self.emitter.emit_power_metrics(power)
