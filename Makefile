@@ -28,8 +28,9 @@ bench-loop: ## North-star closed-loop benchmark: chip-hours to hold p95-ITL SLO 
 	$(PY) bench_loop.py
 
 .PHONY: bench-scenarios
-bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/5, tail stress, strict SLO)
+bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5, tail stress, strict SLO)
 	$(PY) bench_loop.py multi-model-mix
+	$(PY) bench_loop.py multihost-70b
 	$(PY) bench_loop.py hetero-fleet
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo

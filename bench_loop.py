@@ -299,7 +299,6 @@ class VariantScenario:
     tokens: TokenDistribution
     slo_itl_ms: float
     slo_ttft_ms: float
-    chip: str = "v5e"           # chip generation (power curve lookup)
 
 
 def _power_curve(chip: str):
@@ -409,7 +408,10 @@ def run_scenario(sc: Scenario) -> dict:
 
     chip_ms = {v.name: 0.0 for v in sc.variants}
     watt_ms = {v.name: 0.0 for v in sc.variants}
-    curves = {v.name: _power_curve(v.chip) for v in sc.variants}
+    # chip generation comes from the scenario's accelerator catalog (one
+    # source of truth; a per-variant copy could silently desync the curve)
+    curves = {v.name: _power_curve(sc.accelerators[v.accelerator]["chip"])
+              for v in sc.variants}
     peak_desired = {v.name: 1 for v in sc.variants}
     last_sample_ms = 0.0
     next_reconcile = sc.reconcile_ms
@@ -512,6 +514,13 @@ _CFG_70B_V5P4 = SliceModelConfig(
     alpha=14.0, beta=0.06, gamma=10.0, delta=0.08,
     max_batch_size=48, hbm_gb=380.0, model_size_gb=140.0, kv_mb_per_token=0.8,
 )
+# Llama-70B TP=16 on a multi-host v5e-16 pod slice (2 hosts x 8 chips):
+# wide TP cuts per-token latency, bf16 weights over 256 GB aggregate HBM
+_CFG_70B_V5E16 = SliceModelConfig(
+    model_name="llama-70b", slice_name="v5e-16",
+    alpha=12.0, beta=0.05, gamma=8.0, delta=0.06,
+    max_batch_size=64, hbm_gb=256.0, model_size_gb=140.0, kv_mb_per_token=0.8,
+)
 
 SCENARIOS: dict[str, Scenario] = {
     # strict mode: hold the FULL Premium SLO — p95 TTFT (500ms) AND p95
@@ -569,6 +578,28 @@ SCENARIOS: dict[str, Scenario] = {
             ),
         ],
     ),
+    # BASELINE config 4: multi-host v5e-16 pod slices (TP=16 Llama-70B).
+    # A replica is an ATOMIC 16-chip unit — scale-out steps the chip count
+    # by whole pod slices, exactly the granularity GKE multi-host TPU
+    # node pools scale at.
+    "multihost-70b": Scenario(
+        key="multihost-70b",
+        title="Llama-70B TP=16 on multi-host v5e-16 pod slices",
+        accelerators={
+            "v5e-16": {"chip": "v5e", "chips": "16", "cost": "320.0"},
+        },
+        service_classes={"freemium": _FREEMIUM_YAML},
+        variants=[
+            VariantScenario(
+                name="chat-70b", model="llama-70b", sc_key="freemium",
+                accelerator="v5e-16", chips_per_replica=16,
+                cfg=_CFG_70B_V5E16,
+                ramp=[(300, 600), (300, 1500), (300, 3000), (300, 3600),
+                      (300, 1500), (300, 600)],
+                tokens=TOKENS, slo_itl_ms=200.0, slo_ttft_ms=4000.0,
+            ),
+        ],
+    ),
     # BASELINE config 5: heterogeneous chip generations in one fleet
     "hetero-fleet": Scenario(
         key="hetero-fleet",
@@ -583,7 +614,6 @@ SCENARIOS: dict[str, Scenario] = {
             VariantScenario(
                 name="summarize-70b", model="llama-70b", sc_key="freemium",
                 accelerator="v5p-4", chips_per_replica=4, cfg=_CFG_70B_V5P4,
-                chip="v5p",
                 ramp=[(300, 300), (300, 600), (300, 1200), (300, 1500),
                       (300, 600), (300, 120)],
                 tokens=TOKENS, slo_itl_ms=200.0, slo_ttft_ms=4000.0,
