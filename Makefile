@@ -116,20 +116,26 @@ test-e2e-kind: ## Full kind e2e: fake-TPU cluster, controller, loadgen, scale-ou
 
 .PHONY: install-crd
 install-crd: ## Apply the VariantAutoscaling CRD
-	kubectl apply -f deploy/crd/
+	kubectl apply -k deploy/crd/
 
 .PHONY: deploy
 deploy: install-crd ## Apply manager + config manifests
 	kubectl apply -f deploy/manager/namespace.yaml
-	kubectl apply -f deploy/config/
-	kubectl apply -f deploy/manager/rbac.yaml
+	kubectl apply -k deploy/config/
+	kubectl apply -k deploy/rbac/
 	kubectl apply -f deploy/manager/deployment.yaml
+
+.PHONY: deploy-kustomize
+deploy-kustomize: ## Apply the full kustomize install (CRD+RBAC+manager+config+monitors)
+	kubectl apply -k deploy/default
+	kubectl apply -k deploy/prometheus || true  # requires prometheus-operator CRDs
 
 .PHONY: undeploy
 undeploy: ## Remove manager + CRD
-	kubectl delete -f deploy/manager/ --ignore-not-found
-	kubectl delete -f deploy/config/ --ignore-not-found
-	kubectl delete -f deploy/crd/ --ignore-not-found
+	kubectl delete -k deploy/manager/ --ignore-not-found
+	kubectl delete -k deploy/rbac/ --ignore-not-found
+	kubectl delete -k deploy/config/ --ignore-not-found
+	kubectl delete -k deploy/crd/ --ignore-not-found
 
 .PHONY: helm-template
 helm-template: ## Render the Helm chart (requires helm)

@@ -42,15 +42,15 @@ if [[ "${WITH_PROMETHEUS}" == "1" ]]; then
 fi
 
 echo ">> installing CRD + manager + config"
-kubectl apply -f "${REPO_ROOT}/deploy/crd/"
+kubectl apply -k "${REPO_ROOT}/deploy/crd/"
 kubectl apply -f "${REPO_ROOT}/deploy/manager/namespace.yaml"
-kubectl apply -f "${REPO_ROOT}/deploy/config/"
+kubectl apply -k "${REPO_ROOT}/deploy/config/"
 if [[ -n "${PROM_URL}" ]]; then
   kubectl -n workload-variant-autoscaler-system patch configmap \
     workload-variant-autoscaler-variantautoscaling-config \
     --type merge -p "{\"data\":{\"PROMETHEUS_BASE_URL\":\"${PROM_URL}\"}}"
 fi
-kubectl apply -f "${REPO_ROOT}/deploy/manager/rbac.yaml"
+kubectl apply -k "${REPO_ROOT}/deploy/rbac/"
 kubectl apply -f "${REPO_ROOT}/deploy/manager/deployment.yaml"
 if [[ "${ALLOW_HTTP_PROM}" == "1" ]]; then
   kubectl -n workload-variant-autoscaler-system patch deployment wva-controller \
@@ -59,8 +59,8 @@ if [[ "${ALLOW_HTTP_PROM}" == "1" ]]; then
       "value": "--allow-http-prom"}]'
 fi
 kubectl apply -f "${REPO_ROOT}/deploy/manager/metrics-service.yaml" || true  # ServiceMonitor CRD may be absent
-kubectl apply -f "${REPO_ROOT}/deploy/network-policy/" || true  # no-op without a CNI enforcing policies
-kubectl apply -f "${REPO_ROOT}/deploy/prometheus/" || true      # requires prometheus-operator CRDs
+kubectl apply -k "${REPO_ROOT}/deploy/network-policy/" || true  # no-op without a CNI enforcing policies
+kubectl apply -k "${REPO_ROOT}/deploy/prometheus/" || true      # requires prometheus-operator CRDs
 
 echo ">> installing the TPU emulator variant + VariantAutoscaling"
 kubectl apply -f "${REPO_ROOT}/deploy/examples/tpu-emulator/emulator.yaml" || true

@@ -1,0 +1,183 @@
+"""Deploy-tree sanity: every shipped manifest parses, every kustomization
+resolves, and RBAC actually covers what the controller calls.
+
+The reference validates its config/ tree implicitly by running
+`kubectl apply -k` in CI kind e2e (ci-pr-checks.yaml). Without a cluster
+in this environment, the same invariants are checked statically: YAML
+well-formedness, kustomize path resolution, patch targets, and that the
+ClusterRole grants the verbs the reconcile loop exercises
+(reference config/rbac/role.yaml)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import yaml
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+DEPLOY = REPO_ROOT / "deploy"
+
+KUSTOMIZATION = "kustomization.yaml"
+
+
+def _docs(path: Path):
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d is not None]
+
+
+def all_manifest_files():
+    return sorted(p for p in DEPLOY.rglob("*.yaml"))
+
+
+def test_every_deploy_yaml_parses_and_has_identity():
+    assert all_manifest_files(), "deploy tree is empty?"
+    for path in all_manifest_files():
+        docs = _docs(path)
+        assert docs, f"{path} contains no documents"
+        if path.name == KUSTOMIZATION:
+            continue
+        # Helm values files are config fragments, not k8s objects.
+        if "values" in path.name:
+            continue
+        # Strategic-merge patches omit full identity on purpose but still
+        # need kind + name for targeting.
+        for doc in docs:
+            assert isinstance(doc, dict), f"{path}: non-mapping document"
+            assert doc.get("kind"), f"{path}: document missing kind"
+            assert doc.get("apiVersion"), f"{path}: document missing apiVersion"
+            assert doc.get("metadata", {}).get("name"), (
+                f"{path}: document missing metadata.name"
+            )
+
+
+def test_kustomizations_resolve():
+    kustomizations = sorted(DEPLOY.rglob(KUSTOMIZATION))
+    # the full reference surface: per-component bases + default + openshift
+    dirs = {p.parent.name for p in kustomizations}
+    for expected in ("crd", "rbac", "manager", "config", "network-policy",
+                     "prometheus", "default", "openshift"):
+        assert expected in dirs, f"missing deploy/{expected}/kustomization.yaml"
+    for kfile in kustomizations:
+        k = _docs(kfile)[0]
+        assert k.get("kind") == "Kustomization", kfile
+        for res in k.get("resources", []):
+            target = (kfile.parent / res).resolve()
+            assert target.exists(), f"{kfile}: resource {res} does not exist"
+            if target.is_dir():
+                assert (target / KUSTOMIZATION).exists(), (
+                    f"{kfile}: resource dir {res} has no {KUSTOMIZATION}"
+                )
+        for patch in k.get("patches", []):
+            p = (kfile.parent / patch["path"]).resolve()
+            assert p.exists(), f"{kfile}: patch {patch['path']} missing"
+            target = patch.get("target", {})
+            # the patch file's own identity must agree with its target
+            doc = _docs(p)[0]
+            if target.get("kind"):
+                assert doc["kind"] == target["kind"], (
+                    f"{p}: patch kind {doc['kind']} != target {target['kind']}"
+                )
+            if target.get("name"):
+                assert doc["metadata"]["name"] == target["name"], p
+
+
+def _rules_allow(rules, group: str, resource: str, verb: str) -> bool:
+    for rule in rules:
+        groups = rule.get("apiGroups", [])
+        resources = rule.get("resources", [])
+        verbs = rule.get("verbs", [])
+        if (group in groups or "*" in groups) and \
+           (resource in resources or "*" in resources) and \
+           (verb in verbs or "*" in verbs):
+            return True
+    return False
+
+
+def test_controller_clusterrole_covers_reconcile_loop():
+    role = _docs(DEPLOY / "rbac" / "controller-role.yaml")[0]
+    rules = role["rules"]
+    # what the reconcile cycle actually calls (wvat/controller/kube.py):
+    needed = [
+        ("llmd.ai", "variantautoscalings", "list"),
+        ("llmd.ai", "variantautoscalings", "patch"),       # ownerRefs
+        ("llmd.ai", "variantautoscalings/status", "update"),
+        ("apps", "deployments", "get"),                     # actuator read
+        ("", "configmaps", "get"),                          # 3 ConfigMaps
+        ("", "nodes", "list"),                              # limited mode
+    ]
+    for group, resource, verb in needed:
+        assert _rules_allow(rules, group, resource, verb), (
+            f"controller-role missing {verb} on {group or 'core'}/{resource}"
+        )
+    # and never write workloads: scaling is actuated by HPA/KEDA
+    for verb in ("create", "delete", "patch", "update"):
+        assert not _rules_allow(rules, "apps", "deployments", verb), (
+            f"controller-role must not {verb} deployments"
+        )
+
+
+def test_leader_election_role_is_namespaced():
+    role = _docs(DEPLOY / "rbac" / "leader-election-role.yaml")[0]
+    assert role["kind"] == "Role"  # not ClusterRole: leases are namespaced
+    [rule] = role["rules"]
+    assert "leases" in rule["resources"]
+    for verb in ("get", "create", "update"):
+        assert verb in rule["verbs"]
+
+
+def test_bindings_reference_shipped_subjects():
+    sa = _docs(DEPLOY / "rbac" / "service-account.yaml")[0]
+    roles = {}
+    for path in (DEPLOY / "rbac").glob("*.yaml"):
+        for doc in _docs(path):
+            if doc.get("kind") in ("Role", "ClusterRole"):
+                roles[(doc["kind"], doc["metadata"]["name"])] = doc
+    for path in (DEPLOY / "rbac").glob("*.yaml"):
+        for doc in _docs(path):
+            if doc.get("kind") not in ("RoleBinding", "ClusterRoleBinding"):
+                continue
+            ref = doc["roleRef"]
+            assert (ref["kind"], ref["name"]) in roles, (
+                f"{path}: binding references unshipped {ref['kind']} "
+                f"{ref['name']}"
+            )
+            for subj in doc["subjects"]:
+                assert subj["name"] == sa["metadata"]["name"], path
+                assert subj["namespace"] == sa["metadata"]["namespace"], path
+
+
+def test_openshift_patch_paths_match_manager():
+    dep = _docs(DEPLOY / "manager" / "deployment.yaml")[0]
+    patch = _docs(DEPLOY / "openshift" / "prometheus-patch.yaml")[0]
+    assert patch["metadata"]["name"] == dep["metadata"]["name"]
+    container_names = {
+        c["name"] for c in dep["spec"]["template"]["spec"]["containers"]
+    }
+    for c in patch["spec"]["template"]["spec"]["containers"]:
+        assert c["name"] in container_names, (
+            f"openshift patch targets unknown container {c['name']}"
+        )
+        env_names = {e["name"] for e in c.get("env", [])}
+        # the env family the collector actually reads
+        # (wvat/collector/prometheus.py PromSettings.from_env)
+        assert {"PROMETHEUS_TOKEN_PATH", "PROMETHEUS_CA_CERT_PATH",
+                "PROMETHEUS_SERVER_NAME"} <= env_names
+
+
+def test_openshift_configmap_patch_targets_operator_config():
+    base = _docs(DEPLOY / "config" / "operator-configmap.yaml")[0]
+    patch = _docs(DEPLOY / "openshift" / "configmap-patch.yaml")[0]
+    assert patch["metadata"]["name"] == base["metadata"]["name"]
+    assert patch["data"]["PROMETHEUS_BASE_URL"].startswith("https://"), (
+        "collector enforces HTTPS-only Prometheus"
+    )
+
+
+def test_adapter_values_expose_desired_replicas():
+    for name in ("prometheus-adapter-values.yaml",
+                 "prometheus-adapter-values-ocp.yaml"):
+        values = _docs(DEPLOY / "examples" / name)[0]
+        rules = values["rules"]["external"]
+        series = {r["name"]["as"] for r in rules}
+        assert "inferno_desired_replicas" in series, name
+        assert values["prometheus"]["url"].startswith("https://"), name
