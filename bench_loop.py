@@ -207,6 +207,11 @@ def run(ramp=None, warmup_ms: float = WARMUP_MS,
         )
     sim, fleet, prom, kube, rec, lat = build_loop()
     lat.from_ms = warmup_ms
+    # Warm the XLA kernels exactly as the controller does at startup
+    # (__main__ warmup thread): reconcile_wall_ms then measures the
+    # steady-state cycle, not first-compile.
+    from workload_variant_autoscaler_tpu.ops.batched import warmup as _warm_kernels
+    _warm_kernels(max_batch=CFG.max_batch_size)
     gen = PoissonLoadGenerator(sim, schedule=ramp, tokens=TOKENS, seed=SEED)
     gen.start()
 

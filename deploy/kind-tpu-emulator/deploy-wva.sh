@@ -58,7 +58,7 @@ if [[ "${ALLOW_HTTP_PROM}" == "1" ]]; then
       "path": "/spec/template/spec/containers/0/args/-",
       "value": "--allow-http-prom"}]'
 fi
-kubectl apply -f "${REPO_ROOT}/deploy/manager/metrics-service.yaml" || true  # ServiceMonitor CRD may be absent
+kubectl apply -f "${REPO_ROOT}/deploy/manager/metrics-service.yaml"
 kubectl apply -k "${REPO_ROOT}/deploy/network-policy/" || true  # no-op without a CNI enforcing policies
 kubectl apply -k "${REPO_ROOT}/deploy/prometheus/" || true      # requires prometheus-operator CRDs
 

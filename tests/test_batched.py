@@ -166,3 +166,85 @@ class TestSizeBatch:
         )
         assert bool(res.feasible[0])
         assert not bool(res.feasible[1])
+
+
+class TestShapeStability:
+    """Compile-shape bucketing: load drift and fleet churn must not
+    retrace the kernels (the reconcile loop would otherwise pay a
+    multi-second XLA compile whenever a variant count or a token average
+    moves)."""
+
+    def test_k_max_bucket_quantizes(self):
+        from workload_variant_autoscaler_tpu.ops.batched import k_max_bucket
+
+        assert k_max_bucket(1) == 256
+        assert k_max_bucket(256) == 256
+        assert k_max_bucket(257) == 512
+        assert k_max_bucket(704) == 768
+        assert k_max_bucket(2816) == 2816  # already on the quantum
+        assert k_max_bucket(2817) == 3072
+
+    def test_bucketed_k_is_numerically_identical(self):
+        """States beyond occupancy are masked, so padding K changes
+        nothing."""
+        from workload_variant_autoscaler_tpu.ops.batched import k_max_bucket
+
+        q, k_exact = batch_from_cases(CASES)
+        targets = SLOTargets(
+            ttft=jnp.full(len(CASES), 500.0), itl=jnp.full(len(CASES), 24.0),
+            tps=jnp.zeros(len(CASES)),
+        )
+        a = size_batch(q, targets, k_exact)
+        b = size_batch(q, targets, k_max_bucket(k_exact))
+        np.testing.assert_allclose(np.asarray(a.lam_star),
+                                   np.asarray(b.lam_star), rtol=1e-12)
+        np.testing.assert_array_equal(np.asarray(a.feasible),
+                                      np.asarray(b.feasible))
+
+    def test_fleet_churn_does_not_retrace(self):
+        """System.calculate over shifting fleet sizes and token averages
+        reuses one compiled executable (candidate axis padded to 16, K
+        bucketed)."""
+        from tests.helpers import make_system, server_spec
+
+        before = size_batch._cache_size()
+        # modest token drift: stays inside one K bucket (a large swing
+        # legitimately crosses buckets and compiles once more, ever)
+        for n_variants, out_tok in ((1, 128), (3, 150), (2, 140), (1, 128)):
+            servers = [
+                server_spec(name=f"var-{i}:default", out_tokens=out_tok,
+                            keep_accelerator=True)
+                for i in range(n_variants)
+            ]
+            system, _ = make_system(servers=servers)
+            system.calculate(backend="batched")
+            for server in system.servers.values():
+                assert server.all_allocations, "sizing produced no allocations"
+        # one executable for every fleet <= 16 candidates at one K bucket
+        assert size_batch._cache_size() - before <= 1
+
+    def test_warmup_precompiles_default_shapes(self):
+        from workload_variant_autoscaler_tpu.ops.batched import warmup
+
+        warmup(max_batch=64)
+        before = size_batch._cache_size()
+        warmup(max_batch=64)  # second call: fully cached
+        assert size_batch._cache_size() == before
+
+    def test_enable_persistent_cache_creates_dir(self, tmp_path, monkeypatch):
+        import jax
+
+        from workload_variant_autoscaler_tpu.ops.batched import (
+            enable_persistent_cache,
+        )
+
+        target = tmp_path / "jaxcache"
+        old = jax.config.jax_compilation_cache_dir
+        try:
+            got = enable_persistent_cache(str(target))
+            assert got == str(target)
+            assert target.is_dir()
+            monkeypatch.setenv("WVA_JAX_CACHE_DIR", "off")
+            assert enable_persistent_cache() is None
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old)

@@ -757,3 +757,51 @@ class TestPowerGauges:
             return emitter.value("inferno_fleet_power_watts")
 
         assert watts_at(60.0) > watts_at(2.0) > 0.0
+
+
+class TestWarmupShapes:
+    """Startup warmup derives kernel shapes from the live fleet
+    (translate.warmup_shapes), so the first reconcile hits compiled
+    executables instead of guessing from an env default."""
+
+    def test_shapes_from_fleet(self):
+        from workload_variant_autoscaler_tpu.controller.translate import (
+            warmup_shapes,
+        )
+
+        bucket, mb = warmup_shapes([make_va(), make_va(name="other")])
+        # two VAs x two profile entries = 4 candidates -> one 16-lane
+        # bucket; one K from the fleet-wide max batch (System takes
+        # np.max over all candidates)
+        assert bucket == 16
+        assert mb == 192
+
+    def test_large_fleet_widens_lane_bucket(self):
+        from workload_variant_autoscaler_tpu.controller.translate import (
+            warmup_shapes,
+        )
+
+        vas = [make_va(name=f"va-{i}") for i in range(10)]  # 20 candidates
+        bucket, _ = warmup_shapes(vas)
+        assert bucket == 32
+
+    def test_mesh_uses_lcm_padding_rule(self):
+        """Must match System._calculate_batched's lcm(16, mesh) padding or
+        warmup compiles a shape the reconcile loop never runs."""
+        from workload_variant_autoscaler_tpu.controller.translate import (
+            warmup_shapes,
+        )
+
+        bucket, _ = warmup_shapes([make_va()], mesh_size=3)
+        assert bucket == 48  # lcm(16, 3)
+        bucket, _ = warmup_shapes([make_va()], mesh_size=8)
+        assert bucket == 16  # 8 divides 16
+
+    def test_empty_fleet_defaults(self):
+        from workload_variant_autoscaler_tpu.controller.translate import (
+            warmup_shapes,
+        )
+
+        bucket, mb = warmup_shapes([])
+        assert bucket == 16
+        assert mb == 256

@@ -15,6 +15,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import math
 import os
 import sys
 import threading
@@ -77,6 +78,50 @@ def main(argv=None) -> int:
 
     ready = threading.Event()
     health = HealthServer(args.health_port, ready_check=ready.is_set).start()
+
+    # Warm the XLA kernels off the critical path (while Prometheus
+    # validation backs off and leader election contends), so the first
+    # reconcile runs at steady-state latency instead of stalling seconds
+    # in compilation. The persistent cache makes even a cold restart warm.
+    from .translate import engine_backend, engine_mesh, warmup_shapes
+
+    backend = engine_backend()
+    if backend == "batched" and \
+            os.environ.get("WVA_WARMUP", "1").lower() not in ("0", "false"):
+        # Import here, on the main thread: Python module init is not
+        # thread-safe against itself, and the reconcile thread will import
+        # jax too — two first-imports racing => partially initialized
+        # module crashes in whichever thread loses.
+        from ..ops.batched import enable_persistent_cache, warmup
+
+        mesh = engine_mesh(backend)
+
+        def _warm() -> None:
+            try:
+                cache_dir = enable_persistent_cache()
+                # the shape the fleet will compile, from the live VA list
+                # (fallback: the 256 default when the apiserver isn't
+                # reachable yet — warmup is best-effort, never fatal)
+                mesh_size = int(mesh.devices.size) if mesh is not None else None
+                try:
+                    bucket, max_batch = warmup_shapes(
+                        kube.list_variant_autoscalings(), mesh_size)
+                except Exception:  # noqa: BLE001
+                    bucket, max_batch = (
+                        16 if mesh_size is None else math.lcm(16, mesh_size),
+                        int(os.environ.get("WVA_WARMUP_MAX_BATCH", "256")),
+                    )
+                warmup(max_batch=max_batch, bucket=bucket, mesh=mesh)
+                log.info("engine kernels warmed",
+                         extra=kv(compilation_cache=cache_dir or "off",
+                                  lanes=bucket, max_batch=max_batch,
+                                  sharded=mesh is not None))
+            except Exception as e:  # noqa: BLE001 — warmup is best-effort
+                log.warning("engine warmup failed; first cycle will compile",
+                            extra=kv(error=str(e)))
+
+        threading.Thread(target=_warm, daemon=True,
+                         name="wva-engine-warmup").start()
 
     log.info("validating Prometheus connectivity", extra=kv(url=prom_config.base_url))
     try:

@@ -10,6 +10,7 @@ and capacity is counted in chips per generation.
 from __future__ import annotations
 
 import json
+import math
 import os
 import re
 import time
@@ -246,6 +247,30 @@ def _parse_perf_parms(parms: crd.PerfParms) -> tuple[float, float, float, float]
 
 def scale_to_zero_enabled() -> bool:
     return os.environ.get(SCALE_TO_ZERO_ENV, "").lower() == "true"
+
+
+def warmup_shapes(vas, mesh_size: int | None = None) -> tuple[int, int]:
+    """The kernel shape the fleet will actually compile, derived from the
+    listed VariantAutoscalings: (candidate-lane bucket, max-batch bound).
+
+    Must mirror System._calculate_batched exactly or the warmup compiles
+    a shape the reconcile loop never runs: the candidate axis is padded
+    to a multiple of 16 — lcm(16, mesh size) under WVA_MESH_DEVICES —
+    and ONE K is taken over the whole batch (np.max of the candidates'
+    effective batches), so only the fleet-wide maximum max-batch matters.
+    Profiles without a batch bound warm the 256 default instead of
+    guessing."""
+    max_batch = 0
+    candidates = 0
+    for va in vas:
+        for ap in va.spec.model_profile.accelerators:
+            candidates += 1
+            max_batch = max(
+                max_batch, ap.max_batch_size if ap.max_batch_size > 0 else 256
+            )
+    quantum = 16 if not mesh_size else math.lcm(16, mesh_size)
+    bucket = max(quantum, -(-candidates // quantum) * quantum)
+    return bucket, max_batch or 256
 
 
 def engine_backend() -> str:

@@ -184,6 +184,7 @@ class System:
         from ..ops.batched import (
             SLOTargets,
             analyze_batch,
+            k_max_bucket,
             k_max_for,
             make_queue_batch,
             size_batch,
@@ -209,13 +210,23 @@ class System:
             tpss.append(target.slo_tps)
 
         q = make_queue_batch(alphas, betas, gammas, deltas, in_toks, out_toks, n_eff)
-        k_max = k_max_for(n_eff)
+        # K bucketed for shape stability under load drift (see k_max_bucket)
+        k_max = k_max_bucket(k_max_for(n_eff))
         dtype = q.alpha.dtype
         slo = SLOTargets(
             ttft=jnp.asarray(ttfts, dtype),
             itl=jnp.asarray(itls, dtype),
             tps=jnp.asarray(tpss, dtype),
         )
+        # Bucket the candidate axis so adding/removing a variant (or a
+        # candidate slice) doesn't retrace + recompile the kernel: shapes
+        # only change when the fleet crosses a 16-candidate boundary, and
+        # every crossed bucket stays in jit's executable cache. Padded
+        # lanes are benign invalid queues (valid=False -> feasible=False).
+        from ..parallel import pad_to_multiple
+
+        bucket = 16 if mesh is None else math.lcm(16, int(mesh.devices.size))
+        q, slo, _ = pad_to_multiple(q, slo, bucket)
         if mesh is not None:
             from ..parallel import size_batch_sharded
 
@@ -225,9 +236,10 @@ class System:
         feasible = np.asarray(sized.feasible)
         rate_star = np.asarray(sized.throughput) * 1000.0  # req/sec per replica
 
-        # replica counts + per-replica rates on host (tiny arrays)
-        num_replicas = np.zeros(len(pairs), dtype=np.int64)
-        per_replica_rate = np.zeros(len(pairs))
+        # replica counts + per-replica rates on host (tiny arrays; sized to
+        # the padded batch so the re-analysis call reuses the same shape)
+        num_replicas = np.zeros(q.batch_size, dtype=np.int64)
+        per_replica_rate = np.zeros(q.batch_size)
         for i, (server, acc_name, profile, target) in enumerate(pairs):
             if not feasible[i] or rate_star[i] <= 0:
                 continue

@@ -427,3 +427,65 @@ def k_max_for(max_batch) -> int:
     """Static padded state bound for a set of queue configs."""
     mb = np.max(np.asarray(max_batch))
     return int(mb) * (1 + MAX_QUEUE_TO_BATCH_RATIO)
+
+
+def k_max_bucket(k: int, quantum: int = 256) -> int:
+    """Round a state bound up to a quantum. The effective batch is scaled
+    by the OBSERVED token averages (allocation.py effective_batch_size),
+    so an exact K changes shape — and recompiles the kernel — whenever
+    measured load drifts; bucketing pins the compiled shape. States past
+    each queue's occupancy are masked to -inf in _solve, so a larger K is
+    numerically identical, just a few percent of masked extra work."""
+    return max(-(-k // quantum) * quantum, quantum)
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at `cache_dir` (default:
+    $WVA_JAX_CACHE_DIR, else ~/.cache/wva/jax) so a controller restart
+    reuses compiled kernels instead of paying the multi-second XLA compile
+    on its first reconcile. Set WVA_JAX_CACHE_DIR=off to disable.
+    Returns the directory in effect, or None when disabled."""
+    import os
+
+    cache_dir = cache_dir or os.environ.get("WVA_JAX_CACHE_DIR", "")
+    if cache_dir.lower() in ("off", "0", "none", "disabled"):
+        return None
+    if not cache_dir:
+        cache_dir = os.path.join(
+            os.path.expanduser("~"), ".cache", "wva", "jax")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # default threshold (1s) would skip the ~0.5s analyze_batch compile
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    return cache_dir
+
+
+def warmup(max_batch: int = 256, bucket: int = 16, mesh=None) -> None:
+    """Pre-compile the sizing + re-analysis kernels at the shapes the
+    reconcile loop will use (candidate axis bucketed by
+    System._calculate_batched, K from `max_batch`), so the first real
+    cycle runs at steady-state latency instead of stalling multiple
+    seconds in XLA. Call at controller startup, off the critical path —
+    e.g. while leader election is still contending. With a mesh, warms
+    the sharded executables instead (the ones the mesh path runs)."""
+    b = bucket
+    q = make_queue_batch(
+        np.full(b, 7.0), np.full(b, 0.03), np.full(b, 5.0), np.full(b, 0.1),
+        np.full(b, 128.0), np.full(b, 128.0),
+        np.full(b, max_batch, dtype=np.int64),
+    )
+    k_max = k_max_bucket(k_max_for([max_batch]))
+    d = q.alpha.dtype
+    targets = SLOTargets(
+        ttft=jnp.full(b, 500.0, d), itl=jnp.full(b, 24.0, d),
+        tps=jnp.zeros(b, d),
+    )
+    if mesh is not None:
+        from ..parallel import analyze_batch_sharded, size_batch_sharded
+
+        sized = size_batch_sharded(q, targets, k_max, mesh)
+        per_rep = analyze_batch_sharded(q, sized.throughput * 1000.0, k_max, mesh)
+    else:
+        sized = size_batch(q, targets, k_max)
+        per_rep = analyze_batch(q, sized.throughput * 1000.0, k_max)
+    jax.block_until_ready((sized, per_rep))
