@@ -1,0 +1,236 @@
+"""JetStream metric-family support: the collector speaks the jetstream
+dialect (WVA_METRIC_FAMILY=jetstream) and the closed loop still sees
+saturation — without an admission counter, demand is recovered from the
+prefill backlog derivative (completions/sec + clamp_min(deriv(backlog),0)
+IS the admission rate).
+
+The vllm-family saturation story lives in test_e2e_longcontext.py; this
+file proves the same autoscaler works against a JetStream-shaped endpoint
+(BASELINE north star: "collector scrapes vLLM-TPU / JetStream ... metrics").
+"""
+
+import json
+
+import pytest
+
+from workload_variant_autoscaler_tpu.collector import (
+    JETSTREAM_FAMILY,
+    VLLM_FAMILY,
+    active_family,
+    arrival_rate_query,
+    availability_query,
+    avg_itl_query,
+    avg_prompt_tokens_query,
+    true_arrival_rate_query,
+)
+from workload_variant_autoscaler_tpu.controller import (
+    ACCELERATOR_CM_NAME,
+    CONFIG_MAP_NAME,
+    CONFIG_MAP_NAMESPACE,
+    SERVICE_CLASS_CM_NAME,
+    ConfigMap,
+    Deployment,
+    InMemoryKube,
+    Reconciler,
+    crd,
+)
+from workload_variant_autoscaler_tpu.emulator import (
+    Fleet,
+    PoissonLoadGenerator,
+    PrometheusSink,
+    Simulation,
+    SimPromAPI,
+    SliceModelConfig,
+    TokenDistribution,
+)
+from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
+
+MODEL = "llama-8b"
+NS = "default"
+VARIANT = "chat-8b"
+
+CFG = SliceModelConfig(
+    model_name=MODEL, slice_name="v5e-1",
+    alpha=6.973, beta=0.027, gamma=5.2, delta=0.1,
+    max_batch_size=64, hbm_gb=16.0, model_size_gb=8.0, kv_mb_per_token=0.25,
+)
+
+
+class TestFamilySelection:
+    def test_default_is_vllm(self, monkeypatch):
+        monkeypatch.delenv("WVA_METRIC_FAMILY", raising=False)
+        assert active_family() is VLLM_FAMILY
+
+    def test_env_selects_jetstream(self, monkeypatch):
+        monkeypatch.setenv("WVA_METRIC_FAMILY", "jetstream")
+        assert active_family() is JETSTREAM_FAMILY
+
+    def test_unknown_family_falls_back(self, monkeypatch):
+        monkeypatch.setenv("WVA_METRIC_FAMILY", "tgi")
+        assert active_family() is VLLM_FAMILY
+
+    def test_env_beats_configmap(self, monkeypatch):
+        """Reference env-over-ConfigMap precedence (controller.go:516-538)."""
+        monkeypatch.setenv("WVA_METRIC_FAMILY", "vllm")
+        assert active_family("jetstream") is VLLM_FAMILY
+        monkeypatch.delenv("WVA_METRIC_FAMILY")
+        assert active_family("jetstream") is JETSTREAM_FAMILY
+        assert active_family(None) is VLLM_FAMILY
+
+
+class TestJetstreamQueries:
+    def test_series_names(self):
+        fam = JETSTREAM_FAMILY
+        assert "jetstream_request_success_count_total" in \
+            arrival_rate_query(MODEL, NS, fam)
+        assert "jetstream_request_input_length_sum" in \
+            avg_prompt_tokens_query(MODEL, NS, fam)
+        assert "jetstream_time_per_output_token_sum" in \
+            avg_itl_query(MODEL, NS, fam)
+        assert availability_query(MODEL, NS, fam).startswith(
+            "jetstream_request_success_count_total{")
+
+    def test_demand_recovers_saturation_from_backlog(self):
+        """No admission counter -> the demand query must add the backlog
+        growth to the completion rate, clamped so a draining backlog never
+        under-reports below delivered throughput."""
+        q = true_arrival_rate_query(MODEL, NS, JETSTREAM_FAMILY)
+        assert "jetstream_request_success_count_total" in q
+        assert "clamp_min" in q
+        assert "deriv(jetstream_prefill_backlog_size" in q
+
+    def test_vllm_demand_still_uses_arrival_counter(self):
+        q = true_arrival_rate_query(MODEL, NS, VLLM_FAMILY)
+        assert q.startswith("sum(rate(vllm:request_arrival_total")
+        assert "clamp_min" not in q
+
+
+class TestJetstreamSink:
+    def test_exports_jetstream_series_without_arrival(self):
+        sink = PrometheusSink(MODEL, NS, family="jetstream")
+        assert sink.request_arrival is None
+        names = {
+            metric.name for metric in sink.registry.collect()
+        }
+        assert "jetstream_request_success_count" in names
+        assert "jetstream_prefill_backlog_size" in names
+        assert not any(n.startswith("vllm:") for n in names)
+
+    def test_counters_carry_family_success_name(self):
+        sink = PrometheusSink(MODEL, NS, family="jetstream")
+        sink.request_success.labels(model_name=MODEL, namespace=NS).inc()
+        assert sink.counters()[JETSTREAM_FAMILY.success_total] == 1.0
+
+
+def build_jetstream_loop():
+    prom_sink = PrometheusSink(MODEL, NS, family="jetstream")
+    fleet = Fleet(CFG, prom_sink, replicas=1)
+    sim = Simulation(fleet, seed=11)
+    prom = SimPromAPI(prom_sink, MODEL, NS, family=JETSTREAM_FAMILY)
+
+    kube = InMemoryKube()
+    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
+                                 {"GLOBAL_OPT_INTERVAL": "30s"}))
+    kube.put_configmap(ConfigMap(
+        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"v5e-1": json.dumps({"chip": "v5e", "chips": "1", "cost": "20.0"})},
+    ))
+    kube.put_configmap(ConfigMap(
+        SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"premium": (
+            "name: Premium\npriority: 1\ndata:\n"
+            f"  - model: {MODEL}\n    slo-tpot: 24\n    slo-ttft: 500\n"
+        )},
+    ))
+    kube.put_deployment(Deployment(name=VARIANT, namespace=NS,
+                                   spec_replicas=1, status_replicas=1))
+    kube.put_variant_autoscaling(crd.VariantAutoscaling(
+        metadata=crd.ObjectMeta(name=VARIANT, namespace=NS,
+                                labels={crd.ACCELERATOR_LABEL: "v5e-1"}),
+        spec=crd.VariantAutoscalingSpec(
+            model_id=MODEL,
+            slo_class_ref=crd.ConfigMapKeyRef(name=SERVICE_CLASS_CM_NAME,
+                                              key="premium"),
+            model_profile=crd.ModelProfile(accelerators=[
+                crd.AcceleratorProfile(
+                    acc="v5e-1", acc_count=1,
+                    perf_parms=crd.PerfParms(
+                        decode_parms={"alpha": str(CFG.alpha),
+                                      "beta": str(CFG.beta)},
+                        prefill_parms={"gamma": str(CFG.gamma),
+                                       "delta": str(CFG.delta)},
+                    ),
+                    max_batch_size=CFG.max_batch_size,
+                ),
+            ]),
+        ),
+    ))
+    emitter = MetricsEmitter()
+    rec = Reconciler(kube=kube, prom=prom, emitter=emitter,
+                     now=lambda: sim.now_ms / 1000.0, sleep=lambda _s: None)
+    return sim, fleet, prom, kube, emitter, rec
+
+
+class TestJetstreamClosedLoop:
+    def test_scale_out_with_backlog_derived_demand(self, monkeypatch):
+        """The full loop against a JetStream-shaped endpoint: under a load
+        step that saturates one replica, the collector (jetstream family)
+        must still see excess demand — via the backlog derivative — and
+        scale out."""
+        monkeypatch.setenv("WVA_METRIC_FAMILY", "jetstream")
+        sim, fleet, prom, kube, emitter, rec = build_jetstream_loop()
+
+        gen = PoissonLoadGenerator(
+            sim,
+            schedule=[(60, 600), (240, 4800)],  # 10 -> 80 req/s step
+            tokens=TokenDistribution(avg_input_tokens=128, avg_output_tokens=32,
+                                     distribution="deterministic"),
+            seed=11,
+        )
+        gen.start()
+
+        history: list[tuple[float, int]] = []
+        next_reconcile = 30_000.0
+
+        def on_tick(now_ms):
+            nonlocal next_reconcile
+            prom.scrape(now_ms)
+            if now_ms >= next_reconcile:
+                next_reconcile += 30_000.0
+                rec.reconcile()
+                va = kube.get_variant_autoscaling(VARIANT, NS)
+                desired = va.status.desired_optimized_alloc.num_replicas
+                history.append((now_ms, desired))
+                kube.put_deployment(Deployment(
+                    name=VARIANT, namespace=NS,
+                    spec_replicas=desired, status_replicas=desired))
+                fleet.set_replicas(max(desired, 0), now_ms)
+                sim.kick()
+
+        sim.run_until(300_000.0, on_tick=on_tick, tick_ms=5000.0)
+
+        assert history, "no reconciles ran"
+        peak = max(d for _t, d in history)
+        assert peak > 1, (
+            "jetstream family never scaled out: backlog-derived demand "
+            f"is not reaching the engine (history={history})"
+        )
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert crd.is_condition_true(va, crd.TYPE_OPTIMIZATION_READY)
+        emitted = emitter.value("inferno_desired_replicas",
+                                variant_name=VARIANT)
+        assert va.status.desired_optimized_alloc.num_replicas == emitted
+
+    def test_family_mismatch_is_visible_not_silent(self, monkeypatch):
+        """Collector in vllm mode against a jetstream endpoint: metrics
+        validation must fail with MetricsAvailable=False (absent series),
+        never silently read zero load."""
+        monkeypatch.setenv("WVA_METRIC_FAMILY", "vllm")
+        sim, fleet, prom, kube, emitter, rec = build_jetstream_loop()
+        for t in (5_000.0, 35_000.0):
+            sim.run_until(t)
+            prom.scrape(t)
+        rec.reconcile()
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        cond = crd.get_condition(va, crd.TYPE_METRICS_AVAILABLE)
+        assert cond is not None and cond.status == "False"

@@ -229,3 +229,48 @@ class TestProcessLevel:
         finally:
             proc.send_signal(signal.SIGTERM)
             proc.wait(timeout=10.0)
+
+
+class TestJetstreamDialect:
+    """--metric-family jetstream: the HTTP emulator exports the
+    JetStream-shaped series and its PromQL shim answers the collector's
+    jetstream queries."""
+
+    def test_metrics_exposition_uses_jetstream_names(self):
+        async def t():
+            client = await _client_family("jetstream")
+            try:
+                r = await _chat(client)
+                assert r.status == 200
+                m = await client.get("/metrics")
+                text = await m.text()
+                assert "jetstream_request_success_count_total" in text
+                assert "jetstream_time_to_first_token_sum" in text
+                assert "vllm:" not in text
+            finally:
+                await client.close()
+        run_async(t())
+
+    def test_prom_shim_answers_jetstream_demand_query(self):
+        from workload_variant_autoscaler_tpu.collector import JETSTREAM_FAMILY
+
+        async def t():
+            client = await _client_family("jetstream", with_prom_api=True)
+            try:
+                for _ in range(3):
+                    await _chat(client)
+                q = true_arrival_rate_query("m", "default", JETSTREAM_FAMILY)
+                r = await client.get("/api/v1/query", params={"query": q})
+                body = await r.json()
+                assert body["status"] == "success"
+            finally:
+                await client.close()
+        run_async(t())
+
+
+async def _client_family(family: str, with_prom_api=False) -> TestClient:
+    app = build_app(config=FAST, with_prom_api=with_prom_api,
+                    metric_family=family)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client

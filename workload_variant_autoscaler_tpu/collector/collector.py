@@ -1,14 +1,17 @@
-"""Collector: vLLM-TPU serving metrics -> current load/latency profile.
+"""Collector: TPU serving metrics -> current load/latency profile.
 
 Equivalent of /root/reference internal/collector/collector.go, aimed at
-vLLM-TPU / JetStream Prometheus endpoints. The scraped series keep the
-`vllm:*` names (vLLM-TPU exports the same family; constants below mirror
-internal/constants/metrics.go:7-43), with optional TPU runtime gauges
-(duty cycle / HBM) collected opportunistically for observability.
+vLLM-TPU / JetStream Prometheus endpoints. Series names are grouped into
+a MetricFamily: the default `vllm` dialect (vLLM-TPU exports the same
+family the reference scrapes, internal/constants/metrics.go:7-43) or the
+`jetstream` dialect (WVA_METRIC_FAMILY=jetstream), with optional TPU
+runtime gauges (duty cycle / HBM) collected opportunistically for
+observability.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 
@@ -28,6 +31,70 @@ VLLM_TTFT_SECONDS_SUM = "vllm:time_to_first_token_seconds_sum"
 VLLM_TTFT_SECONDS_COUNT = "vllm:time_to_first_token_seconds_count"
 VLLM_TPOT_SECONDS_SUM = "vllm:time_per_output_token_seconds_sum"
 VLLM_TPOT_SECONDS_COUNT = "vllm:time_per_output_token_seconds_count"
+
+
+@dataclass(frozen=True)
+class MetricFamily:
+    """Series names of one serving-metrics dialect. Histogram fields hold
+    the base name (`_sum`/`_count` are appended by the query builders).
+    `arrival_total` may be None — a dialect without an admission counter
+    infers saturation-visible demand from `queue_depth` instead (see
+    true_arrival_rate_query)."""
+
+    name: str
+    success_total: str
+    arrival_total: str | None
+    queue_depth: str | None
+    prompt_tokens: str
+    generation_tokens: str
+    ttft_seconds: str
+    tpot_seconds: str
+
+
+VLLM_FAMILY = MetricFamily(
+    name="vllm",
+    success_total=VLLM_REQUEST_SUCCESS_TOTAL,
+    arrival_total=VLLM_REQUEST_ARRIVAL_TOTAL,
+    queue_depth="vllm:num_requests_waiting",
+    prompt_tokens="vllm:request_prompt_tokens",
+    generation_tokens="vllm:request_generation_tokens",
+    ttft_seconds="vllm:time_to_first_token_seconds",
+    tpot_seconds="vllm:time_per_output_token_seconds",
+)
+
+# JetStream (MaxText serving) exports histograms for request lengths and
+# token latencies plus backlog gauges, but no admission counter — demand
+# under saturation is recovered from the prefill backlog growth.
+JETSTREAM_FAMILY = MetricFamily(
+    name="jetstream",
+    success_total="jetstream_request_success_count_total",
+    arrival_total=None,
+    queue_depth="jetstream_prefill_backlog_size",
+    prompt_tokens="jetstream_request_input_length",
+    generation_tokens="jetstream_request_output_length",
+    ttft_seconds="jetstream_time_to_first_token",
+    tpot_seconds="jetstream_time_per_output_token",
+)
+
+METRIC_FAMILIES = {f.name: f for f in (VLLM_FAMILY, JETSTREAM_FAMILY)}
+
+
+def active_family(cm_value: str | None = None) -> MetricFamily:
+    """The dialect selected by WVA_METRIC_FAMILY — env first, then the
+    operator-ConfigMap value (reference env-over-ConfigMap precedence,
+    controller.go:516-538), default vllm. An unknown name warns and falls
+    back — a typo must not silently turn off autoscaling."""
+    name = (
+        os.environ.get("WVA_METRIC_FAMILY", "").strip()
+        or (cm_value or "").strip()
+    ).lower() or "vllm"
+    family = METRIC_FAMILIES.get(name)
+    if family is None:
+        log.warning("unknown WVA_METRIC_FAMILY; using vllm",
+                    extra=kv(requested=name,
+                             known=sorted(METRIC_FAMILIES)))
+        return VLLM_FAMILY
+    return family
 
 # optional TPU runtime gauges (tpu-monitoring-library / libtpu names)
 TPU_DUTY_CYCLE = "tpu_duty_cycle_percent"
@@ -51,47 +118,90 @@ def _ratio(num: str, den: str, model: str, namespace: str) -> str:
     return f"{_rate_sum(num, model, namespace)}/{_rate_sum(den, model, namespace)}"
 
 
-def true_arrival_rate_query(model: str, namespace: str) -> str:
+def _deriv_sum(metric: str, model: str, namespace: str) -> str:
+    return (
+        f'sum(deriv({metric}{{{LABEL_MODEL_NAME}="{model}",'
+        f'{LABEL_NAMESPACE}="{namespace}"}}[{RATE_WINDOW}]))'
+    )
+
+
+def true_arrival_rate_query(
+    model: str, namespace: str, family: MetricFamily | None = None
+) -> str:
     """Demand measured at admission. Under saturation the success rate caps
     at delivered throughput, hiding excess load; the arrival counter does
     not (reference emulator exports it, metrics.py:29-38, but the reference
-    collector never reads it — collector.go:170. We prefer it)."""
-    return _rate_sum(VLLM_REQUEST_ARRIVAL_TOTAL, model, namespace)
+    collector never reads it — collector.go:170. We prefer it).
+
+    A dialect without an admission counter (JetStream) recovers the same
+    signal from queue dynamics: completions/sec plus the backlog growth
+    rate is exactly the admission rate, and the clamp keeps a draining
+    backlog from under-reporting below delivered throughput."""
+    family = family or active_family()
+    if family.arrival_total is not None:
+        return _rate_sum(family.arrival_total, model, namespace)
+    if family.queue_depth is not None:
+        return (
+            f"{_rate_sum(family.success_total, model, namespace)} + "
+            f"clamp_min({_deriv_sum(family.queue_depth, model, namespace)}, 0)"
+        )
+    return _rate_sum(family.success_total, model, namespace)
 
 
-def arrival_rate_query(model: str, namespace: str) -> str:
+def arrival_rate_query(
+    model: str, namespace: str, family: MetricFamily | None = None
+) -> str:
     """Completion-rate fallback for endpoints that lack the arrival counter
     (reference parity, collector.go:170)."""
-    return _rate_sum(VLLM_REQUEST_SUCCESS_TOTAL, model, namespace)
+    family = family or active_family()
+    return _rate_sum(family.success_total, model, namespace)
 
 
-def avg_prompt_tokens_query(model: str, namespace: str) -> str:
+def avg_prompt_tokens_query(
+    model: str, namespace: str, family: MetricFamily | None = None
+) -> str:
+    family = family or active_family()
     return _ratio(
-        VLLM_REQUEST_PROMPT_TOKENS_SUM, VLLM_REQUEST_PROMPT_TOKENS_COUNT,
+        f"{family.prompt_tokens}_sum", f"{family.prompt_tokens}_count",
         model, namespace,
     )
 
 
-def avg_generation_tokens_query(model: str, namespace: str) -> str:
+def avg_generation_tokens_query(
+    model: str, namespace: str, family: MetricFamily | None = None
+) -> str:
+    family = family or active_family()
     return _ratio(
-        VLLM_REQUEST_GENERATION_TOKENS_SUM, VLLM_REQUEST_GENERATION_TOKENS_COUNT,
+        f"{family.generation_tokens}_sum", f"{family.generation_tokens}_count",
         model, namespace,
     )
 
 
-def avg_ttft_query(model: str, namespace: str) -> str:
-    return _ratio(VLLM_TTFT_SECONDS_SUM, VLLM_TTFT_SECONDS_COUNT, model, namespace)
+def avg_ttft_query(
+    model: str, namespace: str, family: MetricFamily | None = None
+) -> str:
+    family = family or active_family()
+    return _ratio(f"{family.ttft_seconds}_sum", f"{family.ttft_seconds}_count",
+                  model, namespace)
 
 
-def avg_itl_query(model: str, namespace: str) -> str:
-    return _ratio(VLLM_TPOT_SECONDS_SUM, VLLM_TPOT_SECONDS_COUNT, model, namespace)
+def avg_itl_query(
+    model: str, namespace: str, family: MetricFamily | None = None
+) -> str:
+    family = family or active_family()
+    return _ratio(f"{family.tpot_seconds}_sum", f"{family.tpot_seconds}_count",
+                  model, namespace)
 
 
-def availability_query(model: str, namespace: str | None = None) -> str:
+def availability_query(
+    model: str, namespace: str | None = None,
+    family: MetricFamily | None = None,
+) -> str:
+    family = family or active_family()
     if namespace is None:
-        return f'{VLLM_REQUEST_SUCCESS_TOTAL}{{{LABEL_MODEL_NAME}="{model}"}}'
+        return f'{family.success_total}{{{LABEL_MODEL_NAME}="{model}"}}'
     return (
-        f'{VLLM_REQUEST_SUCCESS_TOTAL}{{{LABEL_MODEL_NAME}="{model}",'
+        f'{family.success_total}{{{LABEL_MODEL_NAME}="{model}",'
         f'{LABEL_NAMESPACE}="{namespace}"}}'
     )
 
@@ -148,17 +258,19 @@ def _value_or_none(prom: PromAPI, promql: str) -> float | None:
 
 
 def validate_metrics_availability(
-    prom: PromAPI, model: str, namespace: str, now: float | None = None
+    prom: PromAPI, model: str, namespace: str, now: float | None = None,
+    family: MetricFamily | None = None,
 ) -> MetricsValidation:
     """Check serving metrics exist and are fresh. Falls back to a
     namespace-less query for emulator endpoints (reference
     collector.go:87-156)."""
     from ..controller import crd
 
+    family = family or active_family()
     try:
-        samples = prom.query(availability_query(model, namespace))
+        samples = prom.query(availability_query(model, namespace, family))
         if not samples:
-            samples = prom.query(availability_query(model))
+            samples = prom.query(availability_query(model, family=family))
     except Exception as e:  # noqa: BLE001 - any query failure is a condition
         log.error("prometheus query failed during validation",
                   extra=kv(model=model, namespace=namespace, error=str(e)))
@@ -211,6 +323,7 @@ def collect_load(
     model: str,
     namespace: str,
     fallback: CollectedLoad | None = None,
+    family: MetricFamily | None = None,
 ) -> CollectedLoad:
     """Run the aggregate queries (reference collector.go:158-278) and
     convert units: arrival req/s -> req/min, latencies sec -> msec.
@@ -230,11 +343,14 @@ def collect_load(
       fall back to the caller-provided last-known values (CR status), then
       to defaults.
     """
+    family = family or active_family()
     success_rps: float | None = None
     success_fetched = False
-    arrival_rps = _value_or_none(prom, true_arrival_rate_query(model, namespace))
+    arrival_rps = _value_or_none(
+        prom, true_arrival_rate_query(model, namespace, family))
     if arrival_rps is None:
-        success_rps = _value_or_none(prom, arrival_rate_query(model, namespace))
+        success_rps = _value_or_none(
+            prom, arrival_rate_query(model, namespace, family))
         success_fetched = True
         arrival_rps = success_rps
         if arrival_rps is None:
@@ -242,10 +358,11 @@ def collect_load(
                         extra=kv(model=model, namespace=namespace))
             arrival_rps = 0.0
 
-    in_tok = _value_or_none(prom, avg_prompt_tokens_query(model, namespace))
-    out_tok = _value_or_none(prom, avg_generation_tokens_query(model, namespace))
-    ttft_s = _value_or_none(prom, avg_ttft_query(model, namespace))
-    itl_s = _value_or_none(prom, avg_itl_query(model, namespace))
+    in_tok = _value_or_none(prom, avg_prompt_tokens_query(model, namespace, family))
+    out_tok = _value_or_none(
+        prom, avg_generation_tokens_query(model, namespace, family))
+    ttft_s = _value_or_none(prom, avg_ttft_query(model, namespace, family))
+    itl_s = _value_or_none(prom, avg_itl_query(model, namespace, family))
 
     missing = [name for name, v in (
         ("avg_prompt_tokens", in_tok),
@@ -255,7 +372,8 @@ def collect_load(
     ) if v is None]
     if arrival_rps > 0.0 and missing:
         if not success_fetched:
-            success_rps = _value_or_none(prom, arrival_rate_query(model, namespace))
+            success_rps = _value_or_none(
+                prom, arrival_rate_query(model, namespace, family))
         if success_rps is not None and success_rps > 0.0:
             raise IncompleteMetricsError(model, namespace, missing)
         # no completions in the window: size from demand + best-known

@@ -22,6 +22,7 @@ from ..actuator import Actuator
 from ..collector import (
     IncompleteMetricsError,
     PromAPI,
+    active_family,
     collect_inventory_k8s,
     collect_load,
     validate_metrics_availability,
@@ -214,7 +215,9 @@ class Reconciler:
 
         prepared = self._prepare(active, accelerator_cm, service_class_cm,
                                  system_spec, result,
-                                 demand_headroom=self._demand_headroom(operator_cm))
+                                 demand_headroom=self._demand_headroom(operator_cm),
+                                 family=active_family(
+                                     operator_cm.get("WVA_METRIC_FAMILY")))
         mark("prepare")
         if not prepared:
             self.emitter.emit_power_metrics({})
@@ -393,7 +396,7 @@ class Reconciler:
         return self._cm_float(operator_cm, "WVA_DEMAND_HEADROOM", 0.0)
 
     def _prepare(self, active, accelerator_cm, service_class_cm, system_spec,
-                 result, demand_headroom: float = 0.0):
+                 result, demand_headroom: float = 0.0, family=None):
         prepared: list[tuple[crd.VariantAutoscaling, Deployment]] = []
         class_by_key = translate.service_class_key_names(service_class_cm)
         for va_listed in active:
@@ -460,7 +463,8 @@ class Reconciler:
                     continue
 
             validation = validate_metrics_availability(
-                self.prom, model, deploy.namespace, now=self.now()
+                self.prom, model, deploy.namespace, now=self.now(),
+                family=family,
             )
             if validation.available:
                 crd.set_condition(
@@ -485,7 +489,8 @@ class Reconciler:
 
             try:
                 load = collect_load(self.prom, model, deploy.namespace,
-                                    fallback=self._last_known_load(va))
+                                    fallback=self._last_known_load(va),
+                                    family=family)
             except IncompleteMetricsError as e:
                 # loaded variant with unusable modeling series: scaling it
                 # on zero-filled data would tear it down to min replicas —

@@ -1,12 +1,16 @@
-"""Prometheus series for the emulator, in the scraped `vllm:*` namespace.
+"""Prometheus series for the emulator, in the scraped serving namespace.
 
 Mirrors the metric surface of the reference emulator
 (/root/reference tools/vllm-emulator/metrics.py) — the series the collector
 queries (internal/constants/metrics.go:7-43) plus scheduler/KV gauges —
-on an instance-scoped registry.
-"""
+on an instance-scoped registry. `family="jetstream"` exports the
+JetStream-shaped dialect instead (histogram request lengths / token
+latencies, backlog gauges, NO admission counter — matching what a real
+JetStream server gives the collector to work with)."""
 
 from __future__ import annotations
+
+from dataclasses import dataclass
 
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
 
@@ -18,47 +22,108 @@ TTFT_BUCKETS = [0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25, 0.5,
 TOKEN_BUCKETS = [1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000]
 
 
+@dataclass(frozen=True)
+class SinkSeries:
+    """Base names (prometheus_client appends _total/_sum/_count) for one
+    serving dialect. `arrival` None = the dialect has no admission
+    counter (JetStream)."""
+
+    arrival: str | None
+    success: str
+    prompt: str
+    generation: str
+    ttft: str
+    tpot: str
+    running: str
+    waiting: str
+    kv: str
+
+
+def _sink_series(family, running: str, kv: str) -> SinkSeries:
+    """Derive the exported base names from the collector's MetricFamily,
+    so the series the emulator emits and the series the collector queries
+    cannot drift apart (counter bases get _total appended by
+    prometheus_client — strip it; histogram fields are already bases).
+    `running`/`kv` are emulator observability extras the collector never
+    queries, hence not part of MetricFamily."""
+    def base(name):
+        return name.removesuffix("_total") if name else None
+
+    return SinkSeries(
+        arrival=base(family.arrival_total),
+        success=base(family.success_total),
+        prompt=family.prompt_tokens,
+        generation=family.generation_tokens,
+        ttft=family.ttft_seconds,
+        tpot=family.tpot_seconds,
+        running=running,
+        waiting=family.queue_depth,
+        kv=kv,
+    )
+
+
+def _sink_families():
+    from ..collector import JETSTREAM_FAMILY, VLLM_FAMILY
+
+    return {
+        "vllm": _sink_series(VLLM_FAMILY,
+                             running="vllm:num_requests_running",
+                             kv="vllm:gpu_cache_usage_perc"),
+        "jetstream": _sink_series(JETSTREAM_FAMILY,
+                                  running="jetstream_slots_used",
+                                  kv="jetstream_kv_cache_utilization"),
+    }
+
+
+SINK_FAMILIES = _sink_families()
+
+
 class PrometheusSink(MetricsSink):
     def __init__(self, model_name: str, namespace: str = "",
-                 registry: CollectorRegistry | None = None):
+                 registry: CollectorRegistry | None = None,
+                 family: str = "vllm"):
         self.registry = registry or CollectorRegistry()
         self.model_name = model_name
         self.namespace = namespace
+        self.family = family
+        series = SINK_FAMILIES[family]
         labelnames = ["model_name"] + (["namespace"] if namespace else [])
         self._labels = {"model_name": model_name}
         if namespace:
             self._labels["namespace"] = namespace
 
         r = self.registry
-        self.request_arrival = Counter(
-            "vllm:request_arrival", "Requests received", labelnames, registry=r)
+        self.request_arrival = None if series.arrival is None else Counter(
+            series.arrival, "Requests received", labelnames, registry=r)
         self.request_success = Counter(
-            "vllm:request_success", "Requests completed", labelnames, registry=r)
+            series.success, "Requests completed", labelnames, registry=r)
         self.prompt_tokens = Histogram(
-            "vllm:request_prompt_tokens", "Prompt token count per request",
+            series.prompt, "Prompt token count per request",
             labelnames, buckets=TOKEN_BUCKETS, registry=r)
         self.generation_tokens = Histogram(
-            "vllm:request_generation_tokens", "Generated token count per request",
+            series.generation, "Generated token count per request",
             labelnames, buckets=TOKEN_BUCKETS, registry=r)
         self.ttft_seconds = Histogram(
-            "vllm:time_to_first_token_seconds", "TTFT seconds",
+            series.ttft, "TTFT seconds",
             labelnames, buckets=TTFT_BUCKETS, registry=r)
         self.tpot_seconds = Histogram(
-            "vllm:time_per_output_token_seconds", "Inter-token latency seconds",
+            series.tpot, "Inter-token latency seconds",
             labelnames, buckets=ITL_BUCKETS, registry=r)
         self.num_running = Gauge(
-            "vllm:num_requests_running", "Requests in decode", labelnames, registry=r)
+            series.running, "Requests in decode", labelnames, registry=r)
         self.num_waiting = Gauge(
-            "vllm:num_requests_waiting", "Requests queued", labelnames, registry=r)
+            series.waiting, "Requests queued", labelnames, registry=r)
         self.kv_usage = Gauge(
-            "vllm:gpu_cache_usage_perc", "KV cache usage fraction",
-            labelnames, registry=r)
+            series.kv, "KV cache usage fraction", labelnames, registry=r)
 
     def on_arrival(self, req: Request) -> None:
         # True demand signal: counted at admission to the fleet, not at
         # completion, so the collector can see load a saturated replica
         # cannot deliver (reference tools/vllm-emulator/metrics.py:29-35).
-        self.request_arrival.labels(**self._labels).inc()
+        # The jetstream dialect has no such counter; demand visibility
+        # comes from the backlog gauge instead.
+        if self.request_arrival is not None:
+            self.request_arrival.labels(**self._labels).inc()
 
     def on_first_token(self, req: Request) -> None:
         self.ttft_seconds.labels(**self._labels).observe(max(req.ttft_ms, 0.0) / 1000.0)

@@ -100,14 +100,19 @@ class RealtimeEmulator:
         return req
 
 
-def build_app(config: SliceModelConfig | None = None, with_prom_api: bool = False):
+def build_app(config: SliceModelConfig | None = None, with_prom_api: bool = False,
+              metric_family: str = "vllm"):
     from aiohttp import web
+
+    from ..collector import METRIC_FAMILIES
 
     config = config or config_from_env()
     namespace = os.environ.get("NAMESPACE", "default")
-    sink = PrometheusSink(config.model_name, namespace)
+    sink = PrometheusSink(config.model_name, namespace, family=metric_family)
     emulator = RealtimeEmulator(config, sink)
-    prom_shim = SimPromAPI(sink, config.model_name, namespace) if with_prom_api else None
+    prom_shim = SimPromAPI(sink, config.model_name, namespace,
+                           family=METRIC_FAMILIES[metric_family]) \
+        if with_prom_api else None
 
     async def chat_completions(request: web.Request):
         try:
@@ -210,8 +215,12 @@ def main(argv=None) -> int:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--with-prom-api", action="store_true",
                         help="serve /api/v1/query from local counters")
+    parser.add_argument("--metric-family", default="vllm",
+                        choices=["vllm", "jetstream"],
+                        help="serving-metrics dialect to export")
     args = parser.parse_args(argv)
-    app = build_app(with_prom_api=args.with_prom_api)
+    app = build_app(with_prom_api=args.with_prom_api,
+                    metric_family=args.metric_family)
     log.info("starting emulator", extra=kv(port=args.port))
     web.run_app(app, host=args.host, port=args.port, print=None)
     return 0

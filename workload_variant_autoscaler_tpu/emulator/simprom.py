@@ -15,6 +15,7 @@ from bisect import bisect_left
 from collections import deque
 
 from ..collector import (
+    MetricFamily,
     arrival_rate_query,
     availability_query,
     avg_generation_tokens_query,
@@ -30,34 +31,49 @@ RATE_WINDOW_S = 60.0
 
 
 class SimPromAPI:
-    """PromAPI over a snapshot history of PrometheusSink counters."""
+    """PromAPI over a snapshot history of PrometheusSink counters.
 
-    def __init__(self, sink: PrometheusSink, model: str, namespace: str):
+    Speaks whichever metric dialect the sink exports (the family defaults
+    to the SINK's dialect, so the exported series and the answered queries
+    agree by construction — not to the env selection, which describes the
+    collector side and may differ in a mismatch test). For a dialect
+    without an admission counter the demand query is evaluated the way a
+    real Prometheus would: completion rate + clamped backlog derivative."""
+
+    def __init__(self, sink: PrometheusSink, model: str, namespace: str,
+                 family: MetricFamily | None = None):
+        from ..collector import METRIC_FAMILIES
+
         self.sink = sink
         self.model = model
         self.namespace = namespace
+        self.family = family or METRIC_FAMILIES[sink.family]
         self.history: deque[tuple[float, dict[str, float]]] = deque(maxlen=4096)
         self.now_s = 0.0
-        self._queries: dict[str, tuple[str, str | None]] = {}
+        self._queries: dict[str, tuple] = {}
         self._register_queries()
 
     def _register_queries(self) -> None:
-        m, ns = self.model, self.namespace
+        m, ns, fam = self.model, self.namespace, self.family
+        if fam.arrival_total is not None:
+            demand = ("rate", fam.arrival_total)
+        else:
+            demand = ("demand", (fam.success_total, fam.queue_depth))
         self._queries = {
-            true_arrival_rate_query(m, ns): ("rate", "vllm:request_arrival_total"),
-            arrival_rate_query(m, ns): ("rate", "vllm:request_success_total"),
-            avg_prompt_tokens_query(m, ns): (
-                "ratio", ("vllm:request_prompt_tokens_sum",
-                          "vllm:request_prompt_tokens_count")),
-            avg_generation_tokens_query(m, ns): (
-                "ratio", ("vllm:request_generation_tokens_sum",
-                          "vllm:request_generation_tokens_count")),
-            avg_ttft_query(m, ns): (
-                "ratio", ("vllm:time_to_first_token_seconds_sum",
-                          "vllm:time_to_first_token_seconds_count")),
-            avg_itl_query(m, ns): (
-                "ratio", ("vllm:time_per_output_token_seconds_sum",
-                          "vllm:time_per_output_token_seconds_count")),
+            true_arrival_rate_query(m, ns, fam): demand,
+            arrival_rate_query(m, ns, fam): ("rate", fam.success_total),
+            avg_prompt_tokens_query(m, ns, fam): (
+                "ratio", (f"{fam.prompt_tokens}_sum",
+                          f"{fam.prompt_tokens}_count")),
+            avg_generation_tokens_query(m, ns, fam): (
+                "ratio", (f"{fam.generation_tokens}_sum",
+                          f"{fam.generation_tokens}_count")),
+            avg_ttft_query(m, ns, fam): (
+                "ratio", (f"{fam.ttft_seconds}_sum",
+                          f"{fam.ttft_seconds}_count")),
+            avg_itl_query(m, ns, fam): (
+                "ratio", (f"{fam.tpot_seconds}_sum",
+                          f"{fam.tpot_seconds}_count")),
         }
 
     # -- driven by the simulation ---------------------------------------
@@ -74,17 +90,35 @@ class SimPromAPI:
         empty vector, not zero."""
         return bool(self.history) and series in self.history[-1][1]
 
-    def _rate(self, series: str) -> float:
+    def _window(self) -> tuple[float, dict, float, dict] | None:
         if len(self.history) < 2:
-            return 0.0
+            return None
         t_now, latest = self.history[-1]
         t_start = t_now - RATE_WINDOW_S
         times = [t for t, _ in self.history]
         i = max(bisect_left(times, t_start) - 1, 0)
         t_old, oldest = self.history[i]
         if t_now <= t_old:
+            return None
+        return t_now, latest, t_old, oldest
+
+    def _rate(self, series: str) -> float:
+        w = self._window()
+        if w is None:
             return 0.0
+        t_now, latest, t_old, oldest = w
         return max(latest.get(series, 0.0) - oldest.get(series, 0.0), 0.0) / (
+            t_now - t_old
+        )
+
+    def _deriv(self, series: str) -> float:
+        """PromQL deriv(): per-second slope of a gauge over the window
+        (signed — a draining backlog derives negative)."""
+        w = self._window()
+        if w is None:
+            return 0.0
+        t_now, latest, t_old, oldest = w
+        return (latest.get(series, 0.0) - oldest.get(series, 0.0)) / (
             t_now - t_old
         )
 
@@ -93,13 +127,14 @@ class SimPromAPI:
         if promql == "up":
             return [Sample(labels={}, value=1.0, timestamp=self.now_s)]
         if promql in (
-            availability_query(self.model, self.namespace),
-            availability_query(self.model),
+            availability_query(self.model, self.namespace, self.family),
+            availability_query(self.model, family=self.family),
         ):
             if not self.history:
                 return []
             return [Sample(labels=labels,
-                           value=self.history[-1][1].get("vllm:request_success_total", 0.0),
+                           value=self.history[-1][1].get(
+                               self.family.success_total, 0.0),
                            timestamp=self.now_s)]
         spec = self._queries.get(promql)
         if spec is None:
@@ -109,6 +144,13 @@ class SimPromAPI:
             if not self._present(payload):
                 return []
             return [Sample(labels=labels, value=self._rate(payload), timestamp=self.now_s)]
+        if kind == "demand":
+            success, queue = payload
+            if not self._present(success):
+                return []
+            value = self._rate(success) + max(
+                self._deriv(queue) if self._present(queue) else 0.0, 0.0)
+            return [Sample(labels=labels, value=value, timestamp=self.now_s)]
         num, den = payload
         if not (self._present(num) and self._present(den)):
             return []
