@@ -102,3 +102,139 @@ def make_system(servers=None, capacity=None, optimizer=None) -> tuple[System, Op
     system = System()
     opt_spec = system.set_from_spec(spec)
     return system, opt_spec
+
+
+# ---------------------------------------------------------------------------
+# Shared closed-loop harness: emulator -> sim-time Prometheus ->
+# reconciler -> (emulated HPA) -> emulator replicas. Used by
+# test_e2e_loop / test_jetstream / test_tail_sizing so the CRD/ConfigMap
+# wiring cannot drift between the loop tests.
+# ---------------------------------------------------------------------------
+
+class CompositeSink:
+    """Fans every sink hook out to multiple sinks. Deliberately NOT a
+    MetricsSink subclass: the base's concrete no-op methods would shadow
+    __getattr__ and swallow all events."""
+
+    def __init__(self, *sinks):
+        self.sinks = sinks
+
+    def __getattr__(self, name):
+        targets = [getattr(s, name) for s in self.sinks]
+
+        def fan_out(*args, **kwargs):
+            for t in targets:
+                t(*args, **kwargs)
+        return fan_out
+
+
+def build_closed_loop(cfg, *, model, variant, ns="default",
+                      slo_itl_ms=24, slo_ttft_ms=500,
+                      accelerator="v5e-1", chip="v5e", chips="1", cost="20.0",
+                      interval="30s", family=None, extra_sinks=(),
+                      operator_extra=None, seed=11):
+    """One-variant closed loop on InMemoryKube + SimPromAPI.
+
+    family: a collector MetricFamily for the emulator sink + prom shim
+    (None = vllm). extra_sinks: additional MetricsSink observers fanned
+    in next to the Prometheus sink (TTFT recorders etc.).
+    Returns (sim, fleet, prom, kube, emitter, reconciler)."""
+    import json as _json
+
+    from workload_variant_autoscaler_tpu.controller import (
+        ACCELERATOR_CM_NAME,
+        CONFIG_MAP_NAME,
+        CONFIG_MAP_NAMESPACE,
+        SERVICE_CLASS_CM_NAME,
+        ConfigMap,
+        Deployment,
+        InMemoryKube,
+        Reconciler,
+        crd,
+    )
+    from workload_variant_autoscaler_tpu.emulator import (
+        Fleet,
+        PrometheusSink,
+        SimPromAPI,
+        Simulation,
+    )
+    from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
+
+    prom_sink = PrometheusSink(model, ns,
+                               family=family.name if family else "vllm")
+    sink = CompositeSink(prom_sink, *extra_sinks) if extra_sinks else prom_sink
+    fleet = Fleet(cfg, sink, replicas=1)
+    sim = Simulation(fleet, seed=seed)
+    prom = SimPromAPI(prom_sink, model, ns, family=family)
+
+    kube = InMemoryKube()
+    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE, {
+        "GLOBAL_OPT_INTERVAL": interval, **(operator_extra or {}),
+    }))
+    kube.put_configmap(ConfigMap(
+        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {accelerator: _json.dumps(
+            {"chip": chip, "chips": chips, "cost": cost})},
+    ))
+    kube.put_configmap(ConfigMap(
+        SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"premium": (
+            "name: Premium\npriority: 1\ndata:\n"
+            f"  - model: {model}\n    slo-tpot: {slo_itl_ms}\n"
+            f"    slo-ttft: {slo_ttft_ms}\n"
+        )},
+    ))
+    kube.put_deployment(Deployment(name=variant, namespace=ns,
+                                   spec_replicas=1, status_replicas=1))
+    kube.put_variant_autoscaling(crd.VariantAutoscaling(
+        metadata=crd.ObjectMeta(name=variant, namespace=ns,
+                                labels={crd.ACCELERATOR_LABEL: accelerator}),
+        spec=crd.VariantAutoscalingSpec(
+            model_id=model,
+            slo_class_ref=crd.ConfigMapKeyRef(name=SERVICE_CLASS_CM_NAME,
+                                              key="premium"),
+            model_profile=crd.ModelProfile(accelerators=[
+                crd.AcceleratorProfile(
+                    acc=accelerator, acc_count=1,
+                    perf_parms=crd.PerfParms(
+                        decode_parms={"alpha": str(cfg.alpha),
+                                      "beta": str(cfg.beta)},
+                        prefill_parms={"gamma": str(cfg.gamma),
+                                       "delta": str(cfg.delta)},
+                    ),
+                    max_batch_size=cfg.max_batch_size,
+                ),
+            ]),
+        ),
+    ))
+    emitter = MetricsEmitter()
+    rec = Reconciler(kube=kube, prom=prom, emitter=emitter,
+                     now=lambda: sim.now_ms / 1000.0, sleep=lambda _s: None)
+    return sim, fleet, prom, kube, emitter, rec
+
+
+def drive_closed_loop(sim, fleet, prom, kube, rec, *, variant, ns="default",
+                      until_ms, reconcile_every_ms=30_000.0,
+                      desired_history=None, tick_ms=5000.0):
+    """Advance sim; scrape every tick; reconcile + emulate HPA actuation."""
+    from workload_variant_autoscaler_tpu.controller import Deployment
+
+    next_reconcile = sim.now_ms + reconcile_every_ms
+
+    def on_tick(now_ms):
+        nonlocal next_reconcile
+        prom.scrape(now_ms)
+        if now_ms >= next_reconcile:
+            next_reconcile += reconcile_every_ms
+            rec.reconcile()
+            va = kube.get_variant_autoscaling(variant, ns)
+            desired = va.status.desired_optimized_alloc.num_replicas
+            if desired_history is not None:
+                desired_history.append((now_ms, desired))
+            kube.put_deployment(Deployment(name=variant, namespace=ns,
+                                           spec_replicas=desired,
+                                           status_replicas=desired))
+            fleet.set_replicas(max(desired, 0), now_ms)
+            sim.kick()
+
+    sim.run_until(until_ms, on_tick=on_tick, tick_ms=tick_ms)

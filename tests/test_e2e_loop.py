@@ -50,21 +50,7 @@ SLO_ITL_MS = 24
 SLO_TTFT_MS = 500
 
 
-class CompositeSink:
-    """Fans every sink hook out to multiple sinks. Deliberately NOT a
-    MetricsSink subclass: the base's concrete no-op methods would shadow
-    __getattr__ and swallow all events."""
-
-    def __init__(self, *sinks: MetricsSink):
-        self.sinks = sinks
-
-    def __getattr__(self, name):
-        targets = [getattr(s, name) for s in self.sinks]
-
-        def fan_out(*args, **kwargs):
-            for t in targets:
-                t(*args, **kwargs)
-        return fan_out
+from tests.helpers import CompositeSink  # noqa: E402 — re-export for test_e2e_longcontext
 
 
 class TTFTLog(MetricsSink):
@@ -87,79 +73,26 @@ class TTFTLog(MetricsSink):
 
 
 def build_loop(min_replicas_env=None, monkeypatch=None):
-    prom_sink = PrometheusSink(MODEL, NS)
+    from tests.helpers import build_closed_loop
+
     ttft_log = TTFTLog()
-    fleet = Fleet(CFG, CompositeSink(prom_sink, ttft_log), replicas=1)
-    sim = Simulation(fleet, seed=11)
-    prom = SimPromAPI(prom_sink, MODEL, NS)
-
-    kube = InMemoryKube()
-    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
-                                 {"GLOBAL_OPT_INTERVAL": "30s"}))
-    kube.put_configmap(ConfigMap(
-        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
-        {"v5e-1": json.dumps({"chip": "v5e", "chips": "1", "cost": "20.0"})},
-    ))
-    kube.put_configmap(ConfigMap(
-        SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
-        {"premium": (
-            "name: Premium\npriority: 1\ndata:\n"
-            f"  - model: {MODEL}\n    slo-tpot: {SLO_ITL_MS}\n"
-            f"    slo-ttft: {SLO_TTFT_MS}\n"
-        )},
-    ))
-    kube.put_deployment(Deployment(name=VARIANT, namespace=NS,
-                                   spec_replicas=1, status_replicas=1))
-    va = crd.VariantAutoscaling(
-        metadata=crd.ObjectMeta(name=VARIANT, namespace=NS,
-                                labels={crd.ACCELERATOR_LABEL: "v5e-1"}),
-        spec=crd.VariantAutoscalingSpec(
-            model_id=MODEL,
-            slo_class_ref=crd.ConfigMapKeyRef(name=SERVICE_CLASS_CM_NAME, key="premium"),
-            model_profile=crd.ModelProfile(accelerators=[
-                crd.AcceleratorProfile(
-                    acc="v5e-1", acc_count=1,
-                    perf_parms=crd.PerfParms(
-                        decode_parms={"alpha": str(CFG.alpha), "beta": str(CFG.beta)},
-                        prefill_parms={"gamma": str(CFG.gamma), "delta": str(CFG.delta)},
-                    ),
-                    max_batch_size=CFG.max_batch_size,
-                ),
-            ]),
-        ),
+    sim, fleet, prom, kube, emitter, rec = build_closed_loop(
+        CFG, model=MODEL, variant=VARIANT,
+        slo_itl_ms=SLO_ITL_MS, slo_ttft_ms=SLO_TTFT_MS,
+        extra_sinks=(ttft_log,),
     )
-    kube.put_variant_autoscaling(va)
-
-    emitter = MetricsEmitter()
-    # controller clock = simulation clock
-    rec = Reconciler(kube=kube, prom=prom, emitter=emitter,
-                     now=lambda: sim.now_ms / 1000.0, sleep=lambda _s: None)
     return sim, fleet, prom, kube, emitter, rec, ttft_log
 
 
 def run_loop(sim, fleet, prom, kube, rec, until_ms, reconcile_every_ms=30_000.0,
              desired_history=None):
     """Advance sim; scrape every 5s; reconcile + emulate HPA actuation."""
-    next_reconcile = sim.now_ms + reconcile_every_ms
+    from tests.helpers import drive_closed_loop
 
-    def on_tick(now_ms):
-        nonlocal next_reconcile
-        prom.scrape(now_ms)
-        if now_ms >= next_reconcile:
-            next_reconcile += reconcile_every_ms
-            rec.reconcile()
-            va = kube.get_variant_autoscaling(VARIANT, NS)
-            desired = va.status.desired_optimized_alloc.num_replicas
-            if desired_history is not None:
-                desired_history.append((now_ms, desired))
-            # emulate HPA: deployment tracks the signal; fleet follows
-            kube.put_deployment(Deployment(name=VARIANT, namespace=NS,
-                                           spec_replicas=desired,
-                                           status_replicas=desired))
-            fleet.set_replicas(max(desired, 0), now_ms)
-            sim.kick()
-
-    sim.run_until(until_ms, on_tick=on_tick, tick_ms=5000.0)
+    drive_closed_loop(sim, fleet, prom, kube, rec, variant=VARIANT,
+                      until_ms=until_ms,
+                      reconcile_every_ms=reconcile_every_ms,
+                      desired_history=desired_history)
 
 
 class TestClosedLoop:

@@ -123,52 +123,10 @@ class TestJetstreamSink:
 
 
 def build_jetstream_loop():
-    prom_sink = PrometheusSink(MODEL, NS, family="jetstream")
-    fleet = Fleet(CFG, prom_sink, replicas=1)
-    sim = Simulation(fleet, seed=11)
-    prom = SimPromAPI(prom_sink, MODEL, NS, family=JETSTREAM_FAMILY)
+    from tests.helpers import build_closed_loop
 
-    kube = InMemoryKube()
-    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
-                                 {"GLOBAL_OPT_INTERVAL": "30s"}))
-    kube.put_configmap(ConfigMap(
-        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
-        {"v5e-1": json.dumps({"chip": "v5e", "chips": "1", "cost": "20.0"})},
-    ))
-    kube.put_configmap(ConfigMap(
-        SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
-        {"premium": (
-            "name: Premium\npriority: 1\ndata:\n"
-            f"  - model: {MODEL}\n    slo-tpot: 24\n    slo-ttft: 500\n"
-        )},
-    ))
-    kube.put_deployment(Deployment(name=VARIANT, namespace=NS,
-                                   spec_replicas=1, status_replicas=1))
-    kube.put_variant_autoscaling(crd.VariantAutoscaling(
-        metadata=crd.ObjectMeta(name=VARIANT, namespace=NS,
-                                labels={crd.ACCELERATOR_LABEL: "v5e-1"}),
-        spec=crd.VariantAutoscalingSpec(
-            model_id=MODEL,
-            slo_class_ref=crd.ConfigMapKeyRef(name=SERVICE_CLASS_CM_NAME,
-                                              key="premium"),
-            model_profile=crd.ModelProfile(accelerators=[
-                crd.AcceleratorProfile(
-                    acc="v5e-1", acc_count=1,
-                    perf_parms=crd.PerfParms(
-                        decode_parms={"alpha": str(CFG.alpha),
-                                      "beta": str(CFG.beta)},
-                        prefill_parms={"gamma": str(CFG.gamma),
-                                       "delta": str(CFG.delta)},
-                    ),
-                    max_batch_size=CFG.max_batch_size,
-                ),
-            ]),
-        ),
-    ))
-    emitter = MetricsEmitter()
-    rec = Reconciler(kube=kube, prom=prom, emitter=emitter,
-                     now=lambda: sim.now_ms / 1000.0, sleep=lambda _s: None)
-    return sim, fleet, prom, kube, emitter, rec
+    return build_closed_loop(CFG, model=MODEL, variant=VARIANT,
+                             family=JETSTREAM_FAMILY)
 
 
 class TestJetstreamClosedLoop:
@@ -189,25 +147,11 @@ class TestJetstreamClosedLoop:
         )
         gen.start()
 
+        from tests.helpers import drive_closed_loop
+
         history: list[tuple[float, int]] = []
-        next_reconcile = 30_000.0
-
-        def on_tick(now_ms):
-            nonlocal next_reconcile
-            prom.scrape(now_ms)
-            if now_ms >= next_reconcile:
-                next_reconcile += 30_000.0
-                rec.reconcile()
-                va = kube.get_variant_autoscaling(VARIANT, NS)
-                desired = va.status.desired_optimized_alloc.num_replicas
-                history.append((now_ms, desired))
-                kube.put_deployment(Deployment(
-                    name=VARIANT, namespace=NS,
-                    spec_replicas=desired, status_replicas=desired))
-                fleet.set_replicas(max(desired, 0), now_ms)
-                sim.kick()
-
-        sim.run_until(300_000.0, on_tick=on_tick, tick_ms=5000.0)
+        drive_closed_loop(sim, fleet, prom, kube, rec, variant=VARIANT,
+                          until_ms=300_000.0, desired_history=history)
 
         assert history, "no reconciles ran"
         peak = max(d for _t, d in history)

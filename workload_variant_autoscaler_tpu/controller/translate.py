@@ -273,6 +273,29 @@ def warmup_shapes(vas, mesh_size: int | None = None) -> tuple[int, int]:
     return bucket, max_batch or 256
 
 
+def ttft_percentile(operator_cm: dict[str, str] | None = None) -> float | None:
+    """WVA_TTFT_PERCENTILE (env over ConfigMap): size the TTFT SLO against
+    this percentile of the TTFT distribution instead of its mean
+    (ops.batched.size_batch_tail — realizes the reference's dead
+    percentile-sizing intent, allocation.go:117 + defaults.go:12-15).
+    Unset/empty = mean sizing (reference parity); valid range (0.5, 1)."""
+    raw = os.environ.get("WVA_TTFT_PERCENTILE", "").strip() \
+        or (operator_cm or {}).get("WVA_TTFT_PERCENTILE", "").strip()
+    if not raw:
+        return None
+    try:
+        p = float(raw)
+    except ValueError:
+        log.warning("bad WVA_TTFT_PERCENTILE, sizing on the mean",
+                    extra=kv(value=raw))
+        return None
+    if not 0.5 < p < 1.0:
+        log.warning("WVA_TTFT_PERCENTILE out of range (0.5, 1); "
+                    "sizing on the mean", extra=kv(value=raw))
+        return None
+    return p
+
+
 def engine_backend() -> str:
     """Analysis backend for the reconcile cycle: the batched JAX kernel by
     default; the C++ kernel when WVA_NATIVE_KERNEL is enabled and

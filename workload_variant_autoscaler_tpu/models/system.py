@@ -112,7 +112,8 @@ class System:
 
     # -- candidate analysis --------------------------------------------
 
-    def calculate(self, backend: str = "batched", mesh=None) -> None:
+    def calculate(self, backend: str = "batched", mesh=None,
+                  ttft_percentile: float | None = None) -> None:
         """Compute candidate allocations for every server.
 
         backend="batched": gather all (server, slice) candidates and solve
@@ -123,21 +124,28 @@ class System:
         call (ops.native) — the fast host path for CPU-only controllers.
         mesh: optional 1-D jax.sharding.Mesh; shards the candidate batch
         across its devices (parallel.size_batch_sharded) for large fleets.
+        ttft_percentile: size the TTFT SLO against this percentile of the
+        TTFT distribution instead of its mean (ops.batched.size_batch_tail;
+        batched backend only).
         """
         for acc in self.accelerators.values():
             acc.calculate()
         if backend == "scalar":
             if mesh is not None:
                 raise ValueError("mesh sharding requires backend='batched'")
+            if ttft_percentile is not None:
+                raise ValueError("ttft_percentile requires backend='batched'")
             for server in self.servers.values():
                 server.calculate(self)
             return
         if backend == "native":
             if mesh is not None:
                 raise ValueError("mesh sharding requires backend='batched'")
+            if ttft_percentile is not None:
+                raise ValueError("ttft_percentile requires backend='batched'")
             self._calculate_native()
             return
-        self._calculate_batched(mesh=mesh)
+        self._calculate_batched(mesh=mesh, ttft_percentile=ttft_percentile)
 
     def _candidate_pairs(self):
         """Feasible (server, acc) candidates with resolved profile/target;
@@ -178,7 +186,8 @@ class System:
             alloc.value = server.cur_allocation.transition_penalty(alloc)
         server.all_allocations[acc_name] = alloc
 
-    def _calculate_batched(self, mesh=None) -> None:
+    def _calculate_batched(self, mesh=None,
+                           ttft_percentile: float | None = None) -> None:
         import jax.numpy as jnp
 
         from ..ops.batched import (
@@ -188,6 +197,7 @@ class System:
             k_max_for,
             make_queue_batch,
             size_batch,
+            size_batch_tail,
         )
 
         pairs = self._candidate_pairs()
@@ -230,7 +240,11 @@ class System:
         if mesh is not None:
             from ..parallel import size_batch_sharded
 
-            sized = size_batch_sharded(q, slo, k_max, mesh)
+            sized = size_batch_sharded(q, slo, k_max, mesh,
+                                       ttft_percentile=ttft_percentile)
+        elif ttft_percentile is not None:
+            sized = size_batch_tail(q, slo, k_max,
+                                    ttft_percentile=ttft_percentile)
         else:
             sized = size_batch(q, slo, k_max)
         feasible = np.asarray(sized.feasible)

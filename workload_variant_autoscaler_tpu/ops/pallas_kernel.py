@@ -167,7 +167,7 @@ def size_batch_pallas(
 
     dtype = q.alpha.dtype
     b = q.batch_size
-    prob = _sizing_problem(q, targets, k_max)
+    prob, _eval_y = _sizing_problem(q, targets, k_max)
 
     # tile the stacked problem for the kernel
     b2 = 2 * b

@@ -77,31 +77,36 @@ def shard_batch(tree, mesh: Mesh):
 
 
 def size_batch_sharded(
-    q: QueueBatch, targets: SLOTargets, k_max: int, mesh: Mesh
+    q: QueueBatch, targets: SLOTargets, k_max: int, mesh: Mesh,
+    ttft_percentile: Optional[float] = None,
 ) -> SizingResult:
     """size_batch with the candidate axis sharded over `mesh`.
 
     Pads to a multiple of the mesh size, shards inputs, runs the fused
     kernel with sharded outputs, and slices the padding back off. Padded
-    lanes come back feasible=False via the valid mask.
+    lanes come back feasible=False via the valid mask. With
+    ttft_percentile, runs the tail-sizing kernel instead.
     """
     n = mesh.devices.size
     q, targets, b = pad_to_multiple(q, targets, n)
     q = shard_batch(q, mesh)
     targets = shard_batch(targets, mesh)
-    sized = _sharded_size_fn(k_max, mesh)(q, targets)
+    sized = _sharded_size_fn(k_max, mesh, ttft_percentile)(q, targets)
     return jax.tree.map(lambda a: a[:b], sized)
 
 
 @lru_cache(maxsize=32)
-def _sharded_size_fn(k_max: int, mesh: Mesh):
-    """Jitted sharded kernel, cached per (k_max, mesh) so repeated
-    reconcile cycles reuse the compiled executable instead of retracing
-    (Mesh hashes by device assignment + axis names)."""
-    return jax.jit(
-        partial(size_batch, k_max=k_max),
-        out_shardings=NamedSharding(mesh, P(AXIS)),
-    )
+def _sharded_size_fn(k_max: int, mesh: Mesh,
+                     ttft_percentile: Optional[float] = None):
+    """Jitted sharded kernel, cached per (k_max, mesh, percentile) so
+    repeated reconcile cycles reuse the compiled executable instead of
+    retracing (Mesh hashes by device assignment + axis names)."""
+    from ..ops.batched import size_batch_tail
+
+    fn = (partial(size_batch, k_max=k_max) if ttft_percentile is None
+          else partial(size_batch_tail, k_max=k_max,
+                       ttft_percentile=ttft_percentile))
+    return jax.jit(fn, out_shardings=NamedSharding(mesh, P(AXIS)))
 
 
 def analyze_batch_sharded(q: QueueBatch, rates_per_sec, k_max: int,
