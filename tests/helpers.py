@@ -132,12 +132,15 @@ def build_closed_loop(cfg, *, model, variant, ns="default",
                       slo_itl_ms=24, slo_ttft_ms=500,
                       accelerator="v5e-1", chip="v5e", chips="1", cost="20.0",
                       interval="30s", family=None, extra_sinks=(),
-                      operator_extra=None, seed=11):
+                      operator_extra=None, seed=11, profile_cfg=None):
     """One-variant closed loop on InMemoryKube + SimPromAPI.
 
     family: a collector MetricFamily for the emulator sink + prom shim
     (None = vllm). extra_sinks: additional MetricsSink observers fanned
-    in next to the Prometheus sink (TTFT recorders etc.).
+    in next to the Prometheus sink (TTFT recorders etc.). profile_cfg:
+    the SliceModelConfig whose alpha/beta/gamma/delta go into the VA's
+    CRD profile — defaults to cfg (profile == emulator physics); pass a
+    different one to model a MISFITTED profile (drift tests).
     Returns (sim, fleet, prom, kube, emitter, reconciler)."""
     import json as _json
 
@@ -160,6 +163,7 @@ def build_closed_loop(cfg, *, model, variant, ns="default",
     )
     from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
 
+    profile_cfg = profile_cfg or cfg
     prom_sink = PrometheusSink(model, ns,
                                family=family.name if family else "vllm")
     sink = CompositeSink(prom_sink, *extra_sinks) if extra_sinks else prom_sink
@@ -197,12 +201,12 @@ def build_closed_loop(cfg, *, model, variant, ns="default",
                 crd.AcceleratorProfile(
                     acc=accelerator, acc_count=1,
                     perf_parms=crd.PerfParms(
-                        decode_parms={"alpha": str(cfg.alpha),
-                                      "beta": str(cfg.beta)},
-                        prefill_parms={"gamma": str(cfg.gamma),
-                                       "delta": str(cfg.delta)},
+                        decode_parms={"alpha": str(profile_cfg.alpha),
+                                      "beta": str(profile_cfg.beta)},
+                        prefill_parms={"gamma": str(profile_cfg.gamma),
+                                       "delta": str(profile_cfg.delta)},
                     ),
-                    max_batch_size=cfg.max_batch_size,
+                    max_batch_size=profile_cfg.max_batch_size,
                 ),
             ]),
         ),

@@ -1,0 +1,186 @@
+"""Perf-model drift watchdog (controller/drift.py + reconciler wiring).
+
+The reference scrapes observed ITL/TTFT but never compares them to its
+own queueing model — a misfitted profile silently mis-sizes forever.
+Here persistent observed-vs-predicted disagreement at the current
+operating point flips PerfModelAccurate=False and exports
+inferno_model_drift_ratio.
+"""
+
+import dataclasses
+
+import pytest
+
+from workload_variant_autoscaler_tpu.collector import CollectedLoad
+from workload_variant_autoscaler_tpu.controller import crd
+from workload_variant_autoscaler_tpu.controller.drift import (
+    DriftReading,
+    predict_latency,
+    within_tolerance,
+)
+from workload_variant_autoscaler_tpu.emulator import (
+    PoissonLoadGenerator,
+    SliceModelConfig,
+    TokenDistribution,
+)
+from workload_variant_autoscaler_tpu.models import (
+    ModelSliceProfile,
+    SystemSpec,
+)
+
+MODEL = "llama-8b"
+NS = "default"
+VARIANT = "chat-8b"
+
+CFG = SliceModelConfig(
+    model_name=MODEL, slice_name="v5e-1",
+    alpha=6.973, beta=0.027, gamma=5.2, delta=0.1,
+    max_batch_size=64, hbm_gb=16.0, model_size_gb=8.0, kv_mb_per_token=0.25,
+)
+
+
+def spec_with_profile() -> SystemSpec:
+    spec = SystemSpec()
+    spec.profiles.append(ModelSliceProfile(
+        model=MODEL, accelerator="v5e-1",
+        alpha=CFG.alpha, beta=CFG.beta, gamma=CFG.gamma, delta=CFG.delta,
+        max_batch_size=64,
+    ))
+    return spec
+
+
+def load(rpm=600.0, itl=0.0, ttft=0.0, in_tok=128.0, out_tok=128.0):
+    return CollectedLoad(arrival_rate_rpm=rpm, avg_input_tokens=in_tok,
+                         avg_output_tokens=out_tok, avg_ttft_ms=ttft,
+                         avg_itl_ms=itl)
+
+
+class TestPredictLatency:
+    def test_accurate_observation_ratio_one(self):
+        spec = spec_with_profile()
+        # first pass: get the predictions, then feed them back as the
+        # "observed" values — ratios must be exactly 1
+        r0 = predict_latency(spec, MODEL, "v5e-1",
+                             load(itl=10.0, ttft=100.0), 1,
+                             server_max_batch=64)
+        assert r0 is not None
+        r = predict_latency(
+            spec, MODEL, "v5e-1",
+            load(itl=r0.predicted_itl_ms, ttft=r0.predicted_ttft_ms), 1,
+            server_max_batch=64,
+        )
+        assert r.itl_ratio == pytest.approx(1.0)
+        assert r.ttft_ratio == pytest.approx(1.0)
+
+    def test_unjudgeable_points_return_none(self):
+        spec = spec_with_profile()
+        mb = 64
+        assert predict_latency(spec, MODEL, "v5e-1", load(), 0,
+                               server_max_batch=mb) is None          # no pods
+        assert predict_latency(spec, MODEL, "v5e-1", load(rpm=0.0), 1,
+                               server_max_batch=mb) is None          # idle
+        assert predict_latency(spec, MODEL, "other", load(), 1,
+                               server_max_batch=mb) is None          # no profile
+        # saturation: per-replica rate beyond the stable region
+        assert predict_latency(spec, MODEL, "v5e-1",
+                               load(rpm=60_000.0), 1,
+                               server_max_batch=mb) is None
+
+    def test_more_replicas_bring_point_back_into_region(self):
+        spec = spec_with_profile()
+        hot = load(rpm=60_000.0, itl=10.0, ttft=100.0)  # 1000 req/s
+        assert predict_latency(spec, MODEL, "v5e-1", hot, 1,
+                               server_max_batch=64) is None
+        assert predict_latency(spec, MODEL, "v5e-1", hot, 64,
+                               server_max_batch=64) is not None
+
+    def test_nothing_observed_is_unjudgeable(self):
+        """Cold-window fallback carries arrivals but zero latency
+        aggregates: no evidence for OR against the model — must not
+        reset the strike counter (VERDICT of review: a drifted profile
+        could otherwise dodge the watchdog via quiet windows)."""
+        spec = spec_with_profile()
+        assert predict_latency(spec, MODEL, "v5e-1",
+                               load(itl=0.0, ttft=0.0), 1,
+                               server_max_batch=64) is None
+
+
+class TestTolerance:
+    def reading(self, itl=1.0, ttft=1.0):
+        return DriftReading(itl_ratio=itl, ttft_ratio=ttft,
+                            predicted_itl_ms=10.0, predicted_ttft_ms=100.0)
+
+    def test_symmetric_in_log_space(self):
+        tol = 0.5
+        assert within_tolerance(self.reading(itl=1.49), tol)
+        assert within_tolerance(self.reading(itl=1.0 / 1.49), tol)
+        assert not within_tolerance(self.reading(itl=1.51), tol)
+        assert not within_tolerance(self.reading(itl=1.0 / 1.51), tol)
+
+    def test_unobservable_metric_is_ignored(self):
+        r = DriftReading(itl_ratio=None, ttft_ratio=1.0,
+                         predicted_itl_ms=10.0, predicted_ttft_ms=100.0)
+        assert within_tolerance(r, 0.5)
+
+
+
+def run_cycles(sim, fleet, prom, kube, rec, *, rps, cycles):
+    from tests.helpers import drive_closed_loop
+
+    gen = PoissonLoadGenerator(
+        sim, schedule=[(cycles * 30 + 30, rps * 60)],
+        tokens=TokenDistribution(avg_input_tokens=128, avg_output_tokens=128,
+                                 distribution="deterministic"),
+        seed=11,
+    )
+    gen.start()
+    drive_closed_loop(sim, fleet, prom, kube, rec, variant=VARIANT,
+                      until_ms=(cycles + 1) * 30_000.0)
+
+
+class TestClosedLoopDrift:
+    def test_honest_profile_stays_accurate(self):
+        from tests.helpers import build_closed_loop
+
+        sim, fleet, prom, kube, emitter, rec = build_closed_loop(
+            CFG, model=MODEL, variant=VARIANT)
+        run_cycles(sim, fleet, prom, kube, rec, rps=10.0, cycles=5)
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        cond = crd.get_condition(va, crd.TYPE_PERF_MODEL_ACCURATE)
+        assert cond is not None and cond.status == "True", cond
+        ratio = emitter.value("inferno_model_drift_ratio",
+                              variant_name=VARIANT, metric="itl")
+        assert ratio == pytest.approx(1.0, rel=0.3)
+
+    def test_misfitted_profile_flips_condition(self):
+        from tests.helpers import build_closed_loop
+
+        # emulator physics decode 2.5x slower than the fitted profile
+        # claims -> observed ITL ~2.5x predicted
+        real = dataclasses.replace(CFG, alpha=CFG.alpha * 2.5,
+                                   beta=CFG.beta * 2.5)
+        sim, fleet, prom, kube, emitter, rec = build_closed_loop(
+            real, model=MODEL, variant=VARIANT, profile_cfg=CFG)
+        run_cycles(sim, fleet, prom, kube, rec, rps=10.0, cycles=6)
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        cond = crd.get_condition(va, crd.TYPE_PERF_MODEL_ACCURATE)
+        assert cond is not None and cond.status == "False", cond
+        assert cond.reason == crd.REASON_PROFILE_DRIFT
+        assert "re-fit" in cond.message
+        ratio = emitter.value("inferno_model_drift_ratio",
+                              variant_name=VARIANT, metric="itl")
+        assert ratio == pytest.approx(2.5, rel=0.3)
+
+    def test_tolerance_zero_disables(self):
+        from tests.helpers import build_closed_loop
+
+        real = dataclasses.replace(CFG, alpha=CFG.alpha * 2.5,
+                                   beta=CFG.beta * 2.5)
+        sim, fleet, prom, kube, emitter, rec = build_closed_loop(
+            real, model=MODEL, variant=VARIANT, profile_cfg=CFG,
+            operator_extra={"WVA_DRIFT_TOLERANCE": "0"})
+        run_cycles(sim, fleet, prom, kube, rec, rps=10.0, cycles=5)
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert crd.get_condition(va, crd.TYPE_PERF_MODEL_ACCURATE) is None
+        assert emitter.value("inferno_model_drift_ratio",
+                             variant_name=VARIANT, metric="itl") is None

@@ -43,6 +43,7 @@ ACCELERATOR_LABEL = "inference.optimization/acceleratorName"
 # Condition types + reasons (reference variantautoscaling_types.go:194-222).
 TYPE_METRICS_AVAILABLE = "MetricsAvailable"
 TYPE_OPTIMIZATION_READY = "OptimizationReady"
+TYPE_PERF_MODEL_ACCURATE = "PerfModelAccurate"
 
 REASON_METRICS_FOUND = "MetricsFound"
 REASON_METRICS_MISSING = "MetricsMissing"
@@ -51,6 +52,8 @@ REASON_METRICS_INCOMPLETE = "MetricsIncomplete"
 REASON_PROMETHEUS_ERROR = "PrometheusError"
 REASON_OPTIMIZATION_SUCCEEDED = "OptimizationSucceeded"
 REASON_OPTIMIZATION_FAILED = "OptimizationFailed"
+REASON_MODEL_MATCHES = "ModelMatchesObservations"
+REASON_PROFILE_DRIFT = "ProfileDrift"
 REASON_METRICS_UNAVAILABLE = "MetricsUnavailable"
 
 
@@ -221,6 +224,18 @@ def set_condition(
             observed_generation=va.metadata.generation, last_transition_time=ts,
         )
     )
+
+
+def remove_condition(va: VariantAutoscaling, cond_type: str) -> bool:
+    """Drop a condition type from the status (meta.RemoveStatusCondition
+    semantics); True when one was present. Used when the feature that
+    maintains a condition is turned off — a stale verdict must not outlive
+    its watchdog."""
+    before = len(va.status.conditions)
+    va.status.conditions = [
+        c for c in va.status.conditions if c.type != cond_type
+    ]
+    return len(va.status.conditions) != before
 
 
 def get_condition(va: VariantAutoscaling, cond_type: str) -> Optional[Condition]:

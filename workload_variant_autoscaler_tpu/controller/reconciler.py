@@ -81,6 +81,9 @@ class Reconciler:
         # (in-memory like HPA's window; a controller restart just delays
         # one scale-down, the fail-safe direction)
         self._recommendations: dict[str, list[tuple[float, int]]] = {}
+        # consecutive out-of-tolerance drift readings per VA (hysteresis:
+        # one noisy 1m-rate sample must not flip PerfModelAccurate)
+        self._drift_strikes: dict[str, int] = {}
 
     # -- config reading (reference controller.go:490-594) ----------------
 
@@ -162,6 +165,8 @@ class Reconciler:
         active_keys = {full_name(va.name, va.namespace) for va in active}
         for stale in [k for k in self._recommendations if k not in active_keys]:
             del self._recommendations[stale]
+        for stale in [k for k in self._drift_strikes if k not in active_keys]:
+            del self._drift_strikes[stale]
         if not active:
             log.info("no active VariantAutoscalings, skipping optimization")
             # no fleet: the power series must read empty, not hold the
@@ -217,7 +222,9 @@ class Reconciler:
                                  system_spec, result,
                                  demand_headroom=self._demand_headroom(operator_cm),
                                  family=active_family(
-                                     operator_cm.get("WVA_METRIC_FAMILY")))
+                                     operator_cm.get("WVA_METRIC_FAMILY")),
+                                 drift_tolerance=self._cm_float(
+                                     operator_cm, "WVA_DRIFT_TOLERANCE", 0.5))
         mark("prepare")
         if not prepared:
             self.emitter.emit_power_metrics({})
@@ -403,8 +410,13 @@ class Reconciler:
         return self._cm_float(operator_cm, "WVA_DEMAND_HEADROOM", 0.0)
 
     def _prepare(self, active, accelerator_cm, service_class_cm, system_spec,
-                 result, demand_headroom: float = 0.0, family=None):
+                 result, demand_headroom: float = 0.0, family=None,
+                 drift_tolerance: float = 0.5):
         prepared: list[tuple[crd.VariantAutoscaling, Deployment]] = []
+        # this cycle's drift readings, replacing the gauge wholesale at
+        # the end (same invariant as the power series: deleted variants'
+        # label sets are cleared, not left stale)
+        drift_samples: dict[tuple[str, str, str], float] = {}
         class_by_key = translate.service_class_key_names(service_class_cm)
         for va_listed in active:
             name = va_listed.name
@@ -532,9 +544,77 @@ class Reconciler:
 
             translate.add_server_info_to_system_data(
                 system_spec, va, class_name, demand_headroom=demand_headroom)
+            self._track_drift(va, acc_name, load, deploy.current_replicas(),
+                              system_spec, drift_tolerance, drift_samples)
             prepared.append((va, deploy))
             result.processed.append(key)
+        self.emitter.emit_drift_metrics(drift_samples)
         return prepared
+
+    # consecutive out-of-tolerance cycles before the condition flips: one
+    # noisy 1m-rate sample or a transient must not brand the profile bad
+    DRIFT_STRIKES = 3
+
+    def _track_drift(self, va, acc_name, load, current_replicas,
+                     system_spec, tolerance: float,
+                     drift_samples: dict) -> None:
+        """Compare observed latency averages against the queueing model's
+        prediction at the current operating point; persistent mismatch
+        sets PerfModelAccurate=False on the CR (see controller/drift.py).
+        tolerance <= 0 disables the watchdog — and removes any condition
+        a previously-enabled watchdog left behind, so a stale verdict
+        can't outlive the feature."""
+        from . import drift as drift_mod
+
+        key = full_name(va.name, va.namespace)
+        if tolerance <= 0:
+            self._drift_strikes.pop(key, None)
+            crd.remove_condition(va, crd.TYPE_PERF_MODEL_ACCURATE)
+            return
+        reading = drift_mod.predict_latency(
+            system_spec, va.spec.model_id, acc_name, load, current_replicas,
+            server_max_batch=translate.profile_max_batch(va, acc_name),
+        )
+        if reading is None:
+            # unjudgeable point (idle, saturated, missing profile, nothing
+            # observed): keep the previous condition, decay nothing
+            return
+        for metric, ratio in (("itl", reading.itl_ratio),
+                              ("ttft", reading.ttft_ratio)):
+            if ratio is not None:
+                drift_samples[(va.name, va.namespace, metric)] = ratio
+        if drift_mod.within_tolerance(reading, tolerance):
+            self._drift_strikes[key] = 0
+            crd.set_condition(
+                va, crd.TYPE_PERF_MODEL_ACCURATE, "True",
+                crd.REASON_MODEL_MATCHES,
+                "observed ITL/TTFT match the fitted profile at the current "
+                "operating point",
+                now=self.now(),
+            )
+            return
+        strikes = self._drift_strikes.get(key, 0) + 1
+        self._drift_strikes[key] = strikes
+        log.warning(
+            "perf-model drift detected",
+            extra=kv(variant=va.name, strikes=strikes,
+                     itl_ratio=reading.itl_ratio,
+                     ttft_ratio=reading.ttft_ratio,
+                     predicted_itl_ms=round(reading.predicted_itl_ms, 2),
+                     predicted_ttft_ms=round(reading.predicted_ttft_ms, 2)),
+        )
+        if strikes >= self.DRIFT_STRIKES:
+            crd.set_condition(
+                va, crd.TYPE_PERF_MODEL_ACCURATE, "False",
+                crd.REASON_PROFILE_DRIFT,
+                (f"observed/predicted latency ratios (itl "
+                 f"{reading.itl_ratio and round(reading.itl_ratio, 2)}, ttft "
+                 f"{reading.ttft_ratio and round(reading.ttft_ratio, 2)}) "
+                 f"outside tolerance {tolerance} for {strikes} consecutive "
+                 "cycles: re-fit the variant's perf profile "
+                 "(docs/tutorials/parameter-estimation.md)"),
+                now=self.now(),
+            )
 
     @staticmethod
     def _last_known_load(va: crd.VariantAutoscaling):

@@ -110,6 +110,9 @@ INFERNO_RECONCILE_DURATION_MSEC = "inferno_reconcile_duration_msec"
 INFERNO_RECONCILE_STAGE_DURATION_MSEC = "inferno_reconcile_stage_duration_msec"
 INFERNO_VARIANT_POWER_WATTS = "inferno_variant_power_watts"
 INFERNO_FLEET_POWER_WATTS = "inferno_fleet_power_watts"
+INFERNO_MODEL_DRIFT_RATIO = "inferno_model_drift_ratio"
+
+LABEL_METRIC = "metric"
 
 LABEL_STAGE = "stage"
 RECONCILE_STAGES = ("config", "prepare", "analyze", "optimize", "publish")
@@ -189,6 +192,15 @@ class MetricsEmitter:
             "Modeled power draw of the whole optimized fleet",
             registry=self.registry,
         )
+        # perf-model drift (beyond-reference: the reference never compares
+        # its scraped latencies against its own queueing model)
+        self.model_drift = Gauge(
+            INFERNO_MODEL_DRIFT_RATIO,
+            "Observed/predicted latency at the current allocation (1.0 = "
+            "the fitted profile matches reality)",
+            [LABEL_VARIANT_NAME, LABEL_NAMESPACE, LABEL_METRIC],
+            registry=self.registry,
+        )
 
     def emit_solution_time(self, msec: float) -> None:
         self.solution_time.set(msec)
@@ -212,6 +224,22 @@ class MetricsEmitter:
                 }).set(watts)
                 total += watts
             self.fleet_power.set(total)
+
+    def emit_drift_metrics(
+        self, per_variant: dict[tuple[str, str, str], float]
+    ) -> None:
+        """Replace the drift series wholesale each cycle (same invariant
+        as the power gauges: a deleted variant's — or an unjudged
+        metric's — label set disappears rather than exporting its last
+        ratio forever). Keys: (variant_name, namespace, metric)."""
+        with self._lock:
+            self.model_drift.clear()
+            for (variant_name, namespace, metric), ratio in per_variant.items():
+                self.model_drift.labels(**{
+                    LABEL_VARIANT_NAME: variant_name,
+                    LABEL_NAMESPACE: namespace,
+                    LABEL_METRIC: metric,
+                }).set(ratio)
 
     def emit_cycle_timing(self, stage_msec: dict[str, float]) -> None:
         """Publish per-stage durations + their total for the last cycle.
