@@ -759,49 +759,75 @@ class TestPowerGauges:
         assert watts_at(60.0) > watts_at(2.0) > 0.0
 
 
-class TestWarmupShapes:
-    """Startup warmup derives kernel shapes from the live fleet
-    (translate.warmup_shapes), so the first reconcile hits compiled
-    executables instead of guessing from an env default."""
+class TestWarmupPlan:
+    """Startup warmup derives kernel shapes from the live fleet + config
+    (translate.warmup_plan), grouped exactly the way
+    System._calculate_batched groups (per effective TTFT percentile), so
+    the first reconcile hits compiled executables."""
 
-    def test_shapes_from_fleet(self):
+    PREMIUM_P95 = {
+        "premium": (
+            "name: Premium\npriority: 1\ndata:\n"
+            f"  - model: {MODEL}\n    slo-tpot: 24\n    slo-ttft: 500\n"
+            "    slo-ttft-percentile: 0.95\n"
+        ),
+    }
+
+    def test_single_mean_group_from_fleet(self):
         from workload_variant_autoscaler_tpu.controller.translate import (
-            warmup_shapes,
+            warmup_plan,
         )
 
-        bucket, mb = warmup_shapes([make_va(), make_va(name="other")])
+        plan = warmup_plan([make_va(), make_va(name="other")])
         # two VAs x two profile entries = 4 candidates -> one 16-lane
-        # bucket; one K from the fleet-wide max batch (System takes
-        # np.max over all candidates)
-        assert bucket == 16
-        assert mb == 192
+        # mean group; one K from the group max batch
+        assert plan == [(16, 192, None)]
 
     def test_large_fleet_widens_lane_bucket(self):
         from workload_variant_autoscaler_tpu.controller.translate import (
-            warmup_shapes,
+            warmup_plan,
         )
 
         vas = [make_va(name=f"va-{i}") for i in range(10)]  # 20 candidates
-        bucket, _ = warmup_shapes(vas)
+        [(bucket, _mb, _p)] = warmup_plan(vas)
         assert bucket == 32
 
     def test_mesh_uses_lcm_padding_rule(self):
         """Must match System._calculate_batched's lcm(16, mesh) padding or
         warmup compiles a shape the reconcile loop never runs."""
         from workload_variant_autoscaler_tpu.controller.translate import (
-            warmup_shapes,
+            warmup_plan,
         )
 
-        bucket, _ = warmup_shapes([make_va()], mesh_size=3)
+        [(bucket, _m, _p)] = warmup_plan([make_va()], mesh_size=3)
         assert bucket == 48  # lcm(16, 3)
-        bucket, _ = warmup_shapes([make_va()], mesh_size=8)
+        [(bucket, _m, _p)] = warmup_plan([make_va()], mesh_size=8)
         assert bucket == 16  # 8 divides 16
+
+    def test_percentile_class_gets_its_own_group(self):
+        """A class with slo-ttft-percentile compiles the TAIL kernel; the
+        warmup must plan that group or the first cycle recompiles."""
+        from workload_variant_autoscaler_tpu.controller.translate import (
+            warmup_plan,
+        )
+
+        plan = warmup_plan([make_va()], service_class_cm=self.PREMIUM_P95)
+        assert plan == [(16, 192, 0.95)]
+
+    def test_global_percentile_applies_when_class_has_none(self):
+        from workload_variant_autoscaler_tpu.controller.translate import (
+            warmup_plan,
+        )
+
+        plan = warmup_plan(
+            [make_va()],
+            operator_cm={"WVA_TTFT_PERCENTILE": "0.9"},
+        )
+        assert plan == [(16, 192, 0.9)]
 
     def test_empty_fleet_defaults(self):
         from workload_variant_autoscaler_tpu.controller.translate import (
-            warmup_shapes,
+            warmup_plan,
         )
 
-        bucket, mb = warmup_shapes([])
-        assert bucket == 16
-        assert mb == 256
+        assert warmup_plan([]) == [(16, 256, None)]

@@ -215,3 +215,115 @@ class TestClosedLoopTailSizing:
             "mean sizing unexpectedly held the p95 — the percentile knob "
             f"would be pointless (p95={p95:.0f}ms)"
         )
+
+
+class TestPerClassPercentile:
+    """slo-ttft-percentile in the service-class ConfigMap: Premium buys a
+    p95 guarantee, Freemium sizes on the mean, one optimizer cycle."""
+
+    def test_yaml_row_parses_and_validates(self):
+        from workload_variant_autoscaler_tpu.controller.translate import (
+            create_system_data,
+        )
+
+        cm = {
+            "premium": (
+                "name: Premium\npriority: 1\ndata:\n"
+                "  - model: llama-8b\n    slo-tpot: 24\n    slo-ttft: 500\n"
+                "    slo-ttft-percentile: 0.95\n"
+            ),
+            "freemium": (
+                "name: Freemium\npriority: 10\ndata:\n"
+                "  - model: llama-8b\n    slo-tpot: 150\n    slo-ttft: 1500\n"
+            ),
+            "broken": (
+                "name: Broken\npriority: 20\ndata:\n"
+                "  - model: llama-8b\n    slo-tpot: 150\n    slo-ttft: 1500\n"
+                "    slo-ttft-percentile: 1.5\n"
+            ),
+        }
+        spec = create_system_data({}, cm)
+        by_name = {sc.name: sc for sc in spec.service_classes}
+        assert by_name["Premium"].model_targets[0].slo_ttft_percentile == 0.95
+        assert by_name["Freemium"].model_targets[0].slo_ttft_percentile == 0.0
+        # out-of-range degrades to mean sizing, never crashes the class
+        assert by_name["Broken"].model_targets[0].slo_ttft_percentile == 0.0
+
+    def test_mixed_fleet_sizes_each_class_on_its_own_target(self):
+        """Two servers, same model/slice/load; one class carries a p95
+        percentile. The percentile class must get a LOWER per-replica max
+        rate (hence >= replicas) than the mean class with the same SLO."""
+        from tests.helpers import PROFILES, SLICES, server_spec
+        from workload_variant_autoscaler_tpu.models import (
+            ModelTarget,
+            OptimizerSpec,
+            ServiceClassSpec,
+            System,
+            SystemSpec,
+        )
+
+        classes = [
+            ServiceClassSpec(name="P95", priority=1, model_targets=(
+                ModelTarget(model="llama-8b", slo_itl=24.0, slo_ttft=500.0,
+                            slo_ttft_percentile=0.95),
+            )),
+            ServiceClassSpec(name="Mean", priority=10, model_targets=(
+                ModelTarget(model="llama-8b", slo_itl=24.0, slo_ttft=500.0),
+            )),
+        ]
+        servers = [
+            server_spec(name="tail:default", service_class="P95",
+                        keep_accelerator=True),
+            server_spec(name="mean:default", service_class="Mean",
+                        keep_accelerator=True),
+        ]
+        spec = SystemSpec(
+            accelerators=list(SLICES), profiles=list(PROFILES),
+            service_classes=classes, servers=servers,
+            optimizer=OptimizerSpec(unlimited=True),
+        )
+        system = System()
+        system.set_from_spec(spec)
+        system.calculate(backend="batched")
+
+        tail_alloc = system.servers["tail:default"].all_allocations["v5e-1"]
+        mean_alloc = system.servers["mean:default"].all_allocations["v5e-1"]
+        assert tail_alloc.max_arrv_rate_per_replica < \
+            mean_alloc.max_arrv_rate_per_replica
+        assert tail_alloc.num_replicas >= mean_alloc.num_replicas
+
+    def test_global_knob_is_the_fallback(self):
+        """Per-class percentile unset + global WVA_TTFT_PERCENTILE set:
+        the global applies; a per-class value overrides it."""
+        from tests.helpers import PROFILES, SLICES, server_spec
+        from workload_variant_autoscaler_tpu.models import (
+            ModelTarget,
+            OptimizerSpec,
+            ServiceClassSpec,
+            System,
+            SystemSpec,
+        )
+
+        def rate_for(percentile_cls, global_pct):
+            classes = [ServiceClassSpec(name="C", priority=1, model_targets=(
+                ModelTarget(model="llama-8b", slo_itl=24.0, slo_ttft=500.0,
+                            slo_ttft_percentile=percentile_cls),
+            ))]
+            spec = SystemSpec(
+                accelerators=list(SLICES), profiles=list(PROFILES),
+                service_classes=classes,
+                servers=[server_spec(name="s:default", service_class="C",
+                                     keep_accelerator=True)],
+                optimizer=OptimizerSpec(unlimited=True),
+            )
+            system = System()
+            system.set_from_spec(spec)
+            system.calculate(backend="batched", ttft_percentile=global_pct)
+            return system.servers["s:default"].all_allocations[
+                "v5e-1"].max_arrv_rate_per_replica
+
+        mean_rate = rate_for(0.0, None)
+        global_rate = rate_for(0.0, 0.95)
+        override_rate = rate_for(0.99, 0.95)
+        assert global_rate < mean_rate
+        assert override_rate < global_rate  # p99 stricter than global p95

@@ -83,7 +83,8 @@ def main(argv=None) -> int:
     # validation backs off and leader election contends), so the first
     # reconcile runs at steady-state latency instead of stalling seconds
     # in compilation. The persistent cache makes even a cold restart warm.
-    from .translate import engine_backend, engine_mesh, warmup_shapes
+    from .reconciler import CONFIG_MAP_NAME, SERVICE_CLASS_CM_NAME
+    from .translate import engine_backend, engine_mesh, warmup_plan
 
     backend = engine_backend()
     if backend == "batched" and \
@@ -96,25 +97,43 @@ def main(argv=None) -> int:
 
         mesh = engine_mesh(backend)
 
+        def _cm_data(name: str) -> dict:
+            try:
+                return kube.get_configmap(name, args.config_namespace).data
+            except Exception:  # noqa: BLE001 — warmup is best-effort
+                return {}
+
         def _warm() -> None:
             try:
                 cache_dir = enable_persistent_cache()
-                # the shape the fleet will compile, from the live VA list
-                # (fallback: the 256 default when the apiserver isn't
-                # reachable yet — warmup is best-effort, never fatal)
+                # the shapes the fleet will compile — per sizing group
+                # (percentile classes compile the tail kernel) — from the
+                # live VA list + ConfigMaps (fallback: the 256 default
+                # when the apiserver isn't reachable yet)
                 mesh_size = int(mesh.devices.size) if mesh is not None else None
                 try:
-                    bucket, max_batch = warmup_shapes(
-                        kube.list_variant_autoscalings(), mesh_size)
+                    plan = warmup_plan(
+                        kube.list_variant_autoscalings(),
+                        service_class_cm=_cm_data(SERVICE_CLASS_CM_NAME),
+                        operator_cm=_cm_data(CONFIG_MAP_NAME),
+                        mesh_size=mesh_size,
+                    )
                 except Exception:  # noqa: BLE001
-                    bucket, max_batch = (
+                    plan = [(
                         16 if mesh_size is None else math.lcm(16, mesh_size),
                         int(os.environ.get("WVA_WARMUP_MAX_BATCH", "256")),
-                    )
-                warmup(max_batch=max_batch, bucket=bucket, mesh=mesh)
+                        None,
+                    )]
+                for bucket, max_batch, pct in plan:
+                    warmup(max_batch=max_batch, bucket=bucket, mesh=mesh,
+                           ttft_percentile=pct)
                 log.info("engine kernels warmed",
                          extra=kv(compilation_cache=cache_dir or "off",
-                                  lanes=bucket, max_batch=max_batch,
+                                  groups=[
+                                      {"lanes": b, "max_batch": m,
+                                       "ttft_percentile": p}
+                                      for b, m, p in plan
+                                  ],
                                   sharded=mesh is not None))
             except Exception as e:  # noqa: BLE001 — warmup is best-effort
                 log.warning("engine warmup failed; first cycle will compile",

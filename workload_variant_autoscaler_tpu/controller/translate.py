@@ -123,6 +123,8 @@ def create_system_data(
                 model=row.get("model", ""),
                 slo_itl=float(row.get("slo-tpot", 0) or 0),
                 slo_ttft=float(row.get("slo-ttft", 0) or 0),
+                slo_ttft_percentile=_valid_percentile(
+                    row.get("slo-ttft-percentile", 0), key),
             )
             for row in doc.get("data", []) or []
         )
@@ -249,28 +251,81 @@ def scale_to_zero_enabled() -> bool:
     return os.environ.get(SCALE_TO_ZERO_ENV, "").lower() == "true"
 
 
-def warmup_shapes(vas, mesh_size: int | None = None) -> tuple[int, int]:
-    """The kernel shape the fleet will actually compile, derived from the
-    listed VariantAutoscalings: (candidate-lane bucket, max-batch bound).
+def warmup_plan(
+    vas, service_class_cm: dict[str, str] | None = None,
+    operator_cm: dict[str, str] | None = None,
+    mesh_size: int | None = None,
+) -> list[tuple[int, int, float | None]]:
+    """The kernel shapes the fleet will actually compile, derived from
+    the listed VariantAutoscalings + the service-class/operator config:
+    one (candidate-lane bucket, max-batch bound, ttft_percentile|None)
+    entry per sizing group.
 
     Must mirror System._calculate_batched exactly or the warmup compiles
-    a shape the reconcile loop never runs: the candidate axis is padded
-    to a multiple of 16 — lcm(16, mesh size) under WVA_MESH_DEVICES —
-    and ONE K is taken over the whole batch (np.max of the candidates'
-    effective batches), so only the fleet-wide maximum max-batch matters.
-    Profiles without a batch bound warm the 256 default instead of
-    guessing."""
-    max_batch = 0
-    candidates = 0
-    for va in vas:
-        for ap in va.spec.model_profile.accelerators:
-            candidates += 1
-            max_batch = max(
-                max_batch, ap.max_batch_size if ap.max_batch_size > 0 else 256
-            )
+    shapes the reconcile loop never runs: candidates are GROUPED by their
+    effective TTFT percentile (the class's slo-ttft-percentile, else the
+    global WVA_TTFT_PERCENTILE, else mean), each group's candidate axis
+    is padded to a multiple of 16 — lcm(16, mesh size) under
+    WVA_MESH_DEVICES — and each group takes ONE K from its own maximum
+    max-batch. Profiles without a batch bound warm the 256 default
+    instead of guessing; VAs whose class can't be resolved warm in the
+    global-percentile group."""
+    global_p = ttft_percentile(operator_cm) or 0.0
+    spec = create_system_data({}, service_class_cm or {})
+    class_by_key = service_class_key_names(service_class_cm or {})
     quantum = 16 if not mesh_size else math.lcm(16, mesh_size)
-    bucket = max(quantum, -(-candidates // quantum) * quantum)
-    return bucket, max_batch or 256
+
+    groups: dict[float, dict] = {}
+    for va in vas:
+        p = global_p
+        try:
+            target, _cls = find_model_slo_in_spec(
+                spec, va.spec.model_id,
+                preferred_class=class_by_key.get(
+                    va.spec.slo_class_ref.key, ""),
+            )
+            p = target.slo_ttft_percentile or global_p
+        except (KeyError, ValueError):
+            pass
+        group = groups.setdefault(p, {"candidates": 0, "max_batch": 0})
+        for ap in va.spec.model_profile.accelerators:
+            group["candidates"] += 1
+            group["max_batch"] = max(
+                group["max_batch"],
+                ap.max_batch_size if ap.max_batch_size > 0 else 256,
+            )
+    if not groups:
+        groups = {global_p: {"candidates": 0, "max_batch": 256}}
+    return [
+        (max(quantum, -(-g["candidates"] // quantum) * quantum),
+         g["max_batch"] or 256,
+         p or None)
+        for p, g in sorted(groups.items())
+    ]
+
+
+def _parse_percentile(raw, source: str) -> float | None:
+    """One validation rule for every TTFT-percentile knob: valid (0.5, 1)
+    value, or None — a typo must degrade to mean sizing (reference
+    behavior), never crash or silently misconfigure."""
+    try:
+        p = float(raw)
+    except (TypeError, ValueError):
+        log.warning("bad TTFT percentile, sizing on the mean",
+                    extra=kv(source=source, value=raw))
+        return None
+    if not 0.5 < p < 1.0:
+        log.warning("TTFT percentile out of range (0.5, 1); "
+                    "sizing on the mean", extra=kv(source=source, value=raw))
+        return None
+    return p
+
+
+def _valid_percentile(raw, source: str) -> float:
+    """Per-class slo-ttft-percentile from a service-class row; 0 = mean."""
+    if not raw:
+        return 0.0
+    return _parse_percentile(raw, f"service class {source}") or 0.0
 
 
 def ttft_percentile(operator_cm: dict[str, str] | None = None) -> float | None:
@@ -283,17 +338,7 @@ def ttft_percentile(operator_cm: dict[str, str] | None = None) -> float | None:
         or (operator_cm or {}).get("WVA_TTFT_PERCENTILE", "").strip()
     if not raw:
         return None
-    try:
-        p = float(raw)
-    except ValueError:
-        log.warning("bad WVA_TTFT_PERCENTILE, sizing on the mean",
-                    extra=kv(value=raw))
-        return None
-    if not 0.5 < p < 1.0:
-        log.warning("WVA_TTFT_PERCENTILE out of range (0.5, 1); "
-                    "sizing on the mean", extra=kv(value=raw))
-        return None
-    return p
+    return _parse_percentile(raw, "WVA_TTFT_PERCENTILE")
 
 
 def engine_backend() -> str:

@@ -174,6 +174,11 @@ class ModelTarget:
     slo_itl: float = 0.0   # msec
     slo_ttft: float = 0.0  # msec (queueing + prefill)
     slo_tps: float = 0.0   # tokens/sec
+    # Hold slo_ttft at this PERCENTILE of the TTFT distribution instead of
+    # its mean (ops.batched.size_batch_tail); 0 = mean sizing, or the
+    # global WVA_TTFT_PERCENTILE when that is set. Lets a Premium class
+    # buy a p95 guarantee while Freemium sizes on the mean.
+    slo_ttft_percentile: float = 0.0
 
 
 @dataclass(frozen=True)

@@ -130,19 +130,24 @@ class System:
         """
         for acc in self.accelerators.values():
             acc.calculate()
-        if backend == "scalar":
+        if backend in ("scalar", "native"):
             if mesh is not None:
                 raise ValueError("mesh sharding requires backend='batched'")
             if ttft_percentile is not None:
                 raise ValueError("ttft_percentile requires backend='batched'")
+            if any(t.slo_ttft_percentile
+                   for svc in self.service_classes.values()
+                   for t in svc.targets.values()):
+                from ..utils import get_logger
+
+                get_logger("wva.system").warning(
+                    "slo-ttft-percentile targets require the batched "
+                    "backend; sizing those classes on the mean")
+        if backend == "scalar":
             for server in self.servers.values():
                 server.calculate(self)
             return
         if backend == "native":
-            if mesh is not None:
-                raise ValueError("mesh sharding requires backend='batched'")
-            if ttft_percentile is not None:
-                raise ValueError("ttft_percentile requires backend='batched'")
             self._calculate_native()
             return
         self._calculate_batched(mesh=mesh, ttft_percentile=ttft_percentile)
@@ -188,6 +193,27 @@ class System:
 
     def _calculate_batched(self, mesh=None,
                            ttft_percentile: float | None = None) -> None:
+        pairs = self._candidate_pairs()
+        if not pairs:
+            return
+
+        # Group by the EFFECTIVE percentile — the service class's own
+        # slo-ttft-percentile, else the global knob — so Premium can buy a
+        # p95 guarantee while Freemium sizes on the mean in the same
+        # cycle. Each group is a shape-stable kernel call of its own
+        # (percentile is static in size_batch_tail); a homogeneous fleet
+        # degenerates to exactly one call as before.
+        groups: dict[float, list] = {}
+        for pair in pairs:
+            target = pair[3]
+            p = target.slo_ttft_percentile or (ttft_percentile or 0.0)
+            groups.setdefault(p, []).append(pair)
+        for p, group in groups.items():
+            self._size_group(group, mesh=mesh,
+                             ttft_percentile=(p or None))
+
+    def _size_group(self, pairs, mesh=None,
+                    ttft_percentile: float | None = None) -> None:
         import jax.numpy as jnp
 
         from ..ops.batched import (
@@ -199,10 +225,6 @@ class System:
             size_batch,
             size_batch_tail,
         )
-
-        pairs = self._candidate_pairs()
-        if not pairs:
-            return
 
         n_eff, alphas, betas, gammas, deltas, in_toks, out_toks = [], [], [], [], [], [], []
         ttfts, itls, tpss = [], [], []

@@ -606,14 +606,16 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     return cache_dir
 
 
-def warmup(max_batch: int = 256, bucket: int = 16, mesh=None) -> None:
+def warmup(max_batch: int = 256, bucket: int = 16, mesh=None,
+           ttft_percentile: float | None = None) -> None:
     """Pre-compile the sizing + re-analysis kernels at the shapes the
     reconcile loop will use (candidate axis bucketed by
-    System._calculate_batched, K from `max_batch`), so the first real
-    cycle runs at steady-state latency instead of stalling multiple
-    seconds in XLA. Call at controller startup, off the critical path —
-    e.g. while leader election is still contending. With a mesh, warms
-    the sharded executables instead (the ones the mesh path runs)."""
+    System._calculate_batched, K from `max_batch`, tail kernel when a
+    TTFT percentile is configured), so the first real cycle runs at
+    steady-state latency instead of stalling multiple seconds in XLA.
+    Call at controller startup, off the critical path — e.g. while leader
+    election is still contending. With a mesh, warms the sharded
+    executables instead (the ones the mesh path runs)."""
     b = bucket
     q = make_queue_batch(
         np.full(b, 7.0), np.full(b, 0.03), np.full(b, 5.0), np.full(b, 0.1),
@@ -629,8 +631,13 @@ def warmup(max_batch: int = 256, bucket: int = 16, mesh=None) -> None:
     if mesh is not None:
         from ..parallel import analyze_batch_sharded, size_batch_sharded
 
-        sized = size_batch_sharded(q, targets, k_max, mesh)
+        sized = size_batch_sharded(q, targets, k_max, mesh,
+                                   ttft_percentile=ttft_percentile)
         per_rep = analyze_batch_sharded(q, sized.throughput * 1000.0, k_max, mesh)
+    elif ttft_percentile is not None:
+        sized = size_batch_tail(q, targets, k_max,
+                                ttft_percentile=ttft_percentile)
+        per_rep = analyze_batch(q, sized.throughput * 1000.0, k_max)
     else:
         sized = size_batch(q, targets, k_max)
         per_rep = analyze_batch(q, sized.throughput * 1000.0, k_max)
