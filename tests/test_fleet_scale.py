@@ -1,0 +1,161 @@
+"""Fleet-scale reconcile: hundreds of variants through ONE batched kernel
+call per sizing group.
+
+The point of the TPU-native design: the reference sizes candidates in a
+sequential per-variant loop (server.Calculate per VA per accelerator);
+here the whole fleet is one XLA program, so cycle wall time stays flat as
+the fleet grows. This test drives a 256-variant fleet (512 candidates)
+through a full reconcile and bounds the steady-state cycle time.
+"""
+
+import json
+import time
+
+import pytest
+
+from workload_variant_autoscaler_tpu.collector import FakePromAPI
+from workload_variant_autoscaler_tpu.controller import (
+    ACCELERATOR_CM_NAME,
+    CONFIG_MAP_NAME,
+    CONFIG_MAP_NAMESPACE,
+    SERVICE_CLASS_CM_NAME,
+    ConfigMap,
+    Deployment,
+    InMemoryKube,
+    Reconciler,
+    crd,
+)
+from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
+
+N_VARIANTS = 256
+MODEL = "llama-8b"
+NS = "default"
+
+
+def big_cluster(arrival_rps: float = 30.0):
+    kube = InMemoryKube()
+    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
+                                 {"GLOBAL_OPT_INTERVAL": "60s"}))
+    kube.put_configmap(ConfigMap(
+        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {
+            "v5e-1": json.dumps({"chip": "v5e", "chips": "1", "cost": "20.0"}),
+            "v5e-4": json.dumps({"chip": "v5e", "chips": "4", "cost": "80.0"}),
+        },
+    ))
+    kube.put_configmap(ConfigMap(
+        SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"premium": (
+            "name: Premium\npriority: 1\ndata:\n"
+            f"  - model: {MODEL}\n    slo-tpot: 24\n    slo-ttft: 500\n"
+        )},
+    ))
+    prom = FakePromAPI()
+    from workload_variant_autoscaler_tpu.collector import (
+        arrival_rate_query,
+        avg_generation_tokens_query,
+        avg_itl_query,
+        avg_prompt_tokens_query,
+        avg_ttft_query,
+        true_arrival_rate_query,
+    )
+
+    for i in range(N_VARIANTS):
+        name = f"chat-{i}"
+        kube.put_deployment(Deployment(name=name, namespace=NS,
+                                       spec_replicas=1, status_replicas=1))
+        kube.put_variant_autoscaling(crd.VariantAutoscaling(
+            metadata=crd.ObjectMeta(name=name, namespace=NS,
+                                    labels={crd.ACCELERATOR_LABEL: "v5e-1"}),
+            spec=crd.VariantAutoscalingSpec(
+                model_id=MODEL,
+                slo_class_ref=crd.ConfigMapKeyRef(
+                    name=SERVICE_CLASS_CM_NAME, key="premium"),
+                model_profile=crd.ModelProfile(accelerators=[
+                    crd.AcceleratorProfile(
+                        acc="v5e-1", acc_count=1,
+                        perf_parms=crd.PerfParms(
+                            decode_parms={"alpha": "6.973", "beta": "0.027"},
+                            prefill_parms={"gamma": "5.2", "delta": "0.1"},
+                        ),
+                        max_batch_size=64,
+                    ),
+                    crd.AcceleratorProfile(
+                        acc="v5e-4", acc_count=1,
+                        perf_parms=crd.PerfParms(
+                            decode_parms={"alpha": "3.2", "beta": "0.012"},
+                            prefill_parms={"gamma": "2.4", "delta": "0.04"},
+                        ),
+                        max_batch_size=192,
+                    ),
+                ]),
+            ),
+        ))
+    # one shared load shape for all variants (FakePromAPI is keyed by the
+    # exact query string, same for every model/ns pair here)
+    prom.set_result(true_arrival_rate_query(MODEL, NS), arrival_rps)
+    prom.set_result(arrival_rate_query(MODEL, NS), arrival_rps)
+    prom.set_result(avg_prompt_tokens_query(MODEL, NS), 128.0)
+    prom.set_result(avg_generation_tokens_query(MODEL, NS), 128.0)
+    prom.set_result(avg_ttft_query(MODEL, NS), 0.2)
+    prom.set_result(avg_itl_query(MODEL, NS), 0.012)
+
+    emitter = MetricsEmitter()
+    rec = Reconciler(kube=kube, prom=prom, emitter=emitter,
+                     sleep=lambda _s: None)
+    return kube, emitter, rec
+
+
+class TestFleetScale:
+    def test_full_fleet_reconciles_in_one_kernel_call(self):
+        kube, emitter, rec = big_cluster()
+        result = rec.reconcile()  # first cycle pays the XLA compile
+        assert len(result.processed) == N_VARIANTS
+        assert not result.skipped
+
+        t0 = time.perf_counter()
+        result = rec.reconcile()  # steady state: compiled executables
+        wall_s = time.perf_counter() - t0
+        assert len(result.processed) == N_VARIANTS
+
+        # every variant got a recommendation and the conditions are green
+        for i in (0, N_VARIANTS // 2, N_VARIANTS - 1):
+            va = kube.get_variant_autoscaling(f"chat-{i}", NS)
+            assert va.status.desired_optimized_alloc.num_replicas >= 1
+            assert crd.is_condition_true(va, crd.TYPE_OPTIMIZATION_READY)
+
+        # the design claim: a 512-candidate fleet sizes in a handful of
+        # seconds, not minutes of per-variant loops (generous CI bound;
+        # observed ~1-2 s on a shared CPU runner)
+        assert wall_s < 20.0, f"steady-state cycle took {wall_s:.1f}s"
+
+    def test_kernel_call_count_is_per_group_not_per_variant(self, monkeypatch):
+        """The analyze stage must not degrade into a per-variant loop."""
+        import workload_variant_autoscaler_tpu.ops.batched as batched
+
+        calls = {"n": 0}
+        orig = batched.size_batch
+
+        def counting(*args, **kwargs):
+            calls["n"] += 1
+            return orig(*args, **kwargs)
+
+        kube, _emitter, rec = big_cluster()
+        monkeypatch.setattr(
+            "workload_variant_autoscaler_tpu.models.system.System._size_group",
+            _counting_size_group(calls),
+        )
+        rec.reconcile()
+        assert calls["n"] == 1  # one sizing group (all mean-sized)
+
+
+def _counting_size_group(calls):
+    from workload_variant_autoscaler_tpu.models.system import System
+
+    orig = System._size_group
+
+    def wrapper(self, pairs, **kwargs):
+        calls["n"] += 1
+        return orig(self, pairs, **kwargs)
+
+    return wrapper

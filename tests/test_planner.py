@@ -110,3 +110,45 @@ class TestCLI:
         bad.write_text("notalist: true\n")
         with pytest.raises(ValueError):
             load_options(str(bad))
+
+
+class TestPercentilePlanning:
+    def test_percentile_rate_below_mean_rate(self):
+        from workload_variant_autoscaler_tpu.ops.analyzer import TargetPerf
+        from workload_variant_autoscaler_tpu.planner import SliceOption, plan
+
+        opts = [SliceOption(acc="v5e-1", cost=20.0, alpha=6.973, beta=0.027,
+                            gamma=5.2, delta=0.1, max_batch=64)]
+        target = TargetPerf(ttft=500.0, itl=24.0)
+        mean = plan(opts, target, rate_rps=50.0, in_tokens=128, out_tokens=128)
+        p95 = plan(opts, target, rate_rps=50.0, in_tokens=128, out_tokens=128,
+                   ttft_percentile=0.95)
+        assert mean[0].feasible and p95[0].feasible
+        assert p95[0].max_rate_per_replica < mean[0].max_rate_per_replica
+        assert p95[0].replicas >= mean[0].replicas
+
+    def test_cli_flag(self, capsys):
+        import json as _json
+        import tempfile
+
+        from workload_variant_autoscaler_tpu.planner import main
+
+        with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
+            f.write("- acc: v5e-1\n  cost: 20.0\n  alpha: 6.973\n"
+                    "  beta: 0.027\n  gamma: 5.2\n  delta: 0.1\n"
+                    "  maxBatch: 64\n")
+            path = f.name
+        rc = main(["--profiles", path, "--rate", "50", "--slo-ttft", "500",
+                   "--slo-itl", "24", "--ttft-percentile", "0.95", "--json"])
+        assert rc == 0
+        rows = _json.loads(capsys.readouterr().out)
+        assert rows[0]["feasible"]
+
+    def test_cli_rejects_bad_percentile(self):
+        import pytest as _pytest
+
+        from workload_variant_autoscaler_tpu.planner import main
+
+        with _pytest.raises(SystemExit):
+            main(["--profiles", "x.yaml", "--rate", "1",
+                  "--ttft-percentile", "1.5"])

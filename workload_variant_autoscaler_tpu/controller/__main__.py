@@ -119,10 +119,15 @@ def main(argv=None) -> int:
                         mesh_size=mesh_size,
                     )
                 except Exception:  # noqa: BLE001
+                    # apiserver unreachable: the env-only percentile is
+                    # still readable — a percentile fleet must warm the
+                    # tail kernel, not the mean one
+                    from .translate import ttft_percentile as _global_pct
+
                     plan = [(
                         16 if mesh_size is None else math.lcm(16, mesh_size),
                         int(os.environ.get("WVA_WARMUP_MAX_BATCH", "256")),
-                        None,
+                        _global_pct(None),
                     )]
                 for bucket, max_batch, pct in plan:
                     warmup(max_batch=max_batch, bucket=bucket, mesh=mesh,

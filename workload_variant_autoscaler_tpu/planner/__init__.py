@@ -69,19 +69,68 @@ class PlanRow:
     utilization: float = 0.0            # rho at the planned rate
 
 
+def _tail_rates(
+    options: list[SliceOption], target: TargetPerf,
+    in_tokens: int, out_tokens: int, percentile: float,
+) -> list[float | None]:
+    """Per-option (by position — acc names may repeat across candidate
+    fits) max SLO-holding rate (req/sec) with the TTFT target at
+    `percentile` of the distribution (ops.batched.size_batch_tail) —
+    None = infeasible. One batched kernel call over all options."""
+    import numpy as np
+
+    from ..ops.batched import (
+        SLOTargets,
+        k_max_for,
+        make_queue_batch,
+        size_batch_tail,
+    )
+    import jax.numpy as jnp
+
+    q = make_queue_batch(
+        [o.alpha for o in options], [o.beta for o in options],
+        [o.gamma for o in options], [o.delta for o in options],
+        np.full(len(options), float(in_tokens)),
+        np.full(len(options), float(out_tokens)),
+        [o.max_batch for o in options],
+    )
+    d = q.alpha.dtype
+    b = len(options)
+    sized = size_batch_tail(
+        q,
+        SLOTargets(ttft=jnp.full(b, target.ttft, d),
+                   itl=jnp.full(b, target.itl, d),
+                   tps=jnp.full(b, target.tps, d)),
+        k_max_for([o.max_batch for o in options]),
+        ttft_percentile=percentile,
+    )
+    feasible = np.asarray(sized.feasible)
+    rate = np.asarray(sized.throughput) * 1000.0  # req/sec
+    return [
+        float(rate[i]) if feasible[i] and rate[i] > 0 else None
+        for i in range(len(options))
+    ]
+
+
 def plan(
     options: list[SliceOption],
     target: TargetPerf,
     rate_rps: float,
     in_tokens: int,
     out_tokens: int,
+    ttft_percentile: float | None = None,
 ) -> list[PlanRow]:
     """Size every slice option for the load; feasible rows sorted by fleet
-    cost (cheapest first), infeasible rows last."""
+    cost (cheapest first), infeasible rows last. With ttft_percentile,
+    the TTFT SLO is held at that percentile of the distribution (what
+    WVA_TTFT_PERCENTILE / slo-ttft-percentile would do in-cluster)."""
     import math
 
+    tail = (_tail_rates(options, target, in_tokens, out_tokens,
+                        ttft_percentile)
+            if ttft_percentile is not None and options else [])
     rows: list[PlanRow] = []
-    for opt in options:
+    for idx, opt in enumerate(options):
         try:
             analyzer = QueueAnalyzer(
                 QueueConfig(
@@ -101,6 +150,15 @@ def plan(
             continue
 
         rate_star = sized.metrics.throughput  # req/sec per replica
+        if ttft_percentile is not None:
+            tail_rate = tail[idx]
+            if tail_rate is None:
+                rows.append(PlanRow(
+                    acc=opt.acc, feasible=False,
+                    reason=f"p{ttft_percentile * 100:.0f} TTFT target "
+                           "infeasible on this slice"))
+                continue
+            rate_star = min(rate_star, tail_rate)
         # demand exactly as the controller computes it (a TPS SLO overrides
         # the observed rate, models/allocation.py replica_demand)
         demand_rps = replica_demand(rate_rps * 60.0, target.tps, out_tokens)
@@ -193,13 +251,19 @@ def main(argv=None) -> int:
     parser.add_argument("--slo-ttft", type=float, default=0.0, help="msec; 0 disables")
     parser.add_argument("--slo-itl", type=float, default=0.0, help="msec; 0 disables")
     parser.add_argument("--slo-tps", type=float, default=0.0, help="tokens/sec; 0 disables")
+    parser.add_argument("--ttft-percentile", type=float, default=None,
+                        help="hold --slo-ttft at this percentile of the "
+                             "TTFT distribution, e.g. 0.95 (default: mean)")
     parser.add_argument("--json", action="store_true", help="JSON instead of a table")
     args = parser.parse_args(argv)
 
+    if args.ttft_percentile is not None and not 0.5 < args.ttft_percentile < 1.0:
+        parser.error("--ttft-percentile must be in (0.5, 1)")
     rows = plan(
         load_options(args.profiles),
         TargetPerf(ttft=args.slo_ttft, itl=args.slo_itl, tps=args.slo_tps),
         args.rate, args.in_tokens, args.out_tokens,
+        ttft_percentile=args.ttft_percentile,
     )
     if args.json:
         print(json.dumps([asdict(r) for r in rows], indent=2))
