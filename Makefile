@@ -92,6 +92,11 @@ plan: ## Offline capacity planner (PROFILES=..., RATE=...; optional SLO_TTFT/SLO
 	$(PY) -m workload_variant_autoscaler_tpu.planner --profiles $(PROFILES) \
 		--rate $(RATE) --slo-ttft $(or $(SLO_TTFT),0) --slo-itl $(or $(SLO_ITL),0)
 
+.PHONY: fit
+fit: ## Fit alpha/beta/gamma/delta from live Prometheus (MODEL=..., optional PROM=, WINDOW=1h)
+	$(PY) -m workload_variant_autoscaler_tpu.fit --model $(MODEL) \
+		$(if $(PROM),--prom $(PROM) --allow-http-prom) --window $(or $(WINDOW),1h)
+
 ##@ Build & Deploy
 
 .PHONY: docker-build

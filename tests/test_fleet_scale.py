@@ -11,8 +11,6 @@ through a full reconcile and bounds the steady-state cycle time.
 import json
 import time
 
-import pytest
-
 from workload_variant_autoscaler_tpu.collector import FakePromAPI
 from workload_variant_autoscaler_tpu.controller import (
     ACCELERATOR_CM_NAME,
@@ -131,15 +129,7 @@ class TestFleetScale:
 
     def test_kernel_call_count_is_per_group_not_per_variant(self, monkeypatch):
         """The analyze stage must not degrade into a per-variant loop."""
-        import workload_variant_autoscaler_tpu.ops.batched as batched
-
         calls = {"n": 0}
-        orig = batched.size_batch
-
-        def counting(*args, **kwargs):
-            calls["n"] += 1
-            return orig(*args, **kwargs)
-
         kube, _emitter, rec = big_cluster()
         monkeypatch.setattr(
             "workload_variant_autoscaler_tpu.models.system.System._size_group",

@@ -49,6 +49,9 @@ class MetricFamily:
     generation_tokens: str
     ttft_seconds: str
     tpot_seconds: str
+    # in-service concurrency gauge (batch in decode) — observability and
+    # the profile fitter's x-axis, never load-gating
+    running: str | None = None
 
 
 VLLM_FAMILY = MetricFamily(
@@ -60,6 +63,7 @@ VLLM_FAMILY = MetricFamily(
     generation_tokens="vllm:request_generation_tokens",
     ttft_seconds="vllm:time_to_first_token_seconds",
     tpot_seconds="vllm:time_per_output_token_seconds",
+    running="vllm:num_requests_running",
 )
 
 # JetStream (MaxText serving) exports histograms for request lengths and
@@ -74,6 +78,7 @@ JETSTREAM_FAMILY = MetricFamily(
     generation_tokens="jetstream_request_output_length",
     ttft_seconds="jetstream_time_to_first_token",
     tpot_seconds="jetstream_time_per_output_token",
+    running="jetstream_slots_used",
 )
 
 METRIC_FAMILIES = {f.name: f for f in (VLLM_FAMILY, JETSTREAM_FAMILY)}
@@ -191,6 +196,35 @@ def avg_itl_query(
     family = family or active_family()
     return _ratio(f"{family.tpot_seconds}_sum", f"{family.tpot_seconds}_count",
                   model, namespace)
+
+
+def avg_running_query(
+    model: str, namespace: str, family: MetricFamily | None = None
+) -> str:
+    """In-service concurrency over the rate window — the profile fitter's
+    x-axis (decode latency is linear in batch). Empty for a dialect
+    without a running gauge."""
+    family = family or active_family()
+    if family.running is None:
+        return ""
+    return (
+        f'sum(avg_over_time({family.running}{{{LABEL_MODEL_NAME}="{model}",'
+        f'{LABEL_NAMESPACE}="{namespace}"}}[{RATE_WINDOW}]))'
+    )
+
+
+def avg_waiting_query(
+    model: str, namespace: str, family: MetricFamily | None = None
+) -> str:
+    """Queue depth over the rate window — the fitter uses near-zero
+    waiting samples to isolate prefill from queueing wait."""
+    family = family or active_family()
+    if family.queue_depth is None:
+        return ""
+    return (
+        f'sum(avg_over_time({family.queue_depth}{{{LABEL_MODEL_NAME}="{model}",'
+        f'{LABEL_NAMESPACE}="{namespace}"}}[{RATE_WINDOW}]))'
+    )
 
 
 def availability_query(

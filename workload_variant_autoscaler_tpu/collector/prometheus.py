@@ -108,22 +108,23 @@ class HTTPPromAPI:
                 return f.read().strip()
         return None
 
-    def query(self, promql: str) -> list[Sample]:
+    def _get(self, path: str, params: dict) -> dict:
         headers = {}
         token = self._bearer()
         if token:
             headers["Authorization"] = f"Bearer {token}"
         resp = self._session.get(
-            f"{self.config.base_url.rstrip('/')}/api/v1/query",
-            params={"query": promql},
-            headers=headers,
-            timeout=self.timeout,
+            f"{self.config.base_url.rstrip('/')}{path}",
+            params=params, headers=headers, timeout=self.timeout,
         )
         resp.raise_for_status()
         body = resp.json()
         if body.get("status") != "success":
             raise RuntimeError(f"prometheus query failed: {body}")
-        data = body.get("data", {})
+        return body.get("data", {})
+
+    def query(self, promql: str) -> list[Sample]:
+        data = self._get("/api/v1/query", {"query": promql})
         if data.get("resultType") != "vector":
             return []
         out = []
@@ -137,6 +138,28 @@ class HTTPPromAPI:
                 )
             )
         return out
+
+    def query_range(self, promql: str, start_s: float, end_s: float,
+                    step_s: float) -> list[Sample]:
+        """Flat time series of the FIRST result series (the collector's
+        aggregations always reduce to one) between start and end, one
+        Sample per step — the profile fitter's data feed."""
+        data = self._get("/api/v1/query_range", {
+            "query": promql, "start": start_s, "end": end_s,
+            "step": step_s,
+        })
+        if data.get("resultType") != "matrix" or not data.get("result"):
+            return []
+        series = data["result"][0]
+        labels = dict(series.get("metric", {}))
+        # NaN is passed through RAW, unlike the instant query: a 0/0
+        # window means 'unknown', and the fitter must be able to DROP it —
+        # scrubbing to 0.0 here would feed zero-latency ghosts into the
+        # regression
+        return [
+            Sample(labels=labels, value=float(val), timestamp=float(ts))
+            for ts, val in series.get("values", [])
+        ]
 
 
 class FakePromAPI:

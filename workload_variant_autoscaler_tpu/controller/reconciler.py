@@ -612,7 +612,8 @@ class Reconciler:
                  f"{reading.ttft_ratio and round(reading.ttft_ratio, 2)}) "
                  f"outside tolerance {tolerance} for {strikes} consecutive "
                  "cycles: re-fit the variant's perf profile "
-                 "(docs/tutorials/parameter-estimation.md)"),
+                 "(python -m workload_variant_autoscaler_tpu.fit, or "
+                 "docs/tutorials/parameter-estimation.md)"),
                 now=self.now(),
             )
 

@@ -39,13 +39,13 @@ class SinkSeries:
     kv: str
 
 
-def _sink_series(family, running: str, kv: str) -> SinkSeries:
+def _sink_series(family, kv: str) -> SinkSeries:
     """Derive the exported base names from the collector's MetricFamily,
     so the series the emulator emits and the series the collector queries
     cannot drift apart (counter bases get _total appended by
     prometheus_client — strip it; histogram fields are already bases).
-    `running`/`kv` are emulator observability extras the collector never
-    queries, hence not part of MetricFamily."""
+    `kv` is an emulator observability extra the collector never queries,
+    hence not part of MetricFamily."""
     def base(name):
         return name.removesuffix("_total") if name else None
 
@@ -56,7 +56,7 @@ def _sink_series(family, running: str, kv: str) -> SinkSeries:
         generation=family.generation_tokens,
         ttft=family.ttft_seconds,
         tpot=family.tpot_seconds,
-        running=running,
+        running=family.running,
         waiting=family.queue_depth,
         kv=kv,
     )
@@ -66,11 +66,8 @@ def _sink_families():
     from ..collector import JETSTREAM_FAMILY, VLLM_FAMILY
 
     return {
-        "vllm": _sink_series(VLLM_FAMILY,
-                             running="vllm:num_requests_running",
-                             kv="vllm:gpu_cache_usage_perc"),
+        "vllm": _sink_series(VLLM_FAMILY, kv="vllm:gpu_cache_usage_perc"),
         "jetstream": _sink_series(JETSTREAM_FAMILY,
-                                  running="jetstream_slots_used",
                                   kv="jetstream_kv_cache_utilization"),
     }
 

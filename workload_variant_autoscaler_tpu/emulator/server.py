@@ -177,6 +177,37 @@ def build_app(config: SliceModelConfig | None = None, with_prom_api: bool = Fals
             },
         })
 
+    async def prom_query_range(request: web.Request):
+        """Matrix endpoint over the shim's scrape history — lets the
+        profile fitter (wvat.fit) run against this one process."""
+        try:
+            promql = request.query.get("query", "")
+            start = float(request.query["start"])
+            end = float(request.query["end"])
+            step = float(request.query["step"])
+        except (KeyError, ValueError):
+            return web.json_response(
+                {"status": "error", "error": "start/end/step required"},
+                status=400)
+        if step <= 0 or end < start or (end - start) / step > 11_000:
+            # step<=0 would loop the sync shim forever ON the event loop;
+            # the point cap mirrors real Prometheus' 11k-sample limit
+            return web.json_response(
+                {"status": "error",
+                 "error": "need step > 0, end >= start, <= 11000 points"},
+                status=400)
+        samples = prom_shim.query_range(promql, start, end, step)
+        result = []
+        if samples:
+            result = [{
+                "metric": samples[0].labels,
+                "values": [[s.timestamp, str(s.value)] for s in samples],
+            }]
+        return web.json_response({
+            "status": "success",
+            "data": {"resultType": "matrix", "result": result},
+        })
+
     engine_task_key = web.AppKey("engine_task", asyncio.Task)
     scrape_task_key = web.AppKey("scrape_task", asyncio.Task)
 
@@ -200,6 +231,7 @@ def build_app(config: SliceModelConfig | None = None, with_prom_api: bool = Fals
     app.router.add_get("/metrics", metrics)
     if with_prom_api:
         app.router.add_get("/api/v1/query", prom_query)
+        app.router.add_get("/api/v1/query_range", prom_query_range)
     app.on_startup.append(start_background)
     app.on_cleanup.append(stop_background)
     return app

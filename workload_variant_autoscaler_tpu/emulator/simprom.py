@@ -11,12 +11,14 @@ sum(rate(a))/sum(rate(b)) ratios — over the sim clock.
 
 from __future__ import annotations
 
-from bisect import bisect_left
+from bisect import bisect_left, bisect_right
 from collections import deque
 
 from ..collector import (
     MetricFamily,
     arrival_rate_query,
+    avg_running_query,
+    avg_waiting_query,
     availability_query,
     avg_generation_tokens_query,
     avg_itl_query,
@@ -75,6 +77,11 @@ class SimPromAPI:
                 "ratio", (f"{fam.tpot_seconds}_sum",
                           f"{fam.tpot_seconds}_count")),
         }
+        if fam.running:
+            self._queries[avg_running_query(m, ns, fam)] = ("avg", fam.running)
+        if fam.queue_depth:
+            self._queries[avg_waiting_query(m, ns, fam)] = (
+                "avg", fam.queue_depth)
 
     # -- driven by the simulation ---------------------------------------
 
@@ -90,20 +97,29 @@ class SimPromAPI:
         empty vector, not zero."""
         return bool(self.history) and series in self.history[-1][1]
 
-    def _window(self) -> tuple[float, dict, float, dict] | None:
+    def _window(self, as_of: float | None = None):
+        """(t_now, latest, t_old, oldest) for the rate window ending at
+        `as_of` (default: the newest scrape) — historical evaluation is
+        what query_range replays."""
         if len(self.history) < 2:
             return None
-        t_now, latest = self.history[-1]
-        t_start = t_now - RATE_WINDOW_S
         times = [t for t, _ in self.history]
-        i = max(bisect_left(times, t_start) - 1, 0)
+        if as_of is None:
+            j = len(self.history) - 1
+        else:
+            j = bisect_right(times, as_of) - 1
+            if j < 1:
+                return None
+        t_now, latest = self.history[j]
+        t_start = t_now - RATE_WINDOW_S
+        i = max(bisect_left(times, t_start, 0, j) - 1, 0)
         t_old, oldest = self.history[i]
         if t_now <= t_old:
             return None
         return t_now, latest, t_old, oldest
 
-    def _rate(self, series: str) -> float:
-        w = self._window()
+    def _rate(self, series: str, as_of: float | None = None) -> float:
+        w = self._window(as_of)
         if w is None:
             return 0.0
         t_now, latest, t_old, oldest = w
@@ -111,16 +127,60 @@ class SimPromAPI:
             t_now - t_old
         )
 
-    def _deriv(self, series: str) -> float:
+    def _deriv(self, series: str, as_of: float | None = None) -> float:
         """PromQL deriv(): per-second slope of a gauge over the window
         (signed — a draining backlog derives negative)."""
-        w = self._window()
+        w = self._window(as_of)
         if w is None:
             return 0.0
         t_now, latest, t_old, oldest = w
         return (latest.get(series, 0.0) - oldest.get(series, 0.0)) / (
             t_now - t_old
         )
+
+    def _avg(self, series: str, as_of: float | None = None) -> float | None:
+        """PromQL avg_over_time() on a gauge: mean of the snapshots inside
+        the window. None when no snapshot exists there — a timestamp
+        before history began must read 'no data', never a fabricated
+        value from some other point in time."""
+        w = self._window(as_of)
+        if w is None:
+            return None
+        t_now = w[0]
+        vals = [snap.get(series, 0.0) for t, snap in self.history
+                if t_now - RATE_WINDOW_S < t <= t_now]
+        return sum(vals) / len(vals) if vals else None
+
+    def _eval(self, promql: str, as_of: float | None = None):
+        """Value of a registered query at a point in (scrape) time; None =
+        series absent (empty vector)."""
+        spec = self._queries.get(promql)
+        if spec is None:
+            return None
+        kind, payload = spec
+        if kind == "rate":
+            if not self._present(payload):
+                return None
+            return self._rate(payload, as_of)
+        if kind == "avg":
+            if not self._present(payload):
+                return None
+            return self._avg(payload, as_of)
+        if kind == "demand":
+            success, queue = payload
+            if not self._present(success):
+                return None
+            return self._rate(success, as_of) + max(
+                self._deriv(queue, as_of) if self._present(queue) else 0.0,
+                0.0)
+        num, den = payload
+        if not (self._present(num) and self._present(den)):
+            return None
+        den_rate = self._rate(den, as_of)
+        # 0/0 is NaN in PromQL: both series exist but nothing completed in
+        # the window — 'unknown', which the collector must not read as 0
+        return (self._rate(num, as_of) / den_rate if den_rate > 0
+                else float("nan"))
 
     def query(self, promql: str) -> list[Sample]:
         labels = {"model_name": self.model, "namespace": self.namespace}
@@ -136,29 +196,24 @@ class SimPromAPI:
                            value=self.history[-1][1].get(
                                self.family.success_total, 0.0),
                            timestamp=self.now_s)]
-        spec = self._queries.get(promql)
-        if spec is None:
+        value = self._eval(promql)
+        if value is None:
             return []
-        kind, payload = spec
-        if kind == "rate":
-            if not self._present(payload):
-                return []
-            return [Sample(labels=labels, value=self._rate(payload), timestamp=self.now_s)]
-        if kind == "demand":
-            success, queue = payload
-            if not self._present(success):
-                return []
-            value = self._rate(success) + max(
-                self._deriv(queue) if self._present(queue) else 0.0, 0.0)
-            return [Sample(labels=labels, value=value, timestamp=self.now_s)]
-        num, den = payload
-        if not (self._present(num) and self._present(den)):
-            return []
-        den_rate = self._rate(den)
-        # 0/0 is NaN in PromQL: both series exist but nothing completed in
-        # the window — 'unknown', which the collector must not read as 0
-        value = self._rate(num) / den_rate if den_rate > 0 else float("nan")
         return [Sample(labels=labels, value=value, timestamp=self.now_s)]
+
+    def query_range(self, promql: str, start_s: float, end_s: float,
+                    step_s: float) -> list[Sample]:
+        """Evaluate a registered query at each step over the scrape
+        history (the /api/v1/query_range the profile fitter feeds on)."""
+        labels = {"model_name": self.model, "namespace": self.namespace}
+        out: list[Sample] = []
+        t = start_s
+        while t <= end_s + 1e-9:
+            value = self._eval(promql, as_of=t)
+            if value is not None:
+                out.append(Sample(labels=labels, value=value, timestamp=t))
+            t += step_s
+        return out
 
 
 class MultiPromAPI:

@@ -1,0 +1,239 @@
+"""Online profile fitter: alpha/beta/gamma/delta from live Prometheus.
+
+The reference documents parameter estimation as a MANUAL offline
+procedure — run controlled batch-1 and batch-N benchmarks, derive the
+decode line by hand (docs/tutorials/parameter-estimation.md mirrors its
+tutorial at reference docs/tutorials/parameter-estimation.md:254-265).
+This module automates it against a LIVE serving endpoint: the natural
+load variation over an observation window sweeps the batch axis, and the
+per-window aggregates Prometheus already holds are enough to regress the
+same linear models the analyzer uses:
+
+    ITL(t)  = alpha + beta  * batch(t)                 (decode)
+    TTFT(t) = gamma + delta * in_tokens(t) * batch(t)  (prefill; fitted
+              only on samples with an empty queue, so queueing wait
+              cannot contaminate the prefill line)
+
+It is the closing move of the drift loop: PerfModelAccurate=False says
+"re-fit the profile"; this produces the re-fitted CRD patch.
+
+    python -m workload_variant_autoscaler_tpu.fit \
+        --prom http://prometheus:9090 --model llama-8b --namespace default \
+        --window 1h --step 30s [--replicas N] [--crd-patch]
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from ..collector import (
+    MetricFamily,
+    active_family,
+    avg_itl_query,
+    avg_prompt_tokens_query,
+    avg_running_query,
+    avg_ttft_query,
+    avg_waiting_query,
+)
+
+# Below this spread of observed batch sizes the decode line is
+# unidentifiable (any alpha/beta pair through one point fits) — refuse to
+# emit coefficients rather than emit garbage. The relative rule matters
+# as much as the absolute one: steady load under Poisson noise spreads a
+# few batch units around ONE operating point, which lets a line through
+# but with meaningless coefficients.
+MIN_BATCH_SPREAD = 2.0
+MIN_RELATIVE_SPREAD = 0.5   # (max-min)/mean
+MIN_SAMPLES = 8
+# A line that doesn't explain the data is withheld, not reported: noise
+# fits produce confidently-wrong coefficients.
+MIN_R2 = 0.9
+# A sample counts as queue-free for the prefill fit when the average
+# waiting depth over its window is below this.
+QUEUE_FREE_THRESHOLD = 0.5
+
+
+@dataclass(frozen=True)
+class FitSeries:
+    """Aligned observation vectors (one entry per range step that had all
+    required series)."""
+
+    t: list[float]
+    itl_ms: list[float]
+    ttft_ms: list[float]
+    batch: list[float]        # per-replica in-service concurrency
+    in_tokens: list[float]
+    waiting: list[float | None]  # None = queue depth unobserved that step
+
+
+@dataclass(frozen=True)
+class LineFit:
+    intercept: float
+    slope: float
+    r2: float
+    n: int
+
+
+@dataclass(frozen=True)
+class ProfileFit:
+    alpha: float | None     # msec
+    beta: float | None
+    gamma: float | None
+    delta: float | None
+    decode: LineFit | None
+    prefill: LineFit | None
+    batch_min: float
+    batch_max: float
+    notes: list[str]
+
+
+def collect_series(
+    prom, model: str, namespace: str, start_s: float, end_s: float,
+    step_s: float, replicas: int = 1, family: MetricFamily | None = None,
+) -> FitSeries:
+    """Pull the aligned (ITL, TTFT, batch, in_tokens, waiting) vectors
+    from /api/v1/query_range. `replicas` converts fleet-summed gauges to
+    per-replica values — fit against a single replica where possible."""
+    family = family or active_family()
+
+    def series(promql: str) -> dict[float, float]:
+        if not promql:
+            return {}
+        return {s.timestamp: s.value
+                for s in prom.query_range(promql, start_s, end_s, step_s)
+                if not math.isnan(s.value)}
+
+    itl = series(avg_itl_query(model, namespace, family))
+    ttft = series(avg_ttft_query(model, namespace, family))
+    running = series(avg_running_query(model, namespace, family))
+    in_tok = series(avg_prompt_tokens_query(model, namespace, family))
+    waiting = series(avg_waiting_query(model, namespace, family))
+
+    t, itl_v, ttft_v, batch_v, in_v, wait_v = [], [], [], [], [], []
+    for ts in sorted(set(itl) & set(ttft) & set(running) & set(in_tok)):
+        batch = running[ts] / max(replicas, 1)
+        if batch <= 0:
+            continue
+        t.append(ts)
+        itl_v.append(itl[ts] * 1000.0)    # sec -> msec
+        ttft_v.append(ttft[ts] * 1000.0)
+        batch_v.append(batch)
+        in_v.append(in_tok[ts])
+        # unknown queue depth stays unknown: assuming 0 would mark a
+        # possibly-congested sample queue-free and let wait contaminate
+        # the prefill line
+        w = waiting.get(ts)
+        wait_v.append(None if w is None else w / max(replicas, 1))
+    return FitSeries(t=t, itl_ms=itl_v, ttft_ms=ttft_v, batch=batch_v,
+                     in_tokens=in_v, waiting=wait_v)
+
+
+def _least_squares(x: list[float], y: list[float]) -> LineFit | None:
+    n = len(x)
+    if n < 2:
+        return None
+    mx = sum(x) / n
+    my = sum(y) / n
+    sxx = sum((xi - mx) ** 2 for xi in x)
+    if sxx <= 0:
+        return None
+    sxy = sum((xi - mx) * (yi - my) for xi, yi in zip(x, y))
+    slope = sxy / sxx
+    intercept = my - slope * mx
+    ss_res = sum((yi - (intercept + slope * xi)) ** 2 for xi, yi in zip(x, y))
+    ss_tot = sum((yi - my) ** 2 for yi in y)
+    r2 = 1.0 - ss_res / ss_tot if ss_tot > 0 else 1.0
+    return LineFit(intercept=intercept, slope=slope, r2=r2, n=n)
+
+
+def fit_profile(data: FitSeries) -> ProfileFit:
+    """Regress the analyzer's two linear models from the observations.
+    Coefficients are clamped non-negative (a negative intercept/slope is
+    always noise under this service model) and withheld entirely when the
+    data cannot identify the line."""
+    notes: list[str] = []
+    batch_min = min(data.batch) if data.batch else 0.0
+    batch_max = max(data.batch) if data.batch else 0.0
+    batch_mean = sum(data.batch) / len(data.batch) if data.batch else 0.0
+
+    def spread_ok(lo: float, hi: float, mean: float) -> bool:
+        return (hi - lo) >= max(MIN_BATCH_SPREAD,
+                                MIN_RELATIVE_SPREAD * mean)
+
+    def gated(fit: LineFit | None, line: str) -> LineFit | None:
+        if fit is not None and fit.r2 < MIN_R2:
+            notes.append(
+                f"{line} fit rejected: r2 {fit.r2:.2f} < {MIN_R2} — the "
+                "observations don't follow one line (mixed workloads, "
+                "noise, or load pinned at one operating point)")
+            return None
+        return fit
+
+    decode = None
+    if len(data.batch) < MIN_SAMPLES:
+        notes.append(
+            f"only {len(data.batch)} usable samples (<{MIN_SAMPLES}); "
+            "lengthen --window or --step density")
+    elif not spread_ok(batch_min, batch_max, batch_mean):
+        notes.append(
+            f"batch spread {batch_min:.1f}-{batch_max:.1f} too narrow to "
+            "identify the decode line; observe across more load variation")
+    else:
+        decode = gated(_least_squares(data.batch, data.itl_ms), "decode")
+
+    # prefill: PROVABLY queue-free samples only, x = in_tokens * batch
+    # (unknown queue depth excludes the sample — conservative direction)
+    qf = [(b * it, tt) for b, it, tt, w in
+          zip(data.batch, data.in_tokens, data.ttft_ms, data.waiting)
+          if w is not None and w <= QUEUE_FREE_THRESHOLD]
+    prefill = None
+    if len(qf) < MIN_SAMPLES:
+        notes.append(
+            f"only {len(qf)} queue-free samples for the prefill fit; "
+            "TTFT contaminated by queueing wait elsewhere")
+    else:
+        xs = [x for x, _ in qf]
+        mean_x = sum(xs) / len(xs)
+        if not spread_ok(min(xs), max(xs), mean_x):
+            notes.append("in_tokens*batch spread too narrow for the "
+                         "prefill line")
+        else:
+            prefill = gated(_least_squares(xs, [y for _, y in qf]),
+                            "prefill")
+
+    def pos(v: float | None) -> float | None:
+        return None if v is None else max(v, 0.0)
+
+    return ProfileFit(
+        alpha=pos(decode.intercept) if decode else None,
+        beta=pos(decode.slope) if decode else None,
+        gamma=pos(prefill.intercept) if prefill else None,
+        delta=(pos(prefill.slope) if prefill else None),
+        decode=decode,
+        prefill=prefill,
+        batch_min=batch_min,
+        batch_max=batch_max,
+        notes=notes,
+    )
+
+
+def crd_patch(fit: ProfileFit, acc: str) -> str:
+    """YAML strategic-merge snippet for the VariantAutoscaling profile
+    entry (apply with kubectl patch --type merge after review)."""
+    if fit.alpha is None or fit.gamma is None:
+        raise ValueError("fit incomplete; no patch emitted: "
+                         + "; ".join(fit.notes))
+    return (
+        "spec:\n"
+        "  modelProfile:\n"
+        "    accelerators:\n"
+        f"      - acc: {acc}\n"
+        "        perfParms:\n"
+        "          decodeParms:\n"
+        f"            alpha: \"{fit.alpha:.4f}\"\n"
+        f"            beta: \"{fit.beta:.5f}\"\n"
+        "          prefillParms:\n"
+        f"            gamma: \"{fit.gamma:.4f}\"\n"
+        f"            delta: \"{fit.delta:.5f}\"\n"
+    )
