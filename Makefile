@@ -93,9 +93,10 @@ plan: ## Offline capacity planner (PROFILES=..., RATE=...; optional SLO_TTFT/SLO
 		--rate $(RATE) --slo-ttft $(or $(SLO_TTFT),0) --slo-itl $(or $(SLO_ITL),0)
 
 .PHONY: fit
-fit: ## Fit alpha/beta/gamma/delta from live Prometheus (MODEL=..., optional PROM=, WINDOW=1h)
+fit: ## Fit alpha/beta/gamma/delta from live Prometheus (MODEL=..., optional PROM=, WINDOW=1h; ALLOW_HTTP=1 for emulator endpoints)
 	$(PY) -m workload_variant_autoscaler_tpu.fit --model $(MODEL) \
-		$(if $(PROM),--prom $(PROM) --allow-http-prom) --window $(or $(WINDOW),1h)
+		$(if $(PROM),--prom $(PROM)) $(if $(ALLOW_HTTP),--allow-http-prom) \
+		--window $(or $(WINDOW),1h)
 
 ##@ Build & Deploy
 

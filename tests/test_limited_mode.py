@@ -146,3 +146,34 @@ class TestLimitedReconcile:
         va = kube.get_variant_autoscaling("chat-8b", NS)
         assert crd.is_condition_true(va, crd.TYPE_OPTIMIZATION_READY)
         assert va.status.desired_optimized_alloc.num_replicas == 5
+
+
+class TestLimitedWithPercentileSizing:
+    def test_percentile_raises_demand_capacity_still_caps(self, monkeypatch):
+        """Composition: WVA_TTFT_PERCENTILE inflates the per-replica need
+        (stricter tail target -> lower rate* -> more replicas wanted) and
+        limited mode still caps at the inventory — the two features share
+        the same all_allocations path, so neither may bypass the other."""
+        set_load_rps = 120.0
+        # unlimited baseline, mean sizing: 5 replicas
+        kube, prom, _e, rec = limited_cluster(chips=64)
+        set_load(prom, "llama-8b", set_load_rps, 128.0, 128.0)
+        rec.reconcile()
+        mean_want = kube.get_variant_autoscaling(
+            "chat-8b", NS).status.desired_optimized_alloc.num_replicas
+
+        monkeypatch.setenv("WVA_TTFT_PERCENTILE", "0.95")
+        kube, prom, _e, rec = limited_cluster(chips=64)
+        set_load(prom, "llama-8b", set_load_rps, 128.0, 128.0)
+        rec.reconcile()
+        tail_want = kube.get_variant_autoscaling(
+            "chat-8b", NS).status.desired_optimized_alloc.num_replicas
+        assert tail_want > mean_want  # stricter target needs more replicas
+
+        kube, prom, _e, rec = limited_cluster(chips=3)
+        set_load(prom, "llama-8b", set_load_rps, 128.0, 128.0)
+        result = rec.reconcile()
+        assert not result.error
+        va = kube.get_variant_autoscaling("chat-8b", NS)
+        assert va.status.desired_optimized_alloc.num_replicas == 3
+        assert crd.is_condition_true(va, crd.TYPE_OPTIMIZATION_READY)

@@ -97,13 +97,17 @@ class SimPromAPI:
         empty vector, not zero."""
         return bool(self.history) and series in self.history[-1][1]
 
-    def _window(self, as_of: float | None = None):
+    def _window(self, as_of: float | None = None,
+                times: list[float] | None = None):
         """(t_now, latest, t_old, oldest) for the rate window ending at
         `as_of` (default: the newest scrape) — historical evaluation is
-        what query_range replays."""
+        what query_range replays. `times` lets range evaluation hoist the
+        timestamp list instead of rebuilding it O(history) per step (the
+        handler runs synchronously on the emulator's event loop)."""
         if len(self.history) < 2:
             return None
-        times = [t for t, _ in self.history]
+        if times is None:
+            times = [t for t, _ in self.history]
         if as_of is None:
             j = len(self.history) - 1
         else:
@@ -118,8 +122,9 @@ class SimPromAPI:
             return None
         return t_now, latest, t_old, oldest
 
-    def _rate(self, series: str, as_of: float | None = None) -> float:
-        w = self._window(as_of)
+    def _rate(self, series: str, as_of: float | None = None,
+              times: list[float] | None = None) -> float:
+        w = self._window(as_of, times)
         if w is None:
             return 0.0
         t_now, latest, t_old, oldest = w
@@ -127,10 +132,11 @@ class SimPromAPI:
             t_now - t_old
         )
 
-    def _deriv(self, series: str, as_of: float | None = None) -> float:
+    def _deriv(self, series: str, as_of: float | None = None,
+               times: list[float] | None = None) -> float:
         """PromQL deriv(): per-second slope of a gauge over the window
         (signed — a draining backlog derives negative)."""
-        w = self._window(as_of)
+        w = self._window(as_of, times)
         if w is None:
             return 0.0
         t_now, latest, t_old, oldest = w
@@ -138,12 +144,13 @@ class SimPromAPI:
             t_now - t_old
         )
 
-    def _avg(self, series: str, as_of: float | None = None) -> float | None:
+    def _avg(self, series: str, as_of: float | None = None,
+             times: list[float] | None = None) -> float | None:
         """PromQL avg_over_time() on a gauge: mean of the snapshots inside
         the window. None when no snapshot exists there — a timestamp
         before history began must read 'no data', never a fabricated
         value from some other point in time."""
-        w = self._window(as_of)
+        w = self._window(as_of, times)
         if w is None:
             return None
         t_now = w[0]
@@ -151,7 +158,8 @@ class SimPromAPI:
                 if t_now - RATE_WINDOW_S < t <= t_now]
         return sum(vals) / len(vals) if vals else None
 
-    def _eval(self, promql: str, as_of: float | None = None):
+    def _eval(self, promql: str, as_of: float | None = None,
+              times: list[float] | None = None):
         """Value of a registered query at a point in (scrape) time; None =
         series absent (empty vector)."""
         spec = self._queries.get(promql)
@@ -161,25 +169,26 @@ class SimPromAPI:
         if kind == "rate":
             if not self._present(payload):
                 return None
-            return self._rate(payload, as_of)
+            return self._rate(payload, as_of, times)
         if kind == "avg":
             if not self._present(payload):
                 return None
-            return self._avg(payload, as_of)
+            return self._avg(payload, as_of, times)
         if kind == "demand":
             success, queue = payload
             if not self._present(success):
                 return None
-            return self._rate(success, as_of) + max(
-                self._deriv(queue, as_of) if self._present(queue) else 0.0,
+            return self._rate(success, as_of, times) + max(
+                self._deriv(queue, as_of, times)
+                if self._present(queue) else 0.0,
                 0.0)
         num, den = payload
         if not (self._present(num) and self._present(den)):
             return None
-        den_rate = self._rate(den, as_of)
+        den_rate = self._rate(den, as_of, times)
         # 0/0 is NaN in PromQL: both series exist but nothing completed in
         # the window — 'unknown', which the collector must not read as 0
-        return (self._rate(num, as_of) / den_rate if den_rate > 0
+        return (self._rate(num, as_of, times) / den_rate if den_rate > 0
                 else float("nan"))
 
     def query(self, promql: str) -> list[Sample]:
@@ -206,10 +215,11 @@ class SimPromAPI:
         """Evaluate a registered query at each step over the scrape
         history (the /api/v1/query_range the profile fitter feeds on)."""
         labels = {"model_name": self.model, "namespace": self.namespace}
+        times = [t for t, _ in self.history]  # hoisted: O(history) once
         out: list[Sample] = []
         t = start_s
         while t <= end_s + 1e-9:
-            value = self._eval(promql, as_of=t)
+            value = self._eval(promql, as_of=t, times=times)
             if value is not None:
                 out.append(Sample(labels=labels, value=value, timestamp=t))
             t += step_s
