@@ -28,11 +28,12 @@ CFG = SliceModelConfig(
 )
 
 
-def observed_run(schedule, until_ms=720_000.0, seed=5):
-    sink = PrometheusSink("m", "default")
+def observed_run(schedule, until_ms=720_000.0, seed=5, family=None):
+    sink = PrometheusSink("m", "default",
+                          family=family.name if family else "vllm")
     fleet = Fleet(CFG, sink, replicas=1)
     sim = Simulation(fleet, seed=seed)
-    prom = SimPromAPI(sink, "m", "default")
+    prom = SimPromAPI(sink, "m", "default", family=family)
     gen = PoissonLoadGenerator(
         sim, schedule=schedule,
         tokens=TokenDistribution(avg_input_tokens=128, avg_output_tokens=128,
@@ -135,3 +136,19 @@ class TestRangeQueryWire:
                 await client.close()
 
         asyncio.run(t())
+
+
+class TestFitJetstreamDialect:
+    def test_collect_series_speaks_jetstream(self):
+        """The fitter works against a JetStream-shaped endpoint: family
+        threads through every range query (running gauge =
+        jetstream_slots_used, queue = prefill backlog)."""
+        from workload_variant_autoscaler_tpu.collector import JETSTREAM_FAMILY
+
+        prom = observed_run([(120, 120), (120, 720), (120, 1440)],
+                            until_ms=360_000.0, family=JETSTREAM_FAMILY)
+        data = collect_series(prom, "m", "default", 60.0, 360.0, 15.0,
+                              family=JETSTREAM_FAMILY)
+        assert len(data.t) >= 8
+        fit = fit_profile(data)
+        assert fit.alpha == pytest.approx(CFG.alpha, rel=0.15)

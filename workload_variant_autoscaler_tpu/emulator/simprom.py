@@ -154,9 +154,16 @@ class SimPromAPI:
         if w is None:
             return None
         t_now = w[0]
-        vals = [snap.get(series, 0.0) for t, snap in self.history
-                if t_now - RATE_WINDOW_S < t <= t_now]
-        return sum(vals) / len(vals) if vals else None
+        if times is None:
+            times = [t for t, _ in self.history]
+        # bisect the window bounds instead of rescanning all snapshots
+        # (this runs per range step on the emulator's event loop)
+        lo = bisect_right(times, t_now - RATE_WINDOW_S)
+        hi = bisect_right(times, t_now)
+        if hi <= lo:
+            return None
+        vals = [self.history[i][1].get(series, 0.0) for i in range(lo, hi)]
+        return sum(vals) / len(vals)
 
     def _eval(self, promql: str, as_of: float | None = None,
               times: list[float] | None = None):
