@@ -81,7 +81,19 @@ def bench_tpu(c, iters: int = 20):
     # run-to-run; the max is the robust estimate of device throughput.
     # All runs are returned so the recorded result carries the variance.
     runs = [once() for _ in range(3)]
-    return max(runs), runs
+
+    # percentile sizing (WVA_TTFT_PERCENTILE): the tail kernel adds a
+    # gammaincc mixture per bisection trip — record its throughput too
+    from workload_variant_autoscaler_tpu.ops.batched import size_batch_tail
+
+    jax.block_until_ready(size_batch_tail(q, targets, k_max,
+                                          ttft_percentile=0.95))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = size_batch_tail(q, targets, k_max, ttft_percentile=0.95)
+    jax.block_until_ready(out)
+    tail_rate = len(c["alpha"]) * iters / (time.perf_counter() - t0)
+    return max(runs), runs, tail_rate
 
 
 _XLA_STAGE = r"""
@@ -89,8 +101,9 @@ import json
 import jax
 from bench import bench_tpu, build_candidates
 platform = jax.devices()[0].platform
-rate, runs = bench_tpu(build_candidates(4096))
-print(json.dumps({"rate": rate, "runs": runs, "platform": platform}))
+rate, runs, tail_rate = bench_tpu(build_candidates(4096))
+print(json.dumps({"rate": rate, "runs": runs, "tail_rate": tail_rate,
+                  "platform": platform}))
 """
 
 
@@ -246,6 +259,8 @@ def main() -> None:
         "platform": xla["platform"],
         # tunnel variance: the three raw rates behind the best-of-3 value
         "runs": [round(r, 1) for r in xla["runs"]],
+        # percentile (p95 TTFT) sizing kernel at the same fleet scale
+        "tail_sizings_per_sec": round(xla.get("tail_rate", 0.0), 1),
         "pallas": pallas,
     }))
 
