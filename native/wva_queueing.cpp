@@ -63,7 +63,8 @@ std::vector<double> service_rates(const Params& p) {
 
 // Steady state in log space: logp[n] = n log(lam) - sum_{k<n} log(mu_k),
 // shifted by the max and normalised (ops/queueing.py:54-74).
-Stats solve(double lam, const std::vector<double>& serv_rate, int K) {
+std::vector<double> state_probs(double lam, const std::vector<double>& serv_rate,
+                                int K) {
   const int num = static_cast<int>(serv_rate.size());
   std::vector<double> logp(K + 1);
   logp[0] = 0.0;
@@ -82,6 +83,12 @@ Stats solve(double lam, const std::vector<double>& serv_rate, int K) {
     total += prob[n];
   }
   for (int n = 0; n <= K; ++n) prob[n] /= total;
+  return prob;
+}
+
+Stats solve(double lam, const std::vector<double>& serv_rate, int K) {
+  const int num = static_cast<int>(serv_rate.size());
+  std::vector<double> prob = state_probs(lam, serv_rate, K);
 
   Stats s{};
   double en = 0.0;
@@ -129,6 +136,56 @@ double itl_at(const Params& p, const std::vector<double>& rates, double lam) {
   return decode_time(p, conc);
 }
 
+// P(TTFT exceeds its percentile budget) at rate lam — the native twin of
+// ops/batched.py _tail_problem: prefill at the PERCENTILE of the
+// occupancy distribution plus the PASTA/Erlang queueing-wait tail. For
+// integer k the Erlang survival is the partial Poisson sum
+// Q(k, x) = e^-x sum_{i<k} x^i/i!, advanced by one term per state — the
+// whole mixture costs O(K), no special functions.
+double ttft_tail_at(const Params& p, const std::vector<double>& rates,
+                    double lam, double slo_ttft, double percentile) {
+  const int K = p.occupancy;
+  const int N = p.max_batch;
+  std::vector<double> prob = state_probs(lam, rates, K);
+
+  // occupancy percentile: #states whose cumulative prob stays below pct
+  double cum = 0.0;
+  int nq = 0;
+  for (int n = 0; n <= K; ++n) {
+    cum += prob[n];
+    if (cum < percentile) nq = n + 1;
+  }
+  const double bq = std::min(nq, N);
+  const double prefill_q = prefill_time(p, bq);
+  if (prefill_q >= slo_ttft) return 1.0;
+  const double threshold = slo_ttft - prefill_q;
+
+  double den = 0.0;  // accepted arrivals: states < K (state K is blocked)
+  for (int n = 0; n < K; ++n) den += prob[n];
+  if (den <= 0.0) return 0.0;
+
+  const double mu_n = rates.back();        // full-batch departure rate
+  const double x = mu_n * threshold;
+  double num_sum = 0.0;
+  if (x <= 0.0) {
+    for (int n = N; n < K; ++n) num_sum += prob[n];  // Q(k, 0) = 1
+  } else {
+    const double log_x = std::log(x);
+    double log_term = -x;  // log(e^-x x^0 / 0!)
+    double q = 0.0;        // Q(0, x) = 0
+    int k = 0;
+    for (int n = N; n < K; ++n) {
+      while (k < n - N + 1) {
+        q += std::exp(log_term);
+        ++k;
+        log_term += log_x - std::log(static_cast<double>(k));
+      }
+      num_sum += prob[n] * std::min(q, 1.0);
+    }
+  }
+  return num_sum / den;
+}
+
 bool within_tolerance(double x, double value) {
   if (x == value) return true;
   if (value == 0.0) return false;
@@ -143,14 +200,18 @@ struct SearchResult {
 };
 
 // Monotone bisection with boundary/region semantics (ops/search.py:39-81).
+// force_increasing: a tail probability can be 0 at BOTH boundaries, which
+// would mis-infer 'decreasing' and brand an always-satisfiable lane
+// infeasible (same guard as ops/batched.py _assemble_problem).
 template <typename F>
-SearchResult binary_search(double x_min, double x_max, double y_target, F eval) {
+SearchResult binary_search(double x_min, double x_max, double y_target, F eval,
+                           bool force_increasing = false) {
   const double y_lo = eval(x_min);
   if (within_tolerance(y_lo, y_target)) return {x_min, kIn};
   const double y_hi = eval(x_max);
   if (within_tolerance(y_hi, y_target)) return {x_max, kIn};
 
-  const bool increasing = y_lo < y_hi;
+  const bool increasing = force_increasing || y_lo < y_hi;
   if ((increasing && y_target < y_lo) || (!increasing && y_target > y_lo))
     return {x_min, kBelow};
   if ((increasing && y_target > y_hi) || (!increasing && y_target < y_hi))
@@ -256,6 +317,68 @@ void wva_size_batch(const double* alpha, const double* beta,
     int rc = wva_size(alpha[i], beta[i], gamma[i], delta[i], in_tokens[i],
                       out_tokens[i], max_batch[i], occupancy[i], ttft[i],
                       itl[i], tps[i], out + 11 * i);
+    feasible_out[i] = rc == 0 ? 1 : 0;
+    if (rc != 0)
+      for (int k = 0; k < 11; ++k) out[11 * i + k] = 0.0;
+  }
+}
+
+// Percentile-aware sizing (ops/batched.py size_batch_tail, natively): the
+// TTFT lane holds P(TTFT > slo) <= 1 - ttft_percentile instead of the
+// mean. Same out layout as wva_size.
+int wva_size_tail(double alpha, double beta, double gamma, double delta,
+                  int32_t in_tokens, int32_t out_tokens, int32_t max_batch,
+                  int32_t occupancy, double ttft_target, double itl_target,
+                  double tps_target, double ttft_percentile, double* out) {
+  if (max_batch <= 0 || out_tokens < 1 || in_tokens < 0) return -1;
+  if (!(ttft_percentile > 0.0 && ttft_percentile < 1.0)) return -1;
+  Params p{alpha, beta, gamma, delta, in_tokens, out_tokens, max_batch,
+           occupancy};
+  auto rates = service_rates(p);
+  const double lambda_min = rates.front() * kEpsilon;
+  const double lambda_max = rates.back() * (1.0 - kEpsilon);
+
+  double lam_ttft = lambda_max;
+  if (ttft_target > 0) {
+    auto r = binary_search(
+        lambda_min, lambda_max, 1.0 - ttft_percentile,
+        [&](double x) {
+          return ttft_tail_at(p, rates, x, ttft_target, ttft_percentile);
+        },
+        /*force_increasing=*/true);
+    if (r.indicator == kBelow) return 1;
+    lam_ttft = r.x_star;
+  }
+  double lam_itl = lambda_max;
+  if (itl_target > 0) {
+    auto r = binary_search(lambda_min, lambda_max, itl_target,
+                           [&](double x) { return itl_at(p, rates, x); });
+    if (r.indicator == kBelow) return 2;
+    lam_itl = r.x_star;
+  }
+  double lam_tps = lambda_max;
+  if (tps_target > 0) lam_tps = lambda_max * (1.0 - kStabilitySafetyFraction);
+
+  const double lam = std::min({lam_ttft, lam_itl, lam_tps});
+  out[0] = lam_ttft * 1000.0;
+  out[1] = lam_itl * 1000.0;
+  out[2] = lam_tps * 1000.0;
+  fill_metrics(p, rates, lam, lambda_max, out + 3);
+  return 0;
+}
+
+void wva_size_tail_batch(const double* alpha, const double* beta,
+                         const double* gamma, const double* delta,
+                         const int32_t* in_tokens, const int32_t* out_tokens,
+                         const int32_t* max_batch, const int32_t* occupancy,
+                         const double* ttft, const double* itl,
+                         const double* tps, double ttft_percentile, int32_t n,
+                         double* out, int32_t* feasible_out) {
+  for (int32_t i = 0; i < n; ++i) {
+    int rc = wva_size_tail(alpha[i], beta[i], gamma[i], delta[i],
+                           in_tokens[i], out_tokens[i], max_batch[i],
+                           occupancy[i], ttft[i], itl[i], tps[i],
+                           ttft_percentile, out + 11 * i);
     feasible_out[i] = rc == 0 ? 1 : 0;
     if (rc != 0)
       for (int k = 0; k < 11; ++k) out[11 * i + k] = 0.0;

@@ -139,8 +139,28 @@ class TestTailKernel:
         system, _ = make_system()
         with pytest.raises(ValueError):
             system.calculate(backend="scalar", ttft_percentile=0.95)
-        with pytest.raises(ValueError):
-            system.calculate(backend="native", ttft_percentile=0.95)
+
+    def test_native_backend_sizes_percentile(self):
+        """The C++ kernel carries the tail sizing too (wva_size_tail —
+        exact parity with the JAX path), so CPU-only controllers get the
+        same p95 guarantees."""
+        from workload_variant_autoscaler_tpu.ops import native
+
+        if not native.available():
+            pytest.skip("no native kernel in this environment")
+        from tests.helpers import make_system, server_spec
+
+        def rate(backend, pct):
+            system, _ = make_system(servers=[
+                server_spec(name="s:default", keep_accelerator=True)])
+            system.calculate(backend=backend, ttft_percentile=pct)
+            return system.servers["s:default"].all_allocations[
+                "v5e-1"].max_arrv_rate_per_replica
+
+        native_tail = rate("native", 0.95)
+        batched_tail = rate("batched", 0.95)
+        assert native_tail == pytest.approx(batched_tail, rel=1e-4)
+        assert native_tail < rate("native", None)  # stricter than mean
 
 
 class TestKnobParsing:

@@ -236,11 +236,6 @@ class Reconciler:
         optimizer_spec = system.set_from_spec(system_spec)
         engine_backend = translate.engine_backend()
         ttft_percentile = translate.ttft_percentile(operator_cm)
-        if ttft_percentile is not None and engine_backend != "batched":
-            log.warning("WVA_TTFT_PERCENTILE requires the batched backend; "
-                        "sizing on the mean",
-                        extra=kv(backend=engine_backend))
-            ttft_percentile = None
         system.calculate(backend=engine_backend,
                          mesh=translate.engine_mesh(engine_backend),
                          ttft_percentile=ttft_percentile)

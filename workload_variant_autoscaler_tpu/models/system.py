@@ -47,6 +47,21 @@ class AllocationByType:
     cost: float = 0.0
 
 
+def _percentile_groups(pairs, ttft_percentile: float | None):
+    """Sizing groups by EFFECTIVE percentile — the service class's own
+    slo-ttft-percentile, else the global knob, else mean (0.0) — so
+    Premium can buy a p95 guarantee while Freemium sizes on the mean in
+    the same cycle. One rule for the batched AND native backends (and
+    mirrored by controller/translate.warmup_plan); a homogeneous fleet
+    degenerates to exactly one group."""
+    groups: dict[float, list] = {}
+    for pair in pairs:
+        target = pair[3]
+        p = target.slo_ttft_percentile or (ttft_percentile or 0.0)
+        groups.setdefault(p, []).append(pair)
+    return groups
+
+
 class System:
     def __init__(self) -> None:
         self.accelerators: dict[str, Accelerator] = {}
@@ -130,25 +145,27 @@ class System:
         """
         for acc in self.accelerators.values():
             acc.calculate()
-        if backend in ("scalar", "native"):
+        if backend == "scalar":
             if mesh is not None:
                 raise ValueError("mesh sharding requires backend='batched'")
             if ttft_percentile is not None:
-                raise ValueError("ttft_percentile requires backend='batched'")
+                raise ValueError(
+                    "ttft_percentile requires backend='batched' or 'native'")
             if any(t.slo_ttft_percentile
                    for svc in self.service_classes.values()
                    for t in svc.targets.values()):
                 from ..utils import get_logger
 
                 get_logger("wva.system").warning(
-                    "slo-ttft-percentile targets require the batched "
-                    "backend; sizing those classes on the mean")
-        if backend == "scalar":
+                    "slo-ttft-percentile targets are not supported by the "
+                    "scalar backend; sizing those classes on the mean")
             for server in self.servers.values():
                 server.calculate(self)
             return
         if backend == "native":
-            self._calculate_native()
+            if mesh is not None:
+                raise ValueError("mesh sharding requires backend='batched'")
+            self._calculate_native(ttft_percentile=ttft_percentile)
             return
         self._calculate_batched(mesh=mesh, ttft_percentile=ttft_percentile)
 
@@ -197,18 +214,7 @@ class System:
         if not pairs:
             return
 
-        # Group by the EFFECTIVE percentile — the service class's own
-        # slo-ttft-percentile, else the global knob — so Premium can buy a
-        # p95 guarantee while Freemium sizes on the mean in the same
-        # cycle. Each group is a shape-stable kernel call of its own
-        # (percentile is static in size_batch_tail); a homogeneous fleet
-        # degenerates to exactly one call as before.
-        groups: dict[float, list] = {}
-        for pair in pairs:
-            target = pair[3]
-            p = target.slo_ttft_percentile or (ttft_percentile or 0.0)
-            groups.setdefault(p, []).append(pair)
-        for p, group in groups.items():
+        for p, group in _percentile_groups(pairs, ttft_percentile).items():
             self._size_group(group, mesh=mesh,
                              ttft_percentile=(p or None))
 
@@ -319,12 +325,13 @@ class System:
             alloc.value = alloc.cost
             self._value_and_store(server, acc_name, alloc)
 
-    def _calculate_native(self) -> None:
-        """All sized candidates through the C++ kernel: one FFI call for
-        SLO sizing, then per-replica re-analysis per feasible candidate
-        (native solves are ~0.1 ms, so the host loop is cheap)."""
+    def _calculate_native(self, ttft_percentile: float | None = None) -> None:
+        """All sized candidates through the C++ kernel: one FFI call per
+        sizing group (per effective TTFT percentile, mirroring the
+        batched path), then per-replica re-analysis per feasible
+        candidate (native solves are ~0.1 ms, so the host loop is
+        cheap)."""
         from ..ops import native
-        from ..ops.queueing import MAX_QUEUE_TO_BATCH_RATIO
 
         if not native.available():
             raise RuntimeError(
@@ -334,6 +341,13 @@ class System:
         pairs = self._candidate_pairs()
         if not pairs:
             return
+        for p, group in _percentile_groups(pairs, ttft_percentile).items():
+            self._native_size_group(group, ttft_percentile=(p or None))
+
+    def _native_size_group(self, pairs,
+                           ttft_percentile: float | None = None) -> None:
+        from ..ops import native
+        from ..ops.queueing import MAX_QUEUE_TO_BATCH_RATIO
 
         n_eff = [
             effective_batch_size(profile, server.max_batch_size,
@@ -352,6 +366,7 @@ class System:
             [t.slo_ttft for _s, _a, _p, t in pairs],
             [t.slo_itl for _s, _a, _p, t in pairs],
             [t.slo_tps for _s, _a, _p, t in pairs],
+            ttft_percentile=ttft_percentile,
         )
         rate_star = out[:, 3]  # throughput (req/sec) at the binding rate
 

@@ -70,20 +70,29 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(str(path))
-        except OSError:
+
+            D, I = ctypes.c_double, ctypes.c_int32
+            PD = ctypes.POINTER(ctypes.c_double)
+            PI = ctypes.POINTER(ctypes.c_int32)
+            lib.wva_analyze.restype = ctypes.c_int
+            lib.wva_analyze.argtypes = [D, D, D, D, I, I, I, I, D, PD]
+            lib.wva_size.restype = ctypes.c_int
+            lib.wva_size.argtypes = [D, D, D, D, I, I, I, I, D, D, D, PD]
+            lib.wva_size_batch.restype = None
+            lib.wva_size_batch.argtypes = [PD, PD, PD, PD, PI, PI, PI, PI,
+                                           PD, PD, PD, I, PD, PI]
+            lib.wva_size_tail.restype = ctypes.c_int
+            lib.wva_size_tail.argtypes = [D, D, D, D, I, I, I, I,
+                                          D, D, D, D, PD]
+            lib.wva_size_tail_batch.restype = None
+            lib.wva_size_tail_batch.argtypes = [PD, PD, PD, PD, PI, PI, PI, PI,
+                                                PD, PD, PD, D, I, PD, PI]
+        except (OSError, AttributeError):
+            # AttributeError = a symbol is missing: WVA_NATIVE_LIB points
+            # at a .so built from an older source. Fall back (callers log
+            # 'kernel unavailable'), never crash the reconcile loop.
             _load_failed = True
             return None
-
-        D, I = ctypes.c_double, ctypes.c_int32
-        PD = ctypes.POINTER(ctypes.c_double)
-        PI = ctypes.POINTER(ctypes.c_int32)
-        lib.wva_analyze.restype = ctypes.c_int
-        lib.wva_analyze.argtypes = [D, D, D, D, I, I, I, I, D, PD]
-        lib.wva_size.restype = ctypes.c_int
-        lib.wva_size.argtypes = [D, D, D, D, I, I, I, I, D, D, D, PD]
-        lib.wva_size_batch.restype = None
-        lib.wva_size_batch.argtypes = [PD, PD, PD, PD, PI, PI, PI, PI,
-                                       PD, PD, PD, I, PD, PI]
         _lib = lib
         return _lib
 
@@ -160,10 +169,13 @@ class NativeQueueAnalyzer:
 
 
 def size_batch_native(alpha, beta, gamma, delta, in_tokens, out_tokens,
-                      max_batch, occupancy, ttft, itl, tps):
+                      max_batch, occupancy, ttft, itl, tps,
+                      ttft_percentile=None):
     """Vectorized sizing over n candidates via one FFI call. Returns
     (out[n, 11], feasible[n]) — out rows are [rate_ttft, rate_itl,
-    rate_tps, 8 metric slots]."""
+    rate_tps, 8 metric slots]. With ttft_percentile, the TTFT lane holds
+    that percentile of the TTFT distribution (wva_size_tail — the native
+    twin of ops.batched.size_batch_tail, exact-parity-validated)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native queueing kernel unavailable")
@@ -184,13 +196,19 @@ def size_batch_native(alpha, beta, gamma, delta, in_tokens, out_tokens,
 
     PD = ctypes.POINTER(ctypes.c_double)
     PI = ctypes.POINTER(ctypes.c_int32)
-    lib.wva_size_batch(
+    common = (
         alpha.ctypes.data_as(PD), beta.ctypes.data_as(PD),
         gamma.ctypes.data_as(PD), delta.ctypes.data_as(PD),
         in_tokens.ctypes.data_as(PI), out_tokens.ctypes.data_as(PI),
         max_batch.ctypes.data_as(PI), occupancy.ctypes.data_as(PI),
         ttft.ctypes.data_as(PD), itl.ctypes.data_as(PD),
-        tps.ctypes.data_as(PD), n,
-        out.ctypes.data_as(PD), feasible.ctypes.data_as(PI),
+        tps.ctypes.data_as(PD),
     )
+    if ttft_percentile is None:
+        lib.wva_size_batch(*common, n, out.ctypes.data_as(PD),
+                           feasible.ctypes.data_as(PI))
+    else:
+        lib.wva_size_tail_batch(*common, float(ttft_percentile), n,
+                                out.ctypes.data_as(PD),
+                                feasible.ctypes.data_as(PI))
     return out, feasible.astype(bool)
