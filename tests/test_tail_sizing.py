@@ -347,3 +347,49 @@ class TestPerClassPercentile:
         override_rate = rate_for(0.99, 0.95)
         assert global_rate < mean_rate
         assert override_rate < global_rate  # p99 stricter than global p95
+
+
+class TestErlangIdentity:
+    def test_partial_poisson_sum_matches_gammaincc(self):
+        """The tail kernel's log-space partial-Poisson cumsum must equal
+        the regularized upper incomplete gamma for integer k (the
+        identity both it and the C++ kernel rely on)."""
+        import numpy as np
+        from jax.scipy.special import gammaincc
+
+        from workload_variant_autoscaler_tpu.ops.batched import (
+            _cum_log_mu,
+            _full_batch_mu,
+            _probs,
+            _transition_rates,
+            make_queue_batch,
+            wait_tail_probability,
+        )
+
+        rng = np.random.default_rng(3)
+        b = 64
+        q = make_queue_batch(
+            rng.uniform(4, 8, b), rng.uniform(0.01, 0.05, b),
+            rng.uniform(2, 6, b), rng.uniform(0.05, 0.15, b),
+            np.full(b, 128.0), np.full(b, 128.0), rng.integers(4, 65, b),
+        )
+        k = k_max_for(np.full(b, 64))
+        clm = _cum_log_mu(_transition_rates(q, k))
+        lam = jnp.asarray(rng.uniform(0.001, 0.02, b))
+        thr = jnp.asarray(rng.uniform(0.0, 400.0, b))
+        got = np.asarray(wait_tail_probability(q, clm, lam, k, thr))
+
+        p = np.asarray(_probs(q, clm, lam, k))
+        states = np.arange(k + 1)[None, :]
+        at_n = np.asarray(q.max_batch)[:, None]
+        accepted = states < np.asarray(q.occupancy)[:, None]
+        waiting = accepted & (states >= at_n)
+        k_ahead = np.clip(states - at_n + 1, 1, None).astype(float)
+        x = np.asarray(_full_batch_mu(q))[:, None] * \
+            np.maximum(np.asarray(thr), 0.0)[:, None]
+        g = np.asarray(gammaincc(jnp.asarray(k_ahead),
+                                 jnp.asarray(np.broadcast_to(x, k_ahead.shape))))
+        ref = np.sum(np.where(waiting, p * g, 0.0), axis=1) / np.maximum(
+            np.sum(np.where(accepted, p, 0.0), axis=1), 1e-300)
+        # exact identity at f64 (conftest enables x64); 1e-6-level at f32
+        np.testing.assert_allclose(got, ref, atol=1e-12)

@@ -308,22 +308,39 @@ def wait_tail_probability(
     By PASTA an arrival sees the steady-state distribution p_n. Accepted
     in state n >= N (batch full), it enters service after n-N+1 departures,
     each ~ Exp(mu_N) at the full-batch rate, so W | n ~ Erlang(n-N+1, mu_N)
-    and P(W > t) = sum_{N<=n<K} p_n Q(n-N+1, mu_N t) / P(n < K), with Q the
-    regularized upper incomplete gamma. This is the distribution the
-    reference's dead percentile code (allocation.go:117) APPROXIMATES as a
-    single exponential; the exact mixture costs one gammaincc sweep over
-    the state axis."""
-    from jax.scipy.special import gammaincc
+    and P(W > t) = sum_{N<=n<K} p_n Q(n-N+1, mu_N t) / P(n < K). This is
+    the distribution the reference's dead percentile code
+    (allocation.go:117) APPROXIMATES as a single exponential.
 
+    For integer k the Erlang survival is the partial Poisson sum
+    Q(k, x) = e^-x sum_{i<k} x^i/i!, so ALL k values per lane come from
+    one log-space cumsum over the state axis — elementwise exp + cumsum
+    instead of a transcendental gammaincc per element (~3x faster on TPU;
+    the C++ kernel uses the same identity, wva_queueing.cpp
+    ttft_tail_at)."""
     dtype = clm.dtype
     p = _probs(q, clm, lam, k_max)
     states = jnp.arange(k_max + 1)[None, :]
     at_n = q.max_batch[:, None]
     accepted = states < q.occupancy[:, None]   # state K arrivals are blocked
     waiting = accepted & (states >= at_n)
-    k_ahead = jnp.clip(states - at_n + 1, 1).astype(dtype)
-    x = _full_batch_mu(q)[:, None] * jnp.maximum(threshold_ms, 0.0)[:, None]
-    tail = gammaincc(k_ahead, jnp.broadcast_to(x, k_ahead.shape))
+    x = _full_batch_mu(q) * jnp.maximum(threshold_ms, 0.0)       # [B]
+    safe_x = jnp.maximum(x, jnp.finfo(dtype).tiny)[:, None]
+    # log term_i = -x + sum_{j<=i} (log x - log j), built from SMALL
+    # per-step increments: the direct form i*log(x) - lgamma(i+1)
+    # cancels two ~4e3 quantities at i~700 and loses ~5x precision in
+    # float32 (the TPU dtype); the increment cumsum keeps every operand
+    # O(log K)
+    i1 = jnp.arange(1, k_max, dtype=dtype)[None, :]              # 1..K-1
+    incr = jnp.log(safe_x) - jnp.log(i1)                         # [B, K-1]
+    log_terms = -safe_x + jnp.concatenate(
+        [jnp.zeros((q.batch_size, 1), dtype), jnp.cumsum(incr, axis=1)],
+        axis=1)                                                  # [B, K]
+    q_cum = jnp.clip(jnp.cumsum(jnp.exp(log_terms), axis=1), 0.0, 1.0)
+    k_ahead = jnp.clip(states - at_n + 1, 1)                     # [B, K+1]
+    tail = jnp.take_along_axis(
+        q_cum, jnp.minimum(k_ahead - 1, k_max - 1), axis=1)      # Q(k, x)
+    tail = jnp.where(x[:, None] <= 0, jnp.ones_like(tail), tail)  # Q(k,0)=1
     num = jnp.sum(jnp.where(waiting, p * tail, 0.0), axis=1)
     den = jnp.sum(jnp.where(accepted, p, 0.0), axis=1)
     return num / jnp.maximum(den, jnp.finfo(dtype).tiny)
@@ -442,8 +459,8 @@ def _tail_problem(q: QueueBatch, targets: SLOTargets, k_max: int,
     enabled = jnp.concatenate([targets.ttft > 0, targets.itl > 0])
 
     def eval_y(lam2):
-        # each half on its own [B] problem — the gammaincc sweep (the
-        # expensive new op) runs only on the TTFT lanes, never on the ITL
+        # each half on its own [B] problem — the Erlang tail sweep (the
+        # expensive op) runs only on the TTFT lanes, never on the ITL
         # half whose result a stacked where() would just discard
         lam_t, lam_i = lam2[:b], lam2[b:]
         p = _probs(q, clm, lam_t, k_max)
