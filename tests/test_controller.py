@@ -831,3 +831,70 @@ class TestWarmupPlan:
         )
 
         assert warmup_plan([]) == [(16, 256, None)]
+
+
+class TestTpuRuntimeGauges:
+    """collect_tpu_utilization wired into the cycle: duty-cycle/HBM from
+    the cluster's TPU monitoring re-exported next to the scaling signals
+    (the north star's libtpu-metrics scrape); absent series cost nothing
+    and gate nothing."""
+
+    def test_present_series_reexported(self):
+        from workload_variant_autoscaler_tpu.collector.collector import (
+            TPU_DUTY_CYCLE,
+            TPU_HBM_USAGE,
+        )
+
+        kube, prom, emitter, rec = make_cluster(arrival_rps=5.0)
+        prom.set_result(f'avg({TPU_DUTY_CYCLE}{{namespace="{NS}"}})', 62.5)
+        prom.set_result(f'sum({TPU_HBM_USAGE}{{namespace="{NS}"}})', 12.0e9)
+        rec.reconcile()
+        assert emitter.value("inferno_tpu_duty_cycle_percent",
+                             namespace=NS) == 62.5
+        assert emitter.value("inferno_tpu_hbm_usage_bytes",
+                             namespace=NS) == 12.0e9
+
+    def test_absent_series_do_not_gate_the_cycle(self):
+        from workload_variant_autoscaler_tpu.collector.collector import (
+            TPU_DUTY_CYCLE,
+            TPU_HBM_USAGE,
+        )
+
+        kube, prom, emitter, rec = make_cluster(arrival_rps=5.0)
+        prom.set_empty(f'avg({TPU_DUTY_CYCLE}{{namespace="{NS}"}})')
+        prom.set_empty(f'sum({TPU_HBM_USAGE}{{namespace="{NS}"}})')
+        result = rec.reconcile()
+        assert result.processed  # cycle proceeded
+        assert emitter.value("inferno_tpu_duty_cycle_percent",
+                             namespace=NS) is None
+
+    def test_stale_namespace_series_cleared(self):
+        from workload_variant_autoscaler_tpu.collector.collector import (
+            TPU_DUTY_CYCLE,
+        )
+
+        kube, prom, emitter, rec = make_cluster(arrival_rps=5.0)
+        prom.set_result(f'avg({TPU_DUTY_CYCLE}{{namespace="{NS}"}})', 62.5)
+        rec.reconcile()
+        assert emitter.value("inferno_tpu_duty_cycle_percent",
+                             namespace=NS) == 62.5
+        # upstream exporter goes away: the gauge must not serve 62.5 forever
+        prom.set_empty(f'avg({TPU_DUTY_CYCLE}{{namespace="{NS}"}})')
+        rec.reconcile()
+        assert emitter.value("inferno_tpu_duty_cycle_percent",
+                             namespace=NS) is None
+
+    def test_nan_sample_is_unknown_not_zero(self):
+        from workload_variant_autoscaler_tpu.collector import (
+            collect_tpu_utilization,
+        )
+        from workload_variant_autoscaler_tpu.collector.collector import (
+            TPU_DUTY_CYCLE,
+        )
+        from workload_variant_autoscaler_tpu.collector import FakePromAPI
+
+        prom = FakePromAPI()
+        prom.set_result(f'avg({TPU_DUTY_CYCLE}{{namespace="{NS}"}})',
+                        float("nan"))
+        util = collect_tpu_utilization(prom, NS)
+        assert "duty_cycle_percent" not in util  # unknown, never 0.0

@@ -463,16 +463,20 @@ def collect_inventory_k8s(kube) -> dict[str, int]:
 
 
 def collect_tpu_utilization(prom: PromAPI, namespace: str) -> dict[str, float]:
-    """Opportunistic TPU runtime gauges; absent series yield {} (these are
+    """Opportunistic TPU runtime gauges; absent OR unusable (NaN/Inf)
+    series are simply omitted from the dict — unknown must stay
+    distinguishable from a genuine 0 reading (these are
     observability-only, never gating)."""
     out: dict[str, float] = {}
     try:
-        duty = prom.query(f'avg({TPU_DUTY_CYCLE}{{{LABEL_NAMESPACE}="{namespace}"}})')
-        if duty:
-            out["duty_cycle_percent"] = fix_value(duty[0].value)
-        hbm = prom.query(f'sum({TPU_HBM_USAGE}{{{LABEL_NAMESPACE}="{namespace}"}})')
-        if hbm:
-            out["hbm_usage_bytes"] = fix_value(hbm[0].value)
+        duty = _value_or_none(
+            prom, f'avg({TPU_DUTY_CYCLE}{{{LABEL_NAMESPACE}="{namespace}"}})')
+        if duty is not None:
+            out["duty_cycle_percent"] = duty
+        hbm = _value_or_none(
+            prom, f'sum({TPU_HBM_USAGE}{{{LABEL_NAMESPACE}="{namespace}"}})')
+        if hbm is not None:
+            out["hbm_usage_bytes"] = hbm
     except Exception:  # noqa: BLE001
         return out
     return out

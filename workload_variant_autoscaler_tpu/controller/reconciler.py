@@ -544,6 +544,15 @@ class Reconciler:
             prepared.append((va, deploy))
             result.processed.append(key)
         self.emitter.emit_drift_metrics(drift_samples)
+        # TPU runtime gauges (duty cycle / HBM) per serving namespace,
+        # opportunistic: absent series cost one empty query and gate
+        # nothing (north star: "libtpu metrics" next to the vllm scrape)
+        from ..collector import collect_tpu_utilization
+
+        self.emitter.emit_tpu_utilization_metrics({
+            ns: collect_tpu_utilization(self.prom, ns)
+            for ns in {deploy.namespace for _va, deploy in prepared}
+        })
         return prepared
 
     # consecutive out-of-tolerance cycles before the condition flips: one

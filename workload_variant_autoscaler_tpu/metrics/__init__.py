@@ -111,6 +111,8 @@ INFERNO_RECONCILE_STAGE_DURATION_MSEC = "inferno_reconcile_stage_duration_msec"
 INFERNO_VARIANT_POWER_WATTS = "inferno_variant_power_watts"
 INFERNO_FLEET_POWER_WATTS = "inferno_fleet_power_watts"
 INFERNO_MODEL_DRIFT_RATIO = "inferno_model_drift_ratio"
+INFERNO_TPU_DUTY_CYCLE = "inferno_tpu_duty_cycle_percent"
+INFERNO_TPU_HBM_USAGE = "inferno_tpu_hbm_usage_bytes"
 
 LABEL_METRIC = "metric"
 
@@ -192,6 +194,19 @@ class MetricsEmitter:
             "Modeled power draw of the whole optimized fleet",
             registry=self.registry,
         )
+        # TPU runtime observability re-exported next to the scaling
+        # signals (the north star's "libtpu metrics" scrape: duty cycle /
+        # HBM from tpu-monitoring-library, when the cluster exports them)
+        self.tpu_duty_cycle = Gauge(
+            INFERNO_TPU_DUTY_CYCLE,
+            "Average TPU tensorcore duty cycle over the serving namespace",
+            [LABEL_NAMESPACE], registry=self.registry,
+        )
+        self.tpu_hbm_usage = Gauge(
+            INFERNO_TPU_HBM_USAGE,
+            "Total TPU HBM usage over the serving namespace",
+            [LABEL_NAMESPACE], registry=self.registry,
+        )
         # perf-model drift (beyond-reference: the reference never compares
         # its scraped latencies against its own queueing model)
         self.model_drift = Gauge(
@@ -224,6 +239,26 @@ class MetricsEmitter:
                 }).set(watts)
                 total += watts
             self.fleet_power.set(total)
+
+    def emit_tpu_utilization_metrics(
+        self, per_namespace: dict[str, dict[str, float]]
+    ) -> None:
+        """Replace the TPU runtime gauges wholesale each cycle (same
+        invariant as the power/drift series): a namespace whose upstream
+        series disappeared — or that dropped out of the fleet — must stop
+        exporting its last reading, not serve it forever."""
+        with self._lock:
+            self.tpu_duty_cycle.clear()
+            self.tpu_hbm_usage.clear()
+            for namespace, util in per_namespace.items():
+                if "duty_cycle_percent" in util:
+                    self.tpu_duty_cycle.labels(
+                        **{LABEL_NAMESPACE: namespace}
+                    ).set(util["duty_cycle_percent"])
+                if "hbm_usage_bytes" in util:
+                    self.tpu_hbm_usage.labels(
+                        **{LABEL_NAMESPACE: namespace}
+                    ).set(util["hbm_usage_bytes"])
 
     def emit_drift_metrics(
         self, per_variant: dict[tuple[str, str, str], float]
