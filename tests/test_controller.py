@@ -898,3 +898,68 @@ class TestTpuRuntimeGauges:
                         float("nan"))
         util = collect_tpu_utilization(prom, NS)
         assert "duty_cycle_percent" not in util  # unknown, never 0.0
+
+
+class TestConditionMetrics:
+    """CR conditions exported as inferno_condition_status (kube-state-
+    metrics shape, no kube-state-metrics needed): 1=True, 0=False,
+    wholesale-replaced so deleted variants' series disappear."""
+
+    def test_green_cycle_exports_true_conditions(self):
+        kube, _p, emitter, rec = make_cluster(arrival_rps=5.0)
+        rec.reconcile()
+        assert emitter.value("inferno_condition_status",
+                             variant_name=VARIANT,
+                             type=crd.TYPE_OPTIMIZATION_READY) == 1.0
+        assert emitter.value("inferno_condition_status",
+                             variant_name=VARIANT,
+                             type=crd.TYPE_METRICS_AVAILABLE) == 1.0
+
+    def test_broken_scrape_exports_false_then_clears_on_delete(self):
+        from workload_variant_autoscaler_tpu.collector import (
+            availability_query,
+        )
+
+        kube, prom, emitter, rec = make_cluster(arrival_rps=5.0)
+        prom.set_empty(availability_query(MODEL, NS))
+        prom.set_empty(availability_query(MODEL))
+        rec.reconcile()
+        assert emitter.value("inferno_condition_status",
+                             variant_name=VARIANT,
+                             type=crd.TYPE_METRICS_AVAILABLE) == 0.0
+        # variant removed -> its condition series must disappear
+        del kube.vas[(NS, VARIANT)]
+        rec.reconcile()
+        assert emitter.value("inferno_condition_status",
+                             variant_name=VARIANT,
+                             type=crd.TYPE_METRICS_AVAILABLE) is None
+
+    def test_solver_failure_reaches_the_condition_series(self, monkeypatch):
+        kube, _p, emitter, rec = make_cluster(arrival_rps=5.0)
+        rec.reconcile()  # healthy cycle first
+        assert emitter.value("inferno_condition_status",
+                             variant_name=VARIANT,
+                             type=crd.TYPE_OPTIMIZATION_READY) == 1.0
+        monkeypatch.setattr(
+            "workload_variant_autoscaler_tpu.controller.reconciler."
+            "Manager.optimize",
+            lambda self: (_ for _ in ()).throw(RuntimeError("solver boom")),
+        )
+        rec.reconcile()
+        assert emitter.value("inferno_condition_status",
+                             variant_name=VARIANT,
+                             type=crd.TYPE_OPTIMIZATION_READY) == 0.0
+
+    def test_empty_fleet_clears_all_per_variant_series(self):
+        kube, _p, emitter, rec = make_cluster(arrival_rps=5.0)
+        rec.reconcile()
+        del kube.vas[(NS, VARIANT)]
+        rec.reconcile()
+        for series, labels in (
+            ("inferno_condition_status",
+             {"variant_name": VARIANT, "type": crd.TYPE_OPTIMIZATION_READY}),
+            ("inferno_model_drift_ratio",
+             {"variant_name": VARIANT, "metric": "itl"}),
+            ("inferno_tpu_duty_cycle_percent", {"namespace": NS}),
+        ):
+            assert emitter.value(series, **labels) is None, series

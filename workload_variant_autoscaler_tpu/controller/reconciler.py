@@ -169,9 +169,12 @@ class Reconciler:
             del self._drift_strikes[stale]
         if not active:
             log.info("no active VariantAutoscalings, skipping optimization")
-            # no fleet: the power series must read empty, not hold the
-            # last nonzero wattage forever
+            # no fleet: every per-variant/per-namespace series must read
+            # empty, not hold its last value forever
             self.emitter.emit_power_metrics({})
+            self.emitter.emit_condition_metrics({})
+            self.emitter.emit_drift_metrics({})
+            self.emitter.emit_tpu_utilization_metrics({})
             return result
 
         # limited mode (realizes the reference's dead greedy path +
@@ -228,6 +231,9 @@ class Reconciler:
         mark("prepare")
         if not prepared:
             self.emitter.emit_power_metrics({})
+            # skip-path conditions (MetricsAvailable=False etc.) were
+            # written to the CRs above and must reach the series too
+            self._emit_conditions()
             return result
 
         # analyze: ONE batched kernel call across all candidates (JAX by
@@ -264,6 +270,9 @@ class Reconciler:
                     now=self.now(),
                 )
                 self._update_status(va)
+            # the OptimizationReady=False writes must reach the series
+            # too, or an alert keyed on the condition never fires
+            self._emit_conditions()
             mark("publish")  # the failure-condition status writes
             return result
 
@@ -289,8 +298,27 @@ class Reconciler:
             optimized[key] = alloc
 
         self._apply(prepared, optimized, result, system)
+        self._emit_conditions()
         mark("publish")
         return result
+
+    def _emit_conditions(self) -> None:
+        """CR conditions as inferno_condition_status series (post-write
+        truth: one LIST after publish), kube-state-metrics shape without
+        kube-state-metrics — the shipped alerts can key on
+        MetricsAvailable/OptimizationReady/PerfModelAccurate directly.
+        Observability only: a failure here never fails the cycle."""
+        try:
+            samples: dict[tuple[str, str, str], str] = {}
+            for va in self.kube.list_variant_autoscalings():
+                if not va.is_active():
+                    continue
+                for cond in va.status.conditions:
+                    samples[(va.name, va.namespace, cond.type)] = cond.status
+            self.emitter.emit_condition_metrics(samples)
+        except Exception as e:  # noqa: BLE001
+            log.warning("condition metrics emission failed",
+                        extra=kv(error=str(e)))
 
     # -- scale-down stabilization (beyond-reference; HPA-style) -----------
 

@@ -113,6 +113,9 @@ INFERNO_FLEET_POWER_WATTS = "inferno_fleet_power_watts"
 INFERNO_MODEL_DRIFT_RATIO = "inferno_model_drift_ratio"
 INFERNO_TPU_DUTY_CYCLE = "inferno_tpu_duty_cycle_percent"
 INFERNO_TPU_HBM_USAGE = "inferno_tpu_hbm_usage_bytes"
+INFERNO_CONDITION_STATUS = "inferno_condition_status"
+
+LABEL_CONDITION_TYPE = "type"
 
 LABEL_METRIC = "metric"
 
@@ -207,6 +210,16 @@ class MetricsEmitter:
             "Total TPU HBM usage over the serving namespace",
             [LABEL_NAMESPACE], registry=self.registry,
         )
+        # CR conditions as series (kube-state-metrics shape, without
+        # needing kube-state-metrics): alerts can key on
+        # MetricsAvailable/OptimizationReady/PerfModelAccurate directly
+        self.condition_status = Gauge(
+            INFERNO_CONDITION_STATUS,
+            "VariantAutoscaling condition status (1=True, 0=False, "
+            "-1=Unknown)",
+            [LABEL_VARIANT_NAME, LABEL_NAMESPACE, LABEL_CONDITION_TYPE],
+            registry=self.registry,
+        )
         # perf-model drift (beyond-reference: the reference never compares
         # its scraped latencies against its own queueing model)
         self.model_drift = Gauge(
@@ -259,6 +272,24 @@ class MetricsEmitter:
                     self.tpu_hbm_usage.labels(
                         **{LABEL_NAMESPACE: namespace}
                     ).set(util["hbm_usage_bytes"])
+
+    def emit_condition_metrics(
+        self, per_variant: dict[tuple[str, str, str], str]
+    ) -> None:
+        """Replace the condition series wholesale each cycle (deleted
+        variants' series disappear). Keys: (variant_name, namespace,
+        condition_type); values: 'True' | 'False' | anything else =
+        Unknown."""
+        encoded = {"True": 1.0, "False": 0.0}
+        with self._lock:
+            self.condition_status.clear()
+            for (variant_name, namespace, cond_type), status in \
+                    per_variant.items():
+                self.condition_status.labels(**{
+                    LABEL_VARIANT_NAME: variant_name,
+                    LABEL_NAMESPACE: namespace,
+                    LABEL_CONDITION_TYPE: cond_type,
+                }).set(encoded.get(status, -1.0))
 
     def emit_drift_metrics(
         self, per_variant: dict[tuple[str, str, str], float]
