@@ -161,3 +161,95 @@ class TestSizingInvariants:
             else:
                 np.testing.assert_allclose(lam[i], scalar, rtol=1e-6,
                                            err_msg=f"lane {i}: {row[:7]}")
+
+
+class TestTailSizingInvariants:
+    """Percentile-sizing invariants over the whole profile space
+    (example-based coverage lives in tests/test_tail_sizing.py)."""
+
+    @settings(max_examples=40, deadline=None)
+    @given(ALPHAS, BETAS, GAMMAS, DELTAS, BATCHES, TOKENS, TOKENS,
+           st.floats(0.2, 0.9), st.floats(0.2, 0.9))
+    def test_tail_probability_is_a_probability_and_monotone_in_rate(
+            self, alpha, beta, gamma, delta, max_batch, in_tok, out_tok,
+            lam_frac_lo, thr_frac):
+        import jax.numpy as jnp
+
+        from workload_variant_autoscaler_tpu.ops.batched import (
+            _cum_log_mu,
+            _rate_range,
+            _transition_rates,
+            wait_tail_probability,
+        )
+
+        q = make_queue_batch([alpha], [beta], [gamma], [delta],
+                             [float(in_tok)], [float(out_tok)], [max_batch])
+        k = k_max_for([max_batch])
+        clm = _cum_log_mu(_transition_rates(q, k))
+        lam_min, lam_max = _rate_range(q)
+        lo = float(lam_min[0]) + lam_frac_lo * 0.5 * (
+            float(lam_max[0]) - float(lam_min[0]))
+        hi = lo + 0.4 * (float(lam_max[0]) - lo)
+        thr = jnp.array([thr_frac * 200.0])
+        t_lo = float(wait_tail_probability(q, clm, jnp.array([lo]), k, thr)[0])
+        t_hi = float(wait_tail_probability(q, clm, jnp.array([hi]), k, thr)[0])
+        assert 0.0 <= t_lo <= 1.0 and 0.0 <= t_hi <= 1.0
+        # monotone non-decreasing in the arrival rate (the property the
+        # forced-increasing bisection relies on)
+        assert t_hi >= t_lo - 1e-9
+
+    @settings(max_examples=30, deadline=None)
+    @given(ALPHAS, BETAS, GAMMAS, DELTAS, BATCHES, TOKENS, TOKENS,
+           st.floats(0.2, 0.9), st.floats(0.2, 0.9))
+    def test_tail_sized_rate_never_exceeds_stable_range(
+            self, alpha, beta, gamma, delta, max_batch, in_tok, out_tok,
+            slack_itl, slack_ttft):
+        import jax.numpy as jnp
+
+        from workload_variant_autoscaler_tpu.ops.batched import (
+            _rate_range,
+            size_batch_tail,
+        )
+
+        qa = make_analyzer(alpha, beta, gamma, delta, max_batch,
+                           in_tok, out_tok)
+        target = slo_for(qa, slack_itl, slack_ttft)
+        q = make_queue_batch([alpha], [beta], [gamma], [delta],
+                             [float(in_tok)], [float(out_tok)], [max_batch])
+        k = k_max_for([max_batch])
+        sized = size_batch_tail(
+            q,
+            SLOTargets(ttft=jnp.array([target.ttft]),
+                       itl=jnp.array([target.itl]),
+                       tps=jnp.array([0.0])),
+            k, ttft_percentile=0.95,
+        )
+        _lam_min, lam_max = _rate_range(q)
+        assert float(sized.lam_star[0]) <= float(lam_max[0]) * (1 + 1e-9)
+        if bool(sized.feasible[0]):
+            assert float(sized.lam_star[0]) > 0.0
+
+    @settings(max_examples=25, deadline=None)
+    @given(ALPHAS, BETAS, GAMMAS, DELTAS, BATCHES, TOKENS, TOKENS)
+    def test_percentile_ordering_holds_everywhere(
+            self, alpha, beta, gamma, delta, max_batch, in_tok, out_tok):
+        """p99 admits no more than p90 for ANY profile (monotone in the
+        percentile), with a generous feasible TTFT target."""
+        import jax.numpy as jnp
+
+        from workload_variant_autoscaler_tpu.ops.batched import (
+            size_batch_tail,
+        )
+
+        qa = make_analyzer(alpha, beta, gamma, delta, max_batch,
+                           in_tok, out_tok)
+        target = slo_for(qa, 0.8, 0.8)
+        q = make_queue_batch([alpha], [beta], [gamma], [delta],
+                             [float(in_tok)], [float(out_tok)], [max_batch])
+        k = k_max_for([max_batch])
+        slo = SLOTargets(ttft=jnp.array([target.ttft]),
+                         itl=jnp.array([0.0]), tps=jnp.array([0.0]))
+        r90 = size_batch_tail(q, slo, k, ttft_percentile=0.90)
+        r99 = size_batch_tail(q, slo, k, ttft_percentile=0.99)
+        if bool(r90.feasible[0]) and bool(r99.feasible[0]):
+            assert float(r99.lam_ttft[0]) <= float(r90.lam_ttft[0]) * (1 + 1e-6)
