@@ -181,3 +181,26 @@ def test_adapter_values_expose_desired_replicas():
         series = {r["name"]["as"] for r in rules}
         assert "inferno_desired_replicas" in series, name
         assert values["prometheus"]["url"].startswith("https://"), name
+
+
+def test_grafana_dashboard_series_are_real():
+    """Every PromQL expr in the shipped dashboard references series the
+    emitter actually registers (a renamed gauge must break this test,
+    not the operator's dashboard)."""
+    import json as _json
+    import re
+
+    from workload_variant_autoscaler_tpu import metrics as m
+
+    known = {v for k, v in vars(m).items()
+             if k.startswith("INFERNO_") and isinstance(v, str)}
+    dash = _json.loads((DEPLOY / "grafana-dashboard.json").read_text())
+    assert dash["panels"], "empty dashboard"
+    for panel in dash["panels"]:
+        for target in panel["targets"]:
+            used = set(re.findall(r"inferno_[a-z_]+", target["expr"]))
+            assert used, f"panel {panel['title']!r} has no inferno series"
+            for series in used:
+                assert series in known, (
+                    f"dashboard references unknown series {series}"
+                )
