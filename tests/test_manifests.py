@@ -67,6 +67,11 @@ def test_kustomizations_resolve():
                 assert (target / KUSTOMIZATION).exists(), (
                     f"{kfile}: resource dir {res} has no {KUSTOMIZATION}"
                 )
+        for gen in k.get("configMapGenerator", []):
+            for fname in gen.get("files", []):
+                assert (kfile.parent / fname).exists(), (
+                    f"{kfile}: generator file {fname} missing"
+                )
         for patch in k.get("patches", []):
             p = (kfile.parent / patch["path"]).resolve()
             assert p.exists(), f"{kfile}: patch {patch['path']} missing"
@@ -194,7 +199,7 @@ def test_grafana_dashboard_series_are_real():
 
     known = {v for k, v in vars(m).items()
              if k.startswith("INFERNO_") and isinstance(v, str)}
-    dash = _json.loads((DEPLOY / "grafana-dashboard.json").read_text())
+    dash = _json.loads((DEPLOY / "prometheus" / "grafana-dashboard.json").read_text())
     assert dash["panels"], "empty dashboard"
     for panel in dash["panels"]:
         for target in panel["targets"]:
