@@ -168,7 +168,7 @@ class TestTailSizingInvariants:
     """Percentile-sizing invariants over the whole profile space
     (example-based coverage lives in tests/test_tail_sizing.py)."""
 
-    @settings(max_examples=20, deadline=None)
+    @settings(max_examples=12, deadline=None)
     @given(ALPHAS, BETAS, GAMMAS, DELTAS, BATCHES, TOKENS, TOKENS,
            st.floats(0.2, 0.9), st.floats(0.2, 0.9))
     def test_tail_probability_is_a_probability_and_monotone_in_rate(
@@ -199,7 +199,7 @@ class TestTailSizingInvariants:
         # forced-increasing bisection relies on)
         assert t_hi >= t_lo - 1e-9
 
-    @settings(max_examples=15, deadline=None)
+    @settings(max_examples=10, deadline=None)
     @given(ALPHAS, BETAS, GAMMAS, DELTAS, BATCHES, TOKENS, TOKENS,
            st.floats(0.2, 0.9), st.floats(0.2, 0.9))
     def test_tail_sized_rate_never_exceeds_stable_range(
@@ -230,7 +230,7 @@ class TestTailSizingInvariants:
         if bool(sized.feasible[0]):
             assert float(sized.lam_star[0]) > 0.0
 
-    @settings(max_examples=12, deadline=None)
+    @settings(max_examples=8, deadline=None)
     @given(ALPHAS, BETAS, GAMMAS, DELTAS, BATCHES, TOKENS, TOKENS)
     def test_percentile_ordering_holds_everywhere(
             self, alpha, beta, gamma, delta, max_batch, in_tok, out_tok):
