@@ -6,20 +6,20 @@ multi-chip path). Env must be set before jax is imported anywhere.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Force CPU: the ambient environment points JAX_PLATFORMS at the real TPU
 # tunnel, which tests must never use (slow remote compiles, single chip).
-# jax may already be imported by a sitecustomize hook before this conftest
-# runs, so the env var alone is not enough — override via jax.config too
-# (safe as long as no backend has been initialized yet).
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Single shared implementation of the pin (env var + post-import config
+# update — the env var alone loses when a sitecustomize hook imported
+# jax first): utils/platform.py.
+from workload_variant_autoscaler_tpu.utils.platform import force_cpu
+
+force_cpu(n_devices=8)
 
 import jax
-
-jax.config.update("jax_platforms", "cpu")
 
 # float64 on CPU for tight numerical cross-checks against the numpy
 # reference kernel; the batched kernel is dtype-polymorphic and is also

@@ -9,10 +9,13 @@ import time
 
 from ..collector import HTTPPromAPI, PrometheusConfig
 from ..controller.translate import parse_duration
+from ..utils.platform import force_cpu
 from . import collect_series, crd_patch, fit_profile
 
 
 def main(argv=None) -> int:
+    # offline CLI: never let an ambient TPU tunnel capture the lstsq
+    force_cpu()
     parser = argparse.ArgumentParser(
         description="fit alpha/beta/gamma/delta from serving metrics")
     parser.add_argument("--prom", default=None,

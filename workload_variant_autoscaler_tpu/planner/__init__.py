@@ -235,6 +235,11 @@ def format_table(rows: list[PlanRow]) -> str:
 def main(argv=None) -> int:
     import argparse
 
+    from ..utils.platform import force_cpu
+
+    # offline CLI: never let an ambient TPU tunnel capture the solve
+    force_cpu()
+
     def nonneg(s: str) -> float:
         v = float(s)
         if v < 0:
