@@ -10,6 +10,7 @@ from .kube import (
     KubeClient,
     NotFoundError,
     RestKube,
+    WatchEvent,
 )
 from .reconciler import (
     ACCELERATOR_CM_NAME,
@@ -37,6 +38,7 @@ __all__ = [
     "Reconciler",
     "RestKube",
     "SERVICE_CLASS_CM_NAME",
+    "WatchEvent",
     "crd",
     "translate",
 ]

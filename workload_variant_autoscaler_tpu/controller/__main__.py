@@ -188,7 +188,11 @@ def main(argv=None) -> int:
     # the lease is released instead of held for the full lease duration.
     import signal
 
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    def _terminate(*_) -> None:
+        stop.set()
+        reconciler.kick()  # wake the cadence wait immediately
+
+    signal.signal(signal.SIGTERM, _terminate)
 
     reconcile_thread: list[threading.Thread] = []
 

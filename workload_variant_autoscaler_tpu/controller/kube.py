@@ -20,12 +20,16 @@ import copy
 import json
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Protocol
 
-from ..utils import TerminalError
+from ..utils import TerminalError, get_logger, kv
 from . import schema
 from .crd import GROUP, PLURAL, VERSION, VariantAutoscaling, va_from_dict, va_to_dict
+
+
+_log = get_logger("wva.kube")
 
 
 class NotFoundError(TerminalError):
@@ -38,6 +42,22 @@ class InvalidError(TerminalError):
 
 class ConflictError(Exception):
     """Stale resourceVersion on update (transient: re-get and retry)."""
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    """One apiserver watch event, reduced to what the controller keys on.
+
+    The reconcile loop is level-triggered (every cycle re-reads all
+    state), so events carry identity only — no object payload. Matches
+    the reference's event usage: it enqueues a reconcile request and
+    drops the object (variantautoscaling_controller.go:456-487).
+    """
+
+    type: str        # ADDED | MODIFIED | DELETED
+    kind: str        # VariantAutoscaling | ConfigMap | Deployment
+    name: str
+    namespace: str
 
 
 @dataclass
@@ -117,20 +137,50 @@ class InMemoryKube:
         # `count` trips when count > 0
         self._faults: dict[tuple[str, str], tuple[Callable[[], None], int]] = {}
         self.status_update_count = 0
+        self._watchers: list[Callable[[WatchEvent], None]] = []
+
+    # -- watch (the apiserver's ?watch=true, reduced to callbacks) -------
+
+    def add_watch_listener(self, fn: Callable[[WatchEvent], None]) -> None:
+        """Register a callback fired on every object mutation. Callbacks
+        run on the mutating thread and must be fast and must not call
+        back into the kube synchronously (same discipline as informer
+        event handlers)."""
+        self._watchers.append(fn)
+
+    def _notify(self, event: WatchEvent) -> None:
+        for fn in list(self._watchers):
+            fn(event)
 
     # -- setup helpers ---------------------------------------------------
+    # Mutators take the lock (watch wiring makes concurrent mutation
+    # during a running reconcile the advertised usage) and notify after
+    # releasing it, so a slow listener cannot serialize the API.
 
     def put_configmap(self, cm: ConfigMap) -> None:
-        self.configmaps[(cm.namespace, cm.name)] = cm
+        with self._lock:
+            key = (cm.namespace, cm.name)
+            etype = "MODIFIED" if key in self.configmaps else "ADDED"
+            self.configmaps[key] = cm
+        self._notify(WatchEvent(etype, "ConfigMap", cm.name, cm.namespace))
 
     def put_deployment(self, d: Deployment) -> None:
         if not d.uid:
             d.uid = f"uid-{d.namespace}-{d.name}"
-        self.deployments[(d.namespace, d.name)] = d
+        with self._lock:
+            key = (d.namespace, d.name)
+            etype = "MODIFIED" if key in self.deployments else "ADDED"
+            self.deployments[key] = d
+        self._notify(WatchEvent(etype, "Deployment", d.name, d.namespace))
 
     def put_variant_autoscaling(self, va: VariantAutoscaling) -> None:
         self._admit(va)
-        self.vas[(va.namespace, va.name)] = copy.deepcopy(va)
+        with self._lock:
+            key = (va.namespace, va.name)
+            etype = "MODIFIED" if key in self.vas else "ADDED"
+            self.vas[key] = copy.deepcopy(va)
+        self._notify(
+            WatchEvent(etype, "VariantAutoscaling", va.name, va.namespace))
 
     def _admit(self, va: VariantAutoscaling) -> None:
         """CRD structural-schema admission (apiserver 422 -> InvalidError)."""
@@ -209,6 +259,9 @@ class InMemoryKube:
                 int(stored.metadata.resource_version or "0") + 1
             )
             self.status_update_count += 1
+        # outside the lock: a slow listener must not serialize the API
+        self._notify(WatchEvent(
+            "MODIFIED", "VariantAutoscaling", va.name, va.namespace))
 
     def patch_owner_reference(self, va: VariantAutoscaling, deploy: Deployment) -> None:
         with self._lock:
@@ -229,7 +282,8 @@ class InMemoryKube:
             va.metadata.owner_references = [ref]
 
     def put_node(self, node: Node) -> None:
-        self.nodes[node.name] = node
+        with self._lock:
+            self.nodes[node.name] = node
 
     def list_nodes(self) -> list[Node]:
         with self._lock:
@@ -270,12 +324,21 @@ class InMemoryKube:
     # -- test conveniences ----------------------------------------------
 
     def delete_deployment(self, name: str, namespace: str) -> None:
-        self.deployments.pop((namespace, name), None)
-        # garbage-collect owned VAs (ownerReference semantics)
-        uid = f"uid-{namespace}-{name}"
-        for key, va in list(self.vas.items()):
-            if va.is_controlled_by(uid):
-                del self.vas[key]
+        events: list[WatchEvent] = []
+        with self._lock:
+            if self.deployments.pop((namespace, name), None) is not None:
+                events.append(
+                    WatchEvent("DELETED", "Deployment", name, namespace))
+            # garbage-collect owned VAs (ownerReference semantics)
+            uid = f"uid-{namespace}-{name}"
+            for key, va in list(self.vas.items()):
+                if va.is_controlled_by(uid):
+                    del self.vas[key]
+                    events.append(WatchEvent(
+                        "DELETED", "VariantAutoscaling", va.name,
+                        va.namespace))
+        for ev in events:
+            self._notify(ev)
 
 
 def _yaml_scalar_str(v) -> str:
@@ -499,6 +562,148 @@ class RestKube:
             body=patch,
             content_type="application/merge-patch+json",
         )
+
+    # -- watch (?watch=true streaming) -----------------------------------
+
+    def watch_variant_autoscalings(
+        self,
+        on_event: Callable[[WatchEvent], None],
+        stop: threading.Event,
+        timeout_seconds: int = 300,
+    ) -> None:
+        """Blocking watch loop over all VariantAutoscalings; call from a
+        dedicated thread. Reconnects forever until `stop` is set."""
+        self._watch_loop(
+            f"/apis/{GROUP}/{VERSION}/{PLURAL}", "VariantAutoscaling",
+            on_event, stop, timeout_seconds=timeout_seconds,
+        )
+
+    def watch_configmap(
+        self,
+        name: str,
+        namespace: str,
+        on_event: Callable[[WatchEvent], None],
+        stop: threading.Event,
+        timeout_seconds: int = 300,
+    ) -> None:
+        """Blocking watch loop over one named ConfigMap (the operator
+        config); the apiserver filters via fieldSelector."""
+        self._watch_loop(
+            f"/api/v1/namespaces/{namespace}/configmaps", "ConfigMap",
+            on_event, stop,
+            field_selector=f"metadata.name={name}",
+            timeout_seconds=timeout_seconds,
+        )
+
+    def _watch_loop(
+        self,
+        list_path: str,
+        kind: str,
+        on_event: Callable[[WatchEvent], None],
+        stop: threading.Event,
+        field_selector: Optional[str] = None,
+        timeout_seconds: int = 300,
+    ) -> None:
+        """List-then-watch with resourceVersion bookkeeping.
+
+        Mirrors the informer contract: an initial LIST pins the
+        resourceVersion, then a chunked ?watch=true stream delivers
+        events from that version on. The stream RV advances with every
+        event; on server-side expiry (timeoutSeconds) the watch resumes
+        from the last seen RV, and on `410 Gone` / ERROR events the
+        outer loop re-LISTs from scratch (the cache window moved on).
+        The reconcile loop is level-triggered, so a re-list loses
+        nothing — the next cycle re-reads all state anyway.
+        """
+        backoff = 1.0
+        last_warn = 0.0
+
+        def warn(msg: str, **fields) -> None:
+            # rate-limited: a permanently broken watch (401, TLS, bad
+            # URL) must be visible without flooding at retry cadence
+            nonlocal last_warn
+            now = time.monotonic()
+            if now - last_warn >= 60.0:
+                last_warn = now
+                _log.warning(msg, extra=kv(kind=kind, path=list_path,
+                                           **fields))
+
+        while not stop.is_set():
+            # 1. LIST: pin the resourceVersion to watch from
+            try:
+                params = {"fieldSelector": field_selector} if field_selector else None
+                resp = self._session.get(
+                    f"{self.base_url}{list_path}", params=params,
+                    timeout=self.timeout)
+                resp.raise_for_status()
+                rv = (resp.json().get("metadata") or {}).get(
+                    "resourceVersion", "")
+            except Exception as e:  # noqa: BLE001 — reconnect forever
+                warn("watch LIST failed; retrying", error=str(e))
+                stop.wait(backoff)
+                backoff = min(backoff * 2, 30.0)
+                continue
+            backoff = 1.0
+
+            # 2. WATCH: stream from rv until expiry or error
+            relist = False
+            while not stop.is_set() and not relist:
+                params = {
+                    "watch": "true",
+                    "allowWatchBookmarks": "true",
+                    "timeoutSeconds": str(timeout_seconds),
+                }
+                if rv:
+                    params["resourceVersion"] = rv
+                if field_selector:
+                    params["fieldSelector"] = field_selector
+                stream = None
+                try:
+                    stream = self._session.get(
+                        f"{self.base_url}{list_path}", params=params,
+                        stream=True,
+                        timeout=(self.timeout, timeout_seconds + 30),
+                    )
+                    if stream.status_code == 410:
+                        # informers rate-limit relists: never hammer the
+                        # apiserver with back-to-back LIST+WATCH cycles
+                        relist = True
+                        stop.wait(1.0)
+                        continue
+                    stream.raise_for_status()
+                    for line in stream.iter_lines():
+                        if stop.is_set():
+                            return
+                        if not line:
+                            continue
+                        try:
+                            ev = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        etype = ev.get("type", "")
+                        obj = ev.get("object") or {}
+                        if etype == "ERROR":
+                            # e.g. `410 Gone` delivered mid-stream
+                            relist = True
+                            stop.wait(1.0)
+                            break
+                        meta = obj.get("metadata") or {}
+                        if meta.get("resourceVersion"):
+                            rv = meta["resourceVersion"]
+                        if etype == "BOOKMARK":
+                            continue
+                        on_event(WatchEvent(
+                            type=etype, kind=kind,
+                            name=meta.get("name", ""),
+                            namespace=meta.get("namespace", ""),
+                        ))
+                    # clean server-side expiry: resume from last rv
+                except Exception as e:  # noqa: BLE001 — reconnect forever
+                    warn("watch stream failed; reconnecting", error=str(e))
+                    stop.wait(2.0)
+                finally:
+                    if stream is not None:
+                        stream.close()
 
     # only TPU nodes: the apiserver filters, not the client
     _TPU_NODE_SELECTOR = "cloud.google.com%2Fgke-tpu-accelerator"

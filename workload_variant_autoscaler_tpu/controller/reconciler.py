@@ -84,6 +84,8 @@ class Reconciler:
         # consecutive out-of-tolerance drift readings per VA (hysteresis:
         # one noisy 1m-rate sample must not flip PerfModelAccurate)
         self._drift_strikes: dict[str, int] = {}
+        # set by kick() to wake run_forever early (watch-event trigger)
+        self._wake = threading.Event()
 
     # -- config reading (reference controller.go:490-594) ----------------
 
@@ -741,16 +743,82 @@ class Reconciler:
 
     # -- loop -------------------------------------------------------------
 
-    def run_forever(self, stop: Optional[threading.Event] = None) -> None:
-        """RequeueAfter-driven cadence (the reference drops all watch events
-        except Create and paces itself purely by requeue,
-        controller.go:456-487)."""
+    def kick(self) -> None:
+        """Request an immediate reconcile cycle. Thread-safe; multiple
+        kicks before the next cycle coalesce into one (workqueue
+        semantics). Watch events land here; shutdown paths may also call
+        it after setting `stop` to wake the loop promptly."""
+        self._wake.set()
+
+    def on_watch_event(self, ev) -> None:
+        """Watch-event filter -> kick. Mirrors the reference's event
+        wiring (variantautoscaling_controller.go:456-487): VariantAutoscaling
+        Create events reconcile immediately (updates/deletes are dropped —
+        the level-triggered cycle picks them up on cadence), and any
+        change to the operator ConfigMap triggers a cycle so interval/
+        knob edits take effect at once instead of one interval later."""
+        if ev.kind == "VariantAutoscaling" and ev.type == "ADDED":
+            log.info("watch: new VariantAutoscaling, reconciling now",
+                     extra=kv(variant=ev.name, namespace=ev.namespace))
+            self.kick()
+        elif (ev.kind == "ConfigMap" and ev.name == CONFIG_MAP_NAME
+              and ev.namespace == self.config_namespace
+              and ev.type in ("ADDED", "MODIFIED")):
+            log.info("watch: operator ConfigMap changed, reconciling now")
+            self.kick()
+
+    def start_watches(self, stop: threading.Event) -> bool:
+        """Hook watch events to kick(), whatever the kube client offers:
+        InMemoryKube exposes synchronous listeners; RestKube exposes
+        blocking ?watch=true loops, run here on daemon threads. Returns
+        True when a watch source was attached."""
+        kube = self.kube
+        if hasattr(kube, "add_watch_listener"):
+            kube.add_watch_listener(self.on_watch_event)
+            return True
+        if hasattr(kube, "watch_variant_autoscalings"):
+            threading.Thread(
+                target=kube.watch_variant_autoscalings,
+                args=(self.on_watch_event, stop),
+                name="wva-watch-va", daemon=True,
+            ).start()
+            threading.Thread(
+                target=kube.watch_configmap,
+                args=(CONFIG_MAP_NAME, self.config_namespace,
+                      self.on_watch_event, stop),
+                name="wva-watch-cm", daemon=True,
+            ).start()
+            return True
+        return False
+
+    def run_forever(self, stop: Optional[threading.Event] = None,
+                    watch: bool = True) -> None:
+        """RequeueAfter-driven cadence, woken early by watch events.
+
+        The reference paces itself by requeue but registers watches so a
+        VariantAutoscaling Create or an operator-ConfigMap change
+        reconciles immediately (controller.go:456-487); same here: the
+        cadence wait is interruptible by kick(). A kick arriving during
+        a cycle is not lost — the wait returns at once and the next
+        cycle runs (at-least-once after the last event)."""
         stop = stop or threading.Event()
+        if watch:
+            self.start_watches(stop)
         while not stop.is_set():
+            self._wake.clear()
             try:
                 result = self.reconcile()
                 delay = result.requeue_after
             except Exception as e:  # noqa: BLE001
                 log.error("reconcile cycle failed", extra=kv(error=str(e)))
                 delay = DEFAULT_INTERVAL_SECONDS
-            stop.wait(delay)
+            deadline = time.monotonic() + delay
+            while not stop.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                if self._wake.wait(min(remaining, 0.2)):
+                    # brief coalesce window: a kubectl apply of several
+                    # related objects should trigger one cycle, not N
+                    stop.wait(0.1)
+                    break
