@@ -14,8 +14,12 @@ from workload_variant_autoscaler_tpu.ops.batched import (
     k_max_for,
     make_queue_batch,
     size_batch,
+    size_batch_tail,
 )
-from workload_variant_autoscaler_tpu.ops.pallas_kernel import size_batch_pallas
+from workload_variant_autoscaler_tpu.ops.pallas_kernel import (
+    size_batch_pallas,
+    size_batch_tail_pallas,
+)
 
 
 def example_batch(b, seed=0, dtype=jnp.float32):
@@ -56,6 +60,39 @@ class TestPallasEquivalence:
                 np.asarray(getattr(a, field)), np.asarray(getattr(p, field)),
                 rtol=rtol, atol=1e-9, err_msg=field,
             )
+
+    @pytest.mark.parametrize("b", [1, 8, 37])
+    @pytest.mark.parametrize("pct", [0.9, 0.95, 0.99])
+    @pytest.mark.parametrize("dtype,rtol", [
+        (jnp.float64, 1e-9),
+        # f32: the tail eval stacks two prefix scans and an Erlang
+        # mixture per trip; tree-vs-sequential summation order near the
+        # freeze tolerance can stop the search one step apart
+        (jnp.float32, 2e-3),
+    ])
+    def test_tail_matches_fori_loop_path(self, b, pct, dtype, rtol):
+        q, targets, k_max = example_batch(b, seed=100 + b, dtype=dtype)
+        a = size_batch_tail(q, targets, k_max, ttft_percentile=pct)
+        p = size_batch_tail_pallas(q, targets, k_max, ttft_percentile=pct,
+                                   interpret=True)
+        np.testing.assert_array_equal(np.asarray(a.feasible),
+                                      np.asarray(p.feasible))
+        for field in ("lam_ttft", "lam_itl", "lam_star", "throughput",
+                      "token_time", "rho"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(a, field)), np.asarray(getattr(p, field)),
+                rtol=rtol, atol=1e-9, err_msg=field,
+            )
+
+    def test_tail_tile_b_invariance(self):
+        """The tile size is a scheduling knob, never a result knob."""
+        q, targets, k_max = example_batch(16, seed=7, dtype=jnp.float64)
+        base = size_batch_tail_pallas(q, targets, k_max, interpret=True)
+        for tile_b in (16, 32):
+            other = size_batch_tail_pallas(q, targets, k_max, interpret=True,
+                                           tile_b=tile_b)
+            np.testing.assert_allclose(np.asarray(base.lam_star),
+                                       np.asarray(other.lam_star), rtol=1e-12)
 
     def test_infeasible_and_disabled_targets(self):
         # ITL below the decode floor -> infeasible; all-zero targets -> lam_max

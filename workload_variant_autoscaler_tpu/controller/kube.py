@@ -616,6 +616,7 @@ class RestKube:
         nothing — the next cycle re-reads all state anyway.
         """
         backoff = 1.0
+        stream_backoff = 2.0
         last_warn = 0.0
 
         def warn(msg: str, **fields) -> None:
@@ -698,9 +699,16 @@ class RestKube:
                             namespace=meta.get("namespace", ""),
                         ))
                     # clean server-side expiry: resume from last rv
+                    stream_backoff = 2.0
                 except Exception as e:  # noqa: BLE001 — reconnect forever
                     warn("watch stream failed; reconnecting", error=str(e))
-                    stop.wait(2.0)
+                    # exponential, and via a fresh LIST: a persistent
+                    # 403/429 on ?watch=true must not retry hot at a
+                    # fixed cadence (the LIST path already backs off,
+                    # and a re-list is free for a level-triggered loop)
+                    stop.wait(stream_backoff)
+                    stream_backoff = min(stream_backoff * 2, 60.0)
+                    relist = True
                 finally:
                     if stream is not None:
                         stream.close()

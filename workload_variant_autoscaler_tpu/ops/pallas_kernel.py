@@ -1,30 +1,36 @@
-"""Pallas TPU kernel for the SLO-sizing bisection.
+"""Pallas TPU kernels for the SLO-sizing bisection (mean + tail).
 
-Alternative backend for the hot loop of `ops.batched.size_batch`: the
-48/100-trip bisection over the state-dependent M/M/1 solve runs as one
-`pl.pallas_call`, with each program instance owning a tile of candidates.
-The loop-invariant prefix `cumsum(log mu)` tile ([TILE_B, K]) loads into
-VMEM once and stays there for every trip — no HBM round-trips for
-intermediates between trips, which is the traffic XLA's fused fori_loop
-still pays between the solve's reduction stages.
+Alternative backend for the hot loop of `ops.batched.size_batch` and
+`size_batch_tail`: the 48/100-trip bisection over the state-dependent
+M/M/1 solve runs as one `pl.pallas_call`, with each program instance
+owning a tile of candidates. The loop-invariant prefix `cumsum(log mu)`
+tile ([TILE_B, K]) loads into VMEM once and stays there for every trip —
+no HBM round-trips for intermediates between trips, which is the traffic
+XLA's fused fori_loop still pays between the solve's reduction stages.
 
-Layout: candidates along sublanes (TILE_B = 8 for float32), queue states
-along lanes (K padded to a multiple of 128). All per-candidate scalars are
-[TILE_B, 1] columns broadcast against [TILE_B, K_pad] state grids; the
-per-state statistics the solve needs (E[N], E[N in service], p_K, p_0)
-are masked lane reductions, so no in-kernel cumsum is required.
+Layout: candidates along sublanes, queue states along lanes (K padded to
+a multiple of 128). All per-candidate scalars are [TILE_B, 1] columns
+broadcast against [TILE_B, K_pad] state grids; the per-state statistics
+the solve needs (E[N], E[N in service], p_K, p_0) are masked lane
+reductions.
 
-Equivalence with `size_batch` is exact up to float associativity and is
-enforced by tests/test_pallas.py (interpret mode on CPU, compiled on TPU).
+The tail kernel additionally evaluates, per trip, the percentile sizing
+of `size_batch_tail` (occupancy quantile -> prefill budget -> Erlang
+queueing-wait tail, the partial-Poisson identity of
+native/wva_queueing.cpp ttft_tail_at). The two lane-axis prefix sums it
+needs (occupancy CDF, Poisson term accumulation) run as Hillis-Steele
+scans built from static `pltpu.roll` steps, and the per-candidate
+Q(n-N+1, x) alignment — a lane shift by the per-row batch size N — is a
+binary decomposition into conditional static rolls; no gather, no
+dynamic slice, nothing Mosaic won't vectorize.
 
-Status: compiles via Mosaic and runs on a real v5e chip at ~97M
-sizings/s (b=4096, float32) — parity with the XLA fori_loop path, which
-remains the production default (XLA's fusion already keeps this solve
-VMEM-resident; the kernel is the hand-scheduled proof and the substrate
-for layouts XLA won't pick). Exact-parity-validated against size_batch in
-interpret mode on CPU (tests/test_pallas.py) and compiled on TPU.
-Mosaic gotcha encoded below: never use bool vectors as select *values*
-(i8 storage -> mask reuse needs an unsupported i8->i1 trunci).
+Equivalence with `size_batch`/`size_batch_tail` is exact up to float
+associativity and is enforced by tests/test_pallas.py (interpret mode on
+CPU, compiled on TPU).
+
+Mosaic gotchas encoded below: never use bool vectors as select *values*
+(i8 storage -> mask reuse needs an unsupported i8->i1 trunci), and keep
+`done` as int32 in the fori_loop carry for the same reason.
 """
 
 from __future__ import annotations
@@ -39,8 +45,10 @@ from .batched import (
     QueueBatch,
     SizingResult,
     SLOTargets,
+    _full_batch_mu,
     _sizing_problem,
     _sizing_result,
+    _tail_problem,
     _within_tol,
     bisection_trips,
 )
@@ -49,19 +57,70 @@ TILE_B = 8      # candidates per program instance (float32 sublane tile)
 LANE = 128      # lane width: state-axis padding quantum
 
 
+def _roll_right(v: jax.Array, shift: int, lane_idx: jax.Array,
+                interpret: bool) -> jax.Array:
+    """Lane shift toward higher indices, zero-filled (not circular)."""
+    if interpret:
+        rolled = jnp.roll(v, shift, axis=1)
+    else:
+        from jax.experimental.pallas import tpu as pltpu
+
+        rolled = pltpu.roll(v, shift=shift, axis=1)
+    return jnp.where(lane_idx >= shift, rolled, 0.0)
+
+
+def _lane_cumsum(v: jax.Array, lane_idx: jax.Array, k_pad: int,
+                 interpret: bool) -> jax.Array:
+    """Inclusive prefix sum along lanes: Hillis-Steele with log2(K_pad)
+    static roll+add steps (tree order — at least as accurate as the
+    sequential sum the XLA path's jnp.cumsum lowers to)."""
+    s = 1
+    while s < k_pad:
+        v = v + _roll_right(v, s, lane_idx, interpret)
+        s *= 2
+    return v
+
+
+def _shift_right_by_row(v: jax.Array, amount: jax.Array, lane_idx: jax.Array,
+                        k_pad: int, interpret: bool) -> jax.Array:
+    """Zero-filled lane shift by a per-row int32 [T, 1] amount: binary
+    decomposition into conditional static rolls."""
+    bit = 1
+    while bit < k_pad:
+        rolled = _roll_right(v, bit, lane_idx, interpret)
+        take = (amount & bit) > 0
+        v = jnp.where(take, rolled, v)
+        bit *= 2
+    return v
+
+
 def _bisect_kernel(
-    # per-candidate scalar columns [T, 1]
-    alpha_ref, beta_ref, gamma_ref, delta_ref, in_tok_ref, out_tok_ref,
-    n_max_ref, k_occ_ref, target_ref, is_ttft_ref, increasing_ref,
-    lo_ref, hi_ref, x0_ref, done_ref,
-    # state grid [T, K_pad]
-    clm_ref,
-    # output [T, 1]
-    x_star_ref,
-    *, trips: int, k_max: int,
+    *refs,
+    trips: int, k_max: int, tile_b: int, k_pad: int,
+    tail_pct: float | None, interpret: bool,
 ):
+    """One tile of the stacked [2B] bisection. Ref layout:
+
+    per-candidate scalar columns [T, 1]:
+      alpha, beta, gamma, delta, in_tok, out_tok, n_max(i32), k_occ(i32),
+      target, is_ttft(i32), increasing(i32), lo, hi, x0, done(i32),
+      [slo_ttft, mu_full  — tail mode only]
+    state grid [T, K_pad]: clm
+    output [T, 1]: x_star
+    """
+    if tail_pct is None:
+        (alpha_ref, beta_ref, gamma_ref, delta_ref, in_tok_ref, out_tok_ref,
+         n_max_ref, k_occ_ref, target_ref, is_ttft_ref, increasing_ref,
+         lo_ref, hi_ref, x0_ref, done_ref, clm_ref, x_star_ref) = refs
+        slo_ref = mun_ref = None
+    else:
+        (alpha_ref, beta_ref, gamma_ref, delta_ref, in_tok_ref, out_tok_ref,
+         n_max_ref, k_occ_ref, target_ref, is_ttft_ref, increasing_ref,
+         lo_ref, hi_ref, x0_ref, done_ref, slo_ref, mun_ref,
+         clm_ref, x_star_ref) = refs
+
     dtype = clm_ref.dtype
-    k_pad = clm_ref.shape[1]
+    tiny = jnp.asarray(jnp.finfo(dtype).tiny, dtype)
     alpha = alpha_ref[:, :]
     beta = beta_ref[:, :]
     gamma = gamma_ref[:, :]
@@ -75,16 +134,23 @@ def _bisect_kernel(
     increasing = increasing_ref[:, :] > 0
     clm = clm_ref[:, :]
 
-    # state index n = 1..k_pad along lanes
-    n_states = (
-        jax.lax.broadcasted_iota(jnp.int32, (TILE_B, k_pad), 1) + 1
-    )
+    # loop invariants, computed once before the trip loop
+    lane_idx = jax.lax.broadcasted_iota(jnp.int32, (tile_b, k_pad), 1)
+    n_states = lane_idx + 1           # lane j holds queue state n = j+1
     nf = n_states.astype(dtype)
     in_range = (n_states <= k_occ) & (n_states <= k_max)
     head = n_states <= n_max          # states with n <= N (all in service)
     at_k = n_states == k_occ          # the blocking state
     neg_inf = jnp.asarray(-jnp.inf, dtype)
     n_max_f = n_max.astype(dtype)
+    if tail_pct is not None:
+        slo = slo_ref[:, :]
+        mun = mun_ref[:, :]
+        # log(i) per Poisson-index lane (i = lane position, i >= 1)
+        log_i = jnp.log(jnp.maximum(lane_idx.astype(dtype), 1.0))
+        erlang_lane = lane_idx <= k_max - 1   # terms i = 0..K-1
+        waiting = in_range & (n_states >= n_max) & (n_states < k_occ)
+        accepted = in_range & (n_states < k_occ)
 
     def eval_y(mid):
         # steady state at rate `mid`: logp[n] = n log(mid) - clm[n-1]
@@ -120,7 +186,41 @@ def _bisect_kernel(
         pre = jnp.where(in_tok > 0, gamma + delta * in_tok * conc, 0.0)
         ttft = w + pre
         itl = alpha + beta * conc
-        return jnp.where(is_ttft, ttft, itl)
+
+        if tail_pct is None:
+            return jnp.where(is_ttft, ttft, itl)
+
+        # ---- percentile lanes: P(wait > slo - prefill(quantile batch)) --
+        # occupancy quantile: count states whose unnormalized CDF is
+        # below pct * z (state 0 counts via p0)
+        cdf = p0 + _lane_cumsum(p_tail, lane_idx, k_pad, interpret)
+        nq = ((p0 < tail_pct * z).astype(dtype)
+              + jnp.sum(jnp.where(cdf < tail_pct * z, 1.0, 0.0),
+                        axis=1, keepdims=True))
+        bq = jnp.minimum(nq, n_max_f)
+        prefill_q = jnp.where(in_tok > 0, gamma + delta * in_tok * bq, 0.0)
+        threshold = jnp.maximum(slo - prefill_q, 0.0)
+        xx = mun * threshold
+        safe_xx = jnp.maximum(xx, tiny)
+        # partial Poisson sum Q(k, x) for ALL k at once: one scan over
+        # per-step increments keeps every operand O(log K) (see
+        # batched.wait_tail_probability on why not i*log(x) - lgamma)
+        incr = jnp.where(lane_idx >= 1, jnp.log(safe_xx) - log_i, 0.0)
+        log_terms = -safe_xx + _lane_cumsum(incr, lane_idx, k_pad, interpret)
+        h = jnp.where(erlang_lane, jnp.exp(log_terms), 0.0)
+        q_cum = jnp.clip(_lane_cumsum(h, lane_idx, k_pad, interpret),
+                         0.0, 1.0)
+        # align Q(n - N + 1, x) with state lane n: shift right by N-1
+        t_erl = _shift_right_by_row(q_cum, n_max - 1, lane_idx, k_pad,
+                                    interpret)
+        t_erl = jnp.where(xx <= 0.0, 1.0, t_erl)   # Q(k, 0) = 1
+        num = jnp.sum(jnp.where(waiting, p_tail * t_erl, 0.0),
+                      axis=1, keepdims=True)
+        den = p0 + jnp.sum(jnp.where(accepted, p_tail, 0.0),
+                           axis=1, keepdims=True)
+        tail_p = num / jnp.maximum(den, tiny)
+        tail_p = jnp.where(prefill_q >= slo, 1.0, tail_p)
+        return jnp.where(is_ttft, tail_p, itl)
 
     def body(_, carry):
         # `done` rides the carry as int32: a carried bool vector would be
@@ -155,23 +255,32 @@ def _pad_rows(a: jax.Array, rows: int) -> jax.Array:
     return jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1), constant_values=1)
 
 
-@partial(jax.jit, static_argnames=("k_max", "interpret"))
-def size_batch_pallas(
-    q: QueueBatch, targets: SLOTargets, k_max: int, interpret: bool = False
-) -> SizingResult:
-    """`size_batch` with the bisection as a Pallas kernel. The prologue
-    (boundary handling) and epilogue (TPS margin, final analysis) are the
-    same `_sizing_problem`/`_sizing_result` helpers the fori_loop backend
-    uses; only the trip loop runs in the kernel."""
+def _half_problem(prob, sl: slice):
+    """Row-slice of a stacked SizingProblem (the fields the kernel
+    plumbing reads). The TTFT and ITL halves are independent bisections
+    that only rejoin in `_sizing_result`, so the tail kernel can run on
+    the TTFT half alone — the XLA path makes the same split
+    (batched.py _tail_problem eval_y) to keep the Erlang sweep off lanes
+    whose result the select would discard."""
+    return prob._replace(
+        q2=jax.tree.map(lambda a: a[sl], prob.q2),
+        clm2=prob.clm2[sl],
+        is_ttft=prob.is_ttft[sl],
+        y_targets=prob.y_targets[sl],
+        increasing=prob.increasing[sl],
+        lo0=prob.lo0[sl], hi0=prob.hi0[sl],
+        x0=prob.x0[sl], done0=prob.done0[sl],
+    )
+
+
+def _run_bisect_kernel(prob, k_max, interpret, tile_b, tail_pct,
+                       slo2=None, mun2=None):
+    """Shared pallas_call plumbing for the mean and tail kernels."""
     from jax.experimental import pallas as pl
 
-    dtype = q.alpha.dtype
-    b = q.batch_size
-    prob, _eval_y = _sizing_problem(q, targets, k_max)
-
-    # tile the stacked problem for the kernel
-    b2 = 2 * b
-    rows = ((b2 + TILE_B - 1) // TILE_B) * TILE_B
+    dtype = prob.q2.alpha.dtype
+    b2 = prob.q2.alpha.shape[0]
+    rows = ((b2 + tile_b - 1) // tile_b) * tile_b
     k_pad = ((k_max + LANE - 1) // LANE) * LANE
 
     def col(a, d=None):
@@ -184,17 +293,7 @@ def size_batch_pallas(
         rows,
     )
 
-    grid = (rows // TILE_B,)
-    scalar_spec = pl.BlockSpec((TILE_B, 1), lambda i: (i, 0))
-    state_spec = pl.BlockSpec((TILE_B, k_pad), lambda i: (i, 0))
-    x_star2 = pl.pallas_call(
-        partial(_bisect_kernel, trips=bisection_trips(dtype), k_max=k_max),
-        grid=grid,
-        in_specs=[scalar_spec] * 15 + [state_spec],
-        out_specs=scalar_spec,
-        out_shape=jax.ShapeDtypeStruct((rows, 1), dtype),
-        interpret=interpret,
-    )(
+    operands = [
         col(q2.alpha), col(q2.beta), col(q2.gamma), col(q2.delta),
         col(q2.in_tokens), col(q2.out_tokens),
         col(q2.max_batch.astype(jnp.int32), jnp.int32),
@@ -203,7 +302,67 @@ def size_batch_pallas(
         col(prob.increasing, jnp.int32),
         col(prob.lo0), col(prob.hi0), col(prob.x0),
         col(prob.done0, jnp.int32),
-        clm_padded,
-    )[:b2, 0]
+    ]
+    if tail_pct is not None:
+        operands += [col(slo2), col(mun2)]
+    operands.append(clm_padded)
 
+    grid = (rows // tile_b,)
+    scalar_spec = pl.BlockSpec((tile_b, 1), lambda i: (i, 0))
+    state_spec = pl.BlockSpec((tile_b, k_pad), lambda i: (i, 0))
+    x_star2 = pl.pallas_call(
+        partial(_bisect_kernel, trips=bisection_trips(dtype), k_max=k_max,
+                tile_b=tile_b, k_pad=k_pad, tail_pct=tail_pct,
+                interpret=interpret),
+        grid=grid,
+        in_specs=[scalar_spec] * (len(operands) - 1) + [state_spec],
+        out_specs=scalar_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, 1), dtype),
+        interpret=interpret,
+    )(*operands)[:b2, 0]
+    return x_star2
+
+
+@partial(jax.jit, static_argnames=("k_max", "interpret", "tile_b"))
+def size_batch_pallas(
+    q: QueueBatch, targets: SLOTargets, k_max: int, interpret: bool = False,
+    tile_b: int = TILE_B,
+) -> SizingResult:
+    """`size_batch` with the bisection as a Pallas kernel. The prologue
+    (boundary handling) and epilogue (TPS margin, final analysis) are the
+    same `_sizing_problem`/`_sizing_result` helpers the fori_loop backend
+    uses; only the trip loop runs in the kernel."""
+    prob, _eval_y = _sizing_problem(q, targets, k_max)
+    x_star2 = _run_bisect_kernel(prob, k_max, interpret, tile_b, None)
+    return _sizing_result(q, targets, prob, x_star2, k_max)
+
+
+@partial(jax.jit,
+         static_argnames=("k_max", "ttft_percentile", "interpret", "tile_b"))
+def size_batch_tail_pallas(
+    q: QueueBatch, targets: SLOTargets, k_max: int,
+    ttft_percentile: float = 0.95, interpret: bool = False,
+    tile_b: int = TILE_B,
+) -> SizingResult:
+    """`size_batch_tail` with the bisection as a Pallas kernel: the TTFT
+    lanes hold P(wait > slo - prefill(quantile batch)) <= 1 - percentile
+    via the in-kernel Erlang/partial-Poisson evaluation; ITL lanes stay
+    on the mean. Same prologue/epilogue as the XLA path.
+
+    The stacked problem splits into its two halves — the tail kernel
+    runs ONLY on the TTFT rows and the ITL rows go through the plain
+    mean kernel — so no trip pays the Erlang scans on lanes whose
+    result would be discarded."""
+    b = q.batch_size
+    prob, _eval_y = _tail_problem(q, targets, k_max, ttft_percentile)
+    x_ttft = _run_bisect_kernel(
+        _half_problem(prob, slice(0, b)), k_max, interpret, tile_b,
+        float(ttft_percentile),
+        slo2=targets.ttft.astype(q.alpha.dtype), mun2=_full_batch_mu(q),
+    )
+    x_itl = _run_bisect_kernel(
+        _half_problem(prob, slice(b, 2 * b)), k_max, interpret, tile_b,
+        None,
+    )
+    x_star2 = jnp.concatenate([x_ttft, x_itl])
     return _sizing_result(q, targets, prob, x_star2, k_max)
