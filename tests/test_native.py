@@ -53,6 +53,24 @@ class TestParity:
         assert b.metrics.avg_token_time == pytest.approx(a.metrics.avg_token_time, rel=1e-9)
         assert b.metrics.rho == pytest.approx(a.metrics.rho, rel=1e-9)
 
+    @pytest.mark.parametrize("case", CASES)
+    @pytest.mark.parametrize("pct", [0.9, 0.95, 0.99])
+    def test_size_tail_matches_python(self, case, pct):
+        """Percentile sizing: the scalar numpy tail search and the C++
+        wva_size_tail walk the same bisection over the same
+        partial-Poisson/Erlang mixture — exact parity."""
+        py, nat = make_pair(case)
+        target = TargetPerf(ttft=case[7], itl=case[8], tps=case[9])
+        a = py.size(target, ttft_percentile=pct)
+        b = nat.size(target, ttft_percentile=pct)
+        assert b.rate_ttft == pytest.approx(a.rate_ttft, rel=1e-9)
+        assert b.rate_itl == pytest.approx(a.rate_itl, rel=1e-9)
+        assert b.metrics.throughput == pytest.approx(
+            a.metrics.throughput, rel=1e-9)
+        if case[7] > 0:
+            # percentile sizing is never laxer than mean sizing
+            assert a.rate_ttft <= py.size(target).rate_ttft * (1 + 1e-9)
+
     @pytest.mark.parametrize("rate_frac", [0.1, 0.5, 0.9])
     def test_analyze_matches_python(self, rate_frac):
         py, nat = make_pair(CASES[0])

@@ -672,6 +672,10 @@ class RestKube:
                         stop.wait(1.0)
                         continue
                     stream.raise_for_status()
+                    # connected: reset the failure backoff here, not at
+                    # clean expiry — a proxy idle-killing long streams
+                    # must not escalate healthy reconnects to the cap
+                    stream_backoff = 2.0
                     for line in stream.iter_lines():
                         if stop.is_set():
                             return
@@ -699,7 +703,6 @@ class RestKube:
                             namespace=meta.get("namespace", ""),
                         ))
                     # clean server-side expiry: resume from last rv
-                    stream_backoff = 2.0
                 except Exception as e:  # noqa: BLE001 — reconnect forever
                     warn("watch stream failed; reconnecting", error=str(e))
                     # exponential, and via a fresh LIST: a persistent

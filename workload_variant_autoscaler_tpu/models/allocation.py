@@ -199,11 +199,16 @@ def zero_load_allocation(
     return alloc
 
 
-def create_allocation(system: "System", server_name: str, acc_name: str) -> Optional[Allocation]:
+def create_allocation(system: "System", server_name: str, acc_name: str,
+                      ttft_percentile: Optional[float] = None) -> Optional[Allocation]:
     """Scalar-path allocation construction (reference allocation.go:27-163).
 
     Returns None when the candidate is infeasible: missing profile/target,
     invalid load, or SLO below the achievable region.
+
+    ttft_percentile: the GLOBAL percentile knob; the service class's own
+    slo-ttft-percentile overrides it (same effective-percentile rule as
+    System._percentile_groups for the batched/native backends).
     """
     acc = system.accelerator(acc_name)
     server = system.server(server_name)
@@ -246,8 +251,10 @@ def create_allocation(system: "System", server_name: str, acc_name: str) -> Opti
             ),
             RequestSize(avg_input_tokens=load.avg_in_tokens, avg_output_tokens=out_tokens),
         )
+        effective_pct = target.slo_ttft_percentile or ttft_percentile
         sized = analyzer.size(
-            TargetPerf(ttft=target.slo_ttft, itl=target.slo_itl, tps=target.slo_tps)
+            TargetPerf(ttft=target.slo_ttft, itl=target.slo_itl, tps=target.slo_tps),
+            ttft_percentile=effective_pct or None,
         )
     except (ValueError, InfeasibleTargetError):
         return None

@@ -179,14 +179,16 @@ class Server:
                 return {cur: accelerators[cur]} if cur in accelerators else {}
         return accelerators
 
-    def calculate(self, system: "System") -> None:
+    def calculate(self, system: "System",
+                  ttft_percentile: Optional[float] = None) -> None:
         """Scalar-path candidate computation (reference server.go:55-67).
         `System.calculate` supersedes this with the batched kernel."""
         from .allocation import create_allocation
 
         self.all_allocations = {}
         for g_name in self.candidate_accelerators(system.accelerators):
-            alloc = create_allocation(system, self.name, g_name)
+            alloc = create_allocation(system, self.name, g_name,
+                                      ttft_percentile=ttft_percentile)
             if alloc is not None:
                 if self.cur_allocation is not None:
                     alloc.value = self.cur_allocation.transition_penalty(alloc)

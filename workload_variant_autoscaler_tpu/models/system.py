@@ -140,27 +140,17 @@ class System:
         mesh: optional 1-D jax.sharding.Mesh; shards the candidate batch
         across its devices (parallel.size_batch_sharded) for large fleets.
         ttft_percentile: size the TTFT SLO against this percentile of the
-        TTFT distribution instead of its mean (ops.batched.size_batch_tail;
-        batched backend only).
+        TTFT distribution instead of its mean — supported by ALL three
+        backends (ops.batched.size_batch_tail / native wva_size_tail /
+        the scalar QueueAnalyzer tail search).
         """
         for acc in self.accelerators.values():
             acc.calculate()
         if backend == "scalar":
             if mesh is not None:
                 raise ValueError("mesh sharding requires backend='batched'")
-            if ttft_percentile is not None:
-                raise ValueError(
-                    "ttft_percentile requires backend='batched' or 'native'")
-            if any(t.slo_ttft_percentile
-                   for svc in self.service_classes.values()
-                   for t in svc.targets.values()):
-                from ..utils import get_logger
-
-                get_logger("wva.system").warning(
-                    "slo-ttft-percentile targets are not supported by the "
-                    "scalar backend; sizing those classes on the mean")
             for server in self.servers.values():
-                server.calculate(self)
+                server.calculate(self, ttft_percentile=ttft_percentile)
             return
         if backend == "native":
             if mesh is not None:

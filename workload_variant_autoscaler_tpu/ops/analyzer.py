@@ -29,6 +29,7 @@ from .queueing import (
     MAX_QUEUE_TO_BATCH_RATIO,
     STABILITY_SAFETY_FRACTION,
     QueueStats,
+    state_dependent_probabilities,
     state_dependent_solve,
 )
 from .search import BELOW_REGION, binary_search
@@ -180,6 +181,43 @@ class QueueAnalyzer:
             self.config.parms, self.request_size.avg_input_tokens, conc
         )
 
+    def _ttft_tail_at(self, lam: float, slo_ttft: float,
+                      percentile: float) -> float:
+        """P(TTFT exceeds slo_ttft) at rate lam, for percentile sizing —
+        the scalar twin of ops/batched.py `_tail_problem` /
+        native/wva_queueing.cpp `ttft_tail_at`: prefill at the PERCENTILE
+        of the occupancy distribution plus the PASTA/Erlang queueing-wait
+        tail. For integer k the Erlang survival is the partial Poisson
+        sum Q(k, x) = e^-x sum_{i<k} x^i/i!, built from one cumsum of
+        per-step log increments (every operand O(log K) — the same
+        precision argument as batched.wait_tail_probability)."""
+        K = self.occupancy
+        N = self.config.max_batch_size
+        p = state_dependent_probabilities(lam, self.serv_rate, K)
+
+        # occupancy percentile -> prefill budget
+        nq = int(np.sum(np.cumsum(p) < percentile))
+        bq = min(nq, N)
+        prefill_q = prefill_time(
+            self.config.parms, self.request_size.avg_input_tokens, bq)
+        if prefill_q >= slo_ttft:
+            return 1.0
+        threshold = slo_ttft - prefill_q
+
+        den = float(np.sum(p[:K]))  # accepted arrivals (state K blocked)
+        if den <= 0.0 or K <= N:
+            return 0.0
+
+        x = float(self.serv_rate[-1]) * threshold  # full-batch departures
+        if x <= 0.0:
+            return float(np.sum(p[N:K])) / den     # Q(k, 0) = 1
+        ks = np.arange(1, K - N + 1, dtype=np.float64)  # k for states N..K-1
+        log_terms = -x + np.concatenate(
+            [[0.0], np.cumsum(np.log(x) - np.log(ks[:-1]))])
+        q_cum = np.minimum(np.cumsum(np.exp(log_terms)), 1.0)  # Q(k, x)
+        num = float(np.dot(p[N:K], q_cum))
+        return num / den
+
     def _itl_at(self, lam: float) -> float:
         stats = self._solve(lam)
         conc = effective_concurrency(
@@ -212,17 +250,36 @@ class QueueAnalyzer:
             rho=rho,
         )
 
-    def size(self, target: TargetPerf) -> SizeResult:
+    def size(self, target: TargetPerf,
+             ttft_percentile: Optional[float] = None) -> SizeResult:
         """Max request rates meeting each target, and metrics at the binding
         one (reference queueanalyzer.go:185-255). Raises
         InfeasibleTargetError when a target is below the achievable region.
+
+        ttft_percentile: hold the TTFT SLO at this percentile of the TTFT
+        distribution instead of its mean — max lam such that
+        P(TTFT > slo_ttft) <= 1 - percentile (the scalar twin of
+        ops/batched.size_batch_tail / native wva_size_tail; the search is
+        forced increasing because the tail probability can be ~0 at both
+        boundaries).
         """
         target.validate()
+        if ttft_percentile is not None and not 0.0 < ttft_percentile < 1.0:
+            raise ValueError(f"invalid ttft_percentile {ttft_percentile}")
         lam_min, lam_max = self.lambda_min, self.lambda_max
 
         lam_ttft = lam_max
         if target.ttft > 0:
-            res = binary_search(lam_min, lam_max, target.ttft, self._ttft_at)
+            if ttft_percentile is not None:
+                res = binary_search(
+                    lam_min, lam_max, 1.0 - ttft_percentile,
+                    lambda lam: self._ttft_tail_at(
+                        lam, target.ttft, ttft_percentile),
+                    increasing=True,
+                )
+            else:
+                res = binary_search(lam_min, lam_max, target.ttft,
+                                    self._ttft_at)
             if res.indicator == BELOW_REGION:
                 raise InfeasibleTargetError(
                     f"TTFT target {target.ttft} below bounded region "

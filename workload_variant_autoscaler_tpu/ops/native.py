@@ -145,11 +145,17 @@ class NativeQueueAnalyzer:
             raise ValueError(f"invalid analyze input (rc={rc})")
         return _metrics_from(buf)
 
-    def size(self, target: TargetPerf) -> SizeResult:
+    def size(self, target: TargetPerf,
+             ttft_percentile: float | None = None) -> SizeResult:
         target.validate()
         buf = (ctypes.c_double * 11)()
-        rc = self._lib.wva_size(*self._args(), target.ttft, target.itl,
-                                target.tps, buf)
+        if ttft_percentile is not None:
+            rc = self._lib.wva_size_tail(
+                *self._args(), target.ttft, target.itl, target.tps,
+                float(ttft_percentile), buf)
+        else:
+            rc = self._lib.wva_size(*self._args(), target.ttft, target.itl,
+                                    target.tps, buf)
         if rc == 1:
             raise InfeasibleTargetError(
                 f"TTFT target {target.ttft} below bounded region")

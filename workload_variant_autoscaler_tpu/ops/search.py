@@ -43,6 +43,7 @@ def binary_search(
     eval_fn: Callable[[float], float],
     tolerance: float = TOLERANCE,
     max_iterations: int = MAX_ITERATIONS,
+    increasing: bool | None = None,
 ) -> BinarySearchResult:
     """Find x* in [x_min, x_max] with eval_fn(x*) ~= y_target.
 
@@ -51,6 +52,12 @@ def binary_search(
     corresponding boundary with a BELOW_REGION/ABOVE_REGION indicator
     (callers treat BELOW_REGION as infeasible, reference
     queueanalyzer.go:208-215).
+
+    increasing: monotonicity direction when the caller knows it; default
+    infers from the boundary evals. A tail probability can be ~0 at BOTH
+    boundaries, which would mis-infer 'decreasing' and brand an
+    always-satisfiable target infeasible (same forcing as the batched
+    path, ops/batched.py _assemble_problem).
     """
     if x_min > x_max:
         raise ValueError(f"invalid range [{x_min}, {x_max}]")
@@ -62,7 +69,8 @@ def binary_search(
     if within_tolerance(y_hi, y_target, tolerance):
         return BinarySearchResult(x_max, IN_REGION)
 
-    increasing = y_lo < y_hi
+    if increasing is None:
+        increasing = y_lo < y_hi
     if (increasing and y_target < y_lo) or (not increasing and y_target > y_lo):
         return BinarySearchResult(x_min, BELOW_REGION)
     if (increasing and y_target > y_hi) or (not increasing and y_target < y_hi):
