@@ -672,15 +672,17 @@ class RestKube:
                         stop.wait(1.0)
                         continue
                     stream.raise_for_status()
-                    # connected: reset the failure backoff here, not at
-                    # clean expiry — a proxy idle-killing long streams
-                    # must not escalate healthy reconnects to the cap
-                    stream_backoff = 2.0
                     for line in stream.iter_lines():
                         if stop.is_set():
                             return
                         if not line:
                             continue
+                        # stream delivered data: reset the failure
+                        # backoff here, not on the 200 alone — a proxy
+                        # idle-killing long streams must not escalate
+                        # healthy reconnects to the cap, but an
+                        # accept-then-drop middlebox still must
+                        stream_backoff = 2.0
                         try:
                             ev = json.loads(line)
                         except json.JSONDecodeError:

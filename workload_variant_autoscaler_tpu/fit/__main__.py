@@ -70,6 +70,7 @@ def main(argv=None) -> int:
         "delta_ms_per_tok_batch": fit.delta and round(fit.delta, 5),
         "decode_r2": fit.decode and round(fit.decode.r2, 4),
         "prefill_r2": fit.prefill and round(fit.prefill.r2, 4),
+        "overhead_ms": fit.overhead_ms and round(fit.overhead_ms, 2),
         "notes": fit.notes,
     }
     print(json.dumps(report, indent=2))

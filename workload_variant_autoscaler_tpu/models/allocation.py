@@ -285,25 +285,31 @@ def create_allocation(system: "System", server_name: str, acc_name: str,
 
 
 def scale_allocation(
-    system: "System", alloc: Allocation, server_name: str
+    system: "System", alloc: Allocation, server_name: str,
+    ttft_percentile: Optional[float] = None,
 ) -> tuple[Optional[Allocation], int]:
     """Recompute this server's allocation on the same slice shape; returns
     (new allocation, replica delta). Reference allocation.go:166-189 —
-    with the nil-deref on an infeasible recompute fixed."""
-    new = create_allocation(system, server_name, alloc.accelerator)
+    with the nil-deref on an infeasible recompute fixed. The global
+    ttft_percentile knob must be threaded through, or a percentile-sized
+    allocation would be silently recomputed on the laxer mean."""
+    new = create_allocation(system, server_name, alloc.accelerator,
+                            ttft_percentile=ttft_percentile)
     if new is None:
         return None, 0
     return new, new.num_replicas - alloc.num_replicas
 
 
 def reallocate(
-    system: "System", server_name: str
+    system: "System", server_name: str,
+    ttft_percentile: Optional[float] = None,
 ) -> tuple[Optional[Allocation], str]:
     """Pick the min-value allocation across all slice shapes
     (reference allocation.go:191-207)."""
     best: Optional[Allocation] = None
     for acc_name in system.accelerators:
-        alloc = create_allocation(system, server_name, acc_name)
+        alloc = create_allocation(system, server_name, acc_name,
+                                  ttft_percentile=ttft_percentile)
         if alloc is not None and (best is None or alloc.value < best.value):
             best = alloc
     if best is None:

@@ -281,6 +281,17 @@ class QueueAnalyzer:
                 res = binary_search(lam_min, lam_max, target.ttft,
                                     self._ttft_at)
             if res.indicator == BELOW_REGION:
+                if ttft_percentile is not None:
+                    # diagnose in the quantity actually searched: citing
+                    # the MEAN region bound here could show a value below
+                    # the SLO and look self-contradictory
+                    raise InfeasibleTargetError(
+                        f"p{ttft_percentile * 100:g} TTFT target "
+                        f"{target.ttft} infeasible: P(TTFT > slo) at the "
+                        f"minimum rate is "
+                        f"{self._ttft_tail_at(lam_min, target.ttft, ttft_percentile):.4f}"
+                        f" > {1.0 - ttft_percentile:.4f}"
+                    )
                 raise InfeasibleTargetError(
                     f"TTFT target {target.ttft} below bounded region "
                     f"[{self._ttft_at(lam_min)}, ...]"
