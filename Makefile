@@ -35,9 +35,17 @@ bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5, tail str
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo
 
+LINT_PATHS = workload_variant_autoscaler_tpu tools bench.py bench_loop.py __graft_entry__.py
+
 .PHONY: lint
-lint: ## Byte-compile as a basic syntax gate
-	$(PY) -m compileall -q workload_variant_autoscaler_tpu tests
+lint: ## Static analysis gate: ruff+mypy when installed, wvalint always
+	@if command -v ruff >/dev/null 2>&1; then \
+		echo "ruff check"; ruff check $(LINT_PATHS); \
+	else echo "ruff not installed; skipping (wvalint gates below)"; fi
+	@if command -v mypy >/dev/null 2>&1; then \
+		echo "mypy"; mypy --ignore-missing-imports workload_variant_autoscaler_tpu; \
+	else echo "mypy not installed; skipping (wvalint gates below)"; fi
+	$(PY) tools/wvalint.py $(LINT_PATHS)
 
 .PHONY: crd-docs
 crd-docs: ## Regenerate docs/reference/variantautoscaling.md from the CRD manifest

@@ -1,0 +1,145 @@
+"""The in-repo static-analysis gate (tools/wvalint.py).
+
+The build image has no ruff/mypy, so the lint rules the reference
+enforces with golangci-lint are implemented from the stdlib; these tests
+pin each rule's behavior (fires on the defect, silent on the idiom) and
+assert the repo itself is clean — the actual CI gate.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import wvalint  # noqa: E402
+
+
+def lint(source: str, with_sigs: bool = False):
+    import ast
+
+    sigs = None
+    if with_sigs:
+        sigs = wvalint._collect_signatures({"x.py": ast.parse(source)})
+    return [f.code for f in wvalint.lint_source("x.py", source, sigs)]
+
+
+class TestRules:
+    def test_undefined_name(self):
+        assert "WVL001" in lint("def f():\n    return missing_thing\n")
+
+    def test_defined_names_pass(self):
+        src = ("import os\n"
+               "def f(x):\n"
+               "    y = os.getcwd()\n"
+               "    return [x + y for x in range(3)]\n")
+        assert lint(src) == []
+
+    def test_conditional_import_binding_counts(self):
+        src = ("try:\n    import fast as impl\nexcept ImportError:\n"
+               "    import slow as impl\n"
+               "def f():\n    return impl\n")
+        assert "WVL001" not in lint(src)
+
+    def test_unused_import(self):
+        assert "WVL002" in lint("import os\nprint(1)\n")
+
+    def test_future_import_exempt(self):
+        assert lint("from __future__ import annotations\nprint(1)\n") == []
+
+    def test_dunder_all_reexport_exempt(self):
+        src = "from os import getcwd\n__all__ = ['getcwd']\n"
+        assert "WVL002" not in lint(src)
+
+    def test_unused_local(self):
+        assert "WVL003" in lint("def f():\n    x = 1\n    return 2\n")
+
+    def test_comprehension_read_local_not_flagged(self):
+        # PEP 709 inlined comprehensions defeat symtable.is_referenced
+        src = ("def f(xs):\n    lim = 3\n"
+               "    return [x for x in xs if x > lim]\n")
+        assert "WVL003" not in lint(src)
+
+    def test_closure_read_local_not_flagged(self):
+        src = ("def f():\n    inv = 2\n"
+               "    def g(x):\n        return x * inv\n"
+               "    return g\n")
+        assert "WVL003" not in lint(src)
+
+    def test_underscore_local_exempt(self):
+        assert "WVL003" not in lint("def f():\n    _unused = 1\n    return 2\n")
+
+    def test_mutable_default(self):
+        assert "WVL101" in lint("def f(x=[]):\n    return x\n")
+
+    def test_bare_except(self):
+        assert "WVL102" in lint(
+            "try:\n    pass\nexcept:\n    pass\n")
+
+    def test_fstring_no_placeholder(self):
+        assert "WVL103" in lint("x = f'static'\n")
+
+    def test_fstring_format_spec_not_flagged(self):
+        assert "WVL103" not in lint("v = 1.5\nx = f'{v:>7.2f}'\n")
+
+    def test_eq_none(self):
+        assert "WVL104" in lint("def f(x):\n    return x == None\n")
+
+    def test_assert_tuple(self):
+        assert "WVL105" in lint("assert (1, 'oops')\n")
+
+    def test_duplicate_dict_key(self):
+        assert "WVL106" in lint("d = {'a': 1, 'a': 2}\n")
+
+    def test_noqa_suppression(self):
+        assert lint("import os  # noqa\nprint(1)\n") == []
+        assert lint("import os  # noqa: WVL002\nprint(1)\n") == []
+        # wrong code does not suppress
+        assert "WVL002" in lint("import os  # noqa: WVL999\nprint(1)\n")
+
+
+class TestCallArity:
+    def test_too_many_positional(self):
+        src = "def f(a, b):\n    return a\nf(1, 2, 3)\n"
+        assert "WVL201" in lint(src, with_sigs=True)
+
+    def test_unknown_kwarg(self):
+        src = "def f(a):\n    return a\nf(a=1, typo=2)\n"
+        assert "WVL201" in lint(src, with_sigs=True)
+
+    def test_valid_calls_pass(self):
+        src = ("def f(a, b=1, *, c=2):\n    return a\n"
+               "f(1)\nf(1, 2)\nf(1, b=2, c=3)\n")
+        assert lint(src, with_sigs=True) == []
+
+    def test_starargs_target_skipped(self):
+        src = "def f(*args):\n    return args\nf(1, 2, 3, 4)\n"
+        assert lint(src, with_sigs=True) == []
+
+    def test_decorated_target_skipped(self):
+        src = ("import functools\n"
+               "@functools.cache\ndef f(a):\n    return a\n"
+               "f(1, 2, 3)\nfunctools.cache\n")
+        assert "WVL201" not in lint(src, with_sigs=True)
+
+    def test_method_calls_not_checked(self):
+        # attribute receivers are unresolvable; stdlib collisions (set.add,
+        # str.format, subprocess.run) must not fire
+        src = ("def add(a, b):\n    return a + b\n"
+               "s = set()\ns.add(1)\nadd(1, 2)\n")
+        assert lint(src, with_sigs=True) == []
+
+
+@pytest.mark.parametrize("paths", [
+    ["workload_variant_autoscaler_tpu", "tools", "bench.py",
+     "bench_loop.py", "__graft_entry__.py"],
+])
+def test_repo_is_clean(paths):
+    """The gate itself: the shipped source must lint clean."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "wvalint.py"), *paths],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert r.returncode == 0, f"lint findings:\n{r.stdout}"

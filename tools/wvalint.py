@@ -1,0 +1,512 @@
+#!/usr/bin/env python
+"""wvalint — stdlib-only static analysis gate for this repo.
+
+The build image has no ruff/mypy/pyflakes and no package installs
+(zero egress), so the lint gate the reference enforces with
+golangci-lint (.github/workflows/ci-pr-checks.yaml:31-37) is
+implemented here from the stdlib: `ast` for structural rules and
+`symtable` for scope-correct name resolution. `make lint` prefers real
+ruff+mypy when they exist on the machine (configs in pyproject.toml)
+and always runs this gate.
+
+Rules (suppress per-line with `# noqa` or `# noqa: WVLxxx`):
+
+  WVL001  undefined name (referenced, resolvable in no enclosing scope,
+          not a builtin, not a module-level binding)
+  WVL002  unused import
+  WVL003  unused local variable (assigned, never read; `_`-prefixed and
+          tuple-unpacking targets exempt)
+  WVL101  mutable default argument (list/dict/set/call literal)
+  WVL102  bare `except:`
+  WVL103  f-string without placeholders
+  WVL104  comparison to None with ==/!= (use is/is not)
+  WVL105  assert on a non-empty tuple (always true)
+  WVL106  duplicate key in dict literal
+  WVL201  intra-package call arity: a positional-count or unknown-kwarg
+          mismatch against a function/method defined in this repo
+          (skipped for *args/**kwargs targets and decorated defs — the
+          achievable slice of what mypy would catch)
+
+Exit status: number of findings (0 = clean).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import os
+import re
+import symtable
+import sys
+from dataclasses import dataclass
+
+NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?", re.I)
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _noqa_lines(source: str) -> dict[int, set[str] | None]:
+    """line -> None (blanket noqa) or set of codes."""
+    out: dict[int, set[str] | None] = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        m = NOQA_RE.search(line)
+        if not m:
+            continue
+        codes = m.group("codes")
+        out[i] = (None if not codes else
+                  {c.strip().upper() for c in codes.split(",") if c.strip()})
+    return out
+
+
+# -- structural rules (ast) ------------------------------------------------
+
+
+class _StructuralVisitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[Finding] = []
+
+    def add(self, node: ast.AST, code: str, msg: str) -> None:
+        self.findings.append(
+            Finding(self.path, getattr(node, "lineno", 0), code, msg))
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _check_defaults(self, node) -> None:
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self.add(d, "WVL101",
+                         f"mutable default argument in {node.name}()")
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self.add(node, "WVL102", "bare `except:` (catch something)")
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node):
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.add(node, "WVL103", "f-string without placeholders")
+        # do NOT recurse into format specs: `f"{x:>7.2f}"` builds a
+        # constant-only JoinedStr for the spec, which is not a finding
+        for v in node.values:
+            if isinstance(v, ast.FormattedValue):
+                self.visit(v.value)
+            # plain constants carry nothing to check
+
+    def visit_Compare(self, node):
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                    (isinstance(comp, ast.Constant) and comp.value is None)
+                    or (isinstance(node.left, ast.Constant)
+                        and node.left.value is None)):
+                self.add(node, "WVL104",
+                         "comparison to None with ==/!= (use is/is not)")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        if isinstance(node.test, ast.Tuple) and node.test.elts:
+            self.add(node, "WVL105",
+                     "assert on a non-empty tuple is always true")
+        self.generic_visit(node)
+
+    def visit_Dict(self, node):
+        seen: set = set()
+        for k in node.keys:
+            if isinstance(k, ast.Constant):
+                try:
+                    hashable = k.value
+                except Exception:  # pragma: no cover
+                    continue
+                if hashable in seen:
+                    self.add(k, "WVL106",
+                             f"duplicate dict key {k.value!r}")
+                seen.add(hashable)
+        self.generic_visit(node)
+
+
+# -- name resolution (symtable) -------------------------------------------
+
+_BUILTINS = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__path__", "__dict__",
+    "__class__", "__module__", "__qualname__", "__annotations__",
+    "WindowsError",
+}
+
+
+def _module_bindings(tree: ast.Module) -> set[str]:
+    """Names bound anywhere at module level (incl. conditional imports)."""
+    names: set[str] = set()
+
+    class TopCollector(ast.NodeVisitor):
+        def visit_Import(self, node):
+            for a in node.names:
+                names.add((a.asname or a.name).split(".")[0])
+
+        def visit_ImportFrom(self, node):
+            for a in node.names:
+                if a.name != "*":
+                    names.add(a.asname or a.name)
+                else:
+                    names.add("*")
+
+        def visit_FunctionDef(self, node):
+            names.add(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            names.add(node.name)
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                names.add(node.id)
+
+    # walk everything: a name assigned inside `if TYPE_CHECKING:` or a
+    # try/except import fallback is still a module binding
+    TopCollector().generic_visit(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                names.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name != "*":
+                    names.add(a.asname or a.name)
+                else:
+                    names.add("*")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, ast.Global):
+            names.update(node.names)
+    return names
+
+
+def _undefined_names(path: str, source: str,
+                     tree: ast.Module) -> list[Finding]:
+    try:
+        table = symtable.symtable(source, path, "exec")
+    except SyntaxError:
+        return []
+    module_names = _module_bindings(tree)
+    if "*" in module_names:
+        return []  # star import: resolution impossible
+    findings: list[Finding] = []
+    # map name -> first use line, from ast (symtable has no line info for
+    # references)
+    use_lines: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            use_lines.setdefault(node.id, node.lineno)
+
+    def walk(tb: symtable.SymbolTable) -> None:
+        for sym in tb.get_symbols():
+            name = sym.get_name()
+            if not sym.is_referenced():
+                continue
+            if sym.is_assigned() or sym.is_parameter() or sym.is_imported():
+                continue
+            if sym.is_free():
+                continue
+            # symtable marks unresolved loads as global-implicit
+            if name in module_names or name in _BUILTINS:
+                continue
+            if tb.get_type() == "class" and name == "__hash__":
+                continue
+            if sym.is_declared_global() or sym.is_global():
+                if name not in module_names and name not in _BUILTINS:
+                    findings.append(Finding(
+                        path, use_lines.get(name, tb.get_lineno()),
+                        "WVL001", f"undefined name {name!r}"))
+        for child in tb.get_children():
+            walk(child)
+
+    walk(table)
+    return findings
+
+
+def _unused(path: str, source: str, tree: ast.Module) -> list[Finding]:
+    """Unused imports (module scope) and unused locals (function scope)."""
+    findings: list[Finding] = []
+    try:
+        table = symtable.symtable(source, path, "exec")
+    except SyntaxError:
+        return []
+
+    # module-level import lines (__future__ imports are directives)
+    import_lines: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                import_lines[(a.asname or a.name).split(".")[0]] = node.lineno
+        elif isinstance(node, ast.ImportFrom) and node.module != "__future__":
+            for a in node.names:
+                if a.name != "*":
+                    import_lines[a.asname or a.name] = node.lineno
+
+    exported = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.List, ast.Tuple))):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    exported.add(elt.value)
+
+    # names referenced anywhere in the module (incl. inside defs) and
+    # names re-exported via explicit `from x import y as y` convention
+    referenced: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            referenced.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                referenced.add(base.id)
+
+    for name, line in import_lines.items():
+        if name in referenced or name in exported or name.startswith("_"):
+            continue
+        findings.append(Finding(path, line, "WVL002",
+                                f"unused import {name!r}"))
+
+    # unused function locals via symtable for LOCALITY + the ast for the
+    # read set (symtable's is_referenced misses reads from inlined
+    # comprehensions, PEP 709) and assign lines
+    assign_lines: dict[tuple[int, str], int] = {}
+    fn_reads: dict[int, set[str]] = {}
+
+    class FnVisitor(ast.NodeVisitor):
+        def visit_FunctionDef(self, fn):
+            reads = fn_reads.setdefault(fn.lineno, set())
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    key = (fn.lineno, node.targets[0].id)
+                    assign_lines.setdefault(key, node.lineno)
+                elif isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Load):
+                    reads.add(node.id)
+            self.generic_visit(fn)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+    FnVisitor().visit(tree)
+
+    def child_free_names(tb: symtable.SymbolTable) -> set:
+        """Names read as free variables by any descendant scope — the
+        parent's symbol for a closure-read local is not marked
+        referenced, so exempt these (pallas kernels close over loop
+        invariants this way)."""
+        out: set = set()
+        for child in tb.get_children():
+            for sym in child.get_symbols():
+                if sym.is_free():
+                    out.add(sym.get_name())
+            out |= child_free_names(child)
+        return out
+
+    def walk(tb: symtable.SymbolTable) -> None:
+        if tb.get_type() == "function":
+            freed = child_free_names(tb)
+            reads = fn_reads.get(tb.get_lineno(), set())
+            for sym in tb.get_symbols():
+                name = sym.get_name()
+                if (sym.is_local() and sym.is_assigned()
+                        and not sym.is_referenced()
+                        and name not in freed
+                        and name not in reads
+                        and not sym.is_parameter()
+                        and not sym.is_imported()
+                        and not name.startswith("_")
+                        and not sym.is_namespace()):
+                    line = assign_lines.get((tb.get_lineno(), name))
+                    if line is None:
+                        continue  # tuple unpacking, with/for targets: exempt
+                    # symtable "referenced" misses nested-scope reads? it
+                    # doesn't — a name read by a closure is marked free
+                    # there and referenced here via is_referenced of child
+                    findings.append(Finding(
+                        path, line, "WVL003",
+                        f"local variable {name!r} assigned but never read"))
+        for child in tb.get_children():
+            walk(child)
+
+    walk(table)
+    return findings
+
+
+# -- intra-package call arity (WVL201) ------------------------------------
+
+
+@dataclass
+class _Sig:
+    name: str
+    pos_max: int          # max positional (excl. self for methods)
+    pos_min: int          # required positional
+    kwargs: set[str]      # acceptable keyword names
+    flexible: bool        # *args/**kwargs/decorated: skip checking
+    is_method: bool
+
+
+def _collect_signatures(trees: dict[str, ast.Module]) -> dict[str, list[_Sig]]:
+    """name -> signatures for all same-named defs in the repo. Checked
+    only when every same-named def agrees on the verdict (conservative:
+    dynamic dispatch can't be resolved statically)."""
+    sigs: dict[str, list[_Sig]] = {}
+    for tree in trees.values():
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            a = node.args
+            flexible = bool(node.decorator_list) or a.vararg is not None \
+                or a.kwarg is not None
+            is_method = False
+            args = list(a.posonlyargs) + list(a.args)
+            if args and args[0].arg in ("self", "cls"):
+                is_method = True
+                args = args[1:]
+            n_defaults = len(a.defaults)
+            kw = {x.arg for x in args} | {x.arg for x in a.kwonlyargs}
+            sigs.setdefault(node.name, []).append(_Sig(
+                name=node.name,
+                pos_max=len(args),
+                pos_min=len(args) - n_defaults,
+                kwargs=kw,
+                flexible=flexible,
+                is_method=is_method,
+            ))
+    return sigs
+
+
+def _check_calls(path: str, tree: ast.Module,
+                 sigs: dict[str, list[_Sig]]) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # bare-name calls only: an attribute call's receiver type is
+        # unresolvable statically, and common method names (add, run,
+        # format, get...) collide with stdlib types constantly
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        else:
+            continue
+        cand = sigs.get(name)
+        if not cand or any(s.flexible for s in cand):
+            continue
+        if any(isinstance(a, ast.Starred) for a in node.args) or \
+                any(k.arg is None for k in node.keywords):
+            continue
+        n_pos = len(node.args)
+        kw_names = {k.arg for k in node.keywords}
+        # a call is flagged only if EVERY candidate signature rejects it
+        def rejects(s: _Sig) -> str | None:
+            if n_pos > s.pos_max:
+                return (f"{name}() takes at most {s.pos_max} positional "
+                        f"args, got {n_pos}")
+            unknown = kw_names - s.kwargs
+            if unknown:
+                return f"{name}() got unknown kwargs {sorted(unknown)}"
+            if n_pos + len(kw_names & s.kwargs) < s.pos_min and \
+                    not (kw_names - s.kwargs):
+                missing = s.pos_min - n_pos - len(kw_names & s.kwargs)
+                return f"{name}() missing {missing} required args"
+            return None
+
+        verdicts = [rejects(s) for s in cand]
+        if all(v is not None for v in verdicts):
+            findings.append(Finding(path, node.lineno, "WVL201", verdicts[0]))
+    return findings
+
+
+# -- driver ----------------------------------------------------------------
+
+
+def lint_source(path: str, source: str,
+                sigs: dict[str, list[_Sig]] | None = None) -> list[Finding]:
+    try:
+        tree = ast.parse(source, path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "WVL000",
+                        f"syntax error: {e.msg}")]
+    v = _StructuralVisitor(path)
+    v.visit(tree)
+    findings = v.findings
+    findings += _undefined_names(path, source, tree)
+    findings += _unused(path, source, tree)
+    if sigs:
+        findings += _check_calls(path, tree, sigs)
+
+    noqa = _noqa_lines(source)
+    out = []
+    for f in findings:
+        codes = noqa.get(f.line, "missing")
+        if codes == "missing":
+            out.append(f)
+        elif codes is None:
+            continue  # blanket noqa
+        elif f.code.upper() not in codes:
+            out.append(f)
+    return out
+
+
+def iter_py_files(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git", "build")]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def main(argv=None) -> int:
+    paths = (argv or sys.argv[1:]) or ["."]
+    files = list(iter_py_files(paths))
+    trees: dict[str, ast.Module] = {}
+    sources: dict[str, str] = {}
+    for fp in files:
+        with open(fp, encoding="utf-8") as f:
+            sources[fp] = f.read()
+        try:
+            trees[fp] = ast.parse(sources[fp], fp)
+        except SyntaxError:
+            pass
+    sigs = _collect_signatures(trees)
+    findings: list[Finding] = []
+    for fp in files:
+        findings += lint_source(fp, sources[fp], sigs)
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        print(f.format())
+    if findings:
+        print(f"\n{len(findings)} finding(s) in {len(files)} files")
+    return min(len(findings), 125)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

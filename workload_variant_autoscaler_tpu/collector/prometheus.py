@@ -12,10 +12,12 @@ from __future__ import annotations
 
 import os
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Protocol
 
-from ..utils import PROMETHEUS_BACKOFF, fix_value, with_backoff
+from ..utils import PROMETHEUS_BACKOFF, fix_value, get_logger, kv, with_backoff
+
+log = get_logger("wva.prometheus")
 
 
 @dataclass(frozen=True)
@@ -150,6 +152,16 @@ class HTTPPromAPI:
         })
         if data.get("resultType") != "matrix" or not data.get("result"):
             return []
+        if len(data["result"]) > 1:
+            # the collector's aggregations reduce to one series; several
+            # means label drift or duplicate jobs — make the truncation
+            # visible instead of silently regressing on partial data
+            log.warning(
+                "query_range returned %d series; using the first "
+                "(mis-scoped query? duplicate jobs?)",
+                len(data["result"]),
+                extra=kv(query=promql[:200]),
+            )
         series = data["result"][0]
         labels = dict(series.get("metric", {}))
         # NaN is passed through RAW, unlike the instant query: a 0/0

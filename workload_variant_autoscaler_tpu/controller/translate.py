@@ -14,7 +14,6 @@ import math
 import os
 import re
 import time
-from dataclasses import dataclass
 
 import yaml
 
@@ -32,7 +31,7 @@ from ..models import (
     SystemSpec,
 )
 from ..models.chips import CHIP_CATALOG
-from ..models.spec import AllocationSolution
+from ..models.spec import AllocationSolution, resolve_for_context
 from ..utils import full_name, get_logger, kv, parse_float_or
 from . import crd
 
@@ -251,6 +250,31 @@ def scale_to_zero_enabled() -> bool:
     return os.environ.get(SCALE_TO_ZERO_ENV, "").lower() == "true"
 
 
+def _warmup_max_batch(va, ap) -> int:
+    """The batch bound the reconcile loop will actually size this
+    candidate with. A context-bucketed profile resolves its bound at the
+    OBSERVED prompt length — warming the static top-level bound can land
+    in a different 256-state K bucket than the first real cycle, which
+    then pays the XLA compile the warmup was meant to absorb. The CR
+    status's last-known token averages are the best available stand-in
+    for the live load (perf-only: wrong guesses just warm an unused
+    shape)."""
+    static = ap.max_batch_size if ap.max_batch_size > 0 else 256
+    if not ap.context_profiles:
+        return static
+    in_tok = parse_float_or(
+        va.status.current_alloc.load.avg_input_tokens, -1.0)
+    if in_tok < 0:
+        return static
+    tmp = SystemSpec()
+    try:
+        add_profile_to_system_data(tmp, va.spec.model_id, ap)
+    except ValueError:
+        return static
+    resolved = resolve_for_context(tmp.profiles[-1], in_tok)
+    return resolved.max_batch_size if resolved.max_batch_size > 0 else static
+
+
 def warmup_plan(
     vas, service_class_cm: dict[str, str] | None = None,
     operator_cm: dict[str, str] | None = None,
@@ -291,9 +315,7 @@ def warmup_plan(
         for ap in va.spec.model_profile.accelerators:
             group["candidates"] += 1
             group["max_batch"] = max(
-                group["max_batch"],
-                ap.max_batch_size if ap.max_batch_size > 0 else 256,
-            )
+                group["max_batch"], _warmup_max_batch(va, ap))
     if not groups:
         groups = {global_p: {"candidates": 0, "max_batch": 256}}
     return [

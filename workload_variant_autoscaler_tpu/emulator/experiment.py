@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import itertools
 import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from .engine import Fleet, MetricsSink, Request, Simulation, SliceModelConfig
 from .loadgen import PoissonLoadGenerator, TokenDistribution

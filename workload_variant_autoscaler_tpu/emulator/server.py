@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import math
 import os
 import random
 import time
@@ -28,7 +29,7 @@ import time
 from prometheus_client import generate_latest
 
 from ..utils import get_logger, kv
-from .engine import Fleet, Replica, Request, SliceModelConfig
+from .engine import Replica, Request, SliceModelConfig
 from .loadgen import TokenDistribution
 from .metrics import PrometheusSink
 from .simprom import SimPromAPI
@@ -197,6 +198,10 @@ def build_app(config: SliceModelConfig | None = None, with_prom_api: bool = Fals
                  "error": "need step > 0, end >= start, <= 11000 points"},
                 status=400)
         samples = prom_shim.query_range(promql, start, end, step)
+        # omit NaN points (0/0 windows) like real Prometheus: bare NaN is
+        # invalid JSON — strict clients would choke, and the fitter drops
+        # NaN anyway so omission is equivalent
+        samples = [s for s in samples if not math.isnan(s.value)]
         result = []
         if samples:
             result = [{

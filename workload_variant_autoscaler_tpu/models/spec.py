@@ -14,7 +14,9 @@ import enum
 import math
 from dataclasses import dataclass, field, replace as dc_replace
 
-from ..ops.queueing import MAX_QUEUE_TO_BATCH_RATIO  # single source of truth
+from ..ops.queueing import (  # noqa: WVL002 — re-exported (allocation.py)
+    MAX_QUEUE_TO_BATCH_RATIO,
+)
 
 # ---------------------------------------------------------------------------
 # Engine constants (reference pkg/config/defaults.go)

@@ -26,7 +26,6 @@ import numpy as np
 
 from .queueing import (
     EPSILON,
-    MAX_QUEUE_TO_BATCH_RATIO,
     STABILITY_SAFETY_FRACTION,
     QueueStats,
     state_dependent_probabilities,

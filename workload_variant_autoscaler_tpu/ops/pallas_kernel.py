@@ -39,7 +39,6 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from .batched import (
     QueueBatch,
