@@ -832,6 +832,42 @@ class TestWarmupPlan:
 
         assert warmup_plan([]) == [(16, 256, None)]
 
+    def test_context_profiles_warm_at_observed_prompt_length(self):
+        """ADVICE r2: a context-bucketed profile resolves its batch bound
+        at the OBSERVED prompt length — the warmup must derive K the same
+        way (from the CR status's last-known token averages) or it
+        compiles a shape the first real cycle never runs."""
+        from workload_variant_autoscaler_tpu.controller.translate import (
+            warmup_plan,
+        )
+
+        va = make_va()
+        ap = va.spec.model_profile.accelerators[0]
+        ap.context_profiles = [
+            crd.ContextProfile(
+                at_context=128, max_batch_size=64,
+                perf_parms=ap.perf_parms),
+            crd.ContextProfile(
+                at_context=8192, max_batch_size=8,
+                perf_parms=ap.perf_parms),
+        ]
+        va.spec.model_profile.accelerators = [ap]
+
+        # no status yet: fall back to the static bound
+        [(_b, mb, _p)] = warmup_plan([va])
+        assert mb == 64
+
+        # long-context load observed: the 8k bucket's bound (8) governs,
+        # so the OTHER profile shape must not be warmed
+        va.status.current_alloc.load.avg_input_tokens = "8192"
+        [(_b, mb, _p)] = warmup_plan([va])
+        assert mb == 8
+
+        # short-context load: the 128 bucket's bound
+        va.status.current_alloc.load.avg_input_tokens = "100"
+        [(_b, mb, _p)] = warmup_plan([va])
+        assert mb == 64
+
 
 class TestTpuRuntimeGauges:
     """collect_tpu_utilization wired into the cycle: duty-cycle/HBM from
@@ -963,3 +999,63 @@ class TestConditionMetrics:
             ("inferno_tpu_duty_cycle_percent", {"namespace": NS}),
         ):
             assert emitter.value(series, **labels) is None, series
+
+
+class TestTpuUtilizationScrapeGate:
+    """ADVICE r2: clusters without the tpu-monitoring-library series must
+    not pay two dead queries per namespace on every reconcile."""
+
+    def _rec(self, prom):
+        from workload_variant_autoscaler_tpu.controller.reconciler import (
+            Reconciler,
+        )
+
+        return Reconciler(kube=InMemoryKube(), prom=prom,
+                          sleep=lambda _s: None)
+
+    def _tpu_queries(self, prom):
+        return [q for q in prom.queries_seen if "tpu_" in q]
+
+    def test_absent_series_back_off(self):
+        prom = FakePromAPI()
+        duty = 'avg(tpu_duty_cycle_percent{namespace="ns"})'
+        hbm = 'sum(tpu_hbm_memory_usage_bytes{namespace="ns"})'
+        prom.set_empty(duty)
+        prom.set_empty(hbm)
+        rec = self._rec(prom)
+        for _ in range(20):
+            rec._collect_tpu_utilization({"ns"})
+        n = len(self._tpu_queries(prom))
+        # 3 probing cycles x 2 queries, then one re-probe every 10th
+        assert n <= 10, f"{n} TPU queries over 20 cycles"
+
+    def test_present_series_scrape_every_cycle(self):
+        prom = FakePromAPI()  # unknown queries return a fresh sample
+        rec = self._rec(prom)
+        for _ in range(5):
+            rec._collect_tpu_utilization({"ns"})
+        assert len(self._tpu_queries(prom)) == 10  # 2 per cycle
+
+    def test_env_disables_scrape(self, monkeypatch):
+        monkeypatch.setenv("WVA_TPU_METRICS", "false")
+        prom = FakePromAPI()
+        rec = self._rec(prom)
+        rec._collect_tpu_utilization({"ns"})
+        assert self._tpu_queries(prom) == []
+
+    def test_series_appearing_resets_backoff(self):
+        prom = FakePromAPI()
+        duty = 'avg(tpu_duty_cycle_percent{namespace="ns"})'
+        hbm = 'sum(tpu_hbm_memory_usage_bytes{namespace="ns"})'
+        prom.set_empty(duty)
+        prom.set_empty(hbm)
+        rec = self._rec(prom)
+        for _ in range(4):
+            rec._collect_tpu_utilization({"ns"})
+        # the DaemonSet lands: series now answer
+        del prom.query_results[duty]
+        del prom.query_results[hbm]
+        for _ in range(12):
+            rec._collect_tpu_utilization({"ns"})
+        tail = self._tpu_queries(prom)[-6:]
+        assert len(tail) == 6  # scraping every cycle again at the end

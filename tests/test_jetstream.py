@@ -104,6 +104,57 @@ class TestJetstreamQueries:
         assert q.startswith("sum(rate(vllm:request_arrival_total")
         assert "clamp_min" not in q
 
+    def test_jetstream_omits_model_matcher_by_default(self):
+        """Upstream JetStream labels series with `id`, not model_name
+        (ADVICE r2): the model matcher is OFF for this dialect while the
+        prometheus-operator-attached namespace label stays."""
+        q = avg_itl_query(MODEL, NS, JETSTREAM_FAMILY)
+        assert "model_name" not in q
+        assert f'namespace="{NS}"' in q
+        # the vllm dialect keeps both matchers
+        qv = avg_itl_query(MODEL, NS, VLLM_FAMILY)
+        assert f'model_name="{MODEL}"' in qv
+
+    def test_jetstream_label_env_overrides(self, monkeypatch):
+        """A scrape config that relabels a model label back on restores
+        per-model scoping via WVA_JETSTREAM_MODEL_LABEL."""
+        from workload_variant_autoscaler_tpu.collector.collector import (
+            active_family,
+        )
+
+        monkeypatch.setenv("WVA_METRIC_FAMILY", "jetstream")
+        monkeypatch.setenv("WVA_JETSTREAM_MODEL_LABEL", "model_name")
+        fam = active_family()
+        q = avg_itl_query(MODEL, NS, fam)
+        assert f'model_name="{MODEL}"' in q
+
+    def test_jetstream_slots_percentage_mode(self, monkeypatch):
+        """Builds exporting slot utilization as a fraction are scaled to
+        a batch via the configured per-replica slot count."""
+        from workload_variant_autoscaler_tpu.collector.collector import (
+            active_family,
+        )
+        from workload_variant_autoscaler_tpu.collector import (
+            avg_running_query,
+        )
+
+        monkeypatch.setenv("WVA_METRIC_FAMILY", "jetstream")
+        monkeypatch.setenv("WVA_JETSTREAM_SLOTS_PERCENTAGE", "true")
+        monkeypatch.setenv("WVA_JETSTREAM_TOTAL_SLOTS", "64")
+        q = avg_running_query(MODEL, NS, active_family())
+        assert "jetstream_slots_used_percentage" in q
+        assert q.endswith("* 64")
+
+    def test_slots_percentage_without_total_keeps_count_gauge(self, monkeypatch):
+        from workload_variant_autoscaler_tpu.collector.collector import (
+            active_family,
+        )
+
+        monkeypatch.setenv("WVA_METRIC_FAMILY", "jetstream")
+        monkeypatch.setenv("WVA_JETSTREAM_SLOTS_PERCENTAGE", "true")
+        monkeypatch.delenv("WVA_JETSTREAM_TOTAL_SLOTS", raising=False)
+        assert active_family().running == "jetstream_slots_used"
+
 
 class TestJetstreamSink:
     def test_exports_jetstream_series_without_arrival(self):

@@ -209,3 +209,128 @@ def test_grafana_dashboard_series_are_real():
                 assert series in known, (
                     f"dashboard references unknown series {series}"
                 )
+
+
+# -- Helm chart render checks (no helm binary needed) ---------------------
+
+CHART = REPO_ROOT / "charts" / "workload-variant-autoscaler-tpu"
+
+
+def _render(value_files=None, sets=None):
+    import sys
+
+    sys.path.insert(0, str(REPO_ROOT / "tools"))
+    from mini_helm import render_chart
+
+    rendered = render_chart(str(CHART),
+                            [str(CHART / f) for f in (value_files or [])],
+                            sets)
+    docs = []
+    for fn, text in sorted(rendered.items()):
+        for doc in yaml.safe_load_all(text):
+            if doc is not None:
+                assert isinstance(doc, dict), f"{fn}: non-mapping doc"
+                docs.append(doc)
+    return docs
+
+
+def _kinds(docs):
+    return {d.get("kind") for d in docs}
+
+
+def test_chart_renders_with_default_values():
+    docs = _render()
+    kinds = _kinds(docs)
+    for expected in ("Namespace", "Deployment", "ConfigMap", "Service",
+                     "ServiceAccount", "ClusterRole", "ClusterRoleBinding",
+                     "Role", "RoleBinding", "ServiceMonitor",
+                     "VariantAutoscaling"):
+        assert expected in kinds, f"chart missing {expected}"
+    # optional features stay off by default
+    assert "HorizontalPodAutoscaler" not in kinds
+    assert not any(d.get("metadata", {}).get("name") == "prometheus-ca"
+                   for d in docs)
+    # every namespaced object carries a namespace
+    cluster_scoped = {"Namespace", "ClusterRole", "ClusterRoleBinding"}
+    for d in docs:
+        if d["kind"] not in cluster_scoped:
+            assert d["metadata"].get("namespace"), \
+                f"{d['kind']}/{d['metadata'].get('name')} lacks namespace"
+
+
+def test_chart_renders_dev_overlay():
+    docs = _render(value_files=["values-dev.yaml"])
+    kinds = _kinds(docs)
+    assert "HorizontalPodAutoscaler" in kinds
+    hpa = next(d for d in docs if d["kind"] == "HorizontalPodAutoscaler")
+    metric = hpa["spec"]["metrics"][0]["external"]["metric"]
+    assert metric["name"] == "inferno_desired_replicas"
+    assert metric["selector"]["matchLabels"]["variant_name"] == "chat-8b"
+    # serving Service + ServiceMonitor pair selects on the model label
+    services = [d for d in docs if d["kind"] == "Service"]
+    serving = [s for s in services
+               if "wva.llm-d.ai/model" in s["spec"].get("selector", {})]
+    assert serving, "dev overlay should enable the serving Service"
+    sms = [d for d in docs if d["kind"] == "ServiceMonitor"]
+    assert any("wva.llm-d.ai/model" in
+               sm["spec"]["selector"].get("matchLabels", {}) for sm in sms)
+    # dev overlay points the controller at plain-http prometheus
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--allow-http-prom" in args
+
+
+def test_chart_prometheus_ca_wiring():
+    """Setting prometheus.caCert must render the ConfigMap AND mount it
+    into the controller with PROMETHEUS_CA_CERT_PATH pointing inside."""
+    pem = "-----BEGIN CERTIFICATE-----\nabc\n-----END CERTIFICATE-----"
+    docs = _render(sets=[f"prometheus.caCert={pem!r}"])
+    # --set strings keep the raw value; accept either quoting outcome
+    cms = [d for d in docs if d.get("kind") == "ConfigMap"
+           and d["metadata"]["name"] == "prometheus-ca"]
+    assert cms, "prometheus-ca ConfigMap not rendered"
+    assert "BEGIN CERTIFICATE" in cms[0]["data"]["ca.crt"]
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    assert env.get("PROMETHEUS_CA_CERT_PATH", "").startswith("/etc/wva/")
+    mounts = container.get("volumeMounts", [])
+    assert any(m["name"] == "prometheus-ca" for m in mounts)
+    vols = dep["spec"]["template"]["spec"].get("volumes", [])
+    assert any(v.get("configMap", {}).get("name") == "prometheus-ca"
+               for v in vols)
+
+
+def test_chart_va_validates_against_crd_schema():
+    """The sample VariantAutoscaling the chart installs must pass the
+    shipped CRD's structural schema (what a real apiserver enforces)."""
+    from workload_variant_autoscaler_tpu.controller import schema
+
+    for docs in (_render(), _render(value_files=["values-dev.yaml"])):
+        vas = [d for d in docs if d.get("kind") == "VariantAutoscaling"]
+        assert vas
+        for va in vas:
+            errors = schema.validate_va_dict(va)
+            assert not errors, errors
+
+
+def test_chart_values_paths_resolve():
+    """Every .Values.* path referenced in a template exists in
+    values.yaml (catches template/values drift statically)."""
+    import re
+
+    with open(CHART / "values.yaml") as f:
+        values = yaml.safe_load(f)
+    missing = []
+    for tpl in sorted((CHART / "templates").glob("*.yaml")):
+        src = tpl.read_text()
+        for m in re.finditer(r"\.Values(?:\.\w+)+", src):
+            path = m.group(0).split(".")[2:]
+            cur = values
+            for part in path:
+                if isinstance(cur, dict) and part in cur:
+                    cur = cur[part]
+                else:
+                    missing.append(f"{tpl.name}: .Values.{'.'.join(path)}")
+                    break
+    assert not missing, missing

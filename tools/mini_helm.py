@@ -1,0 +1,420 @@
+#!/usr/bin/env python
+"""mini_helm — render this repo's Helm chart without helm.
+
+A deliberately small Go-template renderer covering exactly the template
+subset the chart uses (documented in charts/.../README.md). Used by
+tests/test_manifests.py to render-check the chart in environments with
+no helm binary (this build image), and as a CLI for clusters without
+helm:
+
+    python tools/mini_helm.py charts/workload-variant-autoscaler-tpu \
+        [-f overlay-values.yaml ...] [--set a.b=c ...] | kubectl apply -f -
+
+Supported: {{ }} actions with -trim markers, {{/* comments */}},
+if/else/end, range/end (lists, and maps in sorted key order with
+`$k, $v :=`), define/include (from templates/_helpers.tpl), variables,
+dot-paths, string/number/bool literals, and the functions/pipes
+printf, eq, default, quote, indent, nindent, toJson, toYaml. Anything
+else raises — a template drifting outside the subset must fail the
+render test loudly, not render wrong.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+import yaml
+
+ACTION_RE = re.compile(r"\{\{(-)?\s*(.*?)\s*(-)?\}\}", re.S)
+COMMENT_RE = re.compile(r"\{\{(-)?\s*/\*.*?\*/\s*(-)?\}\}", re.S)
+
+
+class TemplateError(Exception):
+    pass
+
+
+# -- tokenizer -------------------------------------------------------------
+
+
+def _tokenize(src: str):
+    """Yield ("text", s) and ("action", expr) tokens with Go-style
+    whitespace trimming applied."""
+    src = COMMENT_RE.sub(lambda m: "{{%s "" %s}}" % (m.group(1) or "",
+                                                     m.group(2) or ""), src)
+    out = []
+    pos = 0
+    for m in ACTION_RE.finditer(src):
+        text = src[pos:m.start()]
+        if m.group(1):  # {{- : trim whitespace (incl. newlines) before
+            text = re.sub(r"\s+$", "", text)
+        out.append(("text", text))
+        out.append(("action", m.group(2), bool(m.group(3))))
+        pos = m.end()
+    out.append(("text", src[pos:]))
+    # apply right-trim: an action with -}} eats following whitespace
+    final = []
+    trim_next = False
+    for tok in out:
+        if tok[0] == "text":
+            s = tok[1]
+            if trim_next:
+                s = re.sub(r"^\s+", "", s)
+                trim_next = False
+            final.append(("text", s))
+        else:
+            final.append(("action", tok[1]))
+            trim_next = tok[2]
+    return final
+
+
+# -- parser ----------------------------------------------------------------
+
+
+class Node:
+    pass
+
+
+class Text(Node):
+    def __init__(self, s):
+        self.s = s
+
+
+class Action(Node):
+    def __init__(self, expr):
+        self.expr = expr
+
+
+class If(Node):
+    def __init__(self, cond):
+        self.cond = cond
+        self.body: list[Node] = []
+        self.orelse: list[Node] = []
+
+
+class Range(Node):
+    def __init__(self, spec):
+        self.spec = spec
+        self.body: list[Node] = []
+
+
+def parse(tokens) -> tuple[list[Node], dict[str, list[Node]]]:
+    root: list[Node] = []
+    defines: dict[str, list[Node]] = {}
+    stack: list[tuple[str, object, list[Node]]] = [("root", None, root)]
+
+    def top() -> list[Node]:
+        return stack[-1][2]
+
+    for tok in tokens:
+        if tok[0] == "text":
+            top().append(Text(tok[1]))
+            continue
+        expr = tok[1].strip()
+        if not expr:
+            continue
+        head = expr.split()[0]
+        if head == "if":
+            node = If(expr[2:].strip())
+            top().append(node)
+            stack.append(("if", node, node.body))
+        elif head == "else":
+            kind, node, _ = stack[-1]
+            if kind != "if":
+                raise TemplateError("else outside if")
+            stack[-1] = ("if-else", node, node.orelse)
+        elif head == "range":
+            node = Range(expr[5:].strip())
+            top().append(node)
+            stack.append(("range", node, node.body))
+        elif head == "define":
+            m = re.match(r'define\s+"([^"]+)"', expr)
+            if not m:
+                raise TemplateError(f"bad define: {expr}")
+            body: list[Node] = []
+            defines[m.group(1)] = body
+            stack.append(("define", m.group(1), body))
+        elif head == "end":
+            if len(stack) == 1:
+                raise TemplateError("unbalanced end")
+            stack.pop()
+        else:
+            top().append(Action(expr))
+    if len(stack) != 1:
+        raise TemplateError("unclosed block")
+    return root, defines
+
+
+# -- evaluation ------------------------------------------------------------
+
+
+_TOKEN_RE = re.compile(r'"(?:[^"\\]|\\.)*"|\||\S+')
+
+
+def _split_expr(expr: str) -> list[list[str]]:
+    """Split an action into pipe stages of word tokens."""
+    stages: list[list[str]] = [[]]
+    for m in _TOKEN_RE.finditer(expr):
+        t = m.group(0)
+        if t == "|":
+            stages.append([])
+        else:
+            stages[-1].append(t)
+    return stages
+
+
+def _truthy(v) -> bool:
+    if v is None or v is False:
+        return False
+    if isinstance(v, (int, float)) and v == 0:
+        return False
+    if isinstance(v, (str, list, dict)) and len(v) == 0:
+        return False
+    return True
+
+
+class Renderer:
+    def __init__(self, context: dict, defines: dict[str, list[Node]]):
+        self.context = context
+        self.defines = defines
+
+    def render(self, nodes: list[Node], dot, variables: dict) -> str:
+        out: list[str] = []
+        for node in nodes:
+            if isinstance(node, Text):
+                out.append(node.s)
+            elif isinstance(node, Action):
+                val = self.eval_expr(node.expr, dot, variables)
+                out.append(self.to_str(val))
+            elif isinstance(node, If):
+                if _truthy(self.eval_expr(node.cond, dot, variables)):
+                    out.append(self.render(node.body, dot, variables))
+                else:
+                    out.append(self.render(node.orelse, dot, variables))
+            elif isinstance(node, Range):
+                out.append(self.eval_range(node, dot, variables))
+        return "".join(out)
+
+    @staticmethod
+    def to_str(v) -> str:
+        if v is None:
+            return ""
+        if v is True:
+            return "true"
+        if v is False:
+            return "false"
+        return str(v)
+
+    def eval_range(self, node: Range, dot, variables) -> str:
+        spec = node.spec
+        m = re.match(r"(\$\w+)\s*,\s*(\$\w+)\s*:=\s*(.+)", spec)
+        out = []
+        if m:
+            kvar, vvar, src = m.group(1), m.group(2), m.group(3)
+            coll = self.eval_expr(src, dot, variables)
+            if coll is None:
+                return ""
+            if isinstance(coll, dict):
+                items = sorted(coll.items())
+            elif isinstance(coll, list):
+                items = list(enumerate(coll))
+            else:
+                raise TemplateError(f"cannot range over {type(coll)}")
+            for k, v in items:
+                nv = dict(variables)
+                nv[kvar] = k
+                nv[vvar] = v
+                out.append(self.render(node.body, v, nv))
+            return "".join(out)
+        coll = self.eval_expr(spec, dot, variables)
+        if coll is None:
+            return ""
+        if isinstance(coll, dict):
+            coll = [v for _, v in sorted(coll.items())]
+        for item in coll:
+            out.append(self.render(node.body, item, variables))
+        return "".join(out)
+
+    def eval_expr(self, expr: str, dot, variables):
+        stages = _split_expr(expr)
+        value = self.eval_stage(stages[0], dot, variables, piped=None)
+        for stage in stages[1:]:
+            value = self.eval_stage(stage, dot, variables, piped=value)
+        return value
+
+    def eval_operand(self, tok: str, dot, variables):
+        if tok.startswith('"'):
+            return json.loads(tok)
+        if tok == ".":
+            return dot
+        if re.fullmatch(r"-?\d+", tok):
+            return int(tok)
+        if re.fullmatch(r"-?\d+\.\d+", tok):
+            return float(tok)
+        if tok in ("true", "false"):
+            return tok == "true"
+        if tok.startswith("$"):
+            name, _, rest = tok.partition(".")
+            if name not in variables:
+                raise TemplateError(f"undefined variable {name}")
+            return self._path(variables[name], rest)
+        if tok.startswith("."):
+            base = (self.context if tok.split(".")[1] in
+                    ("Values", "Chart", "Release") else dot)
+            return self._path(base, tok[1:])
+        raise TemplateError(f"unsupported operand {tok!r}")
+
+    @staticmethod
+    def _path(base, path: str):
+        cur = base
+        for part in [p for p in path.split(".") if p]:
+            if isinstance(cur, dict):
+                cur = cur.get(part)
+            else:
+                cur = getattr(cur, part, None)
+            if cur is None:
+                return None
+        return cur
+
+    def eval_stage(self, words: list[str], dot, variables, piped):
+        if not words:
+            raise TemplateError("empty pipe stage")
+        head = words[0]
+        args = words[1:]
+
+        def ev(tok):
+            return self.eval_operand(tok, dot, variables)
+
+        if head == "include":
+            name = json.loads(args[0])
+            if name not in self.defines:
+                raise TemplateError(f"include of undefined template {name}")
+            ctx = ev(args[1]) if len(args) > 1 else dot
+            return self.render(self.defines[name], ctx, dict(variables))
+        if head == "printf":
+            fmt = json.loads(args[0])
+            vals = [ev(a) for a in args[1:]]
+            fmt = re.sub(r"%[sdvq]",
+                         lambda m: {"s": "%s", "d": "%d", "v": "%s",
+                                    "q": '"%s"'}[m.group(0)[1]], fmt)
+            return fmt % tuple(vals)
+        if head == "eq":
+            vals = [ev(a) for a in args]
+            if piped is not None:
+                vals.append(piped)
+            return all(v == vals[0] for v in vals[1:])
+        if head == "default":
+            d = ev(args[0])
+            v = piped if not args[1:] else ev(args[1])
+            return v if _truthy(v) else d
+        if head == "quote":
+            v = piped if not args else ev(args[0])
+            return json.dumps("" if v is None else self.to_str(v))
+        if head in ("indent", "nindent"):
+            n = int(args[0])
+            v = piped if len(args) < 2 else ev(args[1])
+            s = self.to_str(v)
+            pad = " " * n
+            indented = "\n".join(pad + line if line else line
+                                 for line in s.split("\n"))
+            return ("\n" + indented) if head == "nindent" else indented
+        if head == "toJson":
+            v = piped if not args else ev(args[0])
+            return json.dumps(v)
+        if head == "toYaml":
+            v = piped if not args else ev(args[0])
+            return yaml.safe_dump(v, default_flow_style=False).rstrip("\n")
+        if len(words) == 1 and piped is None:
+            return ev(head)
+        raise TemplateError(f"unsupported function {head!r}")
+
+
+# -- chart driver ----------------------------------------------------------
+
+
+def _deep_merge(base: dict, overlay: dict) -> dict:
+    out = dict(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def render_chart(chart_dir: str, value_files: list[str] | None = None,
+                 sets: list[str] | None = None,
+                 release_name: str = "wva") -> dict[str, str]:
+    """path (relative to templates/) -> rendered text, non-empty only."""
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        chart_meta = yaml.safe_load(f)
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        values = yaml.safe_load(f) or {}
+    for vf in value_files or []:
+        with open(vf) as f:
+            values = _deep_merge(values, yaml.safe_load(f) or {})
+    for s in sets or []:
+        path, _, raw = s.partition("=")
+        cur = values
+        parts = path.split(".")
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        try:
+            cur[parts[-1]] = yaml.safe_load(raw)
+        except yaml.YAMLError:
+            cur[parts[-1]] = raw
+
+    context = {
+        "Values": values,
+        "Chart": {"Name": chart_meta.get("name", ""),
+                  "AppVersion": str(chart_meta.get("appVersion", "")),
+                  "Version": str(chart_meta.get("version", ""))},
+        "Release": {"Name": release_name, "Namespace": "default",
+                    "Service": "Helm"},
+    }
+
+    tdir = os.path.join(chart_dir, "templates")
+    defines: dict[str, list[Node]] = {}
+    sources: dict[str, str] = {}
+    for fn in sorted(os.listdir(tdir)):
+        if not fn.endswith((".yaml", ".yml", ".tpl")):
+            continue
+        with open(os.path.join(tdir, fn)) as f:
+            sources[fn] = f.read()
+    # two passes: collect all defines first (helpers may live anywhere)
+    parsed: dict[str, list[Node]] = {}
+    for fn, src in sources.items():
+        nodes, defs = parse(_tokenize(src))
+        defines.update(defs)
+        parsed[fn] = nodes
+
+    out: dict[str, str] = {}
+    for fn, nodes in parsed.items():
+        if fn.endswith(".tpl"):
+            continue
+        r = Renderer(context, defines)
+        text = r.render(nodes, context, {})
+        if text.strip():
+            out[fn] = text
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description="render a Helm chart (subset)")
+    p.add_argument("chart")
+    p.add_argument("-f", "--values", action="append", default=[])
+    p.add_argument("--set", action="append", default=[], dest="sets")
+    args = p.parse_args(argv)
+    rendered = render_chart(args.chart, args.values, args.sets)
+    for fn in sorted(rendered):
+        print(f"---\n# Source: {fn}")
+        print(rendered[fn].strip("\n"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

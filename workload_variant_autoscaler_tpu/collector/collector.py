@@ -52,6 +52,13 @@ class MetricFamily:
     # in-service concurrency gauge (batch in decode) — observability and
     # the profile fitter's x-axis, never load-gating
     running: str | None = None
+    # label names the per-variant queries match on; "" omits the matcher
+    # entirely (a dialect whose exporter doesn't carry that label)
+    model_label: str = "model_name"
+    namespace_label: str = "namespace"
+    # multiplier applied to the running gauge (a dialect exporting slot
+    # UTILIZATION as a fraction needs x total-slots to become a batch)
+    running_scale: float = 1.0
 
 
 VLLM_FAMILY = MetricFamily(
@@ -69,6 +76,17 @@ VLLM_FAMILY = MetricFamily(
 # JetStream (MaxText serving) exports histograms for request lengths and
 # token latencies plus backlog gauges, but no admission counter — demand
 # under saturation is recovered from the prefill backlog growth.
+#
+# Label caveat (upstream jetstream/core/metrics/prometheus.py): the
+# exporter labels series with its own `id`, NOT model_name — so the
+# model matcher defaults OFF for this dialect (the `namespace` label is
+# attached by prometheus-operator target relabeling and stays). A scrape
+# config that relabels a model label back on can restore per-model
+# scoping via WVA_JETSTREAM_MODEL_LABEL (docs/user-guide/configuration.md).
+# Some builds export slot UTILIZATION (`jetstream_slots_used_percentage`,
+# a 0-1 fraction) instead of a count: set
+# WVA_JETSTREAM_SLOTS_PERCENTAGE=true plus WVA_JETSTREAM_TOTAL_SLOTS=<N>
+# (decode slots per replica) and the running gauge is scaled to a batch.
 JETSTREAM_FAMILY = MetricFamily(
     name="jetstream",
     success_total="jetstream_request_success_count_total",
@@ -79,9 +97,38 @@ JETSTREAM_FAMILY = MetricFamily(
     ttft_seconds="jetstream_time_to_first_token",
     tpot_seconds="jetstream_time_per_output_token",
     running="jetstream_slots_used",
+    model_label="",
 )
 
 METRIC_FAMILIES = {f.name: f for f in (VLLM_FAMILY, JETSTREAM_FAMILY)}
+
+
+def _jetstream_overrides(family: MetricFamily) -> MetricFamily:
+    """Env-tunable deviations for real JetStream endpoints (see the
+    JETSTREAM_FAMILY comment); the in-repo emulator needs none of them."""
+    from dataclasses import replace
+
+    kwargs: dict = {}
+    model_label = os.environ.get("WVA_JETSTREAM_MODEL_LABEL")
+    if model_label is not None:
+        kwargs["model_label"] = model_label.strip()
+    ns_label = os.environ.get("WVA_JETSTREAM_NAMESPACE_LABEL")
+    if ns_label is not None:
+        kwargs["namespace_label"] = ns_label.strip()
+    if os.environ.get("WVA_JETSTREAM_SLOTS_PERCENTAGE", "").lower() in (
+            "1", "true"):
+        from ..utils import parse_float_or
+
+        slots = parse_float_or(
+            os.environ.get("WVA_JETSTREAM_TOTAL_SLOTS"), 0.0)
+        if slots > 0:
+            kwargs["running"] = "jetstream_slots_used_percentage"
+            kwargs["running_scale"] = slots
+        else:
+            log.warning(
+                "WVA_JETSTREAM_SLOTS_PERCENTAGE needs "
+                "WVA_JETSTREAM_TOTAL_SLOTS > 0; keeping the count gauge")
+    return replace(family, **kwargs) if kwargs else family
 
 
 def active_family(cm_value: str | None = None) -> MetricFamily:
@@ -99,6 +146,8 @@ def active_family(cm_value: str | None = None) -> MetricFamily:
                     extra=kv(requested=name,
                              known=sorted(METRIC_FAMILIES)))
         return VLLM_FAMILY
+    if family.name == "jetstream":
+        family = _jetstream_overrides(family)
     return family
 
 # optional TPU runtime gauges (tpu-monitoring-library / libtpu names)
@@ -112,22 +161,37 @@ STALENESS_LIMIT_SECONDS = 300.0  # 5 min (reference collector.go:139-149)
 RATE_WINDOW = "1m"               # (reference collector.go:170-209)
 
 
-def _rate_sum(metric: str, model: str, namespace: str) -> str:
-    return (
-        f'sum(rate({metric}{{{LABEL_MODEL_NAME}="{model}",'
-        f'{LABEL_NAMESPACE}="{namespace}"}}[{RATE_WINDOW}]))'
-    )
+def _selector(model: str, namespace: str | None,
+              family: "MetricFamily | None") -> str:
+    """`{label="value",...}` from the dialect's label names; an empty
+    label name omits that matcher (the dialect's exporter doesn't carry
+    it — see JETSTREAM_FAMILY's label caveat)."""
+    model_label = family.model_label if family else LABEL_MODEL_NAME
+    ns_label = family.namespace_label if family else LABEL_NAMESPACE
+    parts = []
+    if model_label:
+        parts.append(f'{model_label}="{model}"')
+    if ns_label and namespace is not None:
+        parts.append(f'{ns_label}="{namespace}"')
+    return "{" + ",".join(parts) + "}" if parts else ""
 
 
-def _ratio(num: str, den: str, model: str, namespace: str) -> str:
-    return f"{_rate_sum(num, model, namespace)}/{_rate_sum(den, model, namespace)}"
+def _rate_sum(metric: str, model: str, namespace: str,
+              family: "MetricFamily | None" = None) -> str:
+    sel = _selector(model, namespace, family)
+    return f"sum(rate({metric}{sel}[{RATE_WINDOW}]))"
 
 
-def _deriv_sum(metric: str, model: str, namespace: str) -> str:
-    return (
-        f'sum(deriv({metric}{{{LABEL_MODEL_NAME}="{model}",'
-        f'{LABEL_NAMESPACE}="{namespace}"}}[{RATE_WINDOW}]))'
-    )
+def _ratio(num: str, den: str, model: str, namespace: str,
+           family: "MetricFamily | None" = None) -> str:
+    return (f"{_rate_sum(num, model, namespace, family)}/"
+            f"{_rate_sum(den, model, namespace, family)}")
+
+
+def _deriv_sum(metric: str, model: str, namespace: str,
+               family: "MetricFamily | None" = None) -> str:
+    sel = _selector(model, namespace, family)
+    return f"sum(deriv({metric}{sel}[{RATE_WINDOW}]))"
 
 
 def true_arrival_rate_query(
@@ -144,13 +208,13 @@ def true_arrival_rate_query(
     backlog from under-reporting below delivered throughput."""
     family = family or active_family()
     if family.arrival_total is not None:
-        return _rate_sum(family.arrival_total, model, namespace)
+        return _rate_sum(family.arrival_total, model, namespace, family)
     if family.queue_depth is not None:
         return (
-            f"{_rate_sum(family.success_total, model, namespace)} + "
-            f"clamp_min({_deriv_sum(family.queue_depth, model, namespace)}, 0)"
+            f"{_rate_sum(family.success_total, model, namespace, family)} + "
+            f"clamp_min({_deriv_sum(family.queue_depth, model, namespace, family)}, 0)"
         )
-    return _rate_sum(family.success_total, model, namespace)
+    return _rate_sum(family.success_total, model, namespace, family)
 
 
 def arrival_rate_query(
@@ -159,7 +223,7 @@ def arrival_rate_query(
     """Completion-rate fallback for endpoints that lack the arrival counter
     (reference parity, collector.go:170)."""
     family = family or active_family()
-    return _rate_sum(family.success_total, model, namespace)
+    return _rate_sum(family.success_total, model, namespace, family)
 
 
 def avg_prompt_tokens_query(
@@ -168,7 +232,7 @@ def avg_prompt_tokens_query(
     family = family or active_family()
     return _ratio(
         f"{family.prompt_tokens}_sum", f"{family.prompt_tokens}_count",
-        model, namespace,
+        model, namespace, family,
     )
 
 
@@ -178,7 +242,7 @@ def avg_generation_tokens_query(
     family = family or active_family()
     return _ratio(
         f"{family.generation_tokens}_sum", f"{family.generation_tokens}_count",
-        model, namespace,
+        model, namespace, family,
     )
 
 
@@ -187,7 +251,7 @@ def avg_ttft_query(
 ) -> str:
     family = family or active_family()
     return _ratio(f"{family.ttft_seconds}_sum", f"{family.ttft_seconds}_count",
-                  model, namespace)
+                  model, namespace, family)
 
 
 def avg_itl_query(
@@ -195,7 +259,7 @@ def avg_itl_query(
 ) -> str:
     family = family or active_family()
     return _ratio(f"{family.tpot_seconds}_sum", f"{family.tpot_seconds}_count",
-                  model, namespace)
+                  model, namespace, family)
 
 
 def avg_running_query(
@@ -207,10 +271,11 @@ def avg_running_query(
     family = family or active_family()
     if family.running is None:
         return ""
-    return (
-        f'sum(avg_over_time({family.running}{{{LABEL_MODEL_NAME}="{model}",'
-        f'{LABEL_NAMESPACE}="{namespace}"}}[{RATE_WINDOW}]))'
-    )
+    sel = _selector(model, namespace, family)
+    q = f"sum(avg_over_time({family.running}{sel}[{RATE_WINDOW}]))"
+    if family.running_scale != 1.0:
+        q = f"{q} * {family.running_scale:g}"
+    return q
 
 
 def avg_waiting_query(
@@ -221,10 +286,8 @@ def avg_waiting_query(
     family = family or active_family()
     if family.queue_depth is None:
         return ""
-    return (
-        f'sum(avg_over_time({family.queue_depth}{{{LABEL_MODEL_NAME}="{model}",'
-        f'{LABEL_NAMESPACE}="{namespace}"}}[{RATE_WINDOW}]))'
-    )
+    sel = _selector(model, namespace, family)
+    return f"sum(avg_over_time({family.queue_depth}{sel}[{RATE_WINDOW}]))"
 
 
 def availability_query(
@@ -232,12 +295,8 @@ def availability_query(
     family: MetricFamily | None = None,
 ) -> str:
     family = family or active_family()
-    if namespace is None:
-        return f'{family.success_total}{{{LABEL_MODEL_NAME}="{model}"}}'
-    return (
-        f'{family.success_total}{{{LABEL_MODEL_NAME}="{model}",'
-        f'{LABEL_NAMESPACE}="{namespace}"}}'
-    )
+    sel = _selector(model, namespace, family)
+    return f"{family.success_total}{sel}"
 
 
 @dataclass(frozen=True)
@@ -304,7 +363,13 @@ def validate_metrics_availability(
     try:
         samples = prom.query(availability_query(model, namespace, family))
         if not samples:
-            samples = prom.query(availability_query(model, family=family))
+            # namespace-less fallback ONLY while a model matcher keeps it
+            # scoped: for a dialect with no model label (jetstream) the
+            # fallback would be matcher-free and any series anywhere in
+            # the cluster would validate an unrelated broken variant
+            fallback = availability_query(model, family=family)
+            if "{" in fallback:
+                samples = prom.query(fallback)
     except Exception as e:  # noqa: BLE001 - any query failure is a condition
         log.error("prometheus query failed during validation",
                   extra=kv(model=model, namespace=namespace, error=str(e)))

@@ -13,6 +13,7 @@ call (System.calculate), instead of the reference's per-variant loop.
 from __future__ import annotations
 
 import math
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -86,6 +87,8 @@ class Reconciler:
         self._drift_strikes: dict[str, int] = {}
         # set by kick() to wake run_forever early (watch-event trigger)
         self._wake = threading.Event()
+        # ns -> (consecutive empty TPU-gauge probes, cycles skipped since)
+        self._tpu_util_misses: dict[str, tuple[int, int]] = {}
 
     # -- config reading (reference controller.go:490-594) ----------------
 
@@ -574,16 +577,48 @@ class Reconciler:
             prepared.append((va, deploy))
             result.processed.append(key)
         self.emitter.emit_drift_metrics(drift_samples)
-        # TPU runtime gauges (duty cycle / HBM) per serving namespace,
-        # opportunistic: absent series cost one empty query and gate
-        # nothing (north star: "libtpu metrics" next to the vllm scrape)
+        self._collect_tpu_utilization(
+            {deploy.namespace for _va, deploy in prepared})
+        return prepared
+
+    # after this many consecutive empty probes a namespace's TPU-gauge
+    # scrape drops to every Nth cycle: clusters without the
+    # tpu-monitoring-library series should not pay two dead queries per
+    # namespace on every reconcile
+    TPU_UTIL_MISS_LIMIT = 3
+    TPU_UTIL_RETRY_EVERY = 10
+
+    def _collect_tpu_utilization(self, namespaces: set[str]) -> None:
+        """TPU runtime gauges (duty cycle / HBM) per serving namespace,
+        opportunistic and observability-only. WVA_TPU_METRICS=false
+        disables the scrape outright; otherwise namespaces whose series
+        are absent are backed off to an occasional re-probe (they appear
+        within at most TPU_UTIL_RETRY_EVERY cycles of the DaemonSet
+        being installed)."""
+        if os.environ.get("WVA_TPU_METRICS", "").lower() in ("0", "false"):
+            # clear whatever a previously-enabled scrape exported
+            self.emitter.emit_tpu_utilization_metrics({})
+            return
         from ..collector import collect_tpu_utilization
 
-        self.emitter.emit_tpu_utilization_metrics({
-            ns: collect_tpu_utilization(self.prom, ns)
-            for ns in {deploy.namespace for _va, deploy in prepared}
-        })
-        return prepared
+        out: dict[str, dict[str, float]] = {}
+        for ns in namespaces:
+            misses, skipped = self._tpu_util_misses.get(ns, (0, 0))
+            if misses >= self.TPU_UTIL_MISS_LIMIT and \
+                    skipped + 1 < self.TPU_UTIL_RETRY_EVERY:
+                self._tpu_util_misses[ns] = (misses, skipped + 1)
+                out[ns] = {}   # backed off, known-absent
+                continue
+            sample = collect_tpu_utilization(self.prom, ns)
+            out[ns] = sample
+            if sample:
+                self._tpu_util_misses.pop(ns, None)
+            else:
+                self._tpu_util_misses[ns] = (misses + 1, 0)
+        # ALWAYS emit, even empty: the wholesale clear()+set is how a
+        # namespace that dropped out of the fleet stops exporting its
+        # last duty-cycle/HBM reading
+        self.emitter.emit_tpu_utilization_metrics(out)
 
     # consecutive out-of-tolerance cycles before the condition flips: one
     # noisy 1m-rate sample or a transient must not brand the profile bad
