@@ -1056,6 +1056,8 @@ class TestTpuUtilizationScrapeGate:
         del prom.query_results[duty]
         del prom.query_results[hbm]
         for _ in range(12):
+            before = len(self._tpu_queries(prom))
             rec._collect_tpu_utilization({"ns"})
-        tail = self._tpu_queries(prom)[-6:]
-        assert len(tail) == 6  # scraping every cycle again at the end
+        # once a re-probe succeeded, backoff is reset: the LAST cycle
+        # must have issued both queries (not a tautological slice)
+        assert len(self._tpu_queries(prom)) - before == 2

@@ -226,7 +226,8 @@ def _render(value_files=None, sets=None):
                             [str(CHART / f) for f in (value_files or [])],
                             sets)
     docs = []
-    for fn, text in sorted(rendered.items()):
+    # insertion order (crds/ first) — the apply order the CLI emits
+    for fn, text in rendered.items():
         for doc in yaml.safe_load_all(text):
             if doc is not None:
                 assert isinstance(doc, dict), f"{fn}: non-mapping doc"
@@ -250,8 +251,11 @@ def test_chart_renders_with_default_values():
     assert "HorizontalPodAutoscaler" not in kinds
     assert not any(d.get("metadata", {}).get("name") == "prometheus-ca"
                    for d in docs)
+    # the CRD renders first (apply-safe ordering for the kubectl pipe)
+    assert docs[0]["kind"] == "CustomResourceDefinition"
     # every namespaced object carries a namespace
-    cluster_scoped = {"Namespace", "ClusterRole", "ClusterRoleBinding"}
+    cluster_scoped = {"Namespace", "ClusterRole", "ClusterRoleBinding",
+                      "CustomResourceDefinition"}
     for d in docs:
         if d["kind"] not in cluster_scoped:
             assert d["metadata"].get("namespace"), \
@@ -334,3 +338,24 @@ def test_chart_values_paths_resolve():
                     missing.append(f"{tpl.name}: .Values.{'.'.join(path)}")
                     break
     assert not missing, missing
+
+
+def test_mini_helm_else_if_chain():
+    """`{{else if}}` chains must render like helm (one `end` closes the
+    whole chain) — a silent mis-parse here would let a future template
+    edit pass CI while rendering wrong manifests."""
+    import sys
+
+    sys.path.insert(0, str(REPO_ROOT / "tools"))
+    from mini_helm import Renderer, _tokenize, parse
+
+    src = ("{{ if .Values.a }}A{{ else if .Values.b }}B{{ else }}C{{ end }}")
+    nodes, defines = parse(_tokenize(src))
+
+    def render(values):
+        r = Renderer({"Values": values}, defines)
+        return r.render(nodes, {"Values": values}, {})
+
+    assert render({"a": True, "b": True}) == "A"
+    assert render({"a": False, "b": True}) == "B"
+    assert render({"a": False, "b": False}) == "C"

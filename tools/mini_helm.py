@@ -124,7 +124,18 @@ def parse(tokens) -> tuple[list[Node], dict[str, list[Node]]]:
             kind, node, _ = stack[-1]
             if kind != "if":
                 raise TemplateError("else outside if")
-            stack[-1] = ("if-else", node, node.orelse)
+            rest = expr[4:].strip()
+            if rest.startswith("if "):
+                # else-if: a nested If inside the else branch; its `end`
+                # is shared with the parent, so track the extra depth
+                inner = If(rest[3:].strip())
+                node.orelse.append(inner)
+                stack[-1] = ("if-elseif", node, node.orelse)
+                stack.append(("if", inner, inner.body))
+            elif rest:
+                raise TemplateError(f"unsupported else clause: {expr}")
+            else:
+                stack[-1] = ("if-else", node, node.orelse)
         elif head == "range":
             node = Range(expr[5:].strip())
             top().append(node)
@@ -140,6 +151,9 @@ def parse(tokens) -> tuple[list[Node], dict[str, list[Node]]]:
             if len(stack) == 1:
                 raise TemplateError("unbalanced end")
             stack.pop()
+            # one `end` closes an entire if/else-if/else chain
+            while stack[-1][0] == "if-elseif":
+                stack.pop()
         else:
             top().append(Action(expr))
     if len(stack) != 1:
@@ -375,9 +389,17 @@ def render_chart(chart_dir: str, value_files: list[str] | None = None,
                     "Service": "Helm"},
     }
 
-    tdir = os.path.join(chart_dir, "templates")
     defines: dict[str, list[Node]] = {}
     sources: dict[str, str] = {}
+    # crds/ first: helm install applies CRDs before templates, and a
+    # `mini_helm | kubectl apply -f -` pipe needs the same ordering
+    cdir = os.path.join(chart_dir, "crds")
+    if os.path.isdir(cdir):
+        for fn in sorted(os.listdir(cdir)):
+            if fn.endswith((".yaml", ".yml")):
+                with open(os.path.join(cdir, fn)) as f:
+                    sources[os.path.join("crds", fn)] = f.read()
+    tdir = os.path.join(chart_dir, "templates")
     for fn in sorted(os.listdir(tdir)):
         if not fn.endswith((".yaml", ".yml", ".tpl")):
             continue
@@ -410,7 +432,8 @@ def main(argv=None) -> int:
     p.add_argument("--set", action="append", default=[], dest="sets")
     args = p.parse_args(argv)
     rendered = render_chart(args.chart, args.values, args.sets)
-    for fn in sorted(rendered):
+    # insertion order: crds/ first, then templates (apply-safe ordering)
+    for fn in rendered:
         print(f"---\n# Source: {fn}")
         print(rendered[fn].strip("\n"))
     return 0

@@ -103,24 +103,32 @@ JETSTREAM_FAMILY = MetricFamily(
 METRIC_FAMILIES = {f.name: f for f in (VLLM_FAMILY, JETSTREAM_FAMILY)}
 
 
-def _jetstream_overrides(family: MetricFamily) -> MetricFamily:
-    """Env-tunable deviations for real JetStream endpoints (see the
-    JETSTREAM_FAMILY comment); the in-repo emulator needs none of them."""
+def _jetstream_overrides(family: MetricFamily,
+                         cm: dict[str, str] | None = None) -> MetricFamily:
+    """Tunable deviations for real JetStream endpoints (see the
+    JETSTREAM_FAMILY comment); the in-repo emulator needs none of them.
+    Env first, then the operator ConfigMap — the standard knob
+    precedence (reference controller.go:516-538)."""
     from dataclasses import replace
 
+    def knob(key: str) -> str | None:
+        v = os.environ.get(key)
+        if v is None and cm:
+            v = cm.get(key)
+        return v
+
     kwargs: dict = {}
-    model_label = os.environ.get("WVA_JETSTREAM_MODEL_LABEL")
+    model_label = knob("WVA_JETSTREAM_MODEL_LABEL")
     if model_label is not None:
         kwargs["model_label"] = model_label.strip()
-    ns_label = os.environ.get("WVA_JETSTREAM_NAMESPACE_LABEL")
+    ns_label = knob("WVA_JETSTREAM_NAMESPACE_LABEL")
     if ns_label is not None:
         kwargs["namespace_label"] = ns_label.strip()
-    if os.environ.get("WVA_JETSTREAM_SLOTS_PERCENTAGE", "").lower() in (
+    if (knob("WVA_JETSTREAM_SLOTS_PERCENTAGE") or "").lower() in (
             "1", "true"):
         from ..utils import parse_float_or
 
-        slots = parse_float_or(
-            os.environ.get("WVA_JETSTREAM_TOTAL_SLOTS"), 0.0)
+        slots = parse_float_or(knob("WVA_JETSTREAM_TOTAL_SLOTS"), 0.0)
         if slots > 0:
             kwargs["running"] = "jetstream_slots_used_percentage"
             kwargs["running_scale"] = slots
@@ -131,7 +139,8 @@ def _jetstream_overrides(family: MetricFamily) -> MetricFamily:
     return replace(family, **kwargs) if kwargs else family
 
 
-def active_family(cm_value: str | None = None) -> MetricFamily:
+def active_family(cm_value: str | None = None,
+                  cm: dict[str, str] | None = None) -> MetricFamily:
     """The dialect selected by WVA_METRIC_FAMILY — env first, then the
     operator-ConfigMap value (reference env-over-ConfigMap precedence,
     controller.go:516-538), default vllm. An unknown name warns and falls
@@ -147,7 +156,7 @@ def active_family(cm_value: str | None = None) -> MetricFamily:
                              known=sorted(METRIC_FAMILIES)))
         return VLLM_FAMILY
     if family.name == "jetstream":
-        family = _jetstream_overrides(family)
+        family = _jetstream_overrides(family, cm=cm)
     return family
 
 # optional TPU runtime gauges (tpu-monitoring-library / libtpu names)

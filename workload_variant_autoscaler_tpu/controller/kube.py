@@ -178,7 +178,14 @@ class InMemoryKube:
         with self._lock:
             key = (va.namespace, va.name)
             etype = "MODIFIED" if key in self.vas else "ADDED"
-            self.vas[key] = copy.deepcopy(va)
+            stored = copy.deepcopy(va)
+            # every write bumps resourceVersion, like the apiserver
+            prev = self.vas.get(key)
+            stored.metadata.resource_version = str(
+                int((prev.metadata.resource_version if prev else "0")
+                    or "0") + 1)
+            self.vas[key] = stored
+            va.metadata.resource_version = stored.metadata.resource_version
         self._notify(
             WatchEvent(etype, "VariantAutoscaling", va.name, va.namespace))
 
@@ -249,6 +256,15 @@ class InMemoryKube:
             if key not in self.vas:
                 raise NotFoundError(f"variantautoscaling {key} not found")
             stored = self.vas[key]
+            # optimistic concurrency, like the apiserver: a PUT carrying
+            # a resourceVersion older than storage is a 409 (the
+            # reconciler's conflict-retried writer depends on this;
+            # an empty RV skips the check — test-constructed objects)
+            req_rv = va.metadata.resource_version
+            if req_rv and req_rv != stored.metadata.resource_version:
+                raise ConflictError(
+                    f"variantautoscaling {key}: stale resourceVersion "
+                    f"{req_rv} (storage at {stored.metadata.resource_version})")
             # status subresource: spec comes from storage, status from the
             # request — revalidate the merged object like the apiserver does
             merged = copy.deepcopy(stored)
@@ -258,6 +274,8 @@ class InMemoryKube:
             stored.metadata.resource_version = str(
                 int(stored.metadata.resource_version or "0") + 1
             )
+            # hand the new RV back, like a PUT response body does
+            va.metadata.resource_version = stored.metadata.resource_version
             self.status_update_count += 1
         # outside the lock: a slow listener must not serialize the API
         self._notify(WatchEvent(
@@ -279,7 +297,12 @@ class InMemoryKube:
             }
             stored = self.vas[key]
             stored.metadata.owner_references = [ref]
+            # a merge-patch is a write: it bumps resourceVersion (a
+            # status PUT reusing a pre-patch RV must then conflict)
+            stored.metadata.resource_version = str(
+                int(stored.metadata.resource_version or "0") + 1)
             va.metadata.owner_references = [ref]
+            va.metadata.resource_version = stored.metadata.resource_version
 
     def put_node(self, node: Node) -> None:
         with self._lock:
@@ -535,11 +558,16 @@ class RestKube:
         return va_from_dict(obj)
 
     def update_variant_autoscaling_status(self, va: VariantAutoscaling) -> None:
-        self._request(
+        obj = self._request(
             "PUT",
             f"/apis/{GROUP}/{VERSION}/namespaces/{va.namespace}/{PLURAL}/{va.name}/status",
             body=va_to_dict(va),
         )
+        # carry the new resourceVersion back onto the caller's object
+        # (client-go Update semantics) so a follow-up write isn't stale
+        rv = ((obj or {}).get("metadata") or {}).get("resourceVersion")
+        if rv:
+            va.metadata.resource_version = rv
 
     def patch_owner_reference(self, va: VariantAutoscaling, deploy: Deployment) -> None:
         patch = {
@@ -556,12 +584,15 @@ class RestKube:
                 ]
             }
         }
-        self._request(
+        obj = self._request(
             "PATCH",
             f"/apis/{GROUP}/{VERSION}/namespaces/{va.namespace}/{PLURAL}/{va.name}",
             body=patch,
             content_type="application/merge-patch+json",
         )
+        rv = ((obj or {}).get("metadata") or {}).get("resourceVersion")
+        if rv:
+            va.metadata.resource_version = rv
 
     # -- watch (?watch=true streaming) -----------------------------------
 

@@ -230,9 +230,11 @@ class Reconciler:
                                  system_spec, result,
                                  demand_headroom=self._demand_headroom(operator_cm),
                                  family=active_family(
-                                     operator_cm.get("WVA_METRIC_FAMILY")),
+                                     operator_cm.get("WVA_METRIC_FAMILY"),
+                                     cm=operator_cm),
                                  drift_tolerance=self._cm_float(
-                                     operator_cm, "WVA_DRIFT_TOLERANCE", 0.5))
+                                     operator_cm, "WVA_DRIFT_TOLERANCE", 0.5),
+                                 operator_cm=operator_cm)
         mark("prepare")
         if not prepared:
             self.emitter.emit_power_metrics({})
@@ -439,7 +441,7 @@ class Reconciler:
 
     def _prepare(self, active, accelerator_cm, service_class_cm, system_spec,
                  result, demand_headroom: float = 0.0, family=None,
-                 drift_tolerance: float = 0.5):
+                 drift_tolerance: float = 0.5, operator_cm=None):
         prepared: list[tuple[crd.VariantAutoscaling, Deployment]] = []
         # this cycle's drift readings, replacing the gauge wholesale at
         # the end (same invariant as the power series: deleted variants'
@@ -578,7 +580,8 @@ class Reconciler:
             result.processed.append(key)
         self.emitter.emit_drift_metrics(drift_samples)
         self._collect_tpu_utilization(
-            {deploy.namespace for _va, deploy in prepared})
+            {deploy.namespace for _va, deploy in prepared},
+            operator_cm=operator_cm)
         return prepared
 
     # after this many consecutive empty probes a namespace's TPU-gauge
@@ -588,14 +591,18 @@ class Reconciler:
     TPU_UTIL_MISS_LIMIT = 3
     TPU_UTIL_RETRY_EVERY = 10
 
-    def _collect_tpu_utilization(self, namespaces: set[str]) -> None:
+    def _collect_tpu_utilization(self, namespaces: set[str],
+                                 operator_cm=None) -> None:
         """TPU runtime gauges (duty cycle / HBM) per serving namespace,
         opportunistic and observability-only. WVA_TPU_METRICS=false
-        disables the scrape outright; otherwise namespaces whose series
-        are absent are backed off to an occasional re-probe (they appear
-        within at most TPU_UTIL_RETRY_EVERY cycles of the DaemonSet
-        being installed)."""
-        if os.environ.get("WVA_TPU_METRICS", "").lower() in ("0", "false"):
+        (env first, then the operator ConfigMap — the standard knob
+        precedence) disables the scrape outright; otherwise namespaces
+        whose series are absent are backed off to an occasional re-probe
+        (they appear within at most TPU_UTIL_RETRY_EVERY cycles of the
+        DaemonSet being installed)."""
+        knob = (os.environ.get("WVA_TPU_METRICS")
+                or (operator_cm or {}).get("WVA_TPU_METRICS") or "")
+        if knob.lower() in ("0", "false"):
             # clear whatever a previously-enabled scrape exported
             self.emitter.emit_tpu_utilization_metrics({})
             return
