@@ -388,6 +388,10 @@ class Scenario:
     stabilization: str = "180s"
     operator_extra: dict = _field(default_factory=dict)  # extra operator-CM keys
     judge_ttft: bool = False  # strict mode: slo_held requires the TTFT tail too
+    # demand-breakout probe period (0 = off): between cadence cycles the
+    # probe compares live demand against the published capacity envelope
+    # and reconciles early on breakout (reconciler.demand_probe)
+    fast_probe_ms: float = 0.0
 
 
 def _make_va(v: VariantScenario) -> crd.VariantAutoscaling:
@@ -466,11 +470,25 @@ def run_scenario(sc: Scenario) -> dict:
     curves = {v.name: _power_curve(sc.accelerators[v.accelerator]["chip"])
               for v in sc.variants}
     peak_desired = {v.name: 1 for v in sc.variants}
+    probe_kicks = 0
     last_sample_ms = 0.0
     next_reconcile = sc.reconcile_ms
+    next_probe = sc.fast_probe_ms
+
+    def do_reconcile(now_ms):
+        rec.reconcile()
+        for v in sc.variants:
+            va = kube.get_variant_autoscaling(v.name, NS)
+            desired = va.status.desired_optimized_alloc.num_replicas
+            peak_desired[v.name] = max(peak_desired[v.name], desired)
+            kube.put_deployment(Deployment(
+                name=v.name, namespace=NS,
+                spec_replicas=desired, status_replicas=desired))
+            fleets[v.name].set_replicas(max(desired, 0), now_ms)
+        sim.kick()
 
     def on_tick(now_ms):
-        nonlocal last_sample_ms, next_reconcile
+        nonlocal last_sample_ms, next_reconcile, next_probe, probe_kicks
         dt = now_ms - last_sample_ms
         last_sample_ms = now_ms
         for v in sc.variants:
@@ -482,16 +500,14 @@ def run_scenario(sc: Scenario) -> dict:
         prom.scrape(now_ms)
         if now_ms >= next_reconcile:
             next_reconcile += sc.reconcile_ms
-            rec.reconcile()
-            for v in sc.variants:
-                va = kube.get_variant_autoscaling(v.name, NS)
-                desired = va.status.desired_optimized_alloc.num_replicas
-                peak_desired[v.name] = max(peak_desired[v.name], desired)
-                kube.put_deployment(Deployment(
-                    name=v.name, namespace=NS,
-                    spec_replicas=desired, status_replicas=desired))
-                fleets[v.name].set_replicas(max(desired, 0), now_ms)
-            sim.kick()
+            do_reconcile(now_ms)
+        elif sc.fast_probe_ms and now_ms >= next_probe:
+            # sim-time analogue of the controller's probe thread: one
+            # cheap demand query per variant; breakout -> early cycle
+            next_probe += sc.fast_probe_ms
+            if rec.demand_probe():
+                probe_kicks += 1
+                do_reconcile(now_ms)
 
     sim.run_until(duration_ms, on_tick=on_tick, tick_ms=5000.0)
 
@@ -525,7 +541,7 @@ def run_scenario(sc: Scenario) -> dict:
             "energy_wh": round(watt_ms[v.name] / 3_600_000.0, 1),
             "requests": gens[v.name].generated,
         }
-    return {
+    out = {
         "metric": "chip_hours_to_hold_p95_itl_slo",
         "value": round(total_chip_hours, 3),
         "unit": "chip-hours",
@@ -536,6 +552,9 @@ def run_scenario(sc: Scenario) -> dict:
         "scenario": sc.key,
         "variants": per_variant,
     }
+    if sc.fast_probe_ms:
+        out["probe_kicks"] = probe_kicks
+    return out
 
 
 _PREMIUM_YAML = (
@@ -609,6 +628,24 @@ SCENARIOS: dict[str, Scenario] = {
         operator_extra={"WVA_TTFT_PERCENTILE": "0.95",
                         "WVA_DEMAND_HEADROOM": "0.25"},
         judge_ttft=True,
+    ),
+    # strict mode via REACTION TIME instead of blunt headroom: a 5s
+    # demand-breakout probe (reconciler.demand_probe — one PromQL query
+    # between cycles, full reconcile only on breakout) catches each ramp
+    # step within seconds, so the same both-tails guarantee needs less
+    # standing overprovisioning than sharegpt-strict-slo's 0.75. The
+    # reference cannot react faster than its fixed interval at any cost.
+    "sharegpt-fast-probe": Scenario(
+        key="sharegpt-fast-probe",
+        title="config-1 ramp, BOTH p95 tails held: 5s breakout probe + small headroom",
+        accelerators={"v5e-1": {"chip": "v5e", "chips": "1", "cost": "20.0"}},
+        service_classes={"premium": _PREMIUM_YAML},
+        variants=[_CHAT_8B],
+        reconcile_ms=30_000.0,
+        operator_extra={"WVA_DEMAND_HEADROOM": "0.25",
+                        "WVA_FAST_PROBE_WINDOW": "15s"},
+        judge_ttft=True,
+        fast_probe_ms=5_000.0,
     ),
     # config-1 ramp with heavy-tailed (lognormal, sigma=1) lengths: real
     # ShareGPT histograms, not the uniform mix — stresses KV admission and

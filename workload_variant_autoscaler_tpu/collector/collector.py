@@ -186,9 +186,10 @@ def _selector(model: str, namespace: str | None,
 
 
 def _rate_sum(metric: str, model: str, namespace: str,
-              family: "MetricFamily | None" = None) -> str:
+              family: "MetricFamily | None" = None,
+              window: str = RATE_WINDOW) -> str:
     sel = _selector(model, namespace, family)
-    return f"sum(rate({metric}{sel}[{RATE_WINDOW}]))"
+    return f"sum(rate({metric}{sel}[{window}]))"
 
 
 def _ratio(num: str, den: str, model: str, namespace: str,
@@ -198,13 +199,15 @@ def _ratio(num: str, den: str, model: str, namespace: str,
 
 
 def _deriv_sum(metric: str, model: str, namespace: str,
-               family: "MetricFamily | None" = None) -> str:
+               family: "MetricFamily | None" = None,
+               window: str = RATE_WINDOW) -> str:
     sel = _selector(model, namespace, family)
-    return f"sum(deriv({metric}{sel}[{RATE_WINDOW}]))"
+    return f"sum(deriv({metric}{sel}[{window}]))"
 
 
 def true_arrival_rate_query(
-    model: str, namespace: str, family: MetricFamily | None = None
+    model: str, namespace: str, family: MetricFamily | None = None,
+    window: str = RATE_WINDOW,
 ) -> str:
     """Demand measured at admission. Under saturation the success rate caps
     at delivered throughput, hiding excess load; the arrival counter does
@@ -217,13 +220,14 @@ def true_arrival_rate_query(
     backlog from under-reporting below delivered throughput."""
     family = family or active_family()
     if family.arrival_total is not None:
-        return _rate_sum(family.arrival_total, model, namespace, family)
+        return _rate_sum(family.arrival_total, model, namespace, family,
+                         window)
     if family.queue_depth is not None:
         return (
-            f"{_rate_sum(family.success_total, model, namespace, family)} + "
-            f"clamp_min({_deriv_sum(family.queue_depth, model, namespace, family)}, 0)"
+            f"{_rate_sum(family.success_total, model, namespace, family, window)} + "
+            f"clamp_min({_deriv_sum(family.queue_depth, model, namespace, family, window)}, 0)"
         )
-    return _rate_sum(family.success_total, model, namespace, family)
+    return _rate_sum(family.success_total, model, namespace, family, window)
 
 
 def arrival_rate_query(
@@ -432,6 +436,7 @@ def collect_load(
     namespace: str,
     fallback: CollectedLoad | None = None,
     family: MetricFamily | None = None,
+    probe_window: str | None = None,
 ) -> CollectedLoad:
     """Run the aggregate queries (reference collector.go:158-278) and
     convert units: arrival req/s -> req/min, latencies sec -> msec.
@@ -456,6 +461,19 @@ def collect_load(
     success_fetched = False
     arrival_rps = _value_or_none(
         prom, true_arrival_rate_query(model, namespace, family))
+    if arrival_rps is not None and probe_window:
+        # demand-breakout mode (WVA_FAST_DEMAND_PROBE): size on the MAX
+        # of the standard 1m window and the probe's short window. Right
+        # after a ramp step the 1m rate still averages mostly-old load —
+        # a probe-kicked cycle sizing on it under-provisions the very
+        # step it reacted to. Steady state the two windows agree (the
+        # short one is noisier; max() errs a few % conservative, the
+        # fail-safe direction for an SLO autoscaler).
+        short = _value_or_none(
+            prom, true_arrival_rate_query(model, namespace, family,
+                                          window=probe_window))
+        if short is not None:
+            arrival_rps = max(arrival_rps, short)
     if arrival_rps is None:
         success_rps = _value_or_none(
             prom, arrival_rate_query(model, namespace, family))

@@ -89,6 +89,10 @@ class Reconciler:
         self._wake = threading.Event()
         # ns -> (consecutive empty TPU-gauge probes, cycles skipped since)
         self._tpu_util_misses: dict[str, tuple[int, int]] = {}
+        # demand-breakout probe state: key -> (demand PromQL, capacity
+        # the published count sustains in req/s); rebuilt every publish
+        self._probe_targets: dict[str, tuple[str, float]] = {}
+        self._last_operator_cm: dict[str, str] = {}
 
     # -- config reading (reference controller.go:490-594) ----------------
 
@@ -153,6 +157,7 @@ class Reconciler:
 
     def _reconcile_timed(self, mark) -> ReconcileResult:
         operator_cm = self.read_operator_config()
+        self._last_operator_cm = operator_cm  # demand-probe knob source
         interval = self.read_optimization_interval(operator_cm)
         result = ReconcileResult(requeue_after=interval)
 
@@ -238,6 +243,7 @@ class Reconciler:
         mark("prepare")
         if not prepared:
             self.emitter.emit_power_metrics({})
+            self._probe_targets = {}   # nothing published -> nothing to probe
             # skip-path conditions (MetricsAvailable=False etc.) were
             # written to the CRs above and must reach the series too
             self._emit_conditions()
@@ -720,6 +726,12 @@ class Reconciler:
     # -- application (reference controller.go:338-407) -------------------
 
     def _apply(self, prepared, optimized, result, system) -> None:
+        from ..collector import true_arrival_rate_query
+
+        family = active_family(
+            self._last_operator_cm.get("WVA_METRIC_FAMILY"),
+            cm=self._last_operator_cm)
+        probe_targets: dict[str, tuple[str, float]] = {}
         power: dict[tuple[str, str, str], float] = {}
         for va, _deploy in prepared:
             key = full_name(va.name, va.namespace)
@@ -732,6 +744,22 @@ class Reconciler:
             power[(va.name, va.namespace, optimized[key].accelerator)] = (
                 system.variant_power_watts(
                     key, replicas=optimized[key].num_replicas))
+            # capacity envelope for the demand-breakout probe: the rate
+            # the PUBLISHED replica count sustains at the sized operating
+            # point (req/s); a mid-interval probe comparing live demand
+            # against this decides whether to kick an early cycle
+            server = system.servers.get(key)
+            if server is not None and server.allocation is not None:
+                cap = (optimized[key].num_replicas
+                       * server.allocation.max_arrv_rate_per_replica
+                       * 1000.0)
+                if cap > 0:
+                    probe_targets[key] = (
+                        true_arrival_rate_query(va.spec.model_id,
+                                                va.namespace, family,
+                                                window=self.probe_window()),
+                        cap,
+                    )
             try:
                 fresh = with_backoff(
                     lambda: self.kube.get_variant_autoscaling(va.name, va.namespace),
@@ -764,6 +792,7 @@ class Reconciler:
 
             self._update_status(fresh)
         self.emitter.emit_power_metrics(power)
+        self._probe_targets = probe_targets
 
     def _update_status(self, va: crd.VariantAutoscaling) -> None:
         from .kube import ConflictError
@@ -782,6 +811,83 @@ class Reconciler:
             with_backoff(attempt, backoff=STANDARD_BACKOFF, sleep=self.sleep)
         except Exception as e:  # noqa: BLE001
             log.error("failed to update status", extra=kv(variant=va.name, error=str(e)))
+
+    # -- demand-breakout probe (beyond reference) -------------------------
+    # The loop samples Prometheus once per GLOBAL_OPT_INTERVAL; a ramp
+    # step landing right after a cycle runs under-provisioned for up to a
+    # full interval before the controller even sees it (the reference has
+    # the same blindspot — its only mitigation is overprovisioning).
+    # WVA_FAST_DEMAND_PROBE=<seconds> runs ONE cheap demand query per
+    # variant between cycles and kicks an immediate full reconcile when
+    # observed demand breaks out of the published capacity envelope.
+    # Scale-down never triggers early (stabilization governs it).
+
+    PROBE_ENV = "WVA_FAST_DEMAND_PROBE"
+    PROBE_UTIL_ENV = "WVA_FAST_PROBE_UTIL"
+    PROBE_WINDOW_ENV = "WVA_FAST_PROBE_WINDOW"
+
+    def _probe_knob(self, key: str, default: float) -> float:
+        raw = os.environ.get(key) or self._last_operator_cm.get(key)
+        return parse_float_or(raw, default)
+
+    def probe_window(self) -> str:
+        """Rate window for the probe's demand query. Default 1m (safe at
+        any Prometheus scrape interval); drop it to e.g. 15s where the
+        scrape interval permits — a 1m window smooths a ramp step so
+        much that detection can take most of the window."""
+        return (os.environ.get(self.PROBE_WINDOW_ENV)
+                or self._last_operator_cm.get(self.PROBE_WINDOW_ENV)
+                or "1m").strip()
+
+    def demand_probe(self) -> bool:
+        """One demand query per published variant; True (and an
+        immediate-cycle kick) when any variant's observed arrival rate
+        pushes its fleet past WVA_FAST_PROBE_UTIL (default 0.85) of the
+        PUBLISHED capacity. The envelope is replicas x max SLO-feasible
+        rate — the mean SLOs still hold right up to 1.0, but tail
+        latency degrades sharply approaching it, so the danger zone
+        starts below. Scale-down never triggers early (stabilization
+        governs it). Best-effort: query failures skip the variant — the
+        cadence cycle remains the backbone."""
+        util = self._probe_knob(self.PROBE_UTIL_ENV, 0.85)
+        for key, (query, cap_rps) in list(self._probe_targets.items()):
+            try:
+                samples = self.prom.query(query)
+            except Exception:  # noqa: BLE001 — probe is best-effort
+                continue
+            rate = sum(s.value for s in samples
+                       if not math.isnan(s.value) and not math.isinf(s.value))
+            if rate > cap_rps * util:
+                log.info(
+                    "demand breakout: reconciling early",
+                    extra=kv(variant=key, observed_rps=round(rate, 2),
+                             capacity_rps=round(cap_rps, 2),
+                             util_threshold=util))
+                self.kick()
+                return True
+        return False
+
+    def _start_demand_probe(self, stop: threading.Event) -> None:
+        """Poll demand on a daemon thread at the configured period; a
+        disabled knob is re-checked lazily so a ConfigMap edit can turn
+        the probe on/off without a restart."""
+
+        def loop() -> None:
+            while not stop.is_set():
+                interval = self._probe_knob(self.PROBE_ENV, 0.0)
+                if interval <= 0:
+                    stop.wait(5.0)
+                    continue
+                stop.wait(interval)
+                if not stop.is_set():
+                    try:
+                        self.demand_probe()
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("demand probe failed",
+                                    extra=kv(error=str(e)))
+
+        threading.Thread(target=loop, name="wva-demand-probe",
+                         daemon=True).start()
 
     # -- loop -------------------------------------------------------------
 
@@ -846,6 +952,7 @@ class Reconciler:
         stop = stop or threading.Event()
         if watch:
             self.start_watches(stop)
+        self._start_demand_probe(stop)
         while not stop.is_set():
             self._wake.clear()
             try:

@@ -77,6 +77,13 @@ class SimPromAPI:
                 "ratio", (f"{fam.tpot_seconds}_sum",
                           f"{fam.tpot_seconds}_count")),
         }
+        # short-window demand variants: the controller's demand-breakout
+        # probe (reconciler.demand_probe) queries with
+        # WVA_FAST_PROBE_WINDOW to see ramp steps through less smoothing
+        d_kind, d_payload = demand
+        for w_str, w_s in (("15s", 15.0), ("30s", 30.0)):
+            self._queries[true_arrival_rate_query(m, ns, fam, window=w_str)] \
+                = (d_kind + "_w", (d_payload, w_s))
         if fam.running:
             self._queries[avg_running_query(m, ns, fam)] = ("avg", fam.running)
         if fam.queue_depth:
@@ -98,7 +105,8 @@ class SimPromAPI:
         return bool(self.history) and series in self.history[-1][1]
 
     def _window(self, as_of: float | None = None,
-                times: list[float] | None = None):
+                times: list[float] | None = None,
+                window_s: float = RATE_WINDOW_S):
         """(t_now, latest, t_old, oldest) for the rate window ending at
         `as_of` (default: the newest scrape) — historical evaluation is
         what query_range replays. `times` lets range evaluation hoist the
@@ -115,7 +123,7 @@ class SimPromAPI:
             if j < 1:
                 return None
         t_now, latest = self.history[j]
-        t_start = t_now - RATE_WINDOW_S
+        t_start = t_now - window_s
         i = max(bisect_left(times, t_start, 0, j) - 1, 0)
         t_old, oldest = self.history[i]
         if t_now <= t_old:
@@ -123,8 +131,9 @@ class SimPromAPI:
         return t_now, latest, t_old, oldest
 
     def _rate(self, series: str, as_of: float | None = None,
-              times: list[float] | None = None) -> float:
-        w = self._window(as_of, times)
+              times: list[float] | None = None,
+              window_s: float = RATE_WINDOW_S) -> float:
+        w = self._window(as_of, times, window_s)
         if w is None:
             return 0.0
         t_now, latest, t_old, oldest = w
@@ -133,10 +142,11 @@ class SimPromAPI:
         )
 
     def _deriv(self, series: str, as_of: float | None = None,
-               times: list[float] | None = None) -> float:
+               times: list[float] | None = None,
+               window_s: float = RATE_WINDOW_S) -> float:
         """PromQL deriv(): per-second slope of a gauge over the window
         (signed — a draining backlog derives negative)."""
-        w = self._window(as_of, times)
+        w = self._window(as_of, times, window_s)
         if w is None:
             return 0.0
         t_now, latest, t_old, oldest = w
@@ -187,6 +197,19 @@ class SimPromAPI:
                 return None
             return self._rate(success, as_of, times) + max(
                 self._deriv(queue, as_of, times)
+                if self._present(queue) else 0.0,
+                0.0)
+        if kind == "rate_w":
+            series, w_s = payload
+            if not self._present(series):
+                return None
+            return self._rate(series, as_of, times, window_s=w_s)
+        if kind == "demand_w":
+            (success, queue), w_s = payload
+            if not self._present(success):
+                return None
+            return self._rate(success, as_of, times, window_s=w_s) + max(
+                self._deriv(queue, as_of, times, window_s=w_s)
                 if self._present(queue) else 0.0,
                 0.0)
         num, den = payload
