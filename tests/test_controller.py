@@ -1061,3 +1061,191 @@ class TestTpuUtilizationScrapeGate:
         # once a re-probe succeeded, backoff is reset: the LAST cycle
         # must have issued both queries (not a tautological slice)
         assert len(self._tpu_queries(prom)) - before == 2
+
+    def test_namespace_churn_prunes_backoff_state(self):
+        """ADVICE r3: back-off entries for namespaces that left the fleet
+        must be dropped, or the dict grows without bound under churn."""
+        prom = FakePromAPI()
+        prom.set_empty('avg(tpu_duty_cycle_percent{namespace="a"})')
+        prom.set_empty('sum(tpu_hbm_memory_usage_bytes{namespace="a"})')
+        rec = self._rec(prom)
+        for _ in range(5):
+            rec._collect_tpu_utilization({"a"})
+        assert "a" in rec._tpu_util_misses
+        rec._collect_tpu_utilization({"b"})
+        assert "a" not in rec._tpu_util_misses
+
+
+class TestDemandProbeWindow:
+    """ADVICE r3 (medium): with WVA_FAST_DEMAND_PROBE on, cadence cycles
+    must size on max(1m, probe-window) demand — a probe-kicked reconcile
+    that sizes on the smoothed 1m rate under-provisions the very ramp
+    step the probe detected."""
+
+    def _enable_probe(self, kube, window="15s"):
+        kube.put_configmap(ConfigMap(
+            name=CONFIG_MAP_NAME, namespace=CONFIG_MAP_NAMESPACE,
+            data={"GLOBAL_OPT_INTERVAL": "30s",
+                  "WVA_FAST_DEMAND_PROBE": "5",
+                  "WVA_FAST_PROBE_WINDOW": window},
+        ))
+
+    def test_probe_enabled_runs_short_window_query(self):
+        kube, prom, _emitter, rec = make_cluster(arrival_rps=2.0)
+        self._enable_probe(kube)
+        rec.reconcile()
+        short = true_arrival_rate_query(MODEL, NS, window="15s")
+        assert short in prom.queries_seen
+
+    def test_ramp_step_sizes_on_short_window(self):
+        # 1m rate still averages mostly-old load (2 rps); the 15s window
+        # already sees the step (6 rps) -> size on 6
+        kube, prom, _emitter, rec = make_cluster(arrival_rps=2.0)
+        self._enable_probe(kube)
+        prom.set_result(true_arrival_rate_query(MODEL, NS, window="15s"), 6.0)
+        rec.reconcile()
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert va.status.current_alloc.load.arrival_rate == "360.00"
+
+    def test_steady_state_keeps_long_window(self):
+        # the short window is noisier; when it reads LOW the smoothed 1m
+        # rate wins (max() errs conservative)
+        kube, prom, _emitter, rec = make_cluster(arrival_rps=2.0)
+        self._enable_probe(kube)
+        prom.set_result(true_arrival_rate_query(MODEL, NS, window="15s"), 1.0)
+        rec.reconcile()
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert va.status.current_alloc.load.arrival_rate == "120.00"
+
+    def test_probe_disabled_skips_short_window(self):
+        kube, prom, _emitter, rec = make_cluster(arrival_rps=2.0)
+        rec.reconcile()
+        short = true_arrival_rate_query(MODEL, NS, window="15s")
+        assert short not in prom.queries_seen
+
+
+class TestProbeThreadSession:
+    """ADVICE r3: the probe daemon queries concurrently with the
+    reconcile loop; HTTPPromAPI's shared requests.Session is not
+    thread-safe, so the probe must hold its own clone."""
+
+    def test_clonable_client_gets_private_clone(self):
+        class ClonablePromAPI(FakePromAPI):
+            def clone(self):
+                return ClonablePromAPI()
+
+        prom = ClonablePromAPI()
+        rec = Reconciler(kube=InMemoryKube(), prom=prom, sleep=lambda _s: None)
+        probe_prom = rec._probe_client()
+        assert probe_prom is not prom
+        assert rec._probe_client() is probe_prom  # cached, not re-cloned
+
+    def test_fake_without_clone_is_shared(self):
+        prom = FakePromAPI()
+        rec = Reconciler(kube=InMemoryKube(), prom=prom, sleep=lambda _s: None)
+        assert rec._probe_client() is prom
+
+    def test_httppromapi_clone_is_independent(self):
+        from workload_variant_autoscaler_tpu.collector.prometheus import (
+            HTTPPromAPI,
+            PrometheusConfig,
+        )
+
+        api = HTTPPromAPI(PrometheusConfig(base_url="http://prom:9090"),
+                          allow_http=True, timeout=3.0)
+        c = api.clone()
+        assert c is not api
+        assert c._session is not api._session
+        assert c.config is api.config and c.timeout == api.timeout
+
+
+class TestSharedNamespaceWarning:
+    """ADVICE r3: a dialect with no model label (JetStream) aggregates
+    ALL models in a namespace — two VAs sharing one must be called out."""
+
+    def _rec(self):
+        return Reconciler(kube=InMemoryKube(), prom=FakePromAPI(),
+                          sleep=lambda _s: None)
+
+    def _vas(self, *namespaces):
+        return [make_va(name=f"v{i}", namespace=ns)
+                for i, ns in enumerate(namespaces)]
+
+    def _captured(self, fn):
+        # the package logger sets propagate=False, so pytest's caplog
+        # never sees it — attach a recording handler directly
+        import logging
+
+        records: list[logging.LogRecord] = []
+
+        class _Rec(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        logger = logging.getLogger("wva.controller")
+        h = _Rec(level=logging.WARNING)
+        logger.addHandler(h)
+        try:
+            fn()
+        finally:
+            logger.removeHandler(h)
+        return [r.getMessage() for r in records]
+
+    def test_warns_on_shared_namespace(self):
+        from workload_variant_autoscaler_tpu.collector.collector import (
+            JETSTREAM_FAMILY,
+        )
+
+        rec = self._rec()
+        msgs = self._captured(lambda: rec._warn_shared_namespace_aggregation(
+            self._vas("ns1", "ns1", "ns2"), JETSTREAM_FAMILY))
+        assert any("COMBINED load" in m for m in msgs)
+
+    def test_warns_once_per_offending_set(self):
+        from workload_variant_autoscaler_tpu.collector.collector import (
+            JETSTREAM_FAMILY,
+        )
+
+        rec = self._rec()
+        vas = self._vas("ns1", "ns1")
+
+        def twice():
+            rec._warn_shared_namespace_aggregation(vas, JETSTREAM_FAMILY)
+            rec._warn_shared_namespace_aggregation(vas, JETSTREAM_FAMILY)
+
+        msgs = self._captured(twice)
+        assert sum("COMBINED load" in m for m in msgs) == 1
+
+    def test_model_label_present_no_warning(self):
+        from workload_variant_autoscaler_tpu.collector.collector import (
+            VLLM_FAMILY,
+        )
+
+        rec = self._rec()
+        msgs = self._captured(lambda: rec._warn_shared_namespace_aggregation(
+            self._vas("ns1", "ns1"), VLLM_FAMILY))
+        assert not any("COMBINED load" in m for m in msgs)
+
+    def test_distinct_namespaces_no_warning(self):
+        from workload_variant_autoscaler_tpu.collector.collector import (
+            JETSTREAM_FAMILY,
+        )
+
+        rec = self._rec()
+        msgs = self._captured(lambda: rec._warn_shared_namespace_aggregation(
+            self._vas("ns1", "ns2"), JETSTREAM_FAMILY))
+        assert not any("COMBINED load" in m for m in msgs)
+
+    def test_default_window_equal_to_rate_window_skips_duplicate(self):
+        # probe enabled but WVA_FAST_PROBE_WINDOW unset -> default "1m"
+        # == RATE_WINDOW; the short-window query would be byte-identical
+        # to the standard one and must not be issued at all
+        kube, prom, _emitter, rec = make_cluster(arrival_rps=2.0)
+        kube.put_configmap(ConfigMap(
+            name=CONFIG_MAP_NAME, namespace=CONFIG_MAP_NAMESPACE,
+            data={"GLOBAL_OPT_INTERVAL": "30s",
+                  "WVA_FAST_DEMAND_PROBE": "5"},
+        ))
+        rec.reconcile()
+        std = true_arrival_rate_query(MODEL, NS)
+        assert prom.queries_seen.count(std) == 1

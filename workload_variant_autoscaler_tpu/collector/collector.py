@@ -461,7 +461,10 @@ def collect_load(
     success_fetched = False
     arrival_rps = _value_or_none(
         prom, true_arrival_rate_query(model, namespace, family))
-    if arrival_rps is not None and probe_window:
+    if (arrival_rps is not None and probe_window
+            and probe_window != RATE_WINDOW):
+        # identical windows would issue the byte-identical query twice
+        # and max() two equal values — pure Prometheus load for no signal
         # demand-breakout mode (WVA_FAST_DEMAND_PROBE): size on the MAX
         # of the standard 1m window and the probe's short window. Right
         # after a ramp step the 1m rate still averages mostly-old load —

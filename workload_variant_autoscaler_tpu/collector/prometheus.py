@@ -91,6 +91,7 @@ class HTTPPromAPI:
 
         validate_tls_config(config, allow_http=allow_http)
         self.config = config
+        self._allow_http = allow_http
         self.timeout = timeout
         self._session = requests.Session()
         if config.insecure_skip_verify:
@@ -99,6 +100,14 @@ class HTTPPromAPI:
             self._session.verify = config.ca_cert_path
         if config.client_cert_path and config.client_key_path:
             self._session.cert = (config.client_cert_path, config.client_key_path)
+
+    def clone(self) -> "HTTPPromAPI":
+        """Fresh client over the same config with its OWN requests.Session.
+        requests.Session is not documented thread-safe; any daemon thread
+        querying concurrently with the reconcile loop (the demand-breakout
+        probe) must hold its own connection pool, not share this one."""
+        return HTTPPromAPI(self.config, allow_http=self._allow_http,
+                           timeout=self.timeout)
 
     def _bearer(self) -> Optional[str]:
         """Direct token wins over a mounted token file (reference

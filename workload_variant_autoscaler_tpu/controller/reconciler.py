@@ -93,6 +93,12 @@ class Reconciler:
         # the published count sustains in req/s); rebuilt every publish
         self._probe_targets: dict[str, tuple[str, float]] = {}
         self._last_operator_cm: dict[str, str] = {}
+        # namespaces already warned about model-label-free aggregation
+        # (warn on change, not every cycle)
+        self._shared_ns_warned: tuple[str, ...] = ()
+        # the probe daemon thread's private Prometheus client (lazy; a
+        # shared requests.Session is not thread-safe under concurrency)
+        self._probe_prom = None
 
     # -- config reading (reference controller.go:490-594) ----------------
 
@@ -445,6 +451,29 @@ class Reconciler:
         chip-hours rise accordingly."""
         return self._cm_float(operator_cm, "WVA_DEMAND_HEADROOM", 0.0)
 
+    def _warn_shared_namespace_aggregation(self, active, family) -> None:
+        """A dialect with no model label (JetStream's exporter labels
+        series with its own `id`, not model_name) makes every per-variant
+        query aggregate ALL models in the namespace — two VAs sharing a
+        namespace are each silently sized on their combined load,
+        over-provisioning both. Nothing can fix that from here (the label
+        simply isn't on the wire), so detect and say so loudly, once per
+        distinct offending set; WVA_JETSTREAM_MODEL_LABEL restores
+        scoping where the scrape config relabels a model label back on."""
+        if family is None or family.model_label:
+            return
+        counts: dict[str, int] = {}
+        for va in active:
+            counts[va.namespace] = counts.get(va.namespace, 0) + 1
+        shared = tuple(sorted(ns for ns, n in counts.items() if n > 1))
+        if shared and shared != self._shared_ns_warned:
+            log.warning(
+                "metric family has no model label: variants sharing a "
+                "namespace are sized on their COMBINED load "
+                "(set WVA_JETSTREAM_MODEL_LABEL or split namespaces)",
+                extra=kv(family=family.name, namespaces=list(shared)))
+        self._shared_ns_warned = shared
+
     def _prepare(self, active, accelerator_cm, service_class_cm, system_spec,
                  result, demand_headroom: float = 0.0, family=None,
                  drift_tolerance: float = 0.5, operator_cm=None):
@@ -454,6 +483,13 @@ class Reconciler:
         # label sets are cleared, not left stale)
         drift_samples: dict[tuple[str, str, str], float] = {}
         class_by_key = translate.service_class_key_names(service_class_cm)
+        # demand-breakout mode also tightens the CADENCE cycles: size on
+        # max(1m, probe-window) so the probe-kicked reconcile sees the
+        # ramp step its own probe detected, not the smoothed 1m average
+        probe_window = (self.probe_window()
+                        if self._probe_knob(self.PROBE_ENV, 0.0) > 0
+                        else None)
+        self._warn_shared_namespace_aggregation(active, family)
         for va_listed in active:
             name = va_listed.name
             key = full_name(va_listed.name, va_listed.namespace)
@@ -545,7 +581,8 @@ class Reconciler:
             try:
                 load = collect_load(self.prom, model, deploy.namespace,
                                     fallback=self._last_known_load(va),
-                                    family=family)
+                                    family=family,
+                                    probe_window=probe_window)
             except IncompleteMetricsError as e:
                 # loaded variant with unusable modeling series: scaling it
                 # on zero-filled data would tear it down to min replicas —
@@ -628,6 +665,12 @@ class Reconciler:
                 self._tpu_util_misses.pop(ns, None)
             else:
                 self._tpu_util_misses[ns] = (misses + 1, 0)
+        # drop back-off state for namespaces that left the fleet — under
+        # namespace churn the dict would otherwise grow without bound
+        # (unlike _probe_targets, which is rebuilt wholesale each publish)
+        for ns in list(self._tpu_util_misses):
+            if ns not in namespaces:
+                del self._tpu_util_misses[ns]
         # ALWAYS emit, even empty: the wholesale clear()+set is how a
         # namespace that dropped out of the fleet stops exporting its
         # last duty-cycle/HBM reading
@@ -850,9 +893,10 @@ class Reconciler:
         governs it). Best-effort: query failures skip the variant — the
         cadence cycle remains the backbone."""
         util = self._probe_knob(self.PROBE_UTIL_ENV, 0.85)
+        prom = self._probe_client()
         for key, (query, cap_rps) in list(self._probe_targets.items()):
             try:
-                samples = self.prom.query(query)
+                samples = prom.query(query)
             except Exception:  # noqa: BLE001 — probe is best-effort
                 continue
             rate = sum(s.value for s in samples
@@ -866,6 +910,18 @@ class Reconciler:
                 self.kick()
                 return True
         return False
+
+    def _probe_client(self):
+        """The probe daemon's Prometheus client. HTTPPromAPI's shared
+        requests.Session is not documented thread-safe, so the probe
+        thread — which queries concurrently with the reconcile loop —
+        gets its own clone (own Session / connection pool). Clients
+        without clone() (in-memory fakes, sim-time shims) are assumed
+        re-entrant and shared as-is."""
+        if self._probe_prom is None:
+            clone = getattr(self.prom, "clone", None)
+            self._probe_prom = clone() if callable(clone) else self.prom
+        return self._probe_prom
 
     def _start_demand_probe(self, stop: threading.Event) -> None:
         """Poll demand on a daemon thread at the configured period; a
