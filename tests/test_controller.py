@@ -1184,11 +1184,14 @@ class TestSharedNamespaceWarning:
 
         logger = logging.getLogger("wva.controller")
         h = _Rec(level=logging.WARNING)
+        prev_level = logger.level
+        logger.setLevel(logging.WARNING)  # an earlier test may have raised it
         logger.addHandler(h)
         try:
             fn()
         finally:
             logger.removeHandler(h)
+            logger.setLevel(prev_level)
         return [r.getMessage() for r in records]
 
     def test_warns_on_shared_namespace(self):

@@ -128,21 +128,25 @@ class TestFleetScale:
         assert wall_s < 20.0, f"steady-state cycle took {wall_s:.1f}s"
 
     def test_kernel_call_count_is_per_group_not_per_variant(self, monkeypatch):
-        """The analyze stage must not degrade into a per-variant loop."""
+        """The analyze stage must not degrade into a per-variant loop —
+        whichever engine backend is auto-selected (batched-XLA routes
+        through _size_group, native through _native_size_group; both are
+        one batch call per sizing group)."""
         calls = {"n": 0}
         kube, _emitter, rec = big_cluster()
-        monkeypatch.setattr(
-            "workload_variant_autoscaler_tpu.models.system.System._size_group",
-            _counting_size_group(calls),
-        )
+        for name in ("_size_group", "_native_size_group"):
+            monkeypatch.setattr(
+                f"workload_variant_autoscaler_tpu.models.system.System{'.' + name}",
+                _counting_size_group(calls, name),
+            )
         rec.reconcile()
         assert calls["n"] == 1  # one sizing group (all mean-sized)
 
 
-def _counting_size_group(calls):
+def _counting_size_group(calls, name):
     from workload_variant_autoscaler_tpu.models.system import System
 
-    orig = System._size_group
+    orig = getattr(System, name)
 
     def wrapper(self, pairs, **kwargs):
         calls["n"] += 1

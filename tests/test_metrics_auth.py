@@ -1,0 +1,233 @@
+"""Bearer-token authn/authz on /metrics (VERDICT r3 next #4): the
+reference protects its metrics endpoint with controller-runtime's
+WithAuthenticationAndAuthorization filter (cmd/main.go:164-168) —
+TokenReview to authenticate the scraper's ServiceAccount token, then a
+SubjectAccessReview on the nonResourceURL /metrics with verb get. These
+tests drive the rebuild's KubeAuthGate against InMemoryKube's
+TokenReview/SAR fakes, including a live end-to-end scrape through
+MetricsEmitter.serve()."""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from workload_variant_autoscaler_tpu.controller import InMemoryKube
+from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
+from workload_variant_autoscaler_tpu.metrics.authz import (
+    KubeAuthGate,
+    wrap_wsgi,
+)
+
+TOKEN = "sa-token-prometheus-k8s"
+USER = "system:serviceaccount:monitoring:prometheus-k8s"
+
+
+def granted_kube():
+    kube = InMemoryKube()
+    kube.grant_token(TOKEN, USER)
+    kube.grant_access(USER, "get", "/metrics")
+    return kube
+
+
+class TestGateVerdicts:
+    def test_valid_token_with_rbac_allowed(self):
+        gate = KubeAuthGate(granted_kube())
+        assert gate.check(f"Bearer {TOKEN}") == 200
+
+    def test_missing_header_401(self):
+        gate = KubeAuthGate(granted_kube())
+        assert gate.check(None) == 401
+        assert gate.check("") == 401
+
+    def test_non_bearer_scheme_401(self):
+        gate = KubeAuthGate(granted_kube())
+        assert gate.check("Basic dXNlcjpwdw==") == 401
+        assert gate.check("Bearer ") == 401
+
+    def test_unknown_token_401(self):
+        gate = KubeAuthGate(granted_kube())
+        assert gate.check("Bearer forged-token") == 401
+
+    def test_authenticated_without_rbac_403(self):
+        kube = InMemoryKube()
+        kube.grant_token(TOKEN, USER)  # authenticates, but no grant
+        gate = KubeAuthGate(kube)
+        assert gate.check(f"Bearer {TOKEN}") == 403
+
+    def test_group_grant_allows(self):
+        # RBAC bindings commonly target a group, not the username
+        kube = InMemoryKube()
+        kube.grant_token(TOKEN, USER,
+                         groups=["system:serviceaccounts:monitoring"])
+        kube.grant_access("system:serviceaccounts:monitoring",
+                          "get", "/metrics")
+        gate = KubeAuthGate(kube)
+        assert gate.check(f"Bearer {TOKEN}") == 200
+
+    def test_wrong_verb_or_path_denied(self):
+        kube = InMemoryKube()
+        kube.grant_token(TOKEN, USER)
+        kube.grant_access(USER, "get", "/healthz")
+        gate = KubeAuthGate(kube)
+        assert gate.check(f"Bearer {TOKEN}") == 403
+
+
+class TestFailClosed:
+    def test_tokenreview_outage_401(self):
+        kube = granted_kube()
+        kube.inject_fault("create", "TokenReview", RuntimeError("apiserver down"))
+        gate = KubeAuthGate(kube)
+        assert gate.check(f"Bearer {TOKEN}") == 401
+
+    def test_sar_outage_403(self):
+        kube = granted_kube()
+        kube.inject_fault("create", "SubjectAccessReview",
+                          RuntimeError("apiserver down"))
+        gate = KubeAuthGate(kube)
+        assert gate.check(f"Bearer {TOKEN}") == 403
+
+
+class TestVerdictCache:
+    def test_allowed_verdict_cached_within_ttl(self):
+        kube = granted_kube()
+        calls = {"n": 0}
+        orig = kube.create_token_review
+
+        def counting(token):
+            calls["n"] += 1
+            return orig(token)
+
+        kube.create_token_review = counting
+        t = {"now": 0.0}
+        gate = KubeAuthGate(kube, cache_ttl=10.0, now=lambda: t["now"])
+        for _ in range(5):
+            assert gate.check(f"Bearer {TOKEN}") == 200
+        assert calls["n"] == 1  # one TokenReview per TTL, not per scrape
+
+    def test_verdict_reevaluated_after_ttl(self):
+        kube = granted_kube()
+        t = {"now": 0.0}
+        gate = KubeAuthGate(kube, cache_ttl=10.0, now=lambda: t["now"])
+        assert gate.check(f"Bearer {TOKEN}") == 200
+        # the token is revoked; within TTL the stale verdict stands,
+        # after TTL the gate re-checks and denies
+        kube._tokens.clear()
+        t["now"] = 5.0
+        assert gate.check(f"Bearer {TOKEN}") == 200
+        t["now"] = 11.0
+        assert gate.check(f"Bearer {TOKEN}") == 401
+
+    def test_denied_verdict_also_cached(self):
+        kube = InMemoryKube()
+        calls = {"n": 0}
+        orig = kube.create_token_review
+
+        def counting(token):
+            calls["n"] += 1
+            return orig(token)
+
+        kube.create_token_review = counting
+        t = {"now": 0.0}
+        gate = KubeAuthGate(kube, cache_ttl=10.0, now=lambda: t["now"])
+        for _ in range(3):
+            assert gate.check("Bearer junk") == 401
+        assert calls["n"] == 1  # a hammering unauthorized client is cheap
+
+
+class TestWsgiMiddleware:
+    def _app(self):
+        def app(environ, start_response):
+            start_response("200 OK", [("Content-Type", "text/plain")])
+            return [b"metrics-body"]
+        return app
+
+    def _call(self, gated, headers):
+        captured = {}
+
+        def start_response(status, hdrs):
+            captured["status"] = status
+            captured["headers"] = dict(hdrs)
+
+        body = b"".join(gated(headers, start_response))
+        return captured["status"], captured.get("headers", {}), body
+
+    def test_allowed_passes_through(self):
+        gated = wrap_wsgi(self._app(), KubeAuthGate(granted_kube()))
+        status, _h, body = self._call(
+            gated, {"HTTP_AUTHORIZATION": f"Bearer {TOKEN}"})
+        assert status == "200 OK" and body == b"metrics-body"
+
+    def test_anonymous_gets_401_with_challenge(self):
+        gated = wrap_wsgi(self._app(), KubeAuthGate(granted_kube()))
+        status, headers, _b = self._call(gated, {})
+        assert status.startswith("401")
+        assert headers.get("WWW-Authenticate") == "Bearer"
+
+    def test_forbidden_gets_403(self):
+        kube = InMemoryKube()
+        kube.grant_token(TOKEN, USER)
+        gated = wrap_wsgi(self._app(), KubeAuthGate(kube))
+        status, _h, _b = self._call(
+            gated, {"HTTP_AUTHORIZATION": f"Bearer {TOKEN}"})
+        assert status.startswith("403")
+
+
+class TestServeEndToEnd:
+    """Real HTTP server, real scrapes — the hermetic twin of pointing
+    prometheus-k8s at the endpoint."""
+
+    @pytest.fixture()
+    def served(self):
+        emitter = MetricsEmitter()
+        gate = KubeAuthGate(granted_kube())
+        server, thread, _rel = emitter.serve(0, addr="127.0.0.1",
+                                             auth_gate=gate)
+        yield f"http://127.0.0.1:{server.server_address[1]}/metrics"
+        server.shutdown()
+
+    def _get(self, url, token=None):
+        req = urllib.request.Request(url)
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def test_scrape_with_sa_token_succeeds(self, served):
+        status, body = self._get(served, token=TOKEN)
+        assert status == 200
+        assert b"inferno_desired_replicas" in body
+
+    def test_scrape_without_token_401(self, served):
+        status, _ = self._get(served)
+        assert status == 401
+
+    def test_scrape_with_forged_token_401(self, served):
+        status, _ = self._get(served, token="forged")
+        assert status == 401
+
+
+class TestCacheBound:
+    def test_token_spray_bounded_memory(self):
+        """An unauthenticated client spraying unique bearer tokens must
+        not grow the verdict cache without bound (DoS resistance)."""
+        kube = granted_kube()
+        t = {"now": 0.0}
+        gate = KubeAuthGate(kube, cache_ttl=10.0, now=lambda: t["now"])
+        for i in range(3 * gate.CACHE_MAX):
+            gate.check(f"Bearer junk-{i}")  # all live within TTL
+        assert len(gate._cache) <= gate.CACHE_MAX + 1
+
+    def test_legit_scraper_survives_spray_via_refresh(self):
+        kube = granted_kube()
+        t = {"now": 0.0}
+        gate = KubeAuthGate(kube, cache_ttl=10.0, now=lambda: t["now"])
+        assert gate.check(f"Bearer {TOKEN}") == 200
+        for i in range(2 * gate.CACHE_MAX):
+            gate.check(f"Bearer junk-{i}")
+        # the flood may have evicted the verdict; the next scrape just
+        # re-reviews and still passes
+        assert gate.check(f"Bearer {TOKEN}") == 200

@@ -232,7 +232,8 @@ class WatchAPIServer:
     """Fake apiserver for list+watch: scripts each successive watch
     request, records resourceVersion params."""
 
-    def __init__(self, list_rv: str, watch_scripts: list[list[dict]]):
+    def __init__(self, list_rv: str, watch_scripts: list[list[dict]],
+                 watch_statuses: list[int] | None = None):
         self.watch_rvs: list[str] = []
         self.list_count = 0
         outer = self
@@ -245,6 +246,16 @@ class WatchAPIServer:
                     outer.watch_rvs.append(
                         (q.get("resourceVersion") or [""])[0])
                     idx = len(outer.watch_rvs) - 1
+                    status = (watch_statuses[idx]
+                              if watch_statuses and idx < len(watch_statuses)
+                              else 200)
+                    if status != 200:
+                        # HTTP-level failure (e.g. 410 Gone when the RV
+                        # fell out of the apiserver's cache window)
+                        self.send_response(status)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
                     script = (watch_scripts[idx]
                               if idx < len(watch_scripts) else [])
                     self.send_response(200)
@@ -252,7 +263,10 @@ class WatchAPIServer:
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
                     for ev in script:
-                        data = (json.dumps(ev) + "\n").encode()
+                        # {"__raw__": s} injects a non-JSON frame
+                        data = (ev["__raw__"] if "__raw__" in ev
+                                else json.dumps(ev))
+                        data = (data + "\n").encode()
                         self.wfile.write(
                             f"{len(data):x}\r\n".encode() + data + b"\r\n")
                         self.wfile.flush()
@@ -375,5 +389,112 @@ def test_restkube_watch_configmap_uses_field_selector():
         t.join(timeout=5.0)
         assert server.list_count >= 1
         assert server.watch_rvs  # a watch request arrived
+    finally:
+        server.stop()
+
+
+# -- wire-protocol fidelity (VERDICT r3 next #6): pin the resume logic
+# on both sides of the 410/bookmark/expiry scenarios a real apiserver
+# produces ------------------------------------------------------------
+
+
+def _drive_watch(server, n_events, timeout_seconds=5):
+    """Run watch_variant_autoscalings against `server` until n_events
+    arrive (or 15s); returns the events."""
+    kube = RestKube(base_url=server.url)
+    events: list[WatchEvent] = []
+    stop = threading.Event()
+
+    def on_event(ev):
+        events.append(ev)
+        if len(events) >= n_events:
+            stop.set()
+
+    t = threading.Thread(
+        target=kube.watch_variant_autoscalings,
+        args=(on_event, stop), kwargs={"timeout_seconds": timeout_seconds},
+        daemon=True)
+    t.start()
+    deadline = time.monotonic() + 15.0
+    while len(events) < n_events and time.monotonic() < deadline:
+        time.sleep(0.02)
+    stop.set()
+    t.join(timeout=5.0)
+    return events
+
+
+def test_restkube_watch_http_410_forces_fresh_list():
+    """A watch request answered with HTTP `410 Gone` (resume RV fell out
+    of the apiserver's cache window) must re-LIST, not retry the dead
+    RV. Distinct from the mid-stream ERROR event (covered above) — real
+    apiservers produce both forms."""
+    server = WatchAPIServer(
+        list_rv="5",
+        watch_scripts=[[], [_va_event("ADDED", "c", "20")]],
+        watch_statuses=[410, 200])
+    try:
+        events = _drive_watch(server, n_events=1)
+        assert [(e.type, e.name) for e in events] == [("ADDED", "c")]
+        assert server.list_count == 2          # 410 forced a fresh LIST
+        # both watches started from a LIST-pinned RV, never a guess
+        assert server.watch_rvs == ["5", "5"]
+    finally:
+        server.stop()
+
+
+def test_restkube_watch_clean_expiry_resumes_without_relist():
+    """Server-side timeoutSeconds expiry ends the stream cleanly; the
+    client must resume from the LAST EVENT's RV with no re-LIST (the
+    informer contract — a re-list per expiry would hammer the apiserver
+    every timeoutSeconds)."""
+    server = WatchAPIServer(list_rv="5", watch_scripts=[
+        [_va_event("ADDED", "a", "7")],
+        [_va_event("MODIFIED", "a", "9")],
+    ])
+    try:
+        events = _drive_watch(server, n_events=2)
+        assert [(e.type, e.name) for e in events] == [
+            ("ADDED", "a"), ("MODIFIED", "a")]
+        assert server.list_count == 1          # no re-list on expiry
+        assert server.watch_rvs == ["5", "7"]  # resumed from event RV
+    finally:
+        server.stop()
+
+
+def test_restkube_watch_garbled_frame_skipped():
+    """A non-JSON frame in the stream (truncated write, proxy garbage)
+    must be skipped, not kill the watch: later events in the same
+    stream still arrive and still advance the resume RV."""
+    server = WatchAPIServer(list_rv="5", watch_scripts=[
+        [{"__raw__": "}{ not json"},
+         _va_event("ADDED", "a", "6"),
+         _va_event("MODIFIED", "a", "7")],
+        [],
+    ])
+    try:
+        events = _drive_watch(server, n_events=2)
+        assert [(e.type, e.name) for e in events] == [
+            ("ADDED", "a"), ("MODIFIED", "a")]
+        assert server.list_count == 1
+        if len(server.watch_rvs) > 1:          # reconnect after expiry
+            assert server.watch_rvs[1] == "7"  # garbage did not reset RV
+    finally:
+        server.stop()
+
+
+def test_restkube_watch_bookmark_only_stream_advances_resume_rv():
+    """A stream carrying ONLY a bookmark (the apiserver's keep-the-RV-
+    fresh mechanism for quiet collections) must advance the resume RV
+    even though no reconcile-worthy event fired."""
+    server = WatchAPIServer(list_rv="5", watch_scripts=[
+        [{"type": "BOOKMARK",
+          "object": {"metadata": {"resourceVersion": "42"}}}],
+        [_va_event("ADDED", "z", "43")],
+    ])
+    try:
+        events = _drive_watch(server, n_events=1)
+        assert [(e.type, e.name) for e in events] == [("ADDED", "z")]
+        assert server.list_count == 1
+        assert server.watch_rvs == ["5", "42"]  # bookmark RV carried over
     finally:
         server.stop()

@@ -14,7 +14,7 @@ Supported: {{ }} actions with -trim markers, {{/* comments */}},
 if/else/end, range/end (lists, and maps in sorted key order with
 `$k, $v :=`), define/include (from templates/_helpers.tpl), variables,
 dot-paths, string/number/bool literals, and the functions/pipes
-printf, eq, default, quote, indent, nindent, toJson, toYaml. Anything
+printf, eq, or, and, default, quote, indent, nindent, toJson, toYaml. Anything
 else raises — a template drifting outside the subset must fail the
 render test loudly, not render wrong.
 """
@@ -319,6 +319,21 @@ class Renderer:
             if piped is not None:
                 vals.append(piped)
             return all(v == vals[0] for v in vals[1:])
+        if head in ("or", "and"):
+            vals = [ev(a) for a in args]
+            if piped is not None:
+                vals.append(piped)
+            if not vals:
+                raise TemplateError(f"{head} needs at least one operand")
+            if head == "or":
+                for v in vals:
+                    if _truthy(v):
+                        return v
+                return vals[-1]
+            for v in vals:
+                if not _truthy(v):
+                    return v
+            return vals[-1]
         if head == "default":
             d = ev(args[0])
             v = piped if not args[1:] else ev(args[1])

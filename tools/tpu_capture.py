@@ -1,0 +1,75 @@
+"""Opportunistic on-TPU bench capture.
+
+The dev tunnel to the TPU wedges and recovers on a timescale of tens of
+minutes (VERDICT r3: a wedged-then-recovering tunnel erased a whole
+round's TPU evidence). This sidecar polls the cheap canary on a
+staggered schedule and, the moment the backend answers, runs the full
+bench and writes the JSON artifact — so TPU evidence is captured in
+whatever healthy window appears, not just at the one end-of-round shot.
+
+Usage: python tools/tpu_capture.py [out_path] [deadline_seconds]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else \
+        os.path.join(REPO, "BENCH_tpu_capture.json")
+    window_s = float(sys.argv[2]) if len(sys.argv) > 2 else 8 * 3600.0
+    poll_s = float(os.environ.get("WVA_CAPTURE_POLL_S", "900"))
+    deadline = time.monotonic() + window_s
+    n = 0
+    while time.monotonic() < deadline:
+        n += 1
+        c = bench.run_canary(timeout_s=60.0)
+        print(f"[{time.strftime('%H:%M:%S')}] canary #{n}: {c}", flush=True)
+        if c.get("status") == "ok" and c.get("platform") == "tpu":
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.join(REPO, "bench.py")],
+                    capture_output=True, text=True, timeout=7200, cwd=REPO,
+                    env={**os.environ,
+                         # bench's own staggered window stays short here:
+                         # the sidecar IS the staggered schedule
+                         "WVA_BENCH_RETRY_WINDOW_S": "1800"})
+            except subprocess.TimeoutExpired:
+                # the tunnel wedged mid-measurement; the sidecar's whole
+                # job is to outlive that — keep polling
+                print("bench run hit the 7200s guard; resuming polling",
+                      flush=True)
+                time.sleep(poll_s)
+                continue
+            line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                print(f"bench output unparseable: {r.stdout[-400:]} "
+                      f"{r.stderr[-400:]}", flush=True)
+                time.sleep(poll_s)
+                continue
+            if str(rec.get("platform")) == "tpu":
+                with open(out_path, "w") as f:
+                    json.dump(rec, f, indent=1)
+                print(f"captured -> {out_path}", flush=True)
+                return 0
+            print(f"bench ran but platform={rec.get('platform')}; "
+                  "continuing to poll", flush=True)
+        time.sleep(min(poll_s, max(0.0, deadline - time.monotonic())))
+    print("window closed without a healthy TPU", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

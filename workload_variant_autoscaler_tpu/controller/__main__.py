@@ -39,6 +39,15 @@ def main(argv=None) -> int:
     parser.add_argument("--metrics-key", default=os.environ.get("METRICS_TLS_KEY", ""))
     parser.add_argument("--metrics-client-ca", default=os.environ.get("METRICS_CLIENT_CA", ""),
                         help="require+verify client certs against this CA")
+    parser.add_argument("--metrics-kube-auth", action="store_true",
+                        default=os.environ.get("WVA_METRICS_KUBE_AUTH",
+                                               "").lower() in ("1", "true"),
+                        help="require a ServiceAccount bearer token on "
+                             "/metrics, verified via TokenReview + "
+                             "SubjectAccessReview (nonResourceURL "
+                             "/metrics, verb get) — how in-cluster "
+                             "Prometheus authenticates (reference "
+                             "cmd/main.go:164-168)")
     parser.add_argument("--health-port", type=int, default=8081,
                         help="port for /healthz and /readyz probes")
     parser.add_argument("--leader-elect", action="store_true",
@@ -164,11 +173,17 @@ def main(argv=None) -> int:
                   extra=kv(error=str(e)))
         return 1
     emitter = MetricsEmitter()
+    auth_gate = None
+    if args.metrics_kube_auth:
+        from ..metrics.authz import KubeAuthGate
+
+        auth_gate = KubeAuthGate(kube)
     try:
         emitter.serve(
             args.metrics_port, addr=args.metrics_addr,
             certfile=args.metrics_cert or None, keyfile=args.metrics_key or None,
             client_cafile=args.metrics_client_ca or None,
+            auth_gate=auth_gate,
         )
     except ValueError as e:
         log.error("invalid metrics TLS configuration", extra=kv(error=str(e)))

@@ -138,6 +138,10 @@ class InMemoryKube:
         self._faults: dict[tuple[str, str], tuple[Callable[[], None], int]] = {}
         self.status_update_count = 0
         self._watchers: list[Callable[[WatchEvent], None]] = []
+        # authn/authz fakes for the metrics endpoint's TokenReview/SAR
+        # path: token -> (username, groups); (user, verb, path) grants
+        self._tokens: dict[str, tuple[str, list[str]]] = {}
+        self._access: set[tuple[str, str, str]] = set()
 
     # -- watch (the apiserver's ?watch=true, reduced to callbacks) -------
 
@@ -217,6 +221,40 @@ class InMemoryKube:
             else:
                 self._faults[(verb, kind)] = (raiser, count - 1)
         raiser()
+
+    # -- authn/authz (authentication.k8s.io / authorization.k8s.io) ------
+
+    def grant_token(self, token: str, user: str,
+                    groups: Optional[list[str]] = None) -> None:
+        """Register a valid bearer token resolving to `user` (fake of the
+        apiserver's token authenticator)."""
+        self._tokens[token] = (user, groups or [])
+
+    def grant_access(self, user: str, verb: str, path: str) -> None:
+        """RBAC grant for a nonResourceURL (fake of a ClusterRole rule
+        like the reference's metrics-reader: nonResourceURLs /metrics,
+        verbs get)."""
+        self._access.add((user, verb, path))
+
+    def create_token_review(self, token: str) -> dict:
+        """POST tokenreviews — status dict like the apiserver's:
+        {"authenticated": bool, "user": {"username":..., "groups": [...]}}."""
+        self._trip("create", "TokenReview")
+        entry = self._tokens.get(token)
+        if entry is None:
+            return {"authenticated": False}
+        user, groups = entry
+        return {"authenticated": True,
+                "user": {"username": user, "groups": list(groups)}}
+
+    def create_subject_access_review(self, user: str, groups: list[str],
+                                     verb: str, path: str) -> bool:
+        """POST subjectaccessreviews with nonResourceAttributes —
+        allowed?"""
+        self._trip("create", "SubjectAccessReview")
+        if (user, verb, path) in self._access:
+            return True
+        return any((g, verb, path) in self._access for g in groups)
 
     # -- KubeClient ------------------------------------------------------
 
@@ -593,6 +631,40 @@ class RestKube:
         rv = ((obj or {}).get("metadata") or {}).get("resourceVersion")
         if rv:
             va.metadata.resource_version = rv
+
+    # -- authn/authz (metrics-endpoint TokenReview/SAR; reference
+    # cmd/main.go:164-168 protects /metrics with controller-runtime's
+    # WithAuthenticationAndAuthorization filter, which issues exactly
+    # these two POSTs) --------------------------------------------------
+
+    def create_token_review(self, token: str) -> dict:
+        obj = self._request(
+            "POST", "/apis/authentication.k8s.io/v1/tokenreviews",
+            body={
+                "apiVersion": "authentication.k8s.io/v1",
+                "kind": "TokenReview",
+                "spec": {"token": token},
+            },
+        )
+        status = (obj or {}).get("status") or {}
+        return {"authenticated": bool(status.get("authenticated")),
+                "user": status.get("user") or {}}
+
+    def create_subject_access_review(self, user: str, groups: list[str],
+                                     verb: str, path: str) -> bool:
+        obj = self._request(
+            "POST", "/apis/authorization.k8s.io/v1/subjectaccessreviews",
+            body={
+                "apiVersion": "authorization.k8s.io/v1",
+                "kind": "SubjectAccessReview",
+                "spec": {
+                    "user": user,
+                    "groups": list(groups),
+                    "nonResourceAttributes": {"verb": verb, "path": path},
+                },
+            },
+        )
+        return bool(((obj or {}).get("status") or {}).get("allowed"))
 
     # -- watch (?watch=true streaming) -----------------------------------
 

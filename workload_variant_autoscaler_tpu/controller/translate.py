@@ -364,16 +364,39 @@ def ttft_percentile(operator_cm: dict[str, str] | None = None) -> float | None:
 
 
 def engine_backend() -> str:
-    """Analysis backend for the reconcile cycle: the batched JAX kernel by
-    default; the C++ kernel when WVA_NATIVE_KERNEL is enabled and
-    buildable (CPU-only controllers skip JAX dispatch overhead)."""
-    if os.environ.get("WVA_NATIVE_KERNEL", "").lower() in ("1", "true"):
+    """Analysis backend for the reconcile cycle.
+
+    WVA_NATIVE_KERNEL=true  -> the C++ kernel (warn + batched when not
+                               buildable);
+    WVA_NATIVE_KERNEL=false -> the batched JAX kernel, unconditionally;
+    unset (the default)     -> auto-select by platform: a CPU-only host
+      (the realistic production shape — WVA_PLATFORM defaults to the
+      cpu pin precisely because the controller rarely sits on a TPU
+      host) runs the native kernel when buildable, because
+      batched-XLA-on-host loses to it ~5x at fleet scale (BENCH_r03's
+      recorded fallback: 821 sizings/s vs the sequential native
+      baseline's ~4.1k). Accelerator-capable hosts keep the batched
+      XLA kernel — on a TPU it wins by orders of magnitude
+      (BENCH_r02: 89.0M sizings/s).
+    """
+    raw = os.environ.get("WVA_NATIVE_KERNEL", "").strip().lower()
+    if raw in ("1", "true"):
         from ..ops import native
 
         if native.available():
             return "native"
         log.warning("WVA_NATIVE_KERNEL set but kernel unavailable; "
                     "falling back to the batched backend")
+        return "batched"
+    if raw in ("0", "false"):
+        return "batched"
+    from ..utils.platform import host_is_cpu_only
+
+    if host_is_cpu_only():
+        from ..ops import native
+
+        if native.available():
+            return "native"
     return "batched"
 
 

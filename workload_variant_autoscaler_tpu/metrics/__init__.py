@@ -366,25 +366,24 @@ class MetricsEmitter:
     def serve(self, port: int, addr: str = "0.0.0.0",
               certfile: Optional[str] = None, keyfile: Optional[str] = None,
               client_cafile: Optional[str] = None,
-              cert_poll_seconds: float = 10.0):
+              cert_poll_seconds: float = 10.0,
+              auth_gate=None):
         """Expose /metrics for Prometheus to scrape — plain HTTP, or HTTPS
         when a cert/key pair is supplied, with optional required client-CA
         verification (reference cmd/main.go:122-199: TLS-capable metrics
         endpoint with authn/authz). HTTPS serving hot-reloads rotated
         certs without dropping the listener (reference certwatcher parity).
-        Returns (server, thread, reloader); reloader is None for plain
-        HTTP."""
+        auth_gate (metrics.authz.KubeAuthGate) adds bearer-token
+        TokenReview+SubjectAccessReview screening — the reference's
+        WithAuthenticationAndAuthorization filter, how in-cluster
+        Prometheus service accounts actually authenticate — and composes
+        with either transport. Returns (server, thread, reloader);
+        reloader is None for plain HTTP."""
         if bool(certfile) != bool(keyfile):
             raise ValueError("metrics TLS requires both certfile and keyfile")
         if client_cafile and not certfile:
             raise ValueError("metrics client-CA verification requires a server "
                              "certfile/keyfile pair")
-        if not certfile:
-            server, thread = start_http_server(port, addr=addr,
-                                               registry=self.registry)
-            log.info("metrics server started",
-                     extra=kv(port=server.server_address[1], tls=False))
-            return server, thread, None
 
         from wsgiref.simple_server import WSGIRequestHandler
 
@@ -394,9 +393,41 @@ class MetricsEmitter:
             make_wsgi_app,
         )
 
+        app = make_wsgi_app(self.registry)
+        if auth_gate is not None:
+            if not certfile:
+                # bearer tokens are live apiserver credentials; over
+                # cleartext HTTP an on-path observer harvests them
+                # (the reference always fronts its auth filter with
+                # TLS). Permitted for dev/tests, loudly.
+                log.warning(
+                    "metrics kube-auth WITHOUT TLS: ServiceAccount "
+                    "bearer tokens will transit in cleartext — serve "
+                    "with certfile/keyfile (chart: metricsTLSSecret) "
+                    "in production")
+            from .authz import wrap_wsgi
+
+            app = wrap_wsgi(app, auth_gate)
+
         class _QuietHandler(WSGIRequestHandler):
             def log_message(self, fmt, *args):  # noqa: ARG002
                 pass  # scrapes every 10s would spam stderr
+
+        if not certfile:
+            if auth_gate is None:
+                server, thread = start_http_server(port, addr=addr,
+                                                   registry=self.registry)
+            else:
+                server = make_server(addr, port, app, ThreadingWSGIServer,
+                                     handler_class=_QuietHandler)
+                thread = threading.Thread(target=server.serve_forever,
+                                          daemon=True,
+                                          name="wva-metrics-server")
+                thread.start()
+            log.info("metrics server started",
+                     extra=kv(port=server.server_address[1], tls=False,
+                              kube_auth=auth_gate is not None))
+            return server, thread, None
 
         reloader = CertReloader(certfile, keyfile, client_cafile,
                                 poll_seconds=cert_poll_seconds)
@@ -414,7 +445,7 @@ class MetricsEmitter:
             def handle_error(self, request, client_address):  # noqa: ARG002
                 pass  # TLS handshake failures from probes/rotation races
 
-        server = make_server(addr, port, make_wsgi_app(self.registry),
+        server = make_server(addr, port, app,
                              _TLSPerConnServer, handler_class=_QuietHandler)
         reloader.start()
         thread = threading.Thread(target=server.serve_forever, daemon=True,
@@ -422,5 +453,6 @@ class MetricsEmitter:
         thread.start()
         log.info("metrics server started",
                  extra=kv(port=server.server_address[1], tls=True,
-                          cert_hot_reload=True))
+                          cert_hot_reload=True,
+                          kube_auth=auth_gate is not None))
         return server, thread, reloader

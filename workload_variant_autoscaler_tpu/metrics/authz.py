@@ -1,0 +1,129 @@
+"""Kubernetes-native authn/authz for the /metrics endpoint.
+
+The reference protects /metrics with controller-runtime's
+WithAuthenticationAndAuthorization filter (cmd/main.go:164-168): every
+scrape presents a ServiceAccount bearer token, the filter resolves it
+via a TokenReview POST and authorizes `get` on the nonResourceURL
+/metrics via a SubjectAccessReview POST — the way in-cluster Prometheus
+actually authenticates (its ClusterRole carries `nonResourceURLs:
+["/metrics"], verbs: ["get"]`).
+
+This module is that filter for the rebuild's metrics server, usable
+standalone or alongside the TLS/client-CA path (metrics/__init__.serve):
+
+- no/garbled Authorization header, or TokenReview says unauthenticated
+  -> 401;
+- authenticated but the SAR denies -> 403;
+- apiserver unreachable -> 401 fail-closed (an outage must not turn the
+  endpoint public);
+- verdicts are TTL-cached per token so a 10s scrape interval costs one
+  TokenReview+SAR pair per TTL, not per scrape (controller-runtime's
+  authentication/authorization caches behave the same way).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Protocol
+
+from ..utils.logging import get_logger, kv
+
+log = get_logger("wva.metrics")
+
+
+class AuthKube(Protocol):
+    """The two apiserver verbs the gate needs (implemented by both
+    controller.kube.RestKube and InMemoryKube)."""
+
+    def create_token_review(self, token: str) -> dict: ...
+    def create_subject_access_review(self, user: str, groups: list[str],
+                                     verb: str, path: str) -> bool: ...
+
+
+class KubeAuthGate:
+    """TokenReview + SubjectAccessReview gate for one (verb, path)."""
+
+    CACHE_MAX = 1024  # distinct live tokens worth remembering
+
+    def __init__(self, kube: AuthKube, verb: str = "get",
+                 path: str = "/metrics", cache_ttl: float = 10.0,
+                 now: Callable[[], float] = time.monotonic) -> None:
+        self.kube = kube
+        self.verb = verb
+        self.path = path
+        self.cache_ttl = cache_ttl
+        self._now = now
+        self._lock = threading.Lock()
+        # token -> (expiry, http_status) ; 200 = allowed
+        self._cache: dict[str, tuple[float, int]] = {}
+
+    def check(self, authorization: Optional[str]) -> int:
+        """HTTP status for a scrape presenting this Authorization header:
+        200 allowed, 401 unauthenticated, 403 unauthorized."""
+        if not authorization or not authorization.startswith("Bearer "):
+            return 401
+        token = authorization[len("Bearer "):].strip()
+        if not token:
+            return 401
+        t = self._now()
+        with self._lock:
+            hit = self._cache.get(token)
+            if hit is not None and hit[0] > t:
+                return hit[1]
+        status = self._evaluate(token)
+        with self._lock:
+            if len(self._cache) >= self.CACHE_MAX:
+                # an unauthenticated client spraying unique tokens must
+                # not grow memory or turn inserts quadratic: drop
+                # expired entries, and if the flood is all live, drop
+                # EVERYTHING — re-reviewing the handful of legitimate
+                # scrapers costs two apiserver POSTs each, bounded
+                live = {k: v for k, v in self._cache.items() if v[0] > t}
+                self._cache = live if len(live) < self.CACHE_MAX else {}
+            self._cache[token] = (t + self.cache_ttl, status)
+        return status
+
+    def _evaluate(self, token: str) -> int:
+        try:
+            review = self.kube.create_token_review(token)
+        except Exception as e:  # noqa: BLE001 — fail closed
+            log.warning("metrics TokenReview failed; denying scrape",
+                        extra=kv(error=str(e)))
+            return 401
+        if not review.get("authenticated"):
+            return 401
+        user = (review.get("user") or {}).get("username", "")
+        groups = (review.get("user") or {}).get("groups") or []
+        try:
+            allowed = self.kube.create_subject_access_review(
+                user, groups, self.verb, self.path)
+        except Exception as e:  # noqa: BLE001 — fail closed
+            log.warning("metrics SubjectAccessReview failed; denying scrape",
+                        extra=kv(user=user, error=str(e)))
+            return 403
+        if not allowed:
+            log.warning("metrics scrape denied by RBAC",
+                        extra=kv(user=user, verb=self.verb, path=self.path))
+            return 403
+        return 200
+
+
+def wrap_wsgi(app, gate: KubeAuthGate):
+    """WSGI middleware applying the gate to every request."""
+
+    def gated(environ, start_response):
+        status = gate.check(environ.get("HTTP_AUTHORIZATION"))
+        if status == 200:
+            return app(environ, start_response)
+        if status == 401:
+            start_response("401 Unauthorized", [
+                ("Content-Type", "text/plain"),
+                # RFC 6750: tell the client bearer auth is expected
+                ("WWW-Authenticate", "Bearer"),
+            ])
+            return [b"Unauthorized"]
+        start_response("403 Forbidden", [("Content-Type", "text/plain")])
+        return [b"Forbidden"]
+
+    return gated

@@ -25,13 +25,15 @@ from ..ops.analyzer import InfeasibleTargetError
 
 
 def _analyzer_class():
-    """Scalar-path analyzer implementation: the numpy reference kernel, or
-    the C++ kernel when WVA_NATIVE_KERNEL is enabled and buildable (parity
-    guaranteed by tests/test_native.py; useful for CPU-only controllers
-    where per-candidate dispatch latency matters)."""
+    """Scalar-path analyzer implementation: the C++ kernel whenever it is
+    buildable (parity guaranteed by tests/test_native.py) — this path is
+    host-side per-candidate work where the native kernel always wins, so
+    only an explicit WVA_NATIVE_KERNEL=false keeps the numpy reference
+    kernel."""
     import os
 
-    if os.environ.get("WVA_NATIVE_KERNEL", "").lower() in ("1", "true"):
+    if os.environ.get("WVA_NATIVE_KERNEL", "").strip().lower() not in (
+            "0", "false"):
         from ..ops import native
 
         if native.available():
