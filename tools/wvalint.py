@@ -26,6 +26,14 @@ Rules (suppress per-line with `# noqa` or `# noqa: WVLxxx`):
           mismatch against a function/method defined in this repo
           (skipped for *args/**kwargs targets and decorated defs — the
           achievable slice of what mypy would catch)
+  WVL202  return-arity mismatch: `a, b = f(...)` where every in-repo
+          def of f returns a literal tuple of a different length
+          (the unpacking slice of mypy's return-type checking)
+  WVL203  self-attribute existence: `self.x` read inside a class none
+          of whose in-repo hierarchy (ancestors OR descendants) binds
+          `x` (skipped for classes with __getattr__, setattr, dynamic
+          or out-of-repo bases — the self-receiver slice of mypy's
+          attribute checking)
 
 Exit status: number of findings (0 = clean).
 """
@@ -441,11 +449,337 @@ def _check_calls(path: str, tree: ast.Module,
     return findings
 
 
+# -- return-arity at unpacking call sites (WVL202) -------------------------
+
+
+def _walk_own(fn):
+    """Walk a def's own body, pruning nested defs/lambdas/classes (their
+    returns/yields belong to them)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _collect_return_arities(
+        trees: dict[str, ast.Module]) -> dict[str, list[tuple]]:
+    """name -> per-def (tuple-return arities, is_async); arities None =
+    unknowable (decorated, generator, or any return whose shape isn't a
+    literal tuple)."""
+    rets: dict[str, list[tuple]] = {}
+    for tree in trees.values():
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            arities: set[int] | None
+            if node.decorator_list:
+                arities = None
+            else:
+                arities = set()
+                for sub in _walk_own(node):
+                    if isinstance(sub, (ast.Yield, ast.YieldFrom)):
+                        arities = None  # generator: iterable, not a tuple
+                        break
+                    if not isinstance(sub, ast.Return):
+                        continue
+                    if sub.value is None or (
+                            isinstance(sub.value, ast.Constant)
+                            and sub.value.value is None):
+                        arities.add(0)
+                    elif isinstance(sub.value, ast.Tuple) and not any(
+                            isinstance(e, ast.Starred) for e in sub.value.elts):
+                        arities.add(len(sub.value.elts))
+                    else:
+                        arities = None  # non-literal return: shape unknown
+                        break
+                if arities is not None and not arities:
+                    arities = {0}  # falls off the end: returns None
+            rets.setdefault(node.name, []).append((
+                frozenset(arities) if arities is not None else None,
+                isinstance(node, ast.AsyncFunctionDef)))
+    return rets
+
+
+def _fn_local_bindings(fn) -> set:
+    """Names bound in a def's own scope: params, assigned names, nested
+    def/class names, imports. Used to detect shadowing of module-level
+    functions (a call through a parameter must not resolve to the
+    same-named module def)."""
+    a = fn.args
+    names = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.add(node.name)  # binds here; body is its own scope
+            continue
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, ast.Import):
+            for al in node.names:
+                names.add((al.asname or al.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for al in node.names:
+                if al.name != "*":
+                    names.add(al.asname or al.name)
+        stack.extend(ast.iter_child_nodes(node))
+    return names
+
+
+def _check_unpack_arity(path: str, tree: ast.Module,
+                        rets: dict[str, list[tuple]]) -> list[Finding]:
+    """`a, b = f(...)` where every in-repo def of f returns a literal
+    tuple of a different length — the unpacking slice of mypy's
+    return-type checking (bare-name calls only, same conservatism as
+    WVL201; names shadowed by an enclosing scope's params/locals are
+    skipped). Also flags unpacking an un-awaited all-async callee."""
+    findings: list[Finding] = []
+
+    def visit(node, shadowed: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            shadowed = shadowed | _fn_local_bindings(node)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            check(node, shadowed)
+        for child in ast.iter_child_nodes(node):
+            visit(child, shadowed)
+
+    def check(node: ast.Assign, shadowed: frozenset) -> None:
+        target = node.targets[0]
+        if not isinstance(target, (ast.Tuple, ast.List)):
+            return
+        if any(isinstance(e, ast.Starred) for e in target.elts):
+            return  # star target absorbs any arity >= fixed count
+        value = node.value
+        awaited = isinstance(value, ast.Await)
+        if awaited:
+            value = value.value
+        if not isinstance(value, ast.Call) or not isinstance(
+                value.func, ast.Name):
+            return
+        name = value.func.id
+        if name in shadowed:
+            return  # call through a param/local, not the module def
+        cand = rets.get(name)
+        if not cand:
+            return
+        all_async = all(is_async for _a, is_async in cand)
+        any_async = any(is_async for _a, is_async in cand)
+        if not awaited and all_async:
+            findings.append(Finding(
+                path, node.lineno, "WVL202",
+                f"{name}() is async: unpacking the coroutine without "
+                "await"))
+            return
+        # arity check only when the await-ness matches the defs
+        # unambiguously (awaited+all async, or bare+all sync)
+        if awaited != all_async or (not awaited and any_async):
+            return
+        if any(a is None for a, _ in cand):
+            return
+        union: set[int] = set()
+        for a, _ in cand:
+            union |= a
+        n = len(target.elts)
+        if union and n not in union:
+            got = "/".join(str(x) for x in sorted(union))
+            findings.append(Finding(
+                path, node.lineno, "WVL202",
+                f"{name}() returns {got} value(s), unpacked into {n}"))
+
+    visit(tree, frozenset())
+    return findings
+
+
+# -- self-attribute existence (WVL203) -------------------------------------
+
+
+@dataclass
+class _Cls:
+    attrs: set
+    bases: list
+    open: bool  # __getattr__/setattr/unresolvable base: skip checking
+
+
+def _collect_classes(trees: dict[str, ast.Module]) -> dict[str, _Cls]:
+    classes: dict[str, _Cls] = {}
+    for tree in trees.values():
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            attrs: set = set()
+            bases: list = []
+            open_ = bool(node.keywords)  # metaclass/Protocol params
+            for b in node.bases:
+                if isinstance(b, ast.Name):
+                    bases.append(b.id)
+                else:
+                    open_ = True  # x.y / subscripted base: unresolvable
+            # class-BODY bindings only: a method-local `name = 1` must
+            # not whitelist `self.name` (pruned walk, no method bodies)
+            stack = list(node.body)
+            while stack:
+                sub = stack.pop()
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.ClassDef, ast.Lambda)):
+                    if not isinstance(sub, ast.Lambda):
+                        attrs.add(sub.name)
+                    continue
+                if isinstance(sub, ast.Name) and isinstance(
+                        sub.ctx, (ast.Store, ast.Del)):
+                    attrs.add(sub.id)
+                elif isinstance(sub, ast.AnnAssign) and isinstance(
+                        sub.target, ast.Name):
+                    attrs.add(sub.target.id)  # dataclass/NamedTuple field
+                stack.extend(ast.iter_child_nodes(sub))
+
+            def self_recv(call) -> bool:
+                return (len(call.args) >= 1
+                        and isinstance(call.args[0], ast.Name)
+                        and call.args[0].id in ("self", "cls"))
+
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Attribute) and isinstance(
+                        sub.ctx, (ast.Store, ast.Del)) and isinstance(
+                        sub.value, ast.Name) and sub.value.id in (
+                        "self", "cls"):
+                    attrs.add(sub.attr)
+                elif isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Name):
+                    if sub.func.id == "setattr" and self_recv(sub):
+                        open_ = True  # dynamic self attrs: unknowable
+                    elif sub.func.id in ("hasattr", "getattr") and \
+                            self_recv(sub) and len(sub.args) >= 2 and \
+                            isinstance(sub.args[1], ast.Constant) and \
+                            isinstance(sub.args[1].value, str):
+                        # hasattr(self,...)-guarded / getattr(self,...)-
+                        # defaulted access is a deliberate maybe-absent
+                        # pattern; probing OTHER objects proves nothing
+                        # about self
+                        attrs.add(sub.args[1].value)
+            if "__getattr__" in attrs or "__getattribute__" in attrs:
+                open_ = True
+            prev = classes.get(node.name)
+            if prev is not None:
+                prev.attrs |= attrs
+                prev.bases += bases
+                prev.open |= open_
+            else:
+                classes[node.name] = _Cls(attrs, bases, open_)
+    # module-level monkey-patching: C.attr = ... / setattr(C, ...)
+    for tree in trees.values():
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.ctx, ast.Store) and isinstance(
+                    node.value, ast.Name) and node.value.id in classes:
+                classes[node.value.id].attrs.add(node.attr)
+            elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Name) and node.func.id == "setattr" \
+                    and node.args and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in classes:
+                classes[node.args[0].id].open = True
+    return classes
+
+
+def _resolve_classes(classes: dict[str, _Cls]) -> dict[str, tuple[set, bool]]:
+    """name -> (checkable attr set, open). The check set includes every
+    ancestor's AND descendant's attrs: inside a base class's methods,
+    `self` may be any subclass instance (the template-method/mixin
+    pattern), so an attr defined anywhere in the hierarchy is legal."""
+    memo: dict[str, tuple[set, bool]] = {}
+
+    def full(name: str, stack: tuple = ()) -> tuple[set, bool]:
+        if name in memo:
+            return memo[name]
+        if name not in classes or name in stack:
+            return set(), True  # out-of-repo base (or cycle): open
+        c = classes[name]
+        attrs = set(c.attrs)
+        open_ = c.open
+        for b in c.bases:
+            if b == "object":
+                continue
+            battrs, bopen = full(b, stack + (name,))
+            attrs |= battrs
+            open_ |= bopen
+        memo[name] = (attrs, open_)
+        return memo[name]
+
+    out = {name: [set(full(name)[0]), full(name)[1]] for name in classes}
+    # fold each class's full set into every ancestor's check set
+    for name in classes:
+        attrs, open_ = full(name)
+        seen: set = set()
+        stack = list(classes[name].bases)
+        while stack:
+            b = stack.pop()
+            if b in seen or b not in classes:
+                continue
+            seen.add(b)
+            out[b][0] |= attrs
+            out[b][1] |= open_
+            stack.extend(classes[b].bases)
+    return {k: (v[0], v[1]) for k, v in out.items()}
+
+
+def _check_self_attrs(path: str, tree: ast.Module,
+                      resolved: dict[str, tuple[set, bool]]) -> list[Finding]:
+    """`self.x` loads inside a class none of whose hierarchy defines `x`
+    — the self-receiver slice of mypy's attribute checking (the one
+    receiver whose type IS statically known)."""
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = resolved.get(node.name)
+        if info is None or info[1]:
+            continue
+        attrs = info[0]
+        # walk methods directly in the class body, pruning nested classes
+        # (their `self` is theirs)
+        for stmt in node.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack = list(ast.iter_child_nodes(stmt))
+            while stack:
+                sub = stack.pop()
+                if isinstance(sub, ast.ClassDef):
+                    continue
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and any(a.arg == "self" for a in sub.args.args):
+                    continue  # nested def with its own self
+                if isinstance(sub, ast.Attribute) and isinstance(
+                        sub.ctx, ast.Load) and isinstance(
+                        sub.value, ast.Name) and sub.value.id == "self" \
+                        and not (sub.attr.startswith("__")
+                                 and sub.attr.endswith("__")) \
+                        and sub.attr not in attrs:
+                    findings.append(Finding(
+                        path, sub.lineno, "WVL203",
+                        f"{node.name} has no attribute {sub.attr!r}"))
+                stack.extend(ast.iter_child_nodes(sub))
+    return findings
+
+
 # -- driver ----------------------------------------------------------------
 
 
 def lint_source(path: str, source: str,
-                sigs: dict[str, list[_Sig]] | None = None) -> list[Finding]:
+                sigs: dict[str, list[_Sig]] | None = None,
+                rets: dict[str, list[frozenset | None]] | None = None,
+                classes: dict[str, tuple[set, bool]] | None = None,
+                ) -> list[Finding]:
     try:
         tree = ast.parse(source, path)
     except SyntaxError as e:
@@ -458,6 +792,10 @@ def lint_source(path: str, source: str,
     findings += _unused(path, source, tree)
     if sigs:
         findings += _check_calls(path, tree, sigs)
+    if rets:
+        findings += _check_unpack_arity(path, tree, rets)
+    if classes:
+        findings += _check_self_attrs(path, tree, classes)
 
     noqa = _noqa_lines(source)
     out = []
@@ -498,9 +836,11 @@ def main(argv=None) -> int:
         except SyntaxError:
             pass
     sigs = _collect_signatures(trees)
+    rets = _collect_return_arities(trees)
+    classes = _resolve_classes(_collect_classes(trees))
     findings: list[Finding] = []
     for fp in files:
-        findings += lint_source(fp, sources[fp], sigs)
+        findings += lint_source(fp, sources[fp], sigs, rets, classes)
     for f in sorted(findings, key=lambda f: (f.path, f.line)):
         print(f.format())
     if findings:
