@@ -629,20 +629,29 @@ SCENARIOS: dict[str, Scenario] = {
                         "WVA_DEMAND_HEADROOM": "0.25"},
         judge_ttft=True,
     ),
-    # strict mode via REACTION TIME instead of blunt headroom: a 5s
+    # strict mode via REACTION TIME on top of percentile sizing: a 5s
     # demand-breakout probe (reconciler.demand_probe — one PromQL query
     # between cycles, full reconcile only on breakout) catches each ramp
-    # step within seconds, so the same both-tails guarantee needs less
-    # standing overprovisioning than sharegpt-strict-slo's 0.75. The
-    # reference cannot react faster than its fixed interval at any cost.
+    # step within seconds, so percentile sizing needs only 0.13 headroom
+    # for the inter-cycle jumps instead of sharegpt-p95-sizing's 0.25 —
+    # the cheapest committed config that holds BOTH tails (2.362
+    # chip-hours, p95 TTFT 478 ms). The reference cannot react faster
+    # than its fixed interval at any cost.
     "sharegpt-fast-probe": Scenario(
         key="sharegpt-fast-probe",
-        title="config-1 ramp, BOTH p95 tails held: 5s breakout probe + small headroom",
+        title="config-1 ramp, BOTH p95 tails held: p95 sizing + 5s breakout probe",
         accelerators={"v5e-1": {"chip": "v5e", "chips": "1", "cost": "20.0"}},
         service_classes={"premium": _PREMIUM_YAML},
         variants=[_CHAT_8B],
         reconcile_ms=30_000.0,
-        operator_extra={"WVA_DEMAND_HEADROOM": "0.25",
+        # WVA_FAST_DEMAND_PROBE must be SET (not just the sim driving
+        # demand_probe()) — it also switches cadence/kicked cycles to
+        # sizing on max(1m, probe-window) demand, without which a
+        # probe-kicked cycle sizes on the smoothed 1m rate and
+        # under-provisions the very step it reacted to (ADVICE r3)
+        operator_extra={"WVA_FAST_DEMAND_PROBE": "5",
+                        "WVA_TTFT_PERCENTILE": "0.95",
+                        "WVA_DEMAND_HEADROOM": "0.13",
                         "WVA_FAST_PROBE_WINDOW": "15s"},
         judge_ttft=True,
         fast_probe_ms=5_000.0,

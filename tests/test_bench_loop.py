@@ -78,3 +78,40 @@ def test_mini_ramp_holds_slo_and_beats_static():
     # measured energy: bounded by idle/full draw of the provisioned chips
     chip_hours = r["value"]
     assert 60.0 * chip_hours <= r["energy_wh"] <= 200.0 * chip_hours
+
+
+def test_fast_probe_mini_ramp_kicks_and_sizes_on_short_window():
+    """The demand-breakout probe must (a) fire on a ramp step between
+    cadence cycles and (b) size the kicked cycle on the short-window
+    demand (WVA_FAST_DEMAND_PROBE set -> max(1m, probe-window) sizing;
+    ADVICE r3 — without it the kicked cycle under-provisions the very
+    step it reacted to). Discriminating A/B: the same mini ramp with
+    the sizing-side knob stripped must show a measurably WORSE TTFT
+    tail — if the collector's max(1m, probe-window) logic regresses,
+    the two runs converge and this fails."""
+    import dataclasses
+
+    sc = bench_loop.SCENARIOS["sharegpt-fast-probe"]
+    assert sc.operator_extra.get("WVA_FAST_DEMAND_PROBE"), \
+        "scenario must enable the sizing-side knob, not just the sim loop"
+    ramp = [(60, 600), (120, 2700), (60, 600)]
+    mini = dataclasses.replace(
+        sc,
+        variants=[_mini(sc.variants[0], ramp)],
+        warmup_ms=60_000.0,
+    )
+    r_on = bench_loop.run_scenario(mini)
+    assert r_on["probe_kicks"] >= 1          # the 4.5x step broke out
+    assert r_on["variants"]["chat-8b"]["peak_replicas"] > 1
+
+    # knob OFF: sim still drives demand_probe() (kicks happen) but the
+    # kicked cycles size on the smoothed 1m rate — the ADVICE-r3 bug
+    off_extra = {k: v for k, v in sc.operator_extra.items()
+                 if k != "WVA_FAST_DEMAND_PROBE"}
+    r_off = bench_loop.run_scenario(
+        dataclasses.replace(mini, operator_extra=off_extra))
+    ttft_on = r_on["variants"]["chat-8b"]["p95_ttft_ms"]
+    ttft_off = r_off["variants"]["chat-8b"]["p95_ttft_ms"]
+    assert ttft_on < ttft_off, (
+        f"short-window sizing must cut the ramp-step TTFT tail "
+        f"(on={ttft_on}, off={ttft_off})")

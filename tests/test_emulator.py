@@ -292,6 +292,34 @@ class TestSimProm:
         prom = SimPromAPI(sink, "m", "ns")
         assert prom.query("sum(nonexistent)") == []
 
+    def test_arbitrary_short_window_demand_answered(self):
+        """The demand query over ANY rate window must be answered (the
+        probe's WVA_FAST_PROBE_WINDOW is operator-chosen): a whitelist
+        would silently neuter unlisted windows — probe never kicks,
+        sizing falls back to 1m, no error anywhere."""
+        from workload_variant_autoscaler_tpu.collector import (
+            true_arrival_rate_query,
+        )
+
+        sink = PrometheusSink("llama-8b", "default")
+        fleet = Fleet(CFG, sink, replicas=4)
+        sim = Simulation(fleet, seed=3)
+        prom = SimPromAPI(sink, "llama-8b", "default")
+        gen = PoissonLoadGenerator(
+            sim, schedule=600.0,
+            tokens=TokenDistribution(avg_input_tokens=20, avg_output_tokens=2),
+            seed=3,
+        )
+        gen.start()
+        sim.run_until(90_000.0, on_tick=prom.scrape, tick_ms=5000.0)
+        for w in ("10s", "20s", "15s", "2m"):
+            q = true_arrival_rate_query("llama-8b", "default", window=w)
+            samples = prom.query(q)
+            assert samples, f"window {w} went unanswered"
+            assert samples[0].value == pytest.approx(10.0, rel=0.5)  # 600rpm
+        # a window on an unrelated query is NOT misresolved to demand
+        assert prom.query('sum(rate(made_up_series[15s]))') == []
+
 
 class TestLoadgenGaps:
     def test_zero_rpm_gap_pauses_not_kills(self):

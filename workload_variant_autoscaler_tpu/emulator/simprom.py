@@ -77,13 +77,13 @@ class SimPromAPI:
                 "ratio", (f"{fam.tpot_seconds}_sum",
                           f"{fam.tpot_seconds}_count")),
         }
-        # short-window demand variants: the controller's demand-breakout
-        # probe (reconciler.demand_probe) queries with
-        # WVA_FAST_PROBE_WINDOW to see ramp steps through less smoothing
-        d_kind, d_payload = demand
-        for w_str, w_s in (("15s", 15.0), ("30s", 30.0)):
-            self._queries[true_arrival_rate_query(m, ns, fam, window=w_str)] \
-                = (d_kind + "_w", (d_payload, w_s))
+        # short-window demand variants (the controller's demand-breakout
+        # probe queries with WVA_FAST_PROBE_WINDOW) are resolved
+        # DYNAMICALLY in _eval by parsing the window out of the incoming
+        # PromQL — any configured window just works; a whitelist here
+        # would silently neuter unlisted windows (probe never kicks,
+        # sizing falls back to 1m, no error)
+        self._demand = demand
         if fam.running:
             self._queries[avg_running_query(m, ns, fam)] = ("avg", fam.running)
         if fam.queue_depth:
@@ -181,6 +181,8 @@ class SimPromAPI:
         series absent (empty vector)."""
         spec = self._queries.get(promql)
         if spec is None:
+            spec = self._resolve_short_window(promql)
+        if spec is None:
             return None
         kind, payload = spec
         if kind == "rate":
@@ -220,6 +222,33 @@ class SimPromAPI:
         # the window — 'unknown', which the collector must not read as 0
         return (self._rate(num, as_of, times) / den_rate if den_rate > 0
                 else float("nan"))
+
+    _WINDOW_RE = None  # compiled lazily (class-level cache)
+
+    def _resolve_short_window(self, promql: str):
+        """Match a demand query over an ARBITRARY rate window: parse the
+        window out of the incoming PromQL, re-render the canonical
+        demand query with it, and compare. Whatever
+        WVA_FAST_PROBE_WINDOW a scenario configures is answered with
+        the same semantics as the 1m demand query, just over the
+        shorter window. The resolution is cached in _queries."""
+        import re
+
+        if SimPromAPI._WINDOW_RE is None:
+            SimPromAPI._WINDOW_RE = re.compile(r"\[(\d+)(ms|s|m|h)\]")
+        m = SimPromAPI._WINDOW_RE.search(promql)
+        if not m:
+            return None
+        w_str = m.group(1) + m.group(2)
+        if true_arrival_rate_query(self.model, self.namespace, self.family,
+                                   window=w_str) != promql:
+            return None
+        w_s = float(m.group(1)) * {"ms": 0.001, "s": 1.0,
+                                   "m": 60.0, "h": 3600.0}[m.group(2)]
+        d_kind, d_payload = self._demand
+        spec = (d_kind + "_w", (d_payload, w_s))
+        self._queries[promql] = spec
+        return spec
 
     def query(self, promql: str) -> list[Sample]:
         labels = {"model_name": self.model, "namespace": self.namespace}
