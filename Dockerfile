@@ -1,6 +1,24 @@
 # Controller + emulator image. The engine's JAX path runs on CPU inside
 # the cluster (the batched analyzer is cheap at fleet scale); TPU devices
 # are what the *workloads* use, not the autoscaler.
+#
+# Stage 1 compiles the native C++ sizing kernel THROUGH the single build
+# recipe (ops/native.py:_build — the Makefile `native` target), so the
+# shipped .so can never drift from what local builds and tests exercise.
+# On a CPU-only host the engine backend auto-selects this kernel
+# (controller/translate.engine_backend — batched-XLA-on-host loses to it
+# ~5x at fleet scale); the runtime image has no g++, so it must ship the
+# prebuilt .so or auto-selection would silently fall back.
+FROM python:3.12-slim AS native-build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/* \
+    && pip install --no-cache-dir numpy
+WORKDIR /app
+COPY workload_variant_autoscaler_tpu /app/workload_variant_autoscaler_tpu
+COPY native/wva_queueing.cpp /app/native/wva_queueing.cpp
+RUN python -c "from workload_variant_autoscaler_tpu.ops import native; \
+assert native.available(), 'native kernel build failed'"
+
 FROM python:3.12-slim
 
 RUN pip install --no-cache-dir \
@@ -8,7 +26,10 @@ RUN pip install --no-cache-dir \
 
 WORKDIR /app
 COPY workload_variant_autoscaler_tpu /app/workload_variant_autoscaler_tpu
+COPY --from=native-build /app/native /app/native
 
 ENV PYTHONUNBUFFERED=1
+# point straight at the prebuilt kernel: no mtime games, no g++ needed
+ENV WVA_NATIVE_LIB=/app/native/_libwvaq.so
 USER 65532:65532
 ENTRYPOINT ["python", "-m", "workload_variant_autoscaler_tpu.controller"]

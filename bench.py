@@ -83,16 +83,21 @@ def bench_tpu(c, iters: int = 20):
     runs = [once() for _ in range(3)]
 
     # percentile sizing (WVA_TTFT_PERCENTILE): the tail kernel adds a
-    # gammaincc mixture per bisection trip — record its throughput too
+    # gammaincc mixture per bisection trip — record its throughput too,
+    # best-of-3 like every other stage (a single pass would let a
+    # latency spike bias cross-backend tail comparisons)
     from workload_variant_autoscaler_tpu.ops.batched import size_batch_tail
 
     jax.block_until_ready(size_batch_tail(q, targets, k_max,
                                           ttft_percentile=0.95))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = size_batch_tail(q, targets, k_max, ttft_percentile=0.95)
-    jax.block_until_ready(out)
-    tail_rate = len(c["alpha"]) * iters / (time.perf_counter() - t0)
+    tail_rate = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = size_batch_tail(q, targets, k_max, ttft_percentile=0.95)
+        jax.block_until_ready(out)
+        tail_rate = max(tail_rate,
+                        len(c["alpha"]) * iters / (time.perf_counter() - t0))
     return max(runs), runs, tail_rate
 
 
@@ -105,7 +110,8 @@ if os.environ.get("WVA_FORCE_CPU"):
     from workload_variant_autoscaler_tpu.utils.platform import force_cpu
     force_cpu()
 import jax
-from bench import bench_tpu, bench_native_batch, build_candidates
+from bench import (bench_tpu, bench_native_batch, bench_sequential,
+                   build_candidates)
 platform = jax.devices()[0].platform
 c = build_candidates(4096)
 # the CPU fallback runs the same fleet-scale batch at ~1/100000th the
@@ -118,13 +124,19 @@ if os.environ.get("WVA_FORCE_CPU"):
     # On a CPU-only host the DEFAULT engine backend is the native batch
     # kernel (translate.engine_backend auto-selection), not batched-XLA
     # -- report what a default config actually runs, keeping the XLA
-    # rate as an auxiliary series
+    # rate as an auxiliary series. The sequential baseline is measured
+    # HERE, adjacent in time, so vs_baseline compares the two under the
+    # same host load (minutes-apart measurements on a busy shared host
+    # made the ratio flicker around 1)
     nb = bench_native_batch(c)
     if nb is not None:
+        mean_runs, tail_runs = nb
         out.update({"xla_cpu_rate": rate, "xla_cpu_runs": runs,
                     "xla_cpu_tail_rate": tail_rate,
-                    "rate": nb[0], "runs": [nb[0]], "tail_rate": nb[1],
+                    "rate": max(mean_runs), "runs": mean_runs,
+                    "tail_rate": max(tail_runs),
                     "backend": "native-batch (default on CPU-only hosts)"})
+    out["sequential_rate"] = bench_sequential(build_candidates(256))
 print(json.dumps(out))
 """
 
@@ -288,10 +300,12 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
             "platform": "error: all stages failed"}
 
 
-def bench_native_batch(c, iters: int = 10) -> tuple[float, float] | None:
-    """(mean_rate, tail_rate) of the native C++ batch kernel — the
-    default engine backend on CPU-only hosts (translate.engine_backend).
-    None when the kernel isn't buildable."""
+def bench_native_batch(c, iters: int = 10
+                       ) -> tuple[list[float], list[float]] | None:
+    """(mean_rates, tail_rates) — the three best-of-3 raw rates each —
+    of the native C++ batch kernel, the default engine backend on
+    CPU-only hosts (translate.engine_backend). None when the kernel
+    isn't buildable."""
     import numpy as np
 
     from workload_variant_autoscaler_tpu.ops import native
@@ -305,14 +319,21 @@ def bench_native_batch(c, iters: int = 10) -> tuple[float, float] | None:
     tps = np.zeros(len(c["alpha"]))
     b = len(c["alpha"])
 
-    def run(**kw) -> float:
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            native.size_batch_native(
-                c["alpha"], c["beta"], c["gamma"], c["delta"],
-                c["in_tokens"], c["out_tokens"], c["max_batch"],
-                occ, c["ttft"], c["itl"], tps, **kw)
-        return b * iters / (time.perf_counter() - t0)
+    def run(**kw) -> list[float]:
+        # best-of-3: a loaded shared host skews any single pass (the
+        # same protocol the TPU stage uses for tunnel-latency variance);
+        # ALL raw rates are returned so the artifact carries the
+        # variance, not just the max
+        rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                native.size_batch_native(
+                    c["alpha"], c["beta"], c["gamma"], c["delta"],
+                    c["in_tokens"], c["out_tokens"], c["max_batch"],
+                    occ, c["ttft"], c["itl"], tps, **kw)
+            rates.append(b * iters / (time.perf_counter() - t0))
+        return rates
 
     return run(), run(ttft_percentile=0.95)
 
@@ -333,22 +354,29 @@ def bench_sequential(c) -> float:
         native.NativeQueueAnalyzer if native.available() else QueueAnalyzer
     )
     b = len(c["alpha"])
-    t0 = time.perf_counter()
-    for i in range(b):
-        qa = analyzer_cls(
-            QueueConfig(
-                max_batch_size=int(c["max_batch"][i]),
-                max_queue_size=int(c["max_batch"][i]) * 10,
-                parms=ServiceParms(
-                    alpha=float(c["alpha"][i]), beta=float(c["beta"][i]),
-                    gamma=float(c["gamma"][i]), delta=float(c["delta"][i]),
+
+    def once() -> float:
+        t0 = time.perf_counter()
+        for i in range(b):
+            qa = analyzer_cls(
+                QueueConfig(
+                    max_batch_size=int(c["max_batch"][i]),
+                    max_queue_size=int(c["max_batch"][i]) * 10,
+                    parms=ServiceParms(
+                        alpha=float(c["alpha"][i]), beta=float(c["beta"][i]),
+                        gamma=float(c["gamma"][i]), delta=float(c["delta"][i]),
+                    ),
                 ),
-            ),
-            RequestSize(avg_input_tokens=int(c["in_tokens"][i]),
-                        avg_output_tokens=int(c["out_tokens"][i])),
-        )
-        qa.size(TargetPerf(ttft=float(c["ttft"][i]), itl=float(c["itl"][i])))
-    return b / (time.perf_counter() - t0)
+                RequestSize(avg_input_tokens=int(c["in_tokens"][i]),
+                            avg_output_tokens=int(c["out_tokens"][i])),
+            )
+            qa.size(TargetPerf(ttft=float(c["ttft"][i]),
+                               itl=float(c["itl"][i])))
+        return b / (time.perf_counter() - t0)
+
+    # best-of-3, same protocol as the device stages: the baseline must
+    # not win or lose on a scheduling fluke of a shared host
+    return max(once() for _ in range(3))
 
 
 _PALLAS_PROBE = r"""
@@ -465,7 +493,11 @@ def probe_pallas_compile(timeout_s: float = 420.0) -> dict:
 
 def main() -> None:
     xla = run_xla_stage()
-    sequential_rate = bench_sequential(build_candidates(256))
+    # the CPU-fallback stage measures its own baseline adjacent in time;
+    # the on-accelerator path measures it here (host contention is
+    # irrelevant next to a ~10^4x device speedup)
+    sequential_rate = (xla.get("sequential_rate")
+                       or bench_sequential(build_candidates(256)))
     on_accelerator = not (xla["platform"] == "cpu"
                           or xla["platform"].startswith(("cpu-fallback",
                                                          "error")))

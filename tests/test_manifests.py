@@ -359,3 +359,16 @@ def test_mini_helm_else_if_chain():
     assert render({"a": True, "b": True}) == "A"
     assert render({"a": False, "b": True}) == "B"
     assert render({"a": False, "b": False}) == "C"
+
+
+def test_dockerfile_ships_native_kernel():
+    """The runtime image has no g++, and a CPU-only host auto-selects
+    the native backend — the image must build the kernel through the
+    canonical recipe (ops/native.py, not a duplicated g++ line that can
+    drift) and point WVA_NATIVE_LIB at the shipped .so."""
+    from pathlib import Path
+
+    src = (Path(__file__).resolve().parent.parent / "Dockerfile").read_text()
+    assert "native.available()" in src
+    assert "WVA_NATIVE_LIB=/app/native/_libwvaq.so" in src
+    assert "COPY --from=native-build /app/native /app/native" in src
