@@ -31,5 +31,10 @@ COPY --from=native-build /app/native /app/native
 ENV PYTHONUNBUFFERED=1
 # point straight at the prebuilt kernel: no mtime games, no g++ needed
 ENV WVA_NATIVE_LIB=/app/native/_libwvaq.so
+# smoke-check IN THE RUNTIME IMAGE: a .so that built in stage 1 but
+# fails to load here (missing shared lib, path drift) must fail the
+# build, not silently fall back to the slow Python kernel at runtime
+RUN python -c "from workload_variant_autoscaler_tpu.ops import native; \
+assert native.available(), 'shipped native kernel failed to load'"
 USER 65532:65532
 ENTRYPOINT ["python", "-m", "workload_variant_autoscaler_tpu.controller"]

@@ -372,3 +372,26 @@ def test_dockerfile_ships_native_kernel():
     assert "native.available()" in src
     assert "WVA_NATIVE_LIB=/app/native/_libwvaq.so" in src
     assert "COPY --from=native-build /app/native /app/native" in src
+
+
+def test_docs_relative_links_resolve():
+    """Every relative markdown link in README/docs must point at a file
+    that exists (anchors stripped; external URLs skipped)."""
+    import re
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    md_files = [repo / "README.md", *sorted((repo / "docs").rglob("*.md"))]
+    link_re = re.compile(r"\[[^\]]*\]\(([^)\s]+)\)")
+    broken = []
+    for md in md_files:
+        for target in link_re.findall(md.read_text()):
+            if target.startswith(("http://", "https://", "mailto:", "#")):
+                continue
+            path = target.split("#", 1)[0]
+            if not path:
+                continue
+            resolved = (md.parent / path).resolve()
+            if not resolved.exists():
+                broken.append(f"{md.relative_to(repo)} -> {target}")
+    assert not broken, "broken doc links:\n" + "\n".join(broken)
