@@ -1252,3 +1252,33 @@ class TestSharedNamespaceWarning:
         rec.reconcile()
         std = true_arrival_rate_query(MODEL, NS)
         assert prom.queries_seen.count(std) == 1
+
+
+class TestDemandProbeKickCounter:
+    """inferno_demand_probe_kicks_total: the probe's early reconciles
+    must be observable (the sim benchmarks report probe_kicks; live
+    clusters need the counter)."""
+
+    def test_breakout_increments_counter_and_kicks(self):
+        prom = FakePromAPI()
+        prom.set_result("probe-q", 100.0)  # observed demand, req/s
+        emitter = MetricsEmitter()
+        rec = Reconciler(kube=InMemoryKube(), prom=prom, emitter=emitter,
+                         sleep=lambda _s: None)
+        rec._probe_targets = {"chat-8b:prod": ("probe-q", 10.0)}
+        assert rec.demand_probe() is True
+        assert emitter.value("inferno_demand_probe_kicks_total",
+                             variant_name="chat-8b",
+                             namespace="prod") == 1.0
+
+    def test_within_envelope_no_kick_no_count(self):
+        prom = FakePromAPI()
+        prom.set_result("probe-q", 1.0)
+        emitter = MetricsEmitter()
+        rec = Reconciler(kube=InMemoryKube(), prom=prom, emitter=emitter,
+                         sleep=lambda _s: None)
+        rec._probe_targets = {"chat-8b:prod": ("probe-q", 10.0)}
+        assert rec.demand_probe() is False
+        assert emitter.value("inferno_demand_probe_kicks_total",
+                             variant_name="chat-8b",
+                             namespace="prod") is None

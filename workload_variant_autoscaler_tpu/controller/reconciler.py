@@ -907,6 +907,8 @@ class Reconciler:
                     extra=kv(variant=key, observed_rps=round(rate, 2),
                              capacity_rps=round(cap_rps, 2),
                              util_threshold=util))
+                name, _, ns = key.partition(":")
+                self.emitter.emit_probe_kick(name, ns)
                 self.kick()
                 return True
         return False

@@ -114,6 +114,7 @@ INFERNO_MODEL_DRIFT_RATIO = "inferno_model_drift_ratio"
 INFERNO_TPU_DUTY_CYCLE = "inferno_tpu_duty_cycle_percent"
 INFERNO_TPU_HBM_USAGE = "inferno_tpu_hbm_usage_bytes"
 INFERNO_CONDITION_STATUS = "inferno_condition_status"
+INFERNO_DEMAND_PROBE_KICKS_TOTAL = "inferno_demand_probe_kicks_total"
 
 LABEL_CONDITION_TYPE = "type"
 
@@ -141,6 +142,13 @@ class MetricsEmitter:
             INFERNO_REPLICA_SCALING_TOTAL.removesuffix("_total"),
             "Total number of replica scaling operations",
             [LABEL_VARIANT_NAME, LABEL_NAMESPACE, LABEL_DIRECTION, LABEL_REASON],
+            registry=self.registry,
+        )
+        self.demand_probe_kicks_total = Counter(
+            INFERNO_DEMAND_PROBE_KICKS_TOTAL.removesuffix("_total"),
+            "Early reconciles triggered by the demand-breakout probe "
+            "(WVA_FAST_DEMAND_PROBE)",
+            [LABEL_VARIANT_NAME, LABEL_NAMESPACE],
             registry=self.registry,
         )
         self.desired_replicas = Gauge(
@@ -340,6 +348,11 @@ class MetricsEmitter:
                 self.desired_ratio.labels(**labels).set(desired)
             else:
                 self.desired_ratio.labels(**labels).set(desired / current)
+
+    def emit_probe_kick(self, variant_name: str, namespace: str) -> None:
+        self.demand_probe_kicks_total.labels(
+            **{LABEL_VARIANT_NAME: variant_name,
+               LABEL_NAMESPACE: namespace}).inc()
 
     def emit_scaling_event(
         self, variant_name: str, namespace: str, direction: str, reason: str
