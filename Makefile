@@ -35,7 +35,7 @@ bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5, tail str
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo
 
-LINT_PATHS = workload_variant_autoscaler_tpu tools bench.py bench_loop.py __graft_entry__.py
+LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py __graft_entry__.py
 
 .PHONY: lint
 lint: ## Static analysis gate: ruff+mypy when installed, wvalint always

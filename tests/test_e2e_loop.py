@@ -9,32 +9,17 @@ clock because emulator, Prometheus, and controller all advance on the
 simulation clock.
 """
 
-import json
 
-import pytest
 
 from workload_variant_autoscaler_tpu.controller import (
-    ACCELERATOR_CM_NAME,
-    CONFIG_MAP_NAME,
-    CONFIG_MAP_NAMESPACE,
-    SERVICE_CLASS_CM_NAME,
-    ConfigMap,
-    Deployment,
-    InMemoryKube,
-    Reconciler,
     crd,
 )
 from workload_variant_autoscaler_tpu.emulator import (
-    Fleet,
     PoissonLoadGenerator,
-    PrometheusSink,
-    Simulation,
     SliceModelConfig,
-    SimPromAPI,
     TokenDistribution,
 )
 from workload_variant_autoscaler_tpu.emulator.engine import MetricsSink, Request
-from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
 
 MODEL = "llama-8b"
 NS = "default"
@@ -50,7 +35,7 @@ SLO_ITL_MS = 24
 SLO_TTFT_MS = 500
 
 
-from tests.helpers import CompositeSink  # noqa: E402 — re-export for test_e2e_longcontext
+from tests.helpers import CompositeSink  # noqa: E402, WVL002 — re-export for test_e2e_longcontext
 
 
 class TTFTLog(MetricsSink):

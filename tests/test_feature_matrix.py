@@ -6,7 +6,6 @@ observability surface. Features were each validated in isolation; this
 asserts they compose.
 """
 
-import numpy as np
 
 from workload_variant_autoscaler_tpu.collector import JETSTREAM_FAMILY
 from workload_variant_autoscaler_tpu.controller import crd

@@ -9,9 +9,7 @@ file proves the same autoscaler works against a JetStream-shaped endpoint
 (BASELINE north star: "collector scrapes vLLM-TPU / JetStream ... metrics").
 """
 
-import json
 
-import pytest
 
 from workload_variant_autoscaler_tpu.collector import (
     JETSTREAM_FAMILY,
@@ -24,26 +22,14 @@ from workload_variant_autoscaler_tpu.collector import (
     true_arrival_rate_query,
 )
 from workload_variant_autoscaler_tpu.controller import (
-    ACCELERATOR_CM_NAME,
-    CONFIG_MAP_NAME,
-    CONFIG_MAP_NAMESPACE,
-    SERVICE_CLASS_CM_NAME,
-    ConfigMap,
-    Deployment,
-    InMemoryKube,
-    Reconciler,
     crd,
 )
 from workload_variant_autoscaler_tpu.emulator import (
-    Fleet,
     PoissonLoadGenerator,
     PrometheusSink,
-    Simulation,
-    SimPromAPI,
     SliceModelConfig,
     TokenDistribution,
 )
-from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
 
 MODEL = "llama-8b"
 NS = "default"

@@ -11,7 +11,6 @@ that inventory with the configured saturation policy.
 from test_scenarios import (
     NS,
     PROFILE_8B_V5E1,
-    PROFILE_8B_V5E4,
     make_fleet_cluster,
     set_load,
 )

@@ -134,7 +134,7 @@ class TestCallArity:
 
 
 @pytest.mark.parametrize("paths", [
-    ["workload_variant_autoscaler_tpu", "tools", "bench.py",
+    ["workload_variant_autoscaler_tpu", "tools", "tests", "bench.py",
      "bench_loop.py", "__graft_entry__.py"],
 ])
 def test_repo_is_clean(paths):

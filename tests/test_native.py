@@ -1,6 +1,5 @@
 """Native C++ kernel parity with the Python scalar analyzer."""
 
-import numpy as np
 import pytest
 from helpers import make_system, server_spec
 
@@ -187,7 +186,6 @@ class TestEngineIntegration:
 
 class TestBatch:
     def test_batch_matches_scalar_calls(self):
-        n = len(CASES)
         cols = list(zip(*CASES))
         out, feasible = native.size_batch_native(
             cols[0], cols[1], cols[2], cols[3], cols[4], cols[5], cols[6],

@@ -1,7 +1,6 @@
 """Mesh-sharded candidate analysis agrees with the single-device path
 (runs on the 8-virtual-device CPU mesh from conftest)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

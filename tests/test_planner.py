@@ -8,7 +8,6 @@ import pytest
 
 from workload_variant_autoscaler_tpu.ops.analyzer import TargetPerf
 from workload_variant_autoscaler_tpu.planner import (
-    PlanRow,
     SliceOption,
     format_table,
     load_options,

@@ -3,7 +3,6 @@
 
 import pytest
 
-from workload_variant_autoscaler_tpu.models import OptimizerSpec
 from workload_variant_autoscaler_tpu.solver import Manager, Optimizer, Solver
 
 from helpers import make_system, server_spec

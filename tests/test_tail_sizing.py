@@ -9,35 +9,20 @@ plus the Erlang queueing-wait tail (ops.batched.size_batch_tail), and
 VALIDATED against the emulator's measured distribution.
 """
 
-import json
 
 import numpy as np
 import jax.numpy as jnp
 import pytest
 
-from workload_variant_autoscaler_tpu.controller import (
-    ACCELERATOR_CM_NAME,
-    CONFIG_MAP_NAME,
-    CONFIG_MAP_NAMESPACE,
-    SERVICE_CLASS_CM_NAME,
-    ConfigMap,
-    Deployment,
-    InMemoryKube,
-    Reconciler,
-    crd,
-)
 from workload_variant_autoscaler_tpu.controller.translate import ttft_percentile
 from workload_variant_autoscaler_tpu.emulator import (
     Fleet,
     PoissonLoadGenerator,
-    PrometheusSink,
     Simulation,
-    SimPromAPI,
     SliceModelConfig,
     TokenDistribution,
 )
 from workload_variant_autoscaler_tpu.emulator.engine import MetricsSink
-from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
 from workload_variant_autoscaler_tpu.ops.batched import (
     SLOTargets,
     k_max_for,
