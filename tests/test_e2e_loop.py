@@ -35,7 +35,7 @@ SLO_ITL_MS = 24
 SLO_TTFT_MS = 500
 
 
-from tests.helpers import CompositeSink  # noqa: E402, WVL002 — re-export for test_e2e_longcontext
+from tests.helpers import CompositeSink  # noqa: E402, F401, WVL002 — re-export for test_e2e_longcontext
 
 
 class TTFTLog(MetricsSink):
