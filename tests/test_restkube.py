@@ -219,3 +219,72 @@ class TestNodes:
             ("down", 4, False), ("bad", 0, True)]
         # the apiserver filters by the TPU label, not the client
         assert api.last()["path"] == self.NODES_PATH
+
+
+class TestAuthReviews:
+    """TokenReview / SubjectAccessReview POSTs backing the metrics
+    endpoint's kube-auth gate (metrics/authz.py; reference
+    cmd/main.go:164-168). Wire-level: body shapes and status parsing
+    against the scripted apiserver."""
+
+    TR = ("POST", "/apis/authentication.k8s.io/v1/tokenreviews")
+    SAR = ("POST", "/apis/authorization.k8s.io/v1/subjectaccessreviews")
+
+    def test_token_review_request_and_parse(self, api, kube):
+        api.routes[self.TR] = (201, {
+            "status": {"authenticated": True,
+                       "user": {"username": "system:serviceaccount:m:p",
+                                "groups": ["system:serviceaccounts"]}},
+        })
+        out = kube.create_token_review("scraper-token")
+        assert out["authenticated"] is True
+        assert out["user"]["username"] == "system:serviceaccount:m:p"
+        req = api.last()
+        assert req["body"]["kind"] == "TokenReview"
+        assert req["body"]["spec"]["token"] == "scraper-token"
+        # the controller's own SA token authenticates the POST itself
+        assert req["headers"]["Authorization"] == "Bearer tok-123"
+
+    def test_token_review_unauthenticated(self, api, kube):
+        api.routes[self.TR] = (201, {"status": {"authenticated": False}})
+        out = kube.create_token_review("forged")
+        assert out["authenticated"] is False
+
+    def test_token_review_missing_status_is_denied(self, api, kube):
+        api.routes[self.TR] = (201, {})
+        assert kube.create_token_review("x")["authenticated"] is False
+
+    def test_sar_request_and_parse(self, api, kube):
+        api.routes[self.SAR] = (201, {"status": {"allowed": True}})
+        assert kube.create_subject_access_review(
+            "system:serviceaccount:m:p", ["system:serviceaccounts"],
+            "get", "/metrics") is True
+        body = api.last()["body"]
+        assert body["kind"] == "SubjectAccessReview"
+        assert body["spec"]["user"] == "system:serviceaccount:m:p"
+        assert body["spec"]["groups"] == ["system:serviceaccounts"]
+        assert body["spec"]["nonResourceAttributes"] == {
+            "verb": "get", "path": "/metrics"}
+
+    def test_sar_denied_and_missing_status(self, api, kube):
+        api.routes[self.SAR] = (201, {"status": {"allowed": False}})
+        assert kube.create_subject_access_review("u", [], "get",
+                                                 "/metrics") is False
+        api.routes[self.SAR] = (201, {})
+        assert kube.create_subject_access_review("u", [], "get",
+                                                 "/metrics") is False
+
+    def test_gate_end_to_end_over_rest(self, api, kube):
+        """KubeAuthGate driven through RestKube against the scripted
+        apiserver — the full production wiring minus the cluster."""
+        from workload_variant_autoscaler_tpu.metrics.authz import KubeAuthGate
+
+        api.routes[self.TR] = (201, {
+            "status": {"authenticated": True,
+                       "user": {"username": "prom", "groups": []}}})
+        api.routes[self.SAR] = (201, {"status": {"allowed": True}})
+        gate = KubeAuthGate(kube)
+        assert gate.check("Bearer scrape-token") == 200
+        api.routes[self.SAR] = (201, {"status": {"allowed": False}})
+        gate2 = KubeAuthGate(kube)
+        assert gate2.check("Bearer scrape-token") == 403
