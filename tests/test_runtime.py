@@ -6,6 +6,7 @@ Covers the manager plumbing parity with the reference entry point
 """
 
 import threading
+import urllib.error
 import urllib.request
 
 import pytest
@@ -476,3 +477,48 @@ class TestElectionConcurrencyStress:
                 f"unsafe takeover: {h_new} took over {w_new - w_prev:.3f}s "
                 f"after {h_prev}'s last write (lease duration {self.DURATION}s)"
             )
+
+
+class TestMetricsTLSWithKubeAuth:
+    """TLS serving composed with the TokenReview/SAR gate — the
+    production shape for bearer-token scraping (the chart pairs
+    metricsKubeAuth with metricsTLSSecret precisely because bearer
+    tokens must not transit cleartext)."""
+
+    def test_https_scrape_with_token_and_without(self, tmp_path):
+        import ssl
+
+        from workload_variant_autoscaler_tpu.controller import InMemoryKube
+        from workload_variant_autoscaler_tpu.metrics.authz import KubeAuthGate
+
+        certfile, keyfile = make_certpair(
+            tmp_path / "tls.crt", tmp_path / "tls.key")
+        kube = InMemoryKube()
+        kube.grant_token("sa-tok", "prom")
+        kube.grant_access("prom", "get", "/metrics")
+        emitter = MetricsEmitter()
+        emitter.emit_replica_metrics("v", "ns", current=1, desired=3,
+                                     accelerator_type="v5e-8")
+        server, _thread, reloader = emitter.serve(
+            0, addr="127.0.0.1", certfile=certfile, keyfile=keyfile,
+            auth_gate=KubeAuthGate(kube))
+        try:
+            port = server.server_address[1]
+            ctx = ssl.create_default_context(cafile=certfile)
+            ctx.check_hostname = False
+            url = f"https://127.0.0.1:{port}/metrics"
+
+            req = urllib.request.Request(
+                url, headers={"Authorization": "Bearer sa-tok"})
+            with urllib.request.urlopen(req, timeout=5, context=ctx) as r:
+                assert r.status == 200
+                assert b"inferno_desired_replicas" in r.read()
+
+            try:
+                urllib.request.urlopen(url, timeout=5, context=ctx)
+                raise AssertionError("tokenless https scrape must be 401")
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
+        finally:
+            reloader.stop()
+            server.shutdown()
