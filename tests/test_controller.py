@@ -1282,3 +1282,51 @@ class TestDemandProbeKickCounter:
         assert emitter.value("inferno_demand_probe_kicks_total",
                              variant_name="chat-8b",
                              namespace="prod") is None
+
+
+class TestProbeDaemonIntegration:
+    """The probe DAEMON THREAD end-to-end: run_forever starts it, it
+    polls on its own cadence, detects a demand spike breaking out of the
+    published envelope, and kicks an early cycle — wall-clock, real
+    threads (the sim benchmarks drive demand_probe() synchronously; this
+    pins the production wiring)."""
+
+    def test_spike_triggers_early_cycle_and_counter(self, monkeypatch):
+        import threading
+        import time as _time
+
+        monkeypatch.setenv("WVA_FAST_DEMAND_PROBE", "0.1")
+        kube, prom, emitter, rec = make_cluster(arrival_rps=2.0,
+                                                interval="300s")
+        cycles: list[float] = []
+        orig = rec.reconcile
+
+        def counted():
+            cycles.append(_time.monotonic())
+            return orig()
+
+        rec.reconcile = counted
+        stop = threading.Event()
+        t = threading.Thread(target=rec.run_forever, args=(stop,),
+                             daemon=True)
+        t.start()
+        try:
+            deadline = _time.monotonic() + 10.0
+            while len(cycles) < 1 and _time.monotonic() < deadline:
+                _time.sleep(0.02)
+            assert cycles, "startup cycle missing"
+            # published capacity now sized for ~2 rps; spike to 40 rps
+            t_spike = _time.monotonic()
+            prom.set_result(true_arrival_rate_query(MODEL, NS), 40.0)
+            while len(cycles) < 2 and _time.monotonic() < t_spike + 8.0:
+                _time.sleep(0.02)
+            assert len(cycles) >= 2, "probe did not kick an early cycle"
+            assert cycles[1] - t_spike < 5.0  # not the 300s interval
+            assert emitter.value("inferno_demand_probe_kicks_total",
+                                 variant_name=VARIANT,
+                                 namespace=NS) >= 1
+        finally:
+            stop.set()
+            rec.kick()
+            t.join(timeout=5.0)
+        assert not t.is_alive()
