@@ -361,6 +361,32 @@ def test_mini_helm_else_if_chain():
     assert render({"a": False, "b": False}) == "C"
 
 
+def test_mini_helm_or_and_functions():
+    """Go template `or`/`and` return the deciding OPERAND's value (not a
+    coerced bool) with short-circuit truthiness — the chart's TLS/CA
+    volume conditionals depend on these semantics."""
+    import sys
+
+    sys.path.insert(0, str(REPO_ROOT / "tools"))
+    from mini_helm import Renderer, _tokenize, parse
+
+    def render(src, values):
+        nodes, defines = parse(_tokenize(src))
+        r = Renderer({"Values": values}, defines)
+        return r.render(nodes, {"Values": values}, {})
+
+    # value semantics: first truthy (or), first falsey (and), else last
+    assert render("{{ or .Values.a .Values.b }}", {"a": "", "b": "x"}) == "x"
+    assert render("{{ or .Values.a .Values.b }}", {"a": "y", "b": "x"}) == "y"
+    assert render("{{ and .Values.a .Values.b }}", {"a": "y", "b": "x"}) == "x"
+    assert render("{{ and .Values.a .Values.b }}", {"a": "", "b": "x"}) == ""
+    # the chart's actual shape: either condition mounts the volume block
+    src = "{{ if or .Values.ca .Values.tls }}V{{ end }}"
+    assert render(src, {"ca": "", "tls": "s"}) == "V"
+    assert render(src, {"ca": "pem", "tls": ""}) == "V"
+    assert render(src, {"ca": "", "tls": ""}) == ""
+
+
 def test_dockerfile_ships_native_kernel():
     """The runtime image has no g++, and a CPU-only host auto-selects
     the native backend — the image must build the kernel through the
