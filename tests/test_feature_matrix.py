@@ -1,9 +1,11 @@
-"""All-features-on interaction soak: every round-2 capability enabled in
-ONE closed loop — JetStream dialect (backlog-derived demand), percentile
-TTFT sizing, limited mode against node inventory, scale-down
-stabilization + demand headroom, drift watchdog, and the full
-observability surface. Features were each validated in isolation; this
-asserts they compose.
+"""All-features-on interaction soak: every capability enabled in ONE
+closed loop — JetStream dialect (backlog-derived demand), percentile
+TTFT sizing, fast-probe short-window demand sizing (round 4: the
+max(1m, probe-window) path must compose with the backlog-derived
+JetStream demand query), limited mode against node inventory,
+scale-down stabilization + demand headroom, drift watchdog, and the
+full observability surface. Features were each validated in isolation;
+this asserts they compose.
 """
 
 
@@ -41,6 +43,10 @@ def test_every_feature_composes(monkeypatch):
             "WVA_SCALE_DOWN_STABILIZATION": "60s",
             "WVA_DEMAND_HEADROOM": "0.25",
             "WVA_DRIFT_TOLERANCE": "0.5",
+            # round 4: cadence cycles size on max(1m, 15s) demand — the
+            # short-window variant of the JetStream backlog-derived query
+            "WVA_FAST_DEMAND_PROBE": "5",
+            "WVA_FAST_PROBE_WINDOW": "15s",
         },
     )
     # limited mode needs inventory: 8 v5e chips across 2 nodes
