@@ -53,7 +53,7 @@ def best_of(once, n: int = 3) -> list[float]:
     return [once() for _ in range(n)]
 
 
-def bench_tpu(c, iters: int = 20):
+def bench_tpu(c, iters: int = 100, n_runs: int = 5):
     import jax
     import jax.numpy as jnp
 
@@ -85,16 +85,22 @@ def bench_tpu(c, iters: int = 20):
         jax.block_until_ready(out)
         return b * iters / (time.perf_counter() - t0)
 
-    # warmup/compile, then best-of-3 (tunnel latency varies run-to-run)
+    # warmup/compile, then best-of-n. On an accelerator the default is
+    # 100 iters x 5 runs: a 20-iter window is ~2 ms of compute at the
+    # recorded rates, so one tunnel-latency spike sinks a whole run
+    # (BENCH_tpu_capture_r04.json runs spread 14M-48M); a ~10 ms window
+    # amortizes dispatch and 5 runs make a clean reading near-certain.
     jax.block_until_ready(size_batch(q, targets, k_max))
-    runs = best_of(lambda: timed(lambda: size_batch(q, targets, k_max)))
+    runs = best_of(lambda: timed(lambda: size_batch(q, targets, k_max)),
+                   n=n_runs)
 
     # percentile sizing (WVA_TTFT_PERCENTILE): the tail kernel adds a
     # gammaincc mixture per bisection trip — same protocol
     jax.block_until_ready(size_batch_tail(q, targets, k_max,
                                           ttft_percentile=0.95))
     tail_runs = best_of(lambda: timed(
-        lambda: size_batch_tail(q, targets, k_max, ttft_percentile=0.95)))
+        lambda: size_batch_tail(q, targets, k_max, ttft_percentile=0.95)),
+        n=n_runs)
     return max(runs), runs, max(tail_runs), tail_runs
 
 
@@ -112,9 +118,11 @@ from bench import (bench_tpu, bench_native_batch, bench_sequential,
 platform = jax.devices()[0].platform
 c = build_candidates(4096)
 # the CPU fallback runs the same fleet-scale batch at ~1/100000th the
-# device rate; fewer timed iterations keep it inside the stage timeout
-iters = 5 if os.environ.get("WVA_FORCE_CPU") else 20
-rate, runs, tail_rate, tail_runs = bench_tpu(c, iters=iters)
+# device rate; fewer timed iterations + runs keep it inside the timeout
+if os.environ.get("WVA_FORCE_CPU"):
+    rate, runs, tail_rate, tail_runs = bench_tpu(c, iters=5, n_runs=3)
+else:
+    rate, runs, tail_rate, tail_runs = bench_tpu(c)
 out = {"rate": rate, "runs": runs, "tail_rate": tail_rate,
        "tail_runs": tail_runs, "platform": platform}
 if os.environ.get("WVA_FORCE_CPU"):
@@ -404,13 +412,13 @@ t = SLOTargets(ttft=jnp.full(b, 500., jnp.float32),
                tps=jnp.zeros(b, jnp.float32))
 k = k_max_for(np.full(b, 64))
 
-def rate(fn, iters=20):
-    # same protocol as the XLA stage: warmup compile, then best-of-3
-    # (the tunnel's latency varies run-to-run; max is the robust
-    # device-throughput estimate)
+def rate(fn, iters=100):
+    # same protocol as the XLA stage: warmup compile, then best-of-5
+    # over ~10ms timed windows (the tunnel's latency varies run-to-run;
+    # max is the robust device-throughput estimate)
     jax.block_until_ready(fn().lam_star)
     best = 0.0
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.perf_counter()
         for _ in range(iters):
             out = fn()
