@@ -1,0 +1,747 @@
+"""Wire-level Kubernetes apiserver facade over an ``InMemoryKube``.
+
+The reference proves its controller against a *real* apiserver twice: the
+envtest tier boots kube-apiserver+etcd binaries
+(``internal/controller/suite_test.go:56-93``) and the kind e2e tier runs a
+whole cluster. This image has neither binaries nor docker, so those tiers
+skip — which leaves ``RestKube`` (the production client) validated only by
+scripted per-endpoint servers (``tests/test_watch.py``,
+``tests/test_restkube_auth.py``-style suites). This facade closes the gap
+that remains: it serves the apiserver's actual REST surface — URL layout,
+verbs, content types, status codes, optimistic-concurrency 409s, chunked
+``?watch=true`` streaming with resourceVersion resume, TokenReview /
+SubjectAccessReview POSTs (reference ``cmd/main.go:164-168``), Lease CRUD —
+backed by the same ``InMemoryKube`` semantics every hermetic suite pins.
+The full controller stack (reconciler, watch threads, leader elector,
+metrics auth gate) can then run against HTTP with zero cluster binaries,
+so a wire-shape bug in RestKube (a wrong path, a missing content type, a
+misencoded body) fails a test instead of hiding until someone has a real
+cluster.
+
+Deliberate independence: every JSON body this facade emits for core/v1 and
+coordination/authn/authz kinds is hand-written against the apiserver's
+documented wire format — NOT produced by RestKube's own encoders — so an
+encoding bug on either side surfaces as a mismatch rather than cancelling
+out. (VariantAutoscaling bodies use ``crd.va_to_dict``: that dict IS the
+CRD's wire schema, pinned independently by ``tests/test_schema.py``
+against the shipped OpenAPI manifest.)
+
+resourceVersion model: a real apiserver has ONE storage-global RV space.
+``InMemoryKube`` tracks per-object counters (what optimistic concurrency
+needs); the facade adds a global event sequence (what the watch protocol
+needs): GET/LIST item bodies carry the per-object RV, list envelopes and
+watch frames carry the global sequence. ``RestKube`` — like client-go —
+only ever hands list/frame RVs back to ``?watch=true`` and object RVs
+back to writes, so each consumer sees a coherent space.
+
+Usage (tests or local dev):
+
+    kube = InMemoryKube()
+    srv = MiniApiServer(kube)
+    url = srv.start()           # http://127.0.0.1:<port>
+    client = RestKube(base_url=url, verify=False)
+    ...
+    srv.stop()
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import parse_qs, unquote, urlparse
+
+from workload_variant_autoscaler_tpu.controller.crd import (
+    GROUP,
+    KIND,
+    PLURAL,
+    VERSION,
+    va_to_dict,
+    va_from_dict,
+)
+from workload_variant_autoscaler_tpu.controller.kube import (
+    ConflictError,
+    Deployment,
+    InMemoryKube,
+    InvalidError,
+    NotFoundError,
+    WatchEvent,
+)
+
+WATCH_RING = 2048   # retained events; older resourceVersions get 410
+
+
+def _status_body(code: int, reason: str, message: str) -> dict:
+    """A metav1.Status the way the apiserver writes error bodies."""
+    return {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "metadata": {},
+        "status": "Failure",
+        "message": message,
+        "reason": reason,
+        "code": code,
+    }
+
+
+def _micro_time(unix: float) -> Optional[str]:
+    if unix <= 0:
+        return None
+    import datetime
+
+    return datetime.datetime.fromtimestamp(
+        unix, tz=datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def _parse_micro_time(s: Optional[str]) -> float:
+    if not s:
+        return 0.0
+    import datetime
+
+    s2 = s.replace("Z", "+0000")
+    for fmt in ("%Y-%m-%dT%H:%M:%S.%f%z", "%Y-%m-%dT%H:%M:%S%z"):
+        try:
+            return datetime.datetime.strptime(s2, fmt).timestamp()
+        except ValueError:
+            continue
+    raise InvalidError(f"unparseable lease timestamp {s!r}")
+
+
+@dataclass
+class _Event:
+    seq: int
+    kind: str
+    namespace: str
+    name: str
+    frame: dict       # the full {"type":..., "object":...} wire frame
+
+
+@dataclass
+class Counts:
+    """Request counters for test assertions (how many LISTs did a resume
+    cost?). Guarded by the server's event lock."""
+
+    list_va: int = 0
+    watch_va: int = 0
+    list_cm: int = 0
+    watch_cm: int = 0
+    gone_410: int = 0
+    token_reviews: int = 0
+    access_reviews: int = 0
+
+
+class MiniApiServer:
+    """Serve an ``InMemoryKube`` over the apiserver's REST wire protocol."""
+
+    def __init__(self, kube: InMemoryKube,
+                 require_token: Optional[str] = None,
+                 ring_size: int = WATCH_RING) -> None:
+        self.kube = kube
+        self.require_token = require_token
+        self.counts = Counts()
+        self._lock = threading.Condition()
+        self._seq = 0
+        self._ring: deque[_Event] = deque(maxlen=ring_size)
+        self._stopping = threading.Event()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        kube.add_watch_listener(self._on_event)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> str:
+        handler = _make_handler(self)
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="mini-apiserver")
+        self._thread.start()
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        self._stopping.set()
+        with self._lock:
+            self._lock.notify_all()   # unblock watch waits
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "MiniApiServer":
+        self.url = self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- event plumbing --------------------------------------------------
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        """InMemoryKube mutation -> wire frame in the ring. Runs on the
+        mutating thread; the lookup snapshots the object *now*, which for
+        back-to-back writes can attach the later state to the earlier
+        event — watchers here are level-triggered (they key on identity
+        only), same contract as InMemoryKube.add_watch_listener."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            obj = self._object_for(ev, seq)
+            frame = {"type": ev.type, "object": obj}
+            self._ring.append(_Event(seq, ev.kind, ev.namespace, ev.name,
+                                     frame))
+            self._lock.notify_all()
+
+    def _object_for(self, ev: WatchEvent, seq: int) -> dict:
+        # direct storage reads under the kube's lock (RLock; the mutator
+        # notifies AFTER releasing it, so no deadlock): the public getters
+        # would trip injected "get" faults on every watch frame
+        if ev.type != "DELETED":
+            with self.kube._lock:
+                if ev.kind == "VariantAutoscaling":
+                    va = self.kube.vas.get((ev.namespace, ev.name))
+                    if va is not None:
+                        obj = va_to_dict(va)
+                        obj["metadata"]["resourceVersion"] = str(seq)
+                        return obj
+                elif ev.kind == "ConfigMap":
+                    cm = self.kube.configmaps.get((ev.namespace, ev.name))
+                    if cm is not None:
+                        return {
+                            "apiVersion": "v1", "kind": "ConfigMap",
+                            "metadata": {"name": cm.name,
+                                         "namespace": cm.namespace,
+                                         "resourceVersion": str(seq)},
+                            "data": dict(cm.data),
+                        }
+                elif ev.kind == "Deployment":
+                    d = self.kube.deployments.get((ev.namespace, ev.name))
+                    if d is not None:
+                        return self._deployment_body(d, rv=str(seq))
+        # DELETED (or a racing delete): identity-only object, like the
+        # apiserver's final state snapshot reduced to what clients key on
+        kind = ev.kind if ev.kind != "VariantAutoscaling" else KIND
+        api_version = ("v1" if ev.kind in ("ConfigMap", "Deployment")
+                       else f"{GROUP}/{VERSION}")
+        return {
+            "apiVersion": api_version, "kind": kind,
+            "metadata": {"name": ev.name, "namespace": ev.namespace,
+                         "resourceVersion": str(seq)},
+        }
+
+    @staticmethod
+    def _deployment_body(d: Deployment, rv: str = "") -> dict:
+        meta: dict[str, Any] = {
+            "name": d.name, "namespace": d.namespace,
+            "uid": d.uid, "labels": dict(d.labels),
+        }
+        if rv:
+            meta["resourceVersion"] = rv
+        body: dict[str, Any] = {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": meta,
+            "spec": {"replicas": d.spec_replicas},
+        }
+        if d.status_replicas >= 0:
+            body["status"] = {"replicas": d.status_replicas}
+        else:
+            body["status"] = {}
+        return body
+
+    @staticmethod
+    def _node_body(n) -> dict:
+        return {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": n.name, "labels": dict(n.labels)},
+            "spec": ({"unschedulable": True} if n.unschedulable else {}),
+            "status": {
+                "allocatable": {"google.com/tpu": str(n.tpu_capacity)},
+                "conditions": [
+                    {"type": "Ready",
+                     "status": "True" if n.ready else "False"},
+                ],
+            },
+        }
+
+    @staticmethod
+    def _lease_body(lease) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {
+                "name": lease.name, "namespace": lease.namespace,
+                "resourceVersion": lease.resource_version,
+            },
+            "spec": {
+                "holderIdentity": lease.holder,
+                "acquireTime": _micro_time(lease.acquire_time),
+                "renewTime": _micro_time(lease.renew_time),
+                "leaseDurationSeconds": int(lease.duration_seconds),
+                "leaseTransitions": lease.transitions,
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# HTTP handler
+# ---------------------------------------------------------------------------
+
+_VA_ITEM = re.compile(
+    rf"^/apis/{GROUP}/{VERSION}/namespaces/([^/]+)/{PLURAL}/([^/]+)$")
+_VA_STATUS = re.compile(
+    rf"^/apis/{GROUP}/{VERSION}/namespaces/([^/]+)/{PLURAL}/([^/]+)/status$")
+_VA_LIST = re.compile(rf"^/apis/{GROUP}/{VERSION}/{PLURAL}$")
+_CM_ITEM = re.compile(r"^/api/v1/namespaces/([^/]+)/configmaps/([^/]+)$")
+_CM_LIST = re.compile(r"^/api/v1/namespaces/([^/]+)/configmaps$")
+_DEPLOY_ITEM = re.compile(
+    r"^/apis/apps/v1/namespaces/([^/]+)/deployments/([^/]+)$")
+_NODES = re.compile(r"^/api/v1/nodes$")
+_LEASE_LIST = re.compile(
+    r"^/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases$")
+_LEASE_ITEM = re.compile(
+    r"^/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases/([^/]+)$")
+_TOKEN_REVIEW = "/apis/authentication.k8s.io/v1/tokenreviews"
+_ACCESS_REVIEW = "/apis/authorization.k8s.io/v1/subjectaccessreviews"
+
+
+def _make_handler(srv: MiniApiServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # -- plumbing ----------------------------------------------------
+
+        def log_message(self, fmt, *args):  # noqa: D102 — silence stderr
+            pass
+
+        def _json(self, code: int, body: dict) -> None:
+            raw = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def _error(self, code: int, reason: str, message: str) -> None:
+            self._json(code, _status_body(code, reason, message))
+
+        def _read_body(self) -> Any:
+            if not self._body_raw:
+                return None
+            try:
+                return json.loads(self._body_raw)
+            except json.JSONDecodeError:
+                raise InvalidError("request body is not JSON")
+
+        def _authorized(self) -> bool:
+            if srv.require_token is None:
+                return True
+            got = self.headers.get("Authorization", "")
+            if got == f"Bearer {srv.require_token}":
+                return True
+            self._error(401, "Unauthorized",
+                        "the server has asked for credentials")
+            return False
+
+        def _dispatch(self, method: str) -> None:
+            # drain the request body up front: an error response written
+            # with unread body bytes on the socket desyncs HTTP/1.1
+            # keep-alive — the NEXT request on the connection would be
+            # parsed out of the leftover body
+            try:
+                n = int(self.headers.get("Content-Length", "0") or "0")
+            except ValueError:
+                n = 0
+            self._body_raw = self.rfile.read(n) if n else b""
+            if not self._authorized():
+                return
+            try:
+                self._route(method)
+            except NotFoundError as e:
+                self._error(404, "NotFound", str(e))
+            except ConflictError as e:
+                self._error(409, "Conflict", str(e))
+            except InvalidError as e:
+                self._error(422, "Invalid", str(e))
+            except BrokenPipeError:
+                pass   # client went away mid-stream (watch teardown)
+            except Exception as e:  # noqa: BLE001 — injected faults etc.
+                try:
+                    self._error(500, "InternalError", str(e))
+                except Exception:  # noqa: BLE001 — headers already sent
+                    pass
+
+        def do_GET(self) -> None:    # noqa: N802
+            self._dispatch("GET")
+
+        def do_PUT(self) -> None:    # noqa: N802
+            self._dispatch("PUT")
+
+        def do_POST(self) -> None:   # noqa: N802
+            self._dispatch("POST")
+
+        def do_PATCH(self) -> None:  # noqa: N802
+            self._dispatch("PATCH")
+
+        # -- routing -----------------------------------------------------
+
+        def _route(self, method: str) -> None:
+            parsed = urlparse(self.path)
+            path = parsed.path
+            q = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+
+            if method == "GET":
+                m = _VA_LIST.match(path)
+                if m:
+                    return self._va_list_or_watch(q)
+                m = _VA_ITEM.match(path)
+                if m:
+                    va = srv.kube.get_variant_autoscaling(
+                        m.group(2), m.group(1))
+                    return self._json(200, va_to_dict(va))
+                m = _CM_LIST.match(path)
+                if m:
+                    return self._cm_list_or_watch(m.group(1), q)
+                m = _CM_ITEM.match(path)
+                if m:
+                    cm = srv.kube.get_configmap(m.group(2), m.group(1))
+                    return self._json(200, {
+                        "apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {"name": cm.name,
+                                     "namespace": cm.namespace},
+                        "data": dict(cm.data),
+                    })
+                m = _DEPLOY_ITEM.match(path)
+                if m:
+                    d = srv.kube.get_deployment(m.group(2), m.group(1))
+                    return self._json(200, srv._deployment_body(d))
+                m = _NODES.match(path)
+                if m:
+                    return self._nodes(q)
+                m = _LEASE_ITEM.match(path)
+                if m:
+                    lease = srv.kube.get_lease(m.group(2), m.group(1))
+                    return self._json(200, srv._lease_body(lease))
+                return self._error(404, "NotFound",
+                                   f"unknown path {path}")
+
+            if method == "PUT":
+                m = _VA_STATUS.match(path)
+                if m:
+                    return self._va_status_put(m.group(1), m.group(2))
+                m = _LEASE_ITEM.match(path)
+                if m:
+                    return self._lease_put(m.group(1), m.group(2))
+                return self._error(404, "NotFound", f"unknown path {path}")
+
+            if method == "POST":
+                if path == _TOKEN_REVIEW:
+                    return self._token_review()
+                if path == _ACCESS_REVIEW:
+                    return self._access_review()
+                m = _LEASE_LIST.match(path)
+                if m:
+                    return self._lease_post(m.group(1))
+                return self._error(404, "NotFound", f"unknown path {path}")
+
+            if method == "PATCH":
+                m = _VA_ITEM.match(path)
+                if m:
+                    return self._va_patch(m.group(1), m.group(2))
+                return self._error(404, "NotFound", f"unknown path {path}")
+
+            return self._error(405, "MethodNotAllowed", method)
+
+        # -- VariantAutoscalings ----------------------------------------
+
+        def _va_list_or_watch(self, q: dict[str, str]) -> None:
+            if q.get("watch") == "true":
+                with srv._lock:
+                    srv.counts.watch_va += 1
+                return self._stream_watch("VariantAutoscaling", None, q)
+            with srv._lock:
+                srv.counts.list_va += 1
+                seq = srv._seq
+            items = []
+            for va in srv.kube.list_variant_autoscalings():
+                items.append(va_to_dict(va))
+            self._json(200, {
+                "apiVersion": f"{GROUP}/{VERSION}",
+                "kind": f"{KIND}List",
+                "metadata": {"resourceVersion": str(seq)},
+                "items": items,
+            })
+
+        def _va_status_put(self, ns: str, name: str) -> None:
+            body = self._read_body()
+            if not isinstance(body, dict):
+                raise InvalidError("status PUT requires an object body")
+            va = va_from_dict(body)
+            # path wins over body identity, like the apiserver
+            va.metadata.namespace = ns
+            va.metadata.name = name
+            rv = ((body.get("metadata") or {}).get("resourceVersion"))
+            va.metadata.resource_version = rv or ""
+            srv.kube.update_variant_autoscaling_status(va)
+            stored = srv.kube.get_variant_autoscaling(name, ns)
+            self._json(200, va_to_dict(stored))
+
+        def _va_patch(self, ns: str, name: str) -> None:
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+            if ctype != "application/merge-patch+json":
+                # a real apiserver 415s unsupported patch types — a client
+                # sending the wrong content type must not "work" here
+                return self._error(
+                    415, "UnsupportedMediaType",
+                    f"unsupported patch content type {ctype!r}")
+            body = self._read_body() or {}
+            refs = (body.get("metadata") or {}).get("ownerReferences")
+            if not refs:
+                raise InvalidError(
+                    "only metadata.ownerReferences merge-patches are "
+                    "supported by this facade")
+            ref = refs[0]
+            va = srv.kube.get_variant_autoscaling(name, ns)
+            deploy = Deployment(name=ref.get("name", ""), namespace=ns,
+                                uid=ref.get("uid", ""))
+            srv.kube.patch_owner_reference(va, deploy)
+            stored = srv.kube.get_variant_autoscaling(name, ns)
+            self._json(200, va_to_dict(stored))
+
+        # -- ConfigMaps --------------------------------------------------
+
+        def _cm_list_or_watch(self, ns: str, q: dict[str, str]) -> None:
+            name_filter = None
+            fs = q.get("fieldSelector")
+            if fs:
+                m = re.match(r"^metadata\.name=(.+)$", fs)
+                if not m:
+                    raise InvalidError(f"unsupported fieldSelector {fs!r}")
+                name_filter = m.group(1)
+            if q.get("watch") == "true":
+                with srv._lock:
+                    srv.counts.watch_cm += 1
+                return self._stream_watch("ConfigMap", (ns, name_filter), q)
+            with srv._lock:
+                srv.counts.list_cm += 1
+                seq = srv._seq
+            items = [
+                {"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": cm.name, "namespace": cm.namespace},
+                 "data": dict(cm.data)}
+                for (cns, cname), cm in sorted(srv.kube.configmaps.items())
+                if cns == ns and (name_filter is None or cname == name_filter)
+            ]
+            self._json(200, {
+                "apiVersion": "v1", "kind": "ConfigMapList",
+                "metadata": {"resourceVersion": str(seq)},
+                "items": items,
+            })
+
+        # -- watch streaming ---------------------------------------------
+
+        def _stream_watch(self, kind: str,
+                          cm_scope: Optional[tuple[str, Optional[str]]],
+                          q: dict[str, str]) -> None:
+            try:
+                timeout_s = float(q.get("timeoutSeconds", "300"))
+            except ValueError:
+                raise InvalidError("timeoutSeconds must be numeric")
+            rv_param = q.get("resourceVersion", "")
+            gone = False
+            with srv._lock:
+                if rv_param:
+                    try:
+                        after = int(rv_param)
+                    except ValueError:
+                        raise InvalidError(
+                            f"resourceVersion {rv_param!r} is not valid")
+                    oldest = srv._ring[0].seq if srv._ring else srv._seq + 1
+                    if after + 1 < oldest and after < srv._seq:
+                        # the window moved past the client's RV
+                        srv.counts.gone_410 += 1
+                        gone = True
+                else:
+                    after = srv._seq
+            if gone:
+                return self._error(410, "Expired",
+                                   f"too old resource version: {after}")
+
+            def matches(ev: _Event) -> bool:
+                if ev.kind != kind:
+                    return False
+                if cm_scope is not None:
+                    ns, name_filter = cm_scope
+                    if ev.namespace != ns:
+                        return False
+                    if name_filter is not None and ev.name != name_filter:
+                        return False
+                return True
+
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def send_frame(frame: dict) -> None:
+                raw = (json.dumps(frame) + "\n").encode()
+                self.wfile.write(b"%x\r\n%s\r\n" % (len(raw), raw))
+                self.wfile.flush()
+
+            deadline = time.monotonic() + timeout_s
+            last = after
+            try:
+                while not srv._stopping.is_set():
+                    now = time.monotonic()
+                    if now >= deadline:
+                        break
+                    batch: list[dict] = []
+                    pruned = False
+                    with srv._lock:
+                        oldest = (srv._ring[0].seq if srv._ring
+                                  else srv._seq + 1)
+                        if srv._seq > last and oldest > last + 1:
+                            # events in (last, oldest) fell off the ring
+                            # while this stream was behind: the apiserver
+                            # contract is an in-stream ERROR (410), which
+                            # the client turns into a fresh LIST — silent
+                            # skipping would lose DELETED frames forever
+                            srv.counts.gone_410 += 1
+                            pruned = True
+                        else:
+                            for ev in srv._ring:
+                                if ev.seq > last and matches(ev):
+                                    batch.append(ev.frame)
+                            newest = (srv._ring[-1].seq if srv._ring
+                                      else srv._seq)
+                            if not batch and newest <= last:
+                                srv._lock.wait(min(0.25, deadline - now))
+                            advance = max(last, newest)
+                    if pruned:
+                        send_frame({
+                            "type": "ERROR",
+                            "object": _status_body(
+                                410, "Expired",
+                                f"too old resource version: {last}"),
+                        })
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.wfile.flush()
+                        self.close_connection = True
+                        return
+                    for frame in batch:
+                        send_frame(frame)
+                    last = advance
+                # clean expiry: a final BOOKMARK pins the resume RV, the
+                # way apiservers emit allowWatchBookmarks frames
+                send_frame({
+                    "type": "BOOKMARK",
+                    "object": {
+                        "kind": kind,
+                        "metadata": {"resourceVersion": str(last)},
+                    },
+                })
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            # one watch per connection: the chunked stream has ended, and
+            # a follow-up request on this socket would race the close
+            self.close_connection = True
+
+        # -- nodes -------------------------------------------------------
+
+        def _nodes(self, q: dict[str, str]) -> None:
+            sel = q.get("labelSelector", "")
+            sel = unquote(sel)
+            items = []
+            for n in srv.kube.list_nodes():
+                if sel and "=" in sel:
+                    k, v = sel.split("=", 1)
+                    if n.labels.get(k) != v:
+                        continue
+                elif sel:
+                    if sel not in n.labels:   # existence selector
+                        continue
+                items.append(srv._node_body(n))
+            self._json(200, {
+                "apiVersion": "v1", "kind": "NodeList",
+                "metadata": {}, "items": items,
+            })
+
+        # -- leases ------------------------------------------------------
+
+        def _lease_from_body(self, ns: str, body: dict):
+            from workload_variant_autoscaler_tpu.controller.runtime import (
+                Lease,
+            )
+
+            meta = body.get("metadata") or {}
+            spec = body.get("spec") or {}
+            return Lease(
+                name=meta.get("name", ""),
+                namespace=meta.get("namespace") or ns,
+                holder=spec.get("holderIdentity") or "",
+                acquire_time=_parse_micro_time(spec.get("acquireTime")),
+                renew_time=_parse_micro_time(spec.get("renewTime")),
+                duration_seconds=float(
+                    spec.get("leaseDurationSeconds") or 15),
+                transitions=int(spec.get("leaseTransitions") or 0),
+                resource_version=meta.get("resourceVersion", "0"),
+            )
+
+        def _lease_post(self, ns: str) -> None:
+            body = self._read_body()
+            if not isinstance(body, dict):
+                raise InvalidError("lease POST requires a body")
+            lease = self._lease_from_body(ns, body)
+            srv.kube.create_lease(lease)
+            self._json(201, srv._lease_body(lease))
+
+        def _lease_put(self, ns: str, name: str) -> None:
+            body = self._read_body()
+            if not isinstance(body, dict):
+                raise InvalidError("lease PUT requires a body")
+            lease = self._lease_from_body(ns, body)
+            lease.name = name
+            srv.kube.update_lease(lease)
+            self._json(200, srv._lease_body(lease))
+
+        # -- authn/authz -------------------------------------------------
+
+        def _token_review(self) -> None:
+            body = self._read_body() or {}
+            token = ((body.get("spec") or {}).get("token")) or ""
+            with srv._lock:
+                srv.counts.token_reviews += 1
+            status = srv.kube.create_token_review(token)
+            self._json(201, {
+                "apiVersion": "authentication.k8s.io/v1",
+                "kind": "TokenReview",
+                "status": status,
+            })
+
+        def _access_review(self) -> None:
+            body = self._read_body() or {}
+            spec = body.get("spec") or {}
+            attrs = spec.get("nonResourceAttributes") or {}
+            with srv._lock:
+                srv.counts.access_reviews += 1
+            allowed = srv.kube.create_subject_access_review(
+                spec.get("user") or "",
+                list(spec.get("groups") or []),
+                attrs.get("verb") or "",
+                attrs.get("path") or "",
+            )
+            self._json(201, {
+                "apiVersion": "authorization.k8s.io/v1",
+                "kind": "SubjectAccessReview",
+                "status": {"allowed": bool(allowed)},
+            })
+
+    return Handler
