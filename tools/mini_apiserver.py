@@ -34,7 +34,7 @@ watch frames carry the global sequence. ``RestKube`` — like client-go —
 only ever hands list/frame RVs back to ``?watch=true`` and object RVs
 back to writes, so each consumer sees a coherent space.
 
-Usage (tests or local dev):
+Usage (tests):
 
     kube = InMemoryKube()
     srv = MiniApiServer(kube)
@@ -42,6 +42,17 @@ Usage (tests or local dev):
     client = RestKube(base_url=url, verify=False)
     ...
     srv.stop()
+
+Usage (local dev, fully process-separated — emulator, apiserver, and
+controller as three real processes):
+
+    python -m workload_variant_autoscaler_tpu.emulator --port 8000 \
+        --with-prom-api &
+    python -m tools.mini_apiserver \
+        --manifests deploy/examples/local --port 8001 &
+    PROMETHEUS_BASE_URL=http://127.0.0.1:8000 \
+    python -m workload_variant_autoscaler_tpu.controller \
+        --allow-http-prom --kube-url http://127.0.0.1:8001
 """
 
 from __future__ import annotations
@@ -155,9 +166,9 @@ class MiniApiServer:
 
     # -- lifecycle -------------------------------------------------------
 
-    def start(self) -> str:
+    def start(self, port: int = 0) -> str:
         handler = _make_handler(self)
-        self._server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self._server.daemon_threads = True
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
@@ -745,3 +756,55 @@ def _make_handler(srv: MiniApiServer):
             })
 
     return Handler
+
+
+# ---------------------------------------------------------------------------
+# CLI: a standalone local apiserver for the three-process dev loop
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    import argparse
+    import signal
+
+    from workload_variant_autoscaler_tpu.controller.kube import (
+        in_memory_kube_from_manifests,
+    )
+
+    parser = argparse.ArgumentParser(
+        description="Serve an in-memory Kubernetes apiserver (preloaded "
+                    "from YAML manifests) over the real REST wire protocol "
+                    "for local controller development.")
+    parser.add_argument("--manifests", required=True, metavar="DIR",
+                        help="directory of ConfigMap/Deployment/"
+                             "VariantAutoscaling YAMLs to preload")
+    parser.add_argument("--port", type=int, default=8001)
+    parser.add_argument("--require-token", default=None,
+                        help="reject requests lacking this bearer token")
+    args = parser.parse_args(argv)
+
+    kube = in_memory_kube_from_manifests(args.manifests)
+    srv = MiniApiServer(kube, require_token=args.require_token)
+    url = srv.start(port=args.port)
+    print(f"mini-apiserver listening on {url} "
+          f"({len(kube.vas)} VariantAutoscalings, "
+          f"{len(kube.configmaps)} ConfigMaps, "
+          f"{len(kube.deployments)} Deployments)", flush=True)
+
+    stop = threading.Event()
+
+    def on_signal(_sig, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
