@@ -470,6 +470,54 @@ class TestWireMetricsAuth:
         assert KubeAuthGate(_rest_kube(url)).check("Bearer tok") == 200
 
 
+class TestWireClientAuth:
+    def test_restkube_sends_bearer_token_on_every_verb(self):
+        """In-cluster RestKube authenticates every request with its SA
+        token; a facade requiring the token proves the header is sent on
+        GET, PUT, PATCH, and the watch stream alike."""
+        from workload_variant_autoscaler_tpu.controller.kube import RestKube
+
+        kube = InMemoryKube()
+        srv = MiniApiServer(kube, require_token="sa-token")
+        url = srv.start()
+        try:
+            _seed_minimal_va(kube)
+            good = RestKube(base_url=url, token="sa-token")
+            va = good.get_variant_autoscaling(VARIANT, NS)
+            va.status.desired_optimized_alloc.num_replicas = 2
+            good.update_variant_autoscaling_status(va)      # PUT
+            good.patch_owner_reference(                     # PATCH
+                va, kube.get_deployment(VARIANT, NS))
+            assert good.list_variant_autoscalings()         # LIST
+
+            # tokenless client: every verb is rejected with 401 (raised
+            # as requests HTTPError via raise_for_status)
+            bad = RestKube(base_url=url)
+            with pytest.raises(Exception) as exc:
+                bad.get_variant_autoscaling(VARIANT, NS)
+            assert "401" in str(exc.value)
+
+            # the watch stream carries the header too: events flow
+            log = _EventLog()
+            stop = threading.Event()
+            t = threading.Thread(
+                target=good.watch_variant_autoscalings,
+                args=(log, stop), kwargs={"timeout_seconds": 5},
+                daemon=True)
+            t.start()
+            try:
+                _wait_attached(srv, "watch_va")
+                kube.put_variant_autoscaling(
+                    kube.get_variant_autoscaling(VARIANT, NS))
+                assert log.wait_for(lambda evs: any(
+                    e.name == VARIANT for e in evs))
+            finally:
+                stop.set()
+                t.join(timeout=15)
+        finally:
+            srv.stop()
+
+
 # ---------------------------------------------------------------------------
 # Node inventory over HTTP
 # ---------------------------------------------------------------------------
