@@ -153,6 +153,29 @@ class TestWireClosedLoop:
         assert kube.get_variant_autoscaling(
             VARIANT, NS).status.desired_optimized_alloc.num_replicas == 3
 
+    def test_reconciler_conflict_retry_wins_through_http(self, served_kube):
+        """The reconciler's conflict-retried status writer recovers from
+        a stale RV with every hop over the wire: 409 response -> client
+        ConflictError -> RV refresh via GET -> retried PUT wins (the
+        in-memory twin is tests/test_schema.py::TestApiserverFidelity::
+        test_reconciler_conflict_retry_wins_through)."""
+        from workload_variant_autoscaler_tpu.collector import FakePromAPI
+
+        kube, _srv, url = served_kube
+        _seed_minimal_va(kube)
+        client = _rest_kube(url)
+        stale = client.get_variant_autoscaling(VARIANT, NS)
+        concurrent = client.get_variant_autoscaling(VARIANT, NS)
+        concurrent.status.desired_optimized_alloc.num_replicas = 3
+        client.update_variant_autoscaling_status(concurrent)
+
+        stale.status.desired_optimized_alloc.num_replicas = 5
+        rec = Reconciler(kube=client, prom=FakePromAPI(),
+                         sleep=lambda _s: None)
+        rec._update_status(stale)
+        got = kube.get_variant_autoscaling(VARIANT, NS)
+        assert got.status.desired_optimized_alloc.num_replicas == 5
+
     def test_patch_with_wrong_content_type_is_rejected(self, served_kube):
         """A merge-patch sent as application/json must 415, not silently
         apply — pins the facade's strictness so a future client
