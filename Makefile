@@ -91,6 +91,16 @@ run-controller-local: ## Run the controller against a local emulator, no cluster
 	$(PY) -m workload_variant_autoscaler_tpu.controller --allow-http-prom \
 		--kube-manifests deploy/examples/local
 
+.PHONY: run-apiserver-local
+run-apiserver-local: ## Serve the local manifests over the apiserver wire protocol on :8001 (pair with run-controller-wire)
+	$(PY) -m tools.mini_apiserver --manifests deploy/examples/local --port 8001
+
+.PHONY: run-controller-wire
+run-controller-wire: ## Run the controller through its REST client against run-apiserver-local
+	PROMETHEUS_BASE_URL=http://127.0.0.1:8000 \
+	$(PY) -m workload_variant_autoscaler_tpu.controller --allow-http-prom \
+		--kube-url http://127.0.0.1:8001
+
 .PHONY: experiment
 experiment: ## Offline emulator parameter-estimation sweep
 	$(PY) -m workload_variant_autoscaler_tpu.emulator.experiment
