@@ -96,7 +96,7 @@ run-apiserver-local: ## Serve the local manifests over the apiserver wire protoc
 	$(PY) -m tools.mini_apiserver --manifests deploy/examples/local --port 8001
 
 .PHONY: run-controller-wire
-run-controller-wire: ## Run the controller through its REST client against run-apiserver-local
+run-controller-wire: ## Run the controller through its REST client (needs run-emulator AND run-apiserver-local)
 	PROMETHEUS_BASE_URL=http://127.0.0.1:8000 \
 	$(PY) -m workload_variant_autoscaler_tpu.controller --allow-http-prom \
 		--kube-url http://127.0.0.1:8001

@@ -176,6 +176,36 @@ class TestWireClosedLoop:
         got = kube.get_variant_autoscaling(VARIANT, NS)
         assert got.status.desired_optimized_alloc.num_replicas == 5
 
+    def test_transient_500s_retry_through_http(self, served_kube):
+        """An injected storage fault surfaces as HTTP 500; the client
+        raises a generic (non-terminal) error and with_backoff retries —
+        the wire twin of the in-memory etcd-hiccup test. NotFound stays
+        terminal: a missing ConfigMap must NOT burn retries."""
+        from workload_variant_autoscaler_tpu.controller.kube import (
+            NotFoundError,
+        )
+        from workload_variant_autoscaler_tpu.utils.backoff import (
+            STANDARD_BACKOFF,
+            with_backoff,
+        )
+
+        kube, _srv, url = served_kube
+        kube.put_configmap(ConfigMap("cm", NS, {"k": "v"}))
+        client = _rest_kube(url)
+
+        kube.inject_fault("get", "ConfigMap",
+                          RuntimeError("etcd hiccup"), count=2)
+        sleeps: list[float] = []
+        cm = with_backoff(lambda: client.get_configmap("cm", NS),
+                          backoff=STANDARD_BACKOFF, sleep=sleeps.append)
+        assert cm.data == {"k": "v"}
+        assert len(sleeps) == 2, "two 500s must cost exactly two retries"
+
+        with pytest.raises(NotFoundError):
+            with_backoff(lambda: client.get_configmap("missing", NS),
+                         backoff=STANDARD_BACKOFF, sleep=sleeps.append)
+        assert len(sleeps) == 2, "404 is terminal — no retry burned"
+
     def test_patch_with_wrong_content_type_is_rejected(self, served_kube):
         """A merge-patch sent as application/json must 415, not silently
         apply — pins the facade's strictness so a future client
