@@ -176,6 +176,22 @@ class TestWireClosedLoop:
         got = kube.get_variant_autoscaling(VARIANT, NS)
         assert got.status.desired_optimized_alloc.num_replicas == 5
 
+    def test_status_put_cannot_mutate_spec(self, served_kube):
+        """The status subresource protects spec: a PUT to /status whose
+        body carries an edited spec must land only the status — the
+        apiserver takes spec from storage (the same guarantee
+        tests/test_envtest.py asserts against the real apiserver)."""
+        kube, _srv, url = served_kube
+        _seed_minimal_va(kube)
+        c = _rest_kube(url)
+        va = c.get_variant_autoscaling(VARIANT, NS)
+        va.spec.model_id = "attacker-model"        # smuggled spec edit
+        va.status.desired_optimized_alloc.num_replicas = 4
+        c.update_variant_autoscaling_status(va)
+        stored = kube.get_variant_autoscaling(VARIANT, NS)
+        assert stored.spec.model_id == MODEL, "status PUT mutated spec"
+        assert stored.status.desired_optimized_alloc.num_replicas == 4
+
     def test_transient_500s_retry_through_http(self, served_kube):
         """An injected storage fault surfaces as HTTP 500; the client
         raises a generic (non-terminal) error and with_backoff retries —
