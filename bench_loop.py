@@ -579,6 +579,17 @@ _CFG_70B_V5E8 = SliceModelConfig(
     alpha=20.0, beta=0.1, gamma=15.0, delta=0.15,
     max_batch_size=32, hbm_gb=128.0, model_size_gb=70.0, kv_mb_per_token=0.8,
 )
+# shared by multi-model-mix (mean-based ablation) and multi-model-p95
+# (full-SLO headline): the pair's comparability depends on byte-identical
+# variant configs, so there is exactly ONE definition
+_CHAT_70B_V5E8 = VariantScenario(
+    name="chat-70b", model="llama-70b", sc_key="freemium",
+    accelerator="v5e-8", chips_per_replica=8, cfg=_CFG_70B_V5E8,
+    ramp=[(300, 120), (300, 300), (300, 480), (300, 600),
+          (300, 300), (300, 120)],
+    tokens=TOKENS, slo_itl_ms=200.0, slo_ttft_ms=4000.0,
+)
+
 # Llama-70B on a v5p-4 slice: fewer, beefier chips (95 GB HBM each),
 # bf16 weights fit; faster decode, higher $/hr
 _CFG_70B_V5P4 = SliceModelConfig(
@@ -685,16 +696,30 @@ SCENARIOS: dict[str, Scenario] = {
             "v5e-8": {"chip": "v5e", "chips": "8", "cost": "160.0"},
         },
         service_classes={"premium": _PREMIUM_YAML, "freemium": _FREEMIUM_YAML},
-        variants=[
-            _CHAT_8B,
-            VariantScenario(
-                name="chat-70b", model="llama-70b", sc_key="freemium",
-                accelerator="v5e-8", chips_per_replica=8, cfg=_CFG_70B_V5E8,
-                ramp=[(300, 120), (300, 300), (300, 480), (300, 600),
-                      (300, 300), (300, 120)],
-                tokens=TOKENS, slo_itl_ms=200.0, slo_ttft_ms=4000.0,
-            ),
-        ],
+        variants=[_CHAT_8B, _CHAT_70B_V5E8],
+    ),
+    # multi-model-mix under the FULL-SLO guarantee: percentile sizing +
+    # the 5s breakout probe across the whole fleet, one optimizer run.
+    # All FOUR tails hold (8B p95 TTFT 475/500ms ITL 7.4/24ms; 70B
+    # 1124/4000ms, 22.3/200ms) at 9.861 chip-hours — the mean-based
+    # ablation above is 24% cheaper (7.43) but blows the 70B TTFT tail
+    # (5119/4000ms). Fleet-wide per-variant probe envelopes kick early
+    # cycles independently per model (21 kicks on this ramp).
+    "multi-model-p95": Scenario(
+        key="multi-model-p95",
+        title="8B Premium + 70B Freemium, ALL p95 tails held (p95 sizing + probe)",
+        accelerators={
+            "v5e-1": {"chip": "v5e", "chips": "1", "cost": "20.0"},
+            "v5e-8": {"chip": "v5e", "chips": "8", "cost": "160.0"},
+        },
+        service_classes={"premium": _PREMIUM_YAML, "freemium": _FREEMIUM_YAML},
+        variants=[_CHAT_8B, _CHAT_70B_V5E8],
+        operator_extra={"WVA_FAST_DEMAND_PROBE": "5",
+                        "WVA_TTFT_PERCENTILE": "0.95",
+                        "WVA_DEMAND_HEADROOM": "0.13",
+                        "WVA_FAST_PROBE_WINDOW": "15s"},
+        judge_ttft=True,
+        fast_probe_ms=5_000.0,
     ),
     # BASELINE config 4: multi-host v5e-16 pod slices (TP=16 Llama-70B).
     # A replica is an ATOMIC 16-chip unit — scale-out steps the chip count
