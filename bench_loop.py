@@ -581,7 +581,18 @@ _CFG_70B_V5E8 = SliceModelConfig(
 )
 # shared by multi-model-mix (mean-based ablation) and multi-model-p95
 # (full-SLO headline): the pair's comparability depends on byte-identical
-# variant configs, so there is exactly ONE definition
+# configs, so catalog, class map, and variant each have exactly ONE
+# definition (same rule for the strict-knob dict, shared with
+# sharegpt-fast-probe — BASELINE.md claims "the same knobs")
+_MM_ACCELERATORS = {
+    "v5e-1": {"chip": "v5e", "chips": "1", "cost": "20.0"},
+    "v5e-8": {"chip": "v5e", "chips": "8", "cost": "160.0"},
+}
+_MM_SERVICE_CLASSES = {"premium": _PREMIUM_YAML, "freemium": _FREEMIUM_YAML}
+_FULL_SLO_KNOBS = {"WVA_FAST_DEMAND_PROBE": "5",
+                   "WVA_TTFT_PERCENTILE": "0.95",
+                   "WVA_DEMAND_HEADROOM": "0.13",
+                   "WVA_FAST_PROBE_WINDOW": "15s"}
 _CHAT_70B_V5E8 = VariantScenario(
     name="chat-70b", model="llama-70b", sc_key="freemium",
     accelerator="v5e-8", chips_per_replica=8, cfg=_CFG_70B_V5E8,
@@ -660,10 +671,7 @@ SCENARIOS: dict[str, Scenario] = {
         # sizing on max(1m, probe-window) demand, without which a
         # probe-kicked cycle sizes on the smoothed 1m rate and
         # under-provisions the very step it reacted to (ADVICE r3)
-        operator_extra={"WVA_FAST_DEMAND_PROBE": "5",
-                        "WVA_TTFT_PERCENTILE": "0.95",
-                        "WVA_DEMAND_HEADROOM": "0.13",
-                        "WVA_FAST_PROBE_WINDOW": "15s"},
+        operator_extra=_FULL_SLO_KNOBS,
         judge_ttft=True,
         fast_probe_ms=5_000.0,
     ),
@@ -691,11 +699,8 @@ SCENARIOS: dict[str, Scenario] = {
     "multi-model-mix": Scenario(
         key="multi-model-mix",
         title="8B Premium (v5e-1) + 70B Freemium (v5e-8), one optimizer",
-        accelerators={
-            "v5e-1": {"chip": "v5e", "chips": "1", "cost": "20.0"},
-            "v5e-8": {"chip": "v5e", "chips": "8", "cost": "160.0"},
-        },
-        service_classes={"premium": _PREMIUM_YAML, "freemium": _FREEMIUM_YAML},
+        accelerators=_MM_ACCELERATORS,
+        service_classes=_MM_SERVICE_CLASSES,
         variants=[_CHAT_8B, _CHAT_70B_V5E8],
     ),
     # multi-model-mix under the FULL-SLO guarantee: percentile sizing +
@@ -708,16 +713,10 @@ SCENARIOS: dict[str, Scenario] = {
     "multi-model-p95": Scenario(
         key="multi-model-p95",
         title="8B Premium + 70B Freemium, ALL p95 tails held (p95 sizing + probe)",
-        accelerators={
-            "v5e-1": {"chip": "v5e", "chips": "1", "cost": "20.0"},
-            "v5e-8": {"chip": "v5e", "chips": "8", "cost": "160.0"},
-        },
-        service_classes={"premium": _PREMIUM_YAML, "freemium": _FREEMIUM_YAML},
+        accelerators=_MM_ACCELERATORS,
+        service_classes=_MM_SERVICE_CLASSES,
         variants=[_CHAT_8B, _CHAT_70B_V5E8],
-        operator_extra={"WVA_FAST_DEMAND_PROBE": "5",
-                        "WVA_TTFT_PERCENTILE": "0.95",
-                        "WVA_DEMAND_HEADROOM": "0.13",
-                        "WVA_FAST_PROBE_WINDOW": "15s"},
+        operator_extra=_FULL_SLO_KNOBS,
         judge_ttft=True,
         fast_probe_ms=5_000.0,
     ),
