@@ -1,0 +1,108 @@
+"""Hermetic checks for the kind TPU-emulator scripts.
+
+The scripts themselves need a live kind cluster (reference runs theirs in
+CI, .github/workflows/ci-pr-checks.yaml:31-52); this image has no docker,
+so what CAN be pinned without one is pinned here: shell syntax, the
+JSON-patch payload's shape and JSON-Pointer escaping, and — the part that
+would fail silently in a real cluster — the contract that the labels and
+resource names the scripts fake are EXACTLY the ones the controller's
+inventory collector selects on (a one-character drift would make limited
+mode find zero nodes with nothing erroring).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+from pathlib import Path
+from urllib.parse import unquote
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT_DIR = REPO / "deploy" / "kind-tpu-emulator"
+SCRIPTS = sorted(SCRIPT_DIR.glob("*.sh"))
+
+
+def test_scripts_exist():
+    names = {p.name for p in SCRIPTS}
+    assert {"setup.sh", "deploy-wva.sh", "teardown.sh", "e2e.sh"} <= names
+
+
+@pytest.mark.parametrize("script", SCRIPTS, ids=lambda p: p.name)
+def test_shell_syntax(script):
+    r = subprocess.run(["bash", "-n", str(script)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+def test_status_patch_is_valid_json_pointer_patch():
+    """The node-status patch must be a JSON-patch array whose path uses
+    RFC 6901 escaping (`google.com~1tpu`, `~1` = `/`); an unescaped
+    slash would address a nested `tpu` key under `google.com` and the
+    apiserver would 422."""
+    text = (SCRIPT_DIR / "setup.sh").read_text()
+    m = re.search(r'--data\s+"(\[.*?\])"', text, re.S)
+    assert m, "setup.sh no longer builds the status patch inline"
+    raw = m.group(1).replace('\\"', '"')
+    # substitute the one shell variable the payload carries
+    raw = raw.replace("${CHIPS_PER_NODE}", "8")
+    patch = json.loads(raw)
+    assert patch == [{
+        "op": "add",
+        "path": "/status/capacity/google.com~1tpu",
+        "value": "8",
+    }]
+    # unescaped, the pointer names exactly the resource the collector
+    # parses out of node allocatable/capacity
+    resource = patch[0]["path"].rsplit("/", 1)[-1].replace("~1", "/")
+    assert resource == "google.com/tpu"
+    kube_src = (REPO / "workload_variant_autoscaler_tpu" / "controller"
+                / "kube.py").read_text()
+    assert '"google.com/tpu"' in kube_src
+
+
+def test_script_labels_match_collector_selector():
+    """The labels setup.sh fakes must byte-match the label the inventory
+    collector selects nodes by (collector.GKE_TPU_ACCELERATOR_LABEL and
+    RestKube._TPU_NODE_SELECTOR's URL-encoded form)."""
+    from workload_variant_autoscaler_tpu.collector.collector import (
+        GKE_TPU_ACCELERATOR_LABEL,
+    )
+    from workload_variant_autoscaler_tpu.controller.kube import RestKube
+
+    text = (SCRIPT_DIR / "setup.sh").read_text()
+    assert f'"{GKE_TPU_ACCELERATOR_LABEL}=${{ACCELERATOR}}"' in text, \
+        "setup.sh accelerator label drifted from the collector constant"
+    assert "cloud.google.com/gke-tpu-topology=" in text
+    assert unquote(RestKube._TPU_NODE_SELECTOR) == GKE_TPU_ACCELERATOR_LABEL, \
+        "RestKube's node labelSelector drifted from the collector constant"
+
+
+def test_script_default_accelerator_maps_to_a_generation():
+    """The label VALUE matters too: collect_inventory_k8s drops nodes
+    whose accelerator name is missing from TPU_ACCELERATOR_GENERATIONS,
+    so a renamed default in either file would make the faked cluster
+    report zero capacity with nothing erroring."""
+    from workload_variant_autoscaler_tpu.collector.collector import (
+        TPU_ACCELERATOR_GENERATIONS,
+    )
+
+    text = (SCRIPT_DIR / "setup.sh").read_text()
+    m = re.search(r'^ACCELERATOR="([^"]+)"', text, re.M)
+    assert m, "setup.sh no longer sets a default ACCELERATOR"
+    assert m.group(1) in TPU_ACCELERATOR_GENERATIONS, (
+        f"setup.sh default accelerator {m.group(1)!r} is unknown to "
+        "collector.TPU_ACCELERATOR_GENERATIONS — limited mode would see "
+        "zero capacity on the faked cluster")
+
+
+def test_patch_targets_the_status_subresource():
+    """Writing capacity via /status is the load-bearing trick (a plain
+    node patch is wiped when kubelet refreshes status); pin the URL so a
+    refactor can't silently downgrade it."""
+    text = (SCRIPT_DIR / "setup.sh").read_text()
+    assert re.search(r"/api/v1/nodes/\$\{node\}/status", text), \
+        "node capacity patch no longer targets the status subresource"
+    assert "application/json-patch+json" in text
