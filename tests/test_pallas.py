@@ -111,3 +111,89 @@ class TestPallasEquivalence:
         np.testing.assert_array_equal(np.asarray(a.feasible), np.asarray(p.feasible))
         np.testing.assert_allclose(np.asarray(a.lam_star), np.asarray(p.lam_star),
                                    rtol=1e-6)
+
+
+class TestPallasEngineBackend:
+    """backend="pallas" in System.calculate: the production opt-in for
+    accelerator-host controllers (WVA_PALLAS_KERNEL). Off-TPU it runs
+    the same kernels in interpret mode, so this parity holds on the CPU
+    test mesh and on a real chip alike."""
+
+    def _fleet(self):
+        from tests.helpers import make_system, server_spec
+
+        return make_system(servers=[
+            server_spec(name="chat:premium", arrival_rpm=1800.0),
+            server_spec(name="batch:premium", arrival_rpm=420.0),
+        ])
+
+    def test_matches_batched_backend(self):
+        sys_a, _ = self._fleet()
+        sys_b, _ = self._fleet()
+        sys_a.calculate(backend="batched")
+        sys_b.calculate(backend="pallas")
+        for name, server in sys_a.servers.items():
+            twin = sys_b.servers[name]
+            assert set(server.all_allocations) == set(twin.all_allocations)
+            for acc, alloc in server.all_allocations.items():
+                got = twin.all_allocations[acc]
+                assert got.num_replicas == alloc.num_replicas, (name, acc)
+                assert got.batch_size == alloc.batch_size
+                np.testing.assert_allclose(got.cost, alloc.cost, rtol=1e-6)
+                np.testing.assert_allclose(got.itl, alloc.itl, rtol=1e-5)
+                np.testing.assert_allclose(
+                    got.max_arrv_rate_per_replica,
+                    alloc.max_arrv_rate_per_replica, rtol=1e-5)
+
+    def test_matches_batched_backend_with_percentile(self):
+        sys_a, _ = self._fleet()
+        sys_b, _ = self._fleet()
+        sys_a.calculate(backend="batched", ttft_percentile=0.95)
+        sys_b.calculate(backend="pallas", ttft_percentile=0.95)
+        for name, server in sys_a.servers.items():
+            twin = sys_b.servers[name]
+            for acc, alloc in server.all_allocations.items():
+                assert twin.all_allocations[acc].num_replicas == \
+                    alloc.num_replicas, (name, acc)
+
+    def test_mesh_rejected(self):
+        import pytest
+
+        system, _ = self._fleet()
+        with pytest.raises(ValueError, match="mesh"):
+            system.calculate(backend="pallas", mesh=object())
+
+    def test_env_switch(self, monkeypatch):
+        from workload_variant_autoscaler_tpu.controller import translate
+
+        # CPU-only host: the knob is refused (interpret mode would lose
+        # to the native kernel) and normal selection proceeds
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setenv("WVA_PALLAS_KERNEL", "true")
+        assert translate.engine_backend() != "pallas"
+        # a CUDA host is NOT a TPU: Mosaic would not compile there, so
+        # the knob must be refused, not silently run interpret mode
+        monkeypatch.setenv("JAX_PLATFORMS", "cuda")
+        assert translate.engine_backend() != "pallas"
+        # TPU host: opt-in wins, and takes precedence over
+        # WVA_NATIVE_KERNEL
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+        assert translate.engine_backend() == "pallas"
+        monkeypatch.setenv("WVA_NATIVE_KERNEL", "true")
+        assert translate.engine_backend() == "pallas"
+        # absent knob: unchanged auto behavior
+        monkeypatch.delenv("WVA_PALLAS_KERNEL")
+        monkeypatch.delenv("WVA_NATIVE_KERNEL")
+        assert translate.engine_backend() == "batched"
+
+    def test_host_is_tpu_signatures(self, monkeypatch):
+        from workload_variant_autoscaler_tpu.utils import platform as plat
+
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+        assert plat.host_is_tpu()
+        monkeypatch.setenv("JAX_PLATFORMS", "cuda")
+        assert not plat.host_is_tpu()
+        # no pin + ambient remote-TPU plugin -> TPU
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+        assert plat.host_is_tpu()

@@ -105,7 +105,7 @@ def main(argv=None) -> int:
     from .translate import engine_backend, engine_mesh, warmup_plan
 
     backend = engine_backend()
-    if backend == "batched" and \
+    if backend in ("batched", "pallas") and \
             os.environ.get("WVA_WARMUP", "1").lower() not in ("0", "false"):
         # Import here, on the main thread: Python module init is not
         # thread-safe against itself, and the reconcile thread will import
@@ -149,7 +149,8 @@ def main(argv=None) -> int:
                     )]
                 for bucket, max_batch, pct in plan:
                     warmup(max_batch=max_batch, bucket=bucket, mesh=mesh,
-                           ttft_percentile=pct)
+                           ttft_percentile=pct,
+                           use_pallas=(backend == "pallas"))
                 log.info("engine kernels warmed",
                          extra=kv(compilation_cache=cache_dir or "off",
                                   groups=[

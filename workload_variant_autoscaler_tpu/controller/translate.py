@@ -366,6 +366,15 @@ def ttft_percentile(operator_cm: dict[str, str] | None = None) -> float | None:
 def engine_backend() -> str:
     """Analysis backend for the reconcile cycle.
 
+    WVA_PALLAS_KERNEL=true  -> the hand-written Mosaic kernels, for
+      controllers deliberately scheduled onto TPU hosts (wins over the
+      batched XLA path in the round-4 on-chip capture: 85.0M vs 47.6M
+      mean sizings/s, BENCH_tpu_capture_r04.json). Ignored with a
+      warning on any non-TPU host (env-only check) — Mosaic only
+      compiles on TPU, and interpret-mode Pallas is exact but far
+      slower than the other backends; selection then proceeds exactly
+      as if the knob were unset. Takes precedence over
+      WVA_NATIVE_KERNEL on TPU hosts.
     WVA_NATIVE_KERNEL=true  -> the C++ kernel (warn + batched when not
                                buildable);
     WVA_NATIVE_KERNEL=false -> the batched JAX kernel, unconditionally;
@@ -379,6 +388,14 @@ def engine_backend() -> str:
       XLA kernel — on a TPU it wins by orders of magnitude
       (BENCH_r02: 89.0M sizings/s).
     """
+    from ..utils.platform import host_is_cpu_only, host_is_tpu
+
+    if os.environ.get("WVA_PALLAS_KERNEL", "").strip().lower() in ("1", "true"):
+        if host_is_tpu():
+            return "pallas"
+        log.warning("WVA_PALLAS_KERNEL set on a non-TPU host; Mosaic "
+                    "kernels need a TPU (interpret mode would be slower "
+                    "than the other backends) — selecting as if unset")
     raw = os.environ.get("WVA_NATIVE_KERNEL", "").strip().lower()
     if raw in ("1", "true"):
         from ..ops import native
@@ -390,8 +407,6 @@ def engine_backend() -> str:
         return "batched"
     if raw in ("0", "false"):
         return "batched"
-    from ..utils.platform import host_is_cpu_only
-
     if host_is_cpu_only():
         from ..ops import native
 

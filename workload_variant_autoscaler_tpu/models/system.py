@@ -137,12 +137,19 @@ class System:
         semantics; used for cross-checking).
         backend="native": all candidates through the C++ kernel in one FFI
         call (ops.native) — the fast host path for CPU-only controllers.
+        backend="pallas": the batched path with the bisection running as
+        the hand-written Mosaic kernels (ops.pallas_kernel) instead of
+        the XLA fori_loop — opt-in for accelerator-host controllers
+        (WVA_PALLAS_KERNEL; BENCH_tpu_capture_r04.json records the
+        Pallas mean beating the XLA stage on a v5e). Off-TPU the kernels
+        run in interpret mode, which is exact but slow — parity testing
+        only. The epilogue (analyze_batch) is shared with "batched".
         mesh: optional 1-D jax.sharding.Mesh; shards the candidate batch
         across its devices (parallel.size_batch_sharded) for large fleets.
         ttft_percentile: size the TTFT SLO against this percentile of the
-        TTFT distribution instead of its mean — supported by ALL three
-        backends (ops.batched.size_batch_tail / native wva_size_tail /
-        the scalar QueueAnalyzer tail search).
+        TTFT distribution instead of its mean — supported by ALL
+        backends (ops.batched.size_batch_tail / pallas tail kernel /
+        native wva_size_tail / the scalar QueueAnalyzer tail search).
         """
         for acc in self.accelerators.values():
             acc.calculate()
@@ -157,7 +164,10 @@ class System:
                 raise ValueError("mesh sharding requires backend='batched'")
             self._calculate_native(ttft_percentile=ttft_percentile)
             return
-        self._calculate_batched(mesh=mesh, ttft_percentile=ttft_percentile)
+        if backend == "pallas" and mesh is not None:
+            raise ValueError("mesh sharding requires backend='batched'")
+        self._calculate_batched(mesh=mesh, ttft_percentile=ttft_percentile,
+                                use_pallas=(backend == "pallas"))
 
     def _candidate_pairs(self):
         """Feasible (server, acc) candidates with resolved profile/target;
@@ -199,17 +209,20 @@ class System:
         server.all_allocations[acc_name] = alloc
 
     def _calculate_batched(self, mesh=None,
-                           ttft_percentile: float | None = None) -> None:
+                           ttft_percentile: float | None = None,
+                           use_pallas: bool = False) -> None:
         pairs = self._candidate_pairs()
         if not pairs:
             return
 
         for p, group in _percentile_groups(pairs, ttft_percentile).items():
             self._size_group(group, mesh=mesh,
-                             ttft_percentile=(p or None))
+                             ttft_percentile=(p or None),
+                             use_pallas=use_pallas)
 
     def _size_group(self, pairs, mesh=None,
-                    ttft_percentile: float | None = None) -> None:
+                    ttft_percentile: float | None = None,
+                    use_pallas: bool = False) -> None:
         import jax.numpy as jnp
 
         from ..ops.batched import (
@@ -260,6 +273,25 @@ class System:
 
             sized = size_batch_sharded(q, slo, k_max, mesh,
                                        ttft_percentile=ttft_percentile)
+        elif use_pallas:
+            import jax
+
+            from ..ops.pallas_kernel import (
+                size_batch_pallas,
+                size_batch_tail_pallas,
+            )
+
+            # off-TPU there is no Mosaic: interpret mode keeps the exact
+            # semantics (tests/test_pallas.py pins parity) at CPU speed.
+            # Device platform, not default_backend(): remote-TPU plugins
+            # (axon) report their own backend name but TPU devices.
+            interp = jax.devices()[0].platform != "tpu"
+            if ttft_percentile is not None:
+                sized = size_batch_tail_pallas(
+                    q, slo, k_max, ttft_percentile=ttft_percentile,
+                    interpret=interp)
+            else:
+                sized = size_batch_pallas(q, slo, k_max, interpret=interp)
         elif ttft_percentile is not None:
             sized = size_batch_tail(q, slo, k_max,
                                     ttft_percentile=ttft_percentile)

@@ -624,7 +624,8 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
 
 
 def warmup(max_batch: int = 256, bucket: int = 16, mesh=None,
-           ttft_percentile: float | None = None) -> None:
+           ttft_percentile: float | None = None,
+           use_pallas: bool = False) -> None:
     """Pre-compile the sizing + re-analysis kernels at the shapes the
     reconcile loop will use (candidate axis bucketed by
     System._calculate_batched, K from `max_batch`, tail kernel when a
@@ -651,6 +652,20 @@ def warmup(max_batch: int = 256, bucket: int = 16, mesh=None,
         sized = size_batch_sharded(q, targets, k_max, mesh,
                                    ttft_percentile=ttft_percentile)
         per_rep = analyze_batch_sharded(q, sized.throughput * 1000.0, k_max, mesh)
+    elif use_pallas:
+        # warm the Mosaic executables the pallas backend will run (plus
+        # the shared analyze epilogue); same interpret rule as
+        # System._size_group
+        from .pallas_kernel import size_batch_pallas, size_batch_tail_pallas
+
+        interp = jax.devices()[0].platform != "tpu"
+        if ttft_percentile is not None:
+            sized = size_batch_tail_pallas(
+                q, targets, k_max, ttft_percentile=ttft_percentile,
+                interpret=interp)
+        else:
+            sized = size_batch_pallas(q, targets, k_max, interpret=interp)
+        per_rep = analyze_batch(q, sized.throughput * 1000.0, k_max)
     elif ttft_percentile is not None:
         sized = size_batch_tail(q, targets, k_max,
                                 ttft_percentile=ttft_percentile)
