@@ -262,6 +262,26 @@ def test_chart_renders_with_default_values():
                 f"{d['kind']}/{d['metadata'].get('name')} lacks namespace"
 
 
+def test_chart_extra_env_renders():
+    """controller.extraEnv is the escape hatch for knobs without a
+    dedicated value (engine-backend selectors etc.); default renders
+    must not emit any stray env entries."""
+    docs = _render(sets=[
+        'controller.extraEnv=[{name: WVA_PALLAS_KERNEL, value: "true"}, '
+        '{name: WVA_PLATFORM, value: ambient}]'])
+    dep = next(d for d in docs if d.get("kind") == "Deployment")
+    env = {e["name"]: e.get("value")
+           for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["WVA_PALLAS_KERNEL"] == "true"
+    assert env["WVA_PLATFORM"] == "ambient"
+
+    # default: no extras sneak in
+    dep = next(d for d in _render() if d.get("kind") == "Deployment")
+    names = [e["name"] for e in
+             dep["spec"]["template"]["spec"]["containers"][0]["env"]]
+    assert "WVA_PALLAS_KERNEL" not in names
+
+
 def test_chart_renders_dev_overlay():
     docs = _render(value_files=["values-dev.yaml"])
     kinds = _kinds(docs)
