@@ -219,18 +219,29 @@ def build_closed_loop(cfg, *, model, variant, ns="default",
 
 def drive_closed_loop(sim, fleet, prom, kube, rec, *, variant, ns="default",
                       until_ms, reconcile_every_ms=30_000.0,
-                      desired_history=None, tick_ms=5000.0):
-    """Advance sim; scrape every tick; reconcile + emulate HPA actuation."""
+                      desired_history=None, tick_ms=5000.0,
+                      reconcile=None):
+    """Advance sim; scrape every tick; reconcile + emulate HPA actuation.
+
+    reconcile: optional zero-arg callable run instead of rec.reconcile()
+    (chaos tests wrap it with fault injection / run_forever-style
+    exception swallowing)."""
     from workload_variant_autoscaler_tpu.controller import Deployment
 
     next_reconcile = sim.now_ms + reconcile_every_ms
+
+    def do_reconcile():
+        if reconcile is not None:
+            reconcile()
+        else:
+            rec.reconcile()
 
     def on_tick(now_ms):
         nonlocal next_reconcile
         prom.scrape(now_ms)
         if now_ms >= next_reconcile:
             next_reconcile += reconcile_every_ms
-            rec.reconcile()
+            do_reconcile()
             va = kube.get_variant_autoscaling(variant, ns)
             desired = va.status.desired_optimized_alloc.num_replicas
             if desired_history is not None:
