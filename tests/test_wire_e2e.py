@@ -593,6 +593,41 @@ class TestWireClientAuth:
 
 
 class TestWireNodes:
+    def test_inventory_collection_through_rest(self, served_kube):
+        """collect_inventory_k8s (limited mode's capacity source) through
+        RestKube: labelSelector filtering, generation mapping, and the
+        schedulability/zero-capacity skips all happen across the wire."""
+        from workload_variant_autoscaler_tpu.collector.collector import (
+            collect_inventory_k8s,
+        )
+
+        kube, _srv, url = served_kube
+        kube.put_node(Node(
+            name="v5e-a",
+            labels={"cloud.google.com/gke-tpu-accelerator":
+                    "tpu-v5-lite-podslice"},
+            tpu_capacity=8))
+        kube.put_node(Node(
+            name="v5e-b",
+            labels={"cloud.google.com/gke-tpu-accelerator":
+                    "tpu-v5-lite-podslice"},
+            tpu_capacity=4))
+        kube.put_node(Node(
+            name="v5p-cordoned",
+            labels={"cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice"},
+            tpu_capacity=16, unschedulable=True))
+        kube.put_node(Node(
+            name="unknown-accel",
+            labels={"cloud.google.com/gke-tpu-accelerator": "tpu-v9"},
+            tpu_capacity=8))
+        kube.put_node(Node(
+            name="zero-cap",
+            labels={"cloud.google.com/gke-tpu-accelerator":
+                    "tpu-v5-lite-podslice"},
+            tpu_capacity=0))
+        capacity = collect_inventory_k8s(_rest_kube(url))
+        assert capacity == {"v5e": 12}, capacity
+
     def test_list_nodes_filters_and_parses(self, served_kube):
         kube, _srv, url = served_kube
         kube.put_node(Node(
