@@ -538,7 +538,7 @@ def main() -> None:
         "unit": "candidates/s",
         "vs_baseline": round(xla["rate"] / sequential_rate, 2),
         "platform": xla["platform"],
-        # tunnel variance: the three raw rates behind the best-of-3 value
+        # tunnel variance: every raw rate behind the best-of value
         "runs": [round(r, 1) for r in xla["runs"]],
         # percentile (p95 TTFT) sizing kernel at the same fleet scale
         "tail_sizings_per_sec": round(xla.get("tail_rate", 0.0), 1),
