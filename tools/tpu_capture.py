@@ -28,7 +28,11 @@ def main() -> int:
     out_path = sys.argv[1] if len(sys.argv) > 1 else \
         os.path.join(REPO, "BENCH_tpu_capture.json")
     window_s = float(sys.argv[2]) if len(sys.argv) > 2 else 8 * 3600.0
-    poll_s = float(os.environ.get("WVA_CAPTURE_POLL_S", "900"))
+    # Round-4 empirics: healthy windows can close within ~4 minutes of a
+    # successful probe, so a 15-min poll gap can miss a whole window.
+    # 5-min polls triple the catch probability; a wedged canary costs
+    # only one hung subprocess for its 60 s timeout.
+    poll_s = float(os.environ.get("WVA_CAPTURE_POLL_S", "300"))
     deadline = time.monotonic() + window_s
     n = 0
     while time.monotonic() < deadline:
