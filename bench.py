@@ -18,6 +18,17 @@ measured on a 256-candidate subsample (rate-based). vs_baseline is the
 TPU/sequential speedup (>1 is better).
 
 Prints ONE JSON line. Runs with the ambient env (real TPU chip via axon).
+
+Wall-time contract (round-4 post-mortem: BENCH_r04.json was rc=124 /
+parsed=null because the 45-min retry window + fallback overran the
+driver's timeout — a killed bench records NOTHING, strictly worse than
+any labeled fallback): total wall time is hard-bounded by
+WVA_BENCH_TOTAL_BUDGET_S (default 780 s), every subprocess timeout is
+clipped to the remaining budget, the honest CPU fallback runs the moment
+the tunnel first looks wedged (so a result is in hand early, not saved
+for last), and SIGTERM/SIGALRM print the best result captured so far
+before exiting. Long-window patience lives in tools/tpu_capture.py,
+which owns its own timeout and raises these knobs explicitly.
 """
 
 from __future__ import annotations
@@ -118,9 +129,11 @@ from bench import (bench_tpu, bench_native_batch, bench_sequential,
 platform = jax.devices()[0].platform
 c = build_candidates(4096)
 # the CPU fallback runs the same fleet-scale batch at ~1/100000th the
-# device rate; fewer timed iterations + runs keep it inside the timeout
+# device rate; fewer timed iterations + runs keep its wall time inside
+# the fallback reserve (WVA_BENCH_FALLBACK_RESERVE_S) — the raw runs in
+# the artifact carry the reduced protocol honestly
 if os.environ.get("WVA_FORCE_CPU"):
-    rate, runs, tail_rate, tail_runs = bench_tpu(c, iters=5, n_runs=3)
+    rate, runs, tail_rate, tail_runs = bench_tpu(c, iters=3, n_runs=2)
 else:
     rate, runs, tail_rate, tail_runs = bench_tpu(c)
 out = {"rate": rate, "runs": runs, "tail_rate": tail_rate,
@@ -134,7 +147,7 @@ if os.environ.get("WVA_FORCE_CPU"):
     # vs_baseline compares the two under identical host load and cache
     # footprint (a 256-candidate baseline minutes apart made the ratio
     # flicker around 1; at equal B the batch wins ~1.4x on one core)
-    nb = bench_native_batch(c)
+    nb = bench_native_batch(c, iters=5, n=2)
     if nb is not None:
         mean_runs, nb_tail_runs = nb
         out.update({"xla_cpu_rate": rate, "xla_cpu_runs": runs,
@@ -143,11 +156,13 @@ if os.environ.get("WVA_FORCE_CPU"):
                     "tail_rate": max(nb_tail_runs),
                     "tail_runs": nb_tail_runs,
                     "backend": "native-batch (default on CPU-only hosts)"})
-    from workload_variant_autoscaler_tpu.ops import native as _native
-    # full-set baseline through the native analyzer; the numpy fallback
-    # (no compiler on the host) would take minutes at 4096 — subsample
-    out["sequential_rate"] = bench_sequential(
-        c if _native.available() else build_candidates(256))
+from workload_variant_autoscaler_tpu.ops import native as _native
+# sequential baseline for BOTH paths, measured inside this stage so the
+# orchestrator's budget clipping covers it: full-set through the native
+# analyzer when a compiler is present; the numpy fallback would take
+# minutes at 4096 — subsample
+out["sequential_rate"] = bench_sequential(
+    c if _native.available() else build_candidates(256))
 print(json.dumps(out))
 """
 
@@ -178,7 +193,7 @@ def _subproc(src: str, env, timeout_s: float) -> tuple[str, dict | str | None]:
     try:
         r = subprocess.run([sys.executable, "-c", src],
                            capture_output=True, text=True,
-                           timeout=timeout_s, env=env,
+                           timeout=max(1.0, timeout_s), env=env,
                            cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
         return "timeout", None
@@ -206,64 +221,150 @@ def run_canary(timeout_s: float = 45.0) -> dict:
     return {"status": "error", "detail": out}
 
 
+# Floor for starting one more TPU try: a canary (<=45 s) plus a
+# measurement attempt that still has a chance of finishing.
+_TRY_FLOOR_S = 90.0
+
+
+def resolve_budget(environ) -> dict:
+    """The bench's wall-time budget, all in seconds:
+
+    total   — hard bound on the whole process (canaries, measurement,
+              fallback, pallas probes, printing). Default 780 s: the
+              smallest driver budget ever observed to record a result
+              was ~26 min (round 3), and round 4 proved an ~82-min worst
+              case gets killed into a null artifact — 13 min clears the
+              known-good bound by 2x.
+    window  — time allowed for TPU canary/retry attempts, derived as
+              total - reserve - margin unless WVA_BENCH_RETRY_WINDOW_S
+              is set explicitly (sidecars/CI owning their timeout).
+              When only the window is set, total is derived as
+              window + reserve + margin + 600 (the pallas stages' and
+              print margin's allowance rides on top).
+    reserve — wall time the CPU fallback stage may use.
+    margin  — teardown/printing slack at the very end.
+    """
+    margin = 30.0
+    reserve = float(environ.get("WVA_BENCH_FALLBACK_RESERVE_S", "360"))
+    window_env = environ.get("WVA_BENCH_RETRY_WINDOW_S")
+    total_env = environ.get("WVA_BENCH_TOTAL_BUDGET_S")
+    if total_env is not None:
+        total = float(total_env)
+        # an explicit total is the one promise the SIGALRM backstop (and
+        # the driver) actually enforce: neither the fallback reserve nor
+        # an explicit window may plan past it
+        reserve = min(reserve, max(0.0, total - margin))
+        window = (min(float(window_env), max(0.0, total - reserve - margin))
+                  if window_env is not None
+                  else max(0.0, total - reserve - margin))
+    elif window_env is not None:
+        window = float(window_env)
+        total = window + reserve + margin + 600.0
+    else:
+        total = 780.0
+        window = max(0.0, total - reserve - margin)
+    return {"total": total, "window": window, "reserve": reserve,
+            "margin": margin}
+
+
 def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
                   retry_interval_s: float | None = None,
+                  fallback_reserve_s: float | None = None,
                   sleep=time.sleep, monotonic=time.monotonic,
-                  canary=run_canary, attempt=None) -> dict:
-    """Measure the batched kernel, resilient to a wedged TPU tunnel.
+                  canary=run_canary, attempt=None, on_partial=None) -> dict:
+    """Measure the batched kernel, resilient to a wedged TPU tunnel,
+    inside a hard wall-time bound.
 
     The dev tunnel's observed failure mode is a wedge-then-recover over
-    tens of minutes (round 3 lost its whole TPU evidence to an ~18-min
-    give-up). Protocol:
+    tens of minutes; the driver's observed failure mode is killing a
+    bench that outlives its budget (BENCH_r04.json: rc=124, nothing
+    recorded). Protocol:
 
     1. canary: tiny-shape compile, short timeout — wedged vs healthy.
-    2. healthy on an accelerator -> full measurement (its own timeout;
-       a slow big compile is NOT mistaken for a wedge).
-    3. wedged (or the measurement itself hung) -> retry on a staggered
-       schedule (WVA_BENCH_RETRY_INTERVAL_S, default 15 min) until the
-       bench window (WVA_BENCH_RETRY_WINDOW_S, default 45 min) closes.
-       The default window is a compromise: long enough for three
-       staggered recovery chances, short enough that the worst case —
-       a measurement attempt starting just inside the deadline (+9 min)
-       plus the terminal CPU fallback's 27-min budget, ~82 min total —
-       stays inside any plausible caller timeout. A killed process
-       records NOTHING, which is strictly worse than the labeled
-       fallback. Callers owning their timeout budget
-       (tools/tpu_capture.py, CI) size the window explicitly via the
-       env knobs.
+    2. healthy on an accelerator -> full measurement. Its timeout is
+       clipped so that, if the canary lied (wedge landed between canary
+       and measurement), the fallback's reserve is still intact. At the
+       default budget the first grant is ~345-385 s vs the ~60-120 s a
+       healthy-tunnel measurement actually takes (r04 capture) — 3x
+       headroom; sidecars that need the old 540 s raise the window.
+    3. wedged / crashed / hung-measurement -> run the honest CPU
+       fallback IMMEDIATELY (once) so a result is in hand, then keep
+       retrying the TPU on a stagger (WVA_BENCH_RETRY_INTERVAL_S,
+       default 120 s) while budget remains; a late TPU success replaces
+       the fallback.
     4. healthy but CPU-only ambient env -> no accelerator will appear;
-       fall back immediately.
-    5. terminal state stays the honestly-labeled CPU fallback, carrying
-       the full attempt log.
+       fallback and return.
+    5. total wall time never exceeds window_s + fallback reserve: every
+       canary/measurement/fallback subprocess timeout is clipped to the
+       remaining budget.
 
     Every stage runs in a subprocess (fresh tunnel session each try).
-    sleep/monotonic/canary/attempt are injectable for hermetic tests.
+    on_partial(record) fires when the fallback lands, so the caller can
+    stash a printable result before the retry loop spends the rest of
+    the window. sleep/monotonic/canary/attempt are injectable for
+    hermetic tests; attempt(env, budget_s) must honour budget_s.
     """
     import os
 
+    budget = resolve_budget(os.environ)
     if window_s is None:
-        window_s = float(os.environ.get("WVA_BENCH_RETRY_WINDOW_S", "2700"))
+        window_s = budget["window"]
+    reserve = (fallback_reserve_s if fallback_reserve_s is not None
+               else budget["reserve"])
     if retry_interval_s is None:
         retry_interval_s = float(
-            os.environ.get("WVA_BENCH_RETRY_INTERVAL_S", "900"))
+            os.environ.get("WVA_BENCH_RETRY_INTERVAL_S", "120"))
     if attempt is None:
-        def attempt(env):
-            # the terminal CPU fallback must not itself time out and
-            # zero the round's evidence — its workload is the XLA batch
-            # (best-of-3 mean AND tail), the native batch (same), and
-            # the in-subprocess sequential baseline, ~8 min observed on
-            # a loaded 1-core host — give it generous slack
-            slack = 3.0 if env.get("WVA_FORCE_CPU") else 1.0
-            return _subproc(_XLA_STAGE, env, timeout_s * slack)
+        def attempt(env, budget_s):
+            return _subproc(_XLA_STAGE, env, budget_s)
 
     t_start = monotonic()
-    deadline = t_start + window_s
+    hard_deadline = t_start + window_s + reserve
     attempts: list[dict] = []
-    no_accelerator = False
     crashes = 0  # CONSECUTIVE fast failures (crash/garbled, not hangs)
+    no_accelerator = False
+    fallback: dict | None = None
+    fallback_done = False
+
+    def ensure_fallback() -> None:
+        """Run the labeled CPU fallback once, inside its reserve."""
+        nonlocal fallback, fallback_done
+        if fallback_done:
+            return
+        fallback_done = True
+        cpu_env = {k: v for k, v in os.environ.items()
+                   if k != "PALLAS_AXON_POOL_IPS"}
+        cpu_env["JAX_PLATFORMS"] = "cpu"
+        cpu_env["WVA_FORCE_CPU"] = "1"
+        fb_budget = min(reserve, hard_deadline - monotonic())
+        if fb_budget < 20:
+            attempts.append({"t_s": round(monotonic() - t_start),
+                             "fallback": "skipped (no budget left)"})
+            return
+        kind, out = attempt(cpu_env, fb_budget)
+        attempts.append({"t_s": round(monotonic() - t_start),
+                         "fallback": kind})
+        if kind == "ok":
+            fallback = out
+            if on_partial is not None:
+                partial = dict(out)
+                partial["platform"] = "cpu-fallback (provisional; TPU " \
+                    "retries still in progress)"
+                # snapshot the canary/retry trail so an emergency print
+                # mid-retry still carries the diagnostics
+                partial["attempts"] = list(attempts)
+                on_partial(partial)
 
     while True:
-        entry: dict = {"t_s": round(monotonic() - t_start)}
+        now = monotonic()
+        # while the fallback hasn't run, its reserve is untouchable:
+        # the watchdog that keeps a lying canary + hung measurement
+        # from eating the budget that guarantees SOME result
+        tpu_budget = (hard_deadline - now
+                      - (0.0 if fallback_done else reserve))
+        if tpu_budget < _TRY_FLOOR_S:
+            break
+        entry: dict = {"t_s": round(now - t_start)}
         c = canary()
         entry["canary"] = c["status"]
         if c["status"] == "error":
@@ -271,6 +372,8 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
             # staggered retry schedule will not fix an ImportError
             entry["detail"] = str(c.get("detail", ""))[:200]
             crashes += 1
+            attempts.append(entry)
+            ensure_fallback()
         elif c["status"] == "ok":
             entry["platform"] = c.get("platform")
             if c.get("platform") in ("cpu", "unknown"):
@@ -278,8 +381,13 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
                 # accelerator: retrying cannot conjure one
                 attempts.append(entry)
                 no_accelerator = True
+                ensure_fallback()
                 break
-            kind, out = attempt(dict(os.environ))
+            now = monotonic()
+            stage_budget = min(timeout_s,
+                               hard_deadline - now
+                               - (0.0 if fallback_done else reserve))
+            kind, out = attempt(dict(os.environ), stage_budget)
             entry["stage"] = kind
             if kind == "ok":
                 attempts.append(entry)
@@ -290,22 +398,27 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
                 crashes += 1
             else:
                 crashes = 0  # a hang is the wedge signature, not a crash
+            attempts.append(entry)
+            # any failed measurement — hung OR crashed — means the
+            # result is not in hand yet: bank the fallback now
+            ensure_fallback()
         else:
             crashes = 0  # wedged: retryable, resets the crash streak
-        attempts.append(entry)
+            attempts.append(entry)
+            ensure_fallback()
         if crashes >= 2:
-            break  # deterministic failure: fail fast, don't burn the window
-        remaining = deadline - monotonic()
-        if remaining <= 0:
+            break  # deterministic failure: fail fast, don't burn budget
+        remaining = (hard_deadline - monotonic()
+                     - (0.0 if fallback_done else reserve))
+        if remaining - retry_interval_s < _TRY_FLOOR_S:
+            # a stagger that leaves no room for one more try would just
+            # idle away budget the pallas stages could still use
             break
-        sleep(min(retry_interval_s, remaining))
+        sleep(retry_interval_s)
 
-    cpu_env = {k: v for k, v in os.environ.items()
-               if k != "PALLAS_AXON_POOL_IPS"}
-    cpu_env["JAX_PLATFORMS"] = "cpu"
-    cpu_env["WVA_FORCE_CPU"] = "1"
-    kind, out = attempt(cpu_env)
-    if kind == "ok":
+    ensure_fallback()
+    if fallback is not None:
+        out = fallback
         if no_accelerator:
             out["platform"] = "cpu-fallback (ambient env has no accelerator)"
         elif crashes >= 2:
@@ -313,8 +426,9 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
                                "not wedged — see attempts)")
         else:
             mins = (monotonic() - t_start) / 60.0
+            n_tries = sum(1 for a in attempts if "canary" in a)
             out["platform"] = (f"cpu-fallback (TPU wedged across "
-                               f"{len(attempts)} staggered attempts over "
+                               f"{n_tries} staggered attempts over "
                                f"{mins:.0f} min)")
         out["attempts"] = attempts
         return out
@@ -322,12 +436,12 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
             "platform": "error: all stages failed"}
 
 
-def bench_native_batch(c, iters: int = 10
+def bench_native_batch(c, iters: int = 10, n: int = 3
                        ) -> tuple[list[float], list[float]] | None:
-    """(mean_rates, tail_rates) — the three best-of-3 raw rates each —
-    of the native C++ batch kernel, the default engine backend on
-    CPU-only hosts (translate.engine_backend). None when the kernel
-    isn't buildable."""
+    """(mean_rates, tail_rates) — the best-of-n raw rates each — of the
+    native C++ batch kernel, the default engine backend on CPU-only
+    hosts (translate.engine_backend). None when the kernel isn't
+    buildable."""
     import numpy as np
 
     from workload_variant_autoscaler_tpu.ops import native
@@ -350,8 +464,8 @@ def bench_native_batch(c, iters: int = 10
                 occ, c["ttft"], c["itl"], tps, **kw)
         return b * iters / (time.perf_counter() - t0)
 
-    return (best_of(once),
-            best_of(lambda: once(ttft_percentile=0.95)))
+    return (best_of(once, n=n),
+            best_of(lambda: once(ttft_percentile=0.95), n=n))
 
 
 def bench_sequential(c) -> float:
@@ -469,7 +583,8 @@ def probe_pallas_compile(timeout_s: float = 420.0) -> dict:
 
     try:
         r = subprocess.run([sys.executable, "-c", _PALLAS_PROBE],
-                           capture_output=True, text=True, timeout=timeout_s,
+                           capture_output=True, text=True,
+                           timeout=max(1.0, timeout_s),
                            cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
         return {"status": "timeout",
@@ -507,52 +622,237 @@ def probe_pallas_compile(timeout_s: float = 420.0) -> dict:
     return {"status": "error", "detail": " | ".join(tail)[:400]}
 
 
-def main() -> None:
-    xla = run_xla_stage()
-    # the CPU-fallback stage measures its own baseline adjacent in time;
-    # the on-accelerator path measures it here (host contention is
-    # irrelevant next to a ~10^4x device speedup)
-    sequential_rate = (xla.get("sequential_rate")
-                       or bench_sequential(build_candidates(256)))
-    on_accelerator = not (xla["platform"] == "cpu"
-                          or xla["platform"].startswith(("cpu-fallback",
-                                                         "error")))
-    pallas = (probe_pallas_compile() if on_accelerator
-              else {"status": "skipped",
-                    "detail": f"no accelerator ({xla['platform']})"})
-    if pallas.get("status") == "timeout":
-        c = run_canary()
-        if (c["status"] == "ok"
-                and c.get("platform") not in ("cpu", "unknown")):
-            # the tunnel recovered ON AN ACCELERATOR since the probe
-            # hung — one more try so a transient wedge can't erase the
-            # round's Pallas evidence (a CPU-only recovery can't help)
-            retry = probe_pallas_compile()
-            if retry.get("status") == "compiled":
-                pallas = retry
-            else:
-                pallas["retry"] = retry.get("status")
-    print(json.dumps({
+_PALLAS_E2E = r"""
+# End-to-end reconcile-path latency of the production engine backends:
+# System.calculate (candidate gathering, percentile-tail grouping, the
+# sizing kernel, per-replica re-analysis, allocation valuation) — NOT the
+# standalone kernels. Two service classes split the fleet into a p95 tail
+# group and a mean group, so every cycle runs BOTH kernels, exactly what
+# a WVA_PALLAS_KERNEL=true controller executes per reconcile
+# (VERDICT r4 weak #3: "production engine backend" must be
+# production-path-timed on chip, not standalone-kernel-timed).
+import json, os, time
+import jax
+from workload_variant_autoscaler_tpu.models.spec import (
+    AcceleratorSpec, AllocationData, ModelSliceProfile, ModelTarget,
+    ServerLoadSpec, ServerSpec, ServiceClassSpec, SystemSpec, with_load)
+from workload_variant_autoscaler_tpu.models.system import System
+
+# knobs for hermetic smoke tests (interpret-mode pallas on CPU is exact
+# but far too slow for the production shape)
+N_SERVERS = int(os.environ.get("WVA_E2E_SERVERS", "64"))
+N_CYCLES = int(os.environ.get("WVA_E2E_CYCLES", "20"))
+
+def build():
+    spec = SystemSpec(
+        accelerators=[
+            AcceleratorSpec(name="v5e-4", chip="v5e", chips=4, cost=80.0),
+            AcceleratorSpec(name="v5e-8", chip="v5e", chips=8, cost=160.0),
+        ],
+        service_classes=[
+            ServiceClassSpec(name="premium", priority=1, model_targets=tuple(
+                ModelTarget(model=f"m{i}", slo_itl=24.0, slo_ttft=500.0,
+                            slo_ttft_percentile=0.95)
+                for i in range(N_SERVERS))),
+            ServiceClassSpec(name="freemium", priority=10, model_targets=tuple(
+                ModelTarget(model=f"m{i}", slo_itl=40.0, slo_ttft=2000.0)
+                for i in range(N_SERVERS))),
+        ],
+        capacity={"v5e": 4096},
+    )
+    for i in range(N_SERVERS):
+        for acc in ("v5e-4", "v5e-8"):
+            spec.profiles.append(ModelSliceProfile(
+                model=f"m{i}", accelerator=acc,
+                alpha=4.0 + (i % 16) * 0.25, beta=0.02 + (i % 8) * 0.004,
+                gamma=3.0 + (i % 4), delta=0.08 + (i % 5) * 0.01,
+                max_batch_size=64))
+        spec.servers.append(ServerSpec(
+            name=f"srv-{i}", model=f"m{i}",
+            service_class="premium" if i % 2 == 0 else "freemium",
+            current_alloc=with_load(
+                AllocationData(accelerator="v5e-4", num_replicas=1),
+                ServerLoadSpec(arrival_rate=30.0 + i, avg_in_tokens=128,
+                               avg_out_tokens=128)),
+        ))
+    sysm = System()
+    sysm.set_from_spec(spec)
+    return sysm
+
+sysm = build()
+platform = jax.devices()[0].platform
+res = {"platform": platform, "n_servers": N_SERVERS,
+       "n_candidates": N_SERVERS * 2}
+parity = {}
+for backend in ("batched", "pallas"):
+    sysm.calculate(backend=backend)  # warmup: traces + compiles
+    lats = []
+    for _ in range(N_CYCLES):
+        t0 = time.perf_counter()
+        sysm.calculate(backend=backend)
+        lats.append((time.perf_counter() - t0) * 1000.0)
+    lats.sort()
+    res[backend] = {
+        "p50_ms": round(lats[len(lats) // 2], 2),
+        "min_ms": round(lats[0], 2),
+        "mean_ms": round(sum(lats) / len(lats), 2),
+        "cycles": len(lats),
+    }
+    parity[backend] = {
+        name: {acc: (a.num_replicas, round(a.ttft, 4), round(a.itl, 4))
+               for acc, a in srv.all_allocations.items()}
+        for name, srv in sysm.servers.items()
+    }
+res["backends_agree"] = parity["batched"] == parity["pallas"]
+print(json.dumps(res))
+"""
+
+
+def probe_pallas_e2e(timeout_s: float = 300.0) -> dict:
+    """Time the full System.calculate reconcile path (batched vs pallas
+    backends) on the ambient accelerator — the WVA_PALLAS_KERNEL=true
+    production path end-to-end, with tail grouping and per-replica
+    re-analysis, plus a cross-backend allocation parity check."""
+    import os
+
+    kind, out = _subproc(_PALLAS_E2E, dict(os.environ), timeout_s)
+    if kind == "ok":
+        out["status"] = "ok"
+        return out
+    if kind == "timeout":
+        return {"status": "timeout",
+                "detail": f"e2e reconcile stage hung >{timeout_s:.0f}s"}
+    return {"status": "error", "detail": str(out or "")[:400]}
+
+
+# Best result captured so far, printable at any moment: the SIGTERM /
+# SIGALRM handlers emit THIS when an impatient driver (or our own
+# backstop alarm) fires, so even a kill leaves a parseable JSON line on
+# stdout instead of round 4's empty tail.
+_BEST: dict | None = None
+
+
+def _compose(xla: dict, sequential_rate: float, pallas: dict,
+             pallas_e2e: dict | None = None) -> dict:
+    rec = {
         "metric": "candidate_sizings_per_sec",
-        "value": round(xla["rate"], 1),
+        "value": round(xla.get("rate", 0.0), 1),
         "unit": "candidates/s",
-        "vs_baseline": round(xla["rate"] / sequential_rate, 2),
-        "platform": xla["platform"],
+        "vs_baseline": (round(xla.get("rate", 0.0) / sequential_rate, 2)
+                        if sequential_rate > 0 else 0.0),
+        "platform": xla.get("platform", "unknown"),
         # tunnel variance: every raw rate behind the best-of value
-        "runs": [round(r, 1) for r in xla["runs"]],
+        "runs": [round(r, 1) for r in xla.get("runs", [])],
         # percentile (p95 TTFT) sizing kernel at the same fleet scale
         "tail_sizings_per_sec": round(xla.get("tail_rate", 0.0), 1),
         "tail_runs": [round(r, 1) for r in xla.get("tail_runs", [])],
         "pallas": pallas,
         # canary/retry trail: how the wedge-resilient schedule played out
         "attempts": xla.get("attempts", []),
+    }
+    if pallas_e2e is not None:
+        # end-to-end System.calculate reconcile latency (production
+        # WVA_PALLAS_KERNEL path vs the default batched backend)
+        rec["pallas_e2e"] = pallas_e2e
+    if "backend" in xla:
         # present on the CPU fallback: which backend the headline rate
         # measured (the default for that platform), plus the auxiliary
         # batched-XLA-on-CPU rate for comparison
-        **({"backend": xla["backend"],
-            "xla_cpu_rate": round(xla.get("xla_cpu_rate", 0.0), 1)}
-           if "backend" in xla else {}),
-    }))
+        rec["backend"] = xla["backend"]
+        rec["xla_cpu_rate"] = round(xla.get("xla_cpu_rate", 0.0), 1)
+    return rec
+
+
+def _emergency_record(signum: int) -> dict:
+    rec = dict(_BEST) if _BEST is not None else _compose(
+        {"platform": "interrupted before any stage completed"}, 0.0,
+        {"status": "skipped", "detail": "interrupted"})
+    rec["platform"] = f"{rec.get('platform', 'unknown')} " \
+                      f"(interrupted by signal {signum})"
+    return rec
+
+
+def _emergency_print(signum, frame) -> None:
+    import os
+    import sys
+
+    print(json.dumps(_emergency_record(signum)), flush=True)
+    sys.stdout.flush()
+    os._exit(0)
+
+
+def main() -> None:
+    import os
+    import signal
+
+    global _BEST
+
+    budget = resolve_budget(os.environ)
+    t0 = time.monotonic()
+    deadline = t0 + budget["total"]
+    _BEST = _compose({"platform": "interrupted before any stage completed"},
+                     0.0, {"status": "skipped", "detail": "interrupted"})
+    signal.signal(signal.SIGTERM, _emergency_print)
+    signal.signal(signal.SIGALRM, _emergency_print)
+    # backstop: if clipping failed to bound something, self-interrupt
+    # (and print) before any plausible external killer does
+    signal.alarm(int(budget["total"]) + 60)
+
+    def on_partial(xla_partial: dict) -> None:
+        global _BEST
+        seq = xla_partial.get("sequential_rate") or 0.0
+        _BEST = _compose(xla_partial, seq,
+                         {"status": "skipped",
+                          "detail": "TPU retries still in progress"})
+
+    xla = run_xla_stage(on_partial=on_partial)
+    # the stage measures its own sequential baseline in-subprocess (so
+    # budget clipping covers it); the in-process path is only the
+    # injected-attempt escape hatch
+    sequential_rate = (xla.get("sequential_rate")
+                       or bench_sequential(build_candidates(256)))
+    on_accelerator = not (xla["platform"] == "cpu"
+                          or xla["platform"].startswith(("cpu-fallback",
+                                                         "error")))
+    _BEST = _compose(xla, sequential_rate,
+                     {"status": "pending" if on_accelerator else "skipped",
+                      "detail": ("probe not yet run" if on_accelerator else
+                                 f"no accelerator ({xla['platform']})")})
+
+    def remaining() -> float:
+        return deadline - time.monotonic() - budget["margin"]
+
+    if on_accelerator and remaining() > 60:
+        pallas = probe_pallas_compile(timeout_s=min(420.0, remaining()))
+        if pallas.get("status") == "timeout" and remaining() > 60:
+            c = run_canary()
+            if (c["status"] == "ok"
+                    and c.get("platform") not in ("cpu", "unknown")):
+                # the tunnel recovered ON AN ACCELERATOR since the probe
+                # hung — one more try so a transient wedge can't erase
+                # the round's Pallas evidence
+                retry = probe_pallas_compile(
+                    timeout_s=min(420.0, remaining()))
+                if retry.get("status") == "compiled":
+                    pallas = retry
+                else:
+                    pallas["retry"] = retry.get("status")
+    elif on_accelerator:
+        pallas = {"status": "skipped", "detail": "budget exhausted"}
+    else:
+        pallas = {"status": "skipped",
+                  "detail": f"no accelerator ({xla['platform']})"}
+    _BEST = _compose(xla, sequential_rate, pallas)
+
+    pallas_e2e = None
+    if on_accelerator:
+        if remaining() > 60:
+            pallas_e2e = probe_pallas_e2e(timeout_s=min(300.0, remaining()))
+        else:
+            pallas_e2e = {"status": "skipped", "detail": "budget exhausted"}
+    _BEST = _compose(xla, sequential_rate, pallas, pallas_e2e)
+    signal.alarm(0)
+    print(json.dumps(_BEST))
 
 
 if __name__ == "__main__":

@@ -1,18 +1,34 @@
-"""Wedge-resilient bench orchestration (VERDICT r3 weak #1 / next #1):
-the canary + staggered-retry schedule in bench.run_xla_stage, hermetic —
+"""Wedge-resilient, wall-time-bounded bench orchestration (VERDICT r4
+weak #1 / next #1): bench.run_xla_stage under its hard budget, hermetic —
 canary and measurement stages are injected, no subprocesses, no sleeps.
 
-The failure mode being modeled: the axon dev tunnel wedges (any JAX
-dispatch hangs indefinitely) then recovers tens of minutes later. Round
-3's bench gave up after ~18 min of back-to-back attempts and recorded a
-CPU fallback even though the tunnel recovered within the round."""
+Two failure modes are modeled:
+- the axon dev tunnel wedges (any JAX dispatch hangs indefinitely) then
+  recovers tens of minutes later (round 3 lost its TPU evidence to an
+  ~18-min give-up);
+- the DRIVER kills a bench that outlives its budget (round 4's
+  BENCH_r04.json: rc=124, empty tail, parsed=null — the 45-min retry
+  window plus fallback overran the driver's patience and recorded
+  NOTHING).
 
+The invariant under test: run_xla_stage's wall time never exceeds
+window + fallback reserve, and a printable result exists as early as the
+first wedge (fallback-first), no matter how adversarial the schedule.
+"""
+
+import json
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import bench  # noqa: E402
+
+WINDOW = 390.0
+RESERVE = 360.0
+INTERVAL = 120.0
+CANARY_COST = 45.0      # the canary subprocess timeout
+FALLBACK_COST = 200.0   # a realistic fallback stage duration
 
 
 class Clock:
@@ -26,203 +42,377 @@ class Clock:
         return self.t
 
     def sleep(self, s):
+        assert s > 0
         self.sleeps.append(s)
         self.t += s
 
 
-def tpu_ok():
-    return {"status": "ok", "platform": "tpu"}
+def make_env(clock, canary_outcomes, attempt_fn):
+    """Canary/attempt stand-ins that CONSUME simulated time, so the
+    wall-clock bound is testable: a wedged canary costs its full
+    timeout; an attempt honours (or abuses) its budget via attempt_fn.
+    canary_outcomes: iterable of "ok-tpu"|"ok-cpu"|"wedged"|"error",
+    last value repeats forever."""
+    outcomes = list(canary_outcomes)
+    state = {"i": 0}
 
+    def canary():
+        o = outcomes[min(state["i"], len(outcomes) - 1)]
+        state["i"] += 1
+        if o == "wedged":
+            clock.t += CANARY_COST
+            return {"status": "wedged"}
+        if o == "error":
+            clock.t += 2.0
+            return {"status": "error", "detail": "RuntimeError: bad env"}
+        clock.t += 5.0
+        return {"status": "ok",
+                "platform": "tpu" if o == "ok-tpu" else "cpu"}
 
-def cpu_ok():
-    return {"status": "ok", "platform": "cpu"}
-
-
-def wedged():
-    return {"status": "wedged"}
+    return canary
 
 
 GOOD = {"rate": 5.0e7, "runs": [5.0e7], "tail_rate": 4.0e7,
-        "platform": "tpu"}
+        "platform": "tpu", "sequential_rate": 3000.0}
+FALLBACK = {"rate": 5000.0, "runs": [5000.0], "tail_rate": 950.0,
+            "platform": "cpu", "sequential_rate": 3000.0,
+            "backend": "native-batch (default on CPU-only hosts)"}
+
+
+def fallback_aware(clock, tpu_result=("ok", GOOD), tpu_cost=60.0,
+                   fallback_cost=FALLBACK_COST):
+    """An attempt fn that serves the CPU fallback and a configurable TPU
+    outcome; a ("timeout", None) TPU result consumes its FULL budget,
+    modeling a hung measurement."""
+    calls = {"budgets": [], "fallback_budgets": []}
+
+    def attempt(env, budget_s):
+        if env.get("WVA_FORCE_CPU"):
+            calls["fallback_budgets"].append(budget_s)
+            clock.t += min(fallback_cost, budget_s)
+            if fallback_cost > budget_s:
+                return "timeout", None
+            return "ok", dict(FALLBACK)
+        calls["budgets"].append(budget_s)
+        kind, out = tpu_result
+        clock.t += budget_s if kind == "timeout" else min(tpu_cost, budget_s)
+        return kind, (dict(out) if isinstance(out, dict) else out)
+
+    attempt.calls = calls
+    return attempt
+
+
+def run(clock, canary, attempt, on_partial=None, **kw):
+    kw.setdefault("window_s", WINDOW)
+    kw.setdefault("fallback_reserve_s", RESERVE)
+    kw.setdefault("retry_interval_s", INTERVAL)
+    return bench.run_xla_stage(
+        sleep=clock.sleep, monotonic=clock.monotonic,
+        canary=canary, attempt=attempt, on_partial=on_partial, **kw)
 
 
 class TestHealthyPath:
     def test_healthy_tpu_measures_immediately(self):
         clock = Clock()
-        out = bench.run_xla_stage(
-            window_s=5400, retry_interval_s=1200,
-            sleep=clock.sleep, monotonic=clock.monotonic,
-            canary=tpu_ok, attempt=lambda env: ("ok", dict(GOOD)))
+        attempt = fallback_aware(clock)
+        out = run(clock, make_env(clock, ["ok-tpu"], attempt), attempt)
         assert out["platform"] == "tpu"
         assert clock.sleeps == []          # no retry delay paid
+        assert attempt.calls["fallback_budgets"] == []  # no fallback run
         assert len(out["attempts"]) == 1
         assert out["attempts"][0]["stage"] == "ok"
+
+    def test_healthy_attempt_budget_preserves_reserve(self):
+        # the watchdog: while the fallback hasn't run, a TPU measurement
+        # may not eat into the reserve that guarantees SOME result.
+        # hard deadline = WINDOW + RESERVE; canary cost 5s has elapsed;
+        # the grant must leave RESERVE untouched -> at most WINDOW - 5.
+        clock = Clock()
+        attempt = fallback_aware(clock)
+        run(clock, make_env(clock, ["ok-tpu"], attempt), attempt)
+        (budget,) = attempt.calls["budgets"]
+        assert budget <= WINDOW - 5.0 + 1e-9
 
     def test_cpu_only_env_falls_back_without_retrying(self):
         # a healthy-but-accelerator-free env can't improve with retries:
         # go straight to the labeled CPU fallback
         clock = Clock()
-        calls = []
-
-        def attempt(env):
-            calls.append(env.get("JAX_PLATFORMS"))
-            return "ok", {"rate": 800.0, "runs": [800.0], "platform": "cpu"}
-
-        out = bench.run_xla_stage(
-            window_s=5400, retry_interval_s=1200,
-            sleep=clock.sleep, monotonic=clock.monotonic,
-            canary=cpu_ok, attempt=attempt)
+        attempt = fallback_aware(clock)
+        out = run(clock, make_env(clock, ["ok-cpu"], attempt), attempt)
         assert clock.sleeps == []
-        assert calls == ["cpu"]            # only the fallback stage ran
+        assert attempt.calls["budgets"] == []   # TPU stage never ran
+        assert len(attempt.calls["fallback_budgets"]) == 1
         assert "no accelerator" in out["platform"]
 
 
 class TestWedgedTunnel:
-    def test_staggered_retries_until_recovery(self):
-        # wedged for 3 canaries (~an hour), then the tunnel recovers —
-        # exactly the round-3 scenario that lost the evidence
+    def test_fallback_runs_on_first_wedge_then_recovery_replaces_it(self):
+        # wedged twice, then the tunnel recovers — the round-3 scenario.
+        # NEW in r5: the fallback lands at the FIRST wedge (result in
+        # hand early), and the later TPU success replaces it.
         clock = Clock()
-        state = {"n": 0}
-
-        def canary():
-            state["n"] += 1
-            return tpu_ok() if state["n"] >= 4 else wedged()
-
-        out = bench.run_xla_stage(
-            window_s=5400, retry_interval_s=1200,
-            sleep=clock.sleep, monotonic=clock.monotonic,
-            canary=canary, attempt=lambda env: ("ok", dict(GOOD)))
+        partials = []
+        attempt = fallback_aware(clock)
+        out = run(clock, make_env(clock, ["wedged", "wedged", "ok-tpu"],
+                                  attempt), attempt,
+                  on_partial=partials.append)
         assert out["platform"] == "tpu"
-        assert clock.sleeps == [1200, 1200, 1200]
-        assert [a["canary"] for a in out["attempts"]] == [
-            "wedged", "wedged", "wedged", "ok"]
+        assert len(attempt.calls["fallback_budgets"]) == 1
+        assert len(partials) == 1
+        assert partials[0]["platform"].startswith("cpu-fallback (provisional")
+        # the provisional record carries the retry trail so an emergency
+        # print mid-retry keeps the diagnostics
+        assert partials[0]["attempts"][0]["canary"] == "wedged"
+        assert [a.get("canary") for a in out["attempts"]
+                if "canary" in a] == ["wedged", "wedged", "ok"]
 
     def test_wedged_forever_ends_in_labeled_cpu_fallback(self):
         clock = Clock()
-
-        def attempt(env):
-            if env.get("WVA_FORCE_CPU"):
-                return "ok", {"rate": 800.0, "runs": [800.0],
-                              "platform": "cpu"}
-            raise AssertionError("TPU stage must not run while wedged")
-
-        out = bench.run_xla_stage(
-            window_s=5400, retry_interval_s=1200,
-            sleep=clock.sleep, monotonic=clock.monotonic,
-            canary=wedged, attempt=attempt)
-        # window is honoured: ~5400s of staggered waiting, then give up
-        assert sum(clock.sleeps) >= 5400 - 1
-        assert len(clock.sleeps) >= 4
+        attempt = fallback_aware(clock)
+        out = run(clock, make_env(clock, ["wedged"], attempt), attempt)
         assert out["platform"].startswith("cpu-fallback (TPU wedged")
         assert "staggered attempts" in out["platform"]
-        assert out["rate"] == 800.0
-        assert all(a["canary"] == "wedged" for a in out["attempts"])
+        assert out["rate"] == 5000.0
+        assert clock.t <= WINDOW + RESERVE
 
-    def test_final_sleep_clipped_to_window(self):
+    def test_canary_ok_but_stage_hangs_still_records_fallback(self):
+        # the canary LIES: healthy answer, then the measurement hangs
+        # and eats its whole clipped budget. The reserve must survive
+        # and the fallback must land inside the bound.
         clock = Clock()
-        bench.run_xla_stage(
-            window_s=3000, retry_interval_s=1200,
-            sleep=clock.sleep, monotonic=clock.monotonic,
-            canary=wedged,
-            attempt=lambda env: ("ok", {"rate": 1.0, "runs": [],
-                                        "platform": "cpu"}))
-        # 1200 + 1200 + 600 (clipped), never overshooting the window
-        assert clock.sleeps == [1200, 1200, 600]
+        attempt = fallback_aware(clock, tpu_result=("timeout", None))
+        out = run(clock, make_env(clock, ["ok-tpu"], attempt), attempt)
+        assert out["platform"].startswith("cpu-fallback")
+        assert out["rate"] == 5000.0
+        assert clock.t <= WINDOW + RESERVE
+        # every TPU budget left the reserve intact at grant time
+        for b in attempt.calls["budgets"]:
+            assert b <= WINDOW + RESERVE
 
-    def test_canary_ok_but_stage_hangs_retries(self):
-        # the wedge can land between canary and measurement; the hung
-        # measurement must feed back into the staggered schedule
+    def test_recovery_after_hung_measurement(self):
+        # hang once, then succeed: the retry loop keeps going after the
+        # fallback (fallback_done frees the full remaining budget)
         clock = Clock()
-        state = {"n": 0}
+        seen = {"n": 0}
 
-        def attempt(env):
+        def attempt(env, budget_s):
             if env.get("WVA_FORCE_CPU"):
-                return "ok", {"rate": 800.0, "runs": [800.0],
-                              "platform": "cpu"}
-            state["n"] += 1
-            return ("ok", dict(GOOD)) if state["n"] >= 2 else ("timeout",
-                                                               None)
+                clock.t += FALLBACK_COST
+                return "ok", dict(FALLBACK)
+            seen["n"] += 1
+            if seen["n"] == 1:
+                clock.t += budget_s
+                return "timeout", None
+            clock.t += 30.0
+            return "ok", dict(GOOD)
 
-        out = bench.run_xla_stage(
-            window_s=5400, retry_interval_s=1200,
-            sleep=clock.sleep, monotonic=clock.monotonic,
-            canary=tpu_ok, attempt=attempt)
+        out = run(clock, make_env(clock, ["ok-tpu"], attempt), attempt,
+                  window_s=900.0)
         assert out["platform"] == "tpu"
-        assert clock.sleeps == [1200]
-        assert out["attempts"][0]["stage"] == "timeout"
-        assert out["attempts"][1]["stage"] == "ok"
+        assert seen["n"] == 2
+        assert clock.t <= 900.0 + RESERVE
 
 
-class TestKnobs:
-    def test_env_knobs_read(self, monkeypatch):
-        monkeypatch.setenv("WVA_BENCH_RETRY_WINDOW_S", "100")
+class TestWallTimeBound:
+    """The round-4 bug, pinned: NO schedule may push run_xla_stage past
+    window + reserve — the budget main() promises the driver."""
+
+    def test_always_wedged_worst_case(self):
+        clock = Clock()
+        attempt = fallback_aware(clock)
+        run(clock, make_env(clock, ["wedged"], attempt), attempt)
+        assert clock.t <= WINDOW + RESERVE
+
+    def test_lying_canary_hung_measurement_worst_case(self):
+        clock = Clock()
+        attempt = fallback_aware(clock, tpu_result=("timeout", None))
+        run(clock, make_env(clock, ["ok-tpu"], attempt), attempt)
+        assert clock.t <= WINDOW + RESERVE
+
+    def test_slow_fallback_clipped_to_reserve(self):
+        # even a fallback that WOULD run long gets cut at its reserve
+        clock = Clock()
+        attempt = fallback_aware(clock, fallback_cost=10_000.0)
+        out = run(clock, make_env(clock, ["wedged"], attempt), attempt)
+        assert clock.t <= WINDOW + RESERVE + 1
+        # nothing measurable survived, but the line is still composed
+        assert out["platform"].startswith("error")
+        for b in attempt.calls["fallback_budgets"]:
+            assert b <= RESERVE
+
+    def test_tiny_window_goes_straight_to_fallback(self):
+        # watchdog semantics: if the window can't fit one more try, the
+        # fallback is all that runs
+        clock = Clock()
+        attempt = fallback_aware(clock)
+        out = run(clock, make_env(clock, ["wedged"], attempt), attempt,
+                  window_s=10.0)
+        assert out["platform"].startswith("cpu-fallback")
+        assert clock.t <= 10.0 + RESERVE
+
+    def test_default_budget_fits_known_good_driver_bound(self):
+        # the smallest driver budget ever observed to record a result is
+        # ~26 min (round 3); the default worst case must clear it 2x
+        b = bench.resolve_budget({})
+        assert b["total"] <= 800.0
+        assert b["window"] + b["reserve"] + b["margin"] <= b["total"]
+
+
+class TestBudgetResolution:
+    def test_defaults(self):
+        b = bench.resolve_budget({})
+        assert b == {"total": 780.0, "window": 390.0, "reserve": 360.0,
+                     "margin": 30.0}
+
+    def test_total_env_derives_window(self):
+        b = bench.resolve_budget({"WVA_BENCH_TOTAL_BUDGET_S": "600"})
+        assert b["total"] == 600.0
+        assert b["window"] == 600.0 - 360.0 - 30.0
+
+    def test_window_env_grows_total(self):
+        # a sidecar that owns its timeout may raise the window; the
+        # pallas/margin allowance rides on top
+        b = bench.resolve_budget({"WVA_BENCH_RETRY_WINDOW_S": "1800"})
+        assert b["window"] == 1800.0
+        assert b["total"] == 1800.0 + 360.0 + 30.0 + 600.0
+
+    def test_both_env_respected(self):
+        b = bench.resolve_budget({"WVA_BENCH_RETRY_WINDOW_S": "100",
+                                  "WVA_BENCH_TOTAL_BUDGET_S": "900",
+                                  "WVA_BENCH_FALLBACK_RESERVE_S": "120"})
+        assert b == {"total": 900.0, "window": 100.0, "reserve": 120.0,
+                     "margin": 30.0}
+
+    def test_small_total_clamps_reserve(self):
+        # a driver-sized total below the default reserve must still be
+        # honored: the fallback reserve shrinks to fit, never past it
+        b = bench.resolve_budget({"WVA_BENCH_TOTAL_BUDGET_S": "300"})
+        assert b["total"] == 300.0
+        assert b["window"] + b["reserve"] + b["margin"] <= 300.0
+
+    def test_window_clamped_to_explicit_total(self):
+        # an explicit window must never plan past the hard total: the
+        # total is what the SIGALRM backstop (and the driver) enforce
+        b = bench.resolve_budget({"WVA_BENCH_RETRY_WINDOW_S": "1800",
+                                  "WVA_BENCH_TOTAL_BUDGET_S": "1200"})
+        assert b["total"] == 1200.0
+        assert b["window"] == 1200.0 - 360.0 - 30.0
+
+    def test_env_knobs_reach_run_xla_stage(self, monkeypatch):
+        monkeypatch.setenv("WVA_BENCH_RETRY_WINDOW_S", "200")
+        monkeypatch.setenv("WVA_BENCH_FALLBACK_RESERVE_S", "100")
         monkeypatch.setenv("WVA_BENCH_RETRY_INTERVAL_S", "40")
         clock = Clock()
-        bench.run_xla_stage(
+        attempt = fallback_aware(clock, fallback_cost=50.0)
+        out = bench.run_xla_stage(
             sleep=clock.sleep, monotonic=clock.monotonic,
-            canary=wedged,
-            attempt=lambda env: ("ok", {"rate": 1.0, "runs": [],
-                                        "platform": "cpu"}))
-        assert clock.sleeps == [40, 40, 20]
+            canary=make_env(clock, ["wedged"], attempt), attempt=attempt)
+        assert out["platform"].startswith("cpu-fallback")
+        assert clock.t <= 300.0
+        assert all(s <= 40 for s in clock.sleeps)
 
 
 class TestFastFailure:
     """A deterministic crash is diagnosable in seconds; it must NOT be
-    treated as a wedge and burn the 90-minute staggered window."""
+    treated as a wedge and burn the retry window."""
 
     def test_stage_crashing_fast_short_circuits(self):
         clock = Clock()
 
-        def attempt(env):
+        def attempt(env, budget_s):
             if env.get("WVA_FORCE_CPU"):
-                return "ok", {"rate": 800.0, "runs": [800.0],
-                              "platform": "cpu"}
+                clock.t += FALLBACK_COST
+                return "ok", dict(FALLBACK)
+            clock.t += 5.0
             return "crash", "ImportError: no module named foo"
 
-        out = bench.run_xla_stage(
-            window_s=5400, retry_interval_s=1200,
-            sleep=clock.sleep, monotonic=clock.monotonic,
-            canary=tpu_ok, attempt=attempt)
-        # two consecutive crashes -> give up; only ONE stagger paid
-        assert clock.sleeps == [1200]
+        out = run(clock, make_env(clock, ["ok-tpu"], attempt), attempt)
+        # two consecutive crashes -> give up; at most ONE stagger paid
+        assert len(clock.sleeps) <= 1
         assert "crashing fast" in out["platform"]
         assert out["attempts"][0]["stage"] == "crash"
         assert "ImportError" in out["attempts"][0]["detail"]
+        # the fallback was banked at the FIRST failed measurement, not
+        # saved for the end (a SIGTERM mid-stagger must find a result)
+        assert out["attempts"][1]["fallback"] == "ok"
 
     def test_canary_crashing_fast_short_circuits(self):
         clock = Clock()
-
-        def canary():
-            return {"status": "error", "detail": "RuntimeError: bad env"}
-
-        out = bench.run_xla_stage(
-            window_s=5400, retry_interval_s=1200,
-            sleep=clock.sleep, monotonic=clock.monotonic,
-            canary=canary,
-            attempt=lambda env: ("ok", {"rate": 800.0, "runs": [800.0],
-                                        "platform": "cpu"}))
-        assert clock.sleeps == [1200]
-        assert all(a["canary"] == "error" for a in out["attempts"])
+        attempt = fallback_aware(clock)
+        out = run(clock, make_env(clock, ["error"], attempt), attempt)
+        assert len(clock.sleeps) <= 1
+        assert all(a["canary"] == "error" for a in out["attempts"]
+                   if "canary" in a)
         assert "RuntimeError" in out["attempts"][0]["detail"]
+        # the crash-labeled result still carries the fallback numbers
+        assert out["rate"] == 5000.0
 
     def test_single_transient_crash_keeps_retrying(self):
         # crash, then wedge, then recovery: the consecutive-crash counter
         # resets on non-crash outcomes, so the schedule keeps going
         clock = Clock()
-        state = {"n": 0}
-
-        def canary():
-            state["n"] += 1
-            if state["n"] == 1:
-                return {"status": "error", "detail": "transient"}
-            if state["n"] == 2:
-                return wedged()
-            return tpu_ok()
-
-        out = bench.run_xla_stage(
-            window_s=5400, retry_interval_s=1200,
-            sleep=clock.sleep, monotonic=clock.monotonic,
-            canary=canary, attempt=lambda env: ("ok", dict(GOOD)))
+        attempt = fallback_aware(clock)
+        out = run(clock, make_env(clock, ["error", "wedged", "ok-tpu"],
+                                  attempt), attempt)
         assert out["platform"] == "tpu"
-        assert [a["canary"] for a in out["attempts"]] == [
+        assert [a["canary"] for a in out["attempts"] if "canary" in a] == [
             "error", "wedged", "ok"]
+
+
+class TestEmergencyPrint:
+    """SIGTERM/SIGALRM must leave a parseable JSON line: round 4's rc=124
+    with an EMPTY tail is the bug; an interrupted bench that still prints
+    its best-so-far is the fix."""
+
+    def test_emergency_record_before_any_stage(self, monkeypatch):
+        monkeypatch.setattr(bench, "_BEST", None)
+        rec = bench._emergency_record(15)
+        json.dumps(rec)  # serializable
+        assert rec["metric"] == "candidate_sizings_per_sec"
+        assert "interrupted by signal 15" in rec["platform"]
+        assert rec["value"] == 0.0
+
+    def test_emergency_record_carries_best_so_far(self, monkeypatch):
+        best = bench._compose(dict(FALLBACK, attempts=[{"canary": "wedged"}]),
+                              3000.0, {"status": "skipped"})
+        monkeypatch.setattr(bench, "_BEST", best)
+        rec = bench._emergency_record(14)
+        assert rec["value"] == 5000.0
+        assert rec["vs_baseline"] == round(5000.0 / 3000.0, 2)
+        assert "interrupted by signal 14" in rec["platform"]
+        assert rec["attempts"] == [{"canary": "wedged"}]
+
+    def test_compose_zero_baseline_guard(self):
+        rec = bench._compose({"platform": "x"}, 0.0, {"status": "skipped"})
+        assert rec["vs_baseline"] == 0.0
+
+
+class TestPallasE2EStage:
+    """The end-to-end reconcile stage must not rot between TPU windows:
+    a broken _PALLAS_E2E would silently record status=error during the
+    one healthy window the round gets (VERDICT r4 weak #3)."""
+
+    def test_stage_runs_and_backends_agree(self):
+        import os
+        import subprocess
+        import sys
+
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("PALLAS_AXON")}
+        env.update({"JAX_PLATFORMS": "cpu",
+                    # tiny fleet: interpret-mode pallas is exact but slow
+                    "WVA_E2E_SERVERS": "4", "WVA_E2E_CYCLES": "1"})
+        r = subprocess.run([sys.executable, "-c", bench._PALLAS_E2E],
+                           capture_output=True, text=True, timeout=180,
+                           env=env,
+                           cwd=str(Path(__file__).resolve().parent.parent))
+        assert r.returncode == 0, (r.stderr or r.stdout)[-800:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        # BOTH production backends timed over the same System, and the
+        # allocations they store must be identical (pallas is a faster
+        # engine, not a different policy)
+        assert out["backends_agree"] is True
+        assert out["n_candidates"] == 8
+        for backend in ("batched", "pallas"):
+            assert out[backend]["p50_ms"] > 0
+            assert out[backend]["cycles"] == 1

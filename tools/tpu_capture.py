@@ -45,9 +45,14 @@ def main() -> int:
                     [sys.executable, os.path.join(REPO, "bench.py")],
                     capture_output=True, text=True, timeout=7200, cwd=REPO,
                     env={**os.environ,
-                         # bench's own staggered window stays short here:
-                         # the sidecar IS the staggered schedule
-                         "WVA_BENCH_RETRY_WINDOW_S": "1800"})
+                         # the sidecar owns its timeout, so it may grant
+                         # bench.py a far larger budget than the driver
+                         # default: a 30-min retry window (the sidecar IS
+                         # the long-run staggered schedule) and a total
+                         # that leaves the pallas probe + e2e stages
+                         # ample room, all still under the 7200s guard
+                         "WVA_BENCH_RETRY_WINDOW_S": "1800",
+                         "WVA_BENCH_TOTAL_BUDGET_S": "5400"})
             except subprocess.TimeoutExpired:
                 # the tunnel wedged mid-measurement; the sidecar's whole
                 # job is to outlive that — keep polling
