@@ -197,3 +197,27 @@ class TestPallasEngineBackend:
         monkeypatch.delenv("JAX_PLATFORMS", raising=False)
         monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
         assert plat.host_is_tpu()
+
+    def test_host_is_tpu_vfio_requires_no_cuda(self, monkeypatch):
+        # VFIO is a generic passthrough interface: a numbered group
+        # only signals TPU when the CUDA device signature is absent
+        # (ADVICE r4 — a vfio-bound GPU/NIC host must NOT pass the
+        # WVA_PALLAS_KERNEL gate and then silently run interpret mode)
+        import glob as glob_mod
+
+        from workload_variant_autoscaler_tpu.utils import platform as plat
+
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+        trees = {}
+        monkeypatch.setattr(
+            glob_mod, "glob",
+            lambda pat, **kw: [p for p in trees.get(pat, [])])
+        trees = {"/dev/vfio/[0-9]*": ["/dev/vfio/0"]}
+        assert plat.host_is_tpu()        # vfio group, no CUDA -> TPU
+        trees = {"/dev/vfio/[0-9]*": ["/dev/vfio/0"],
+                 "/dev/nvidia[0-9]*": ["/dev/nvidia0"]}
+        assert not plat.host_is_tpu()    # vfio-bound CUDA host -> not TPU
+        trees = {"/dev/accel*": ["/dev/accel0"],
+                 "/dev/nvidia[0-9]*": ["/dev/nvidia0"]}
+        assert plat.host_is_tpu()        # /dev/accel* decides outright

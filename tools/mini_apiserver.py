@@ -65,7 +65,7 @@ from collections import deque
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
-from urllib.parse import parse_qs, unquote, urlparse
+from urllib.parse import parse_qs, urlparse
 
 from workload_variant_autoscaler_tpu.controller.crd import (
     GROUP,
@@ -668,8 +668,11 @@ def _make_handler(srv: MiniApiServer):
         # -- nodes -------------------------------------------------------
 
         def _nodes(self, q: dict[str, str]) -> None:
+            # parse_qs has already percent-decoded the query string; a
+            # second unquote() would misparse selectors containing a
+            # literal % and deviate from real apiserver behavior
+            # (ADVICE r4)
             sel = q.get("labelSelector", "")
-            sel = unquote(sel)
             items = []
             for n in srv.kube.list_nodes():
                 if sel and "=" in sel:

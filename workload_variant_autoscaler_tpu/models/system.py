@@ -141,7 +141,9 @@ class System:
         the hand-written Mosaic kernels (ops.pallas_kernel) instead of
         the XLA fori_loop — opt-in for accelerator-host controllers
         (WVA_PALLAS_KERNEL; BENCH_tpu_capture_r04.json records the
-        Pallas mean beating the XLA stage on a v5e). Off-TPU the kernels
+        Pallas mean beating that same capture's variance-depressed XLA
+        runs on a v5e — at-parity with the XLA path overall, see
+        BENCH_r02.json). Off-TPU the kernels
         run in interpret mode, which is exact but slow — parity testing
         only. The epilogue (analyze_batch) is shared with "batched".
         mesh: optional 1-D jax.sharding.Mesh; shards the candidate batch

@@ -87,7 +87,14 @@ def host_is_tpu() -> bool:
         return True
     import glob
 
-    return bool(glob.glob("/dev/accel*") or glob.glob("/dev/vfio/[0-9]*"))
+    if glob.glob("/dev/accel*"):
+        return True
+    # numbered /dev/vfio groups are how TPU v5p/v6e surface — but VFIO
+    # is a generic passthrough interface (vfio-bound GPUs/NICs create
+    # them too), so alone it only counts when the CUDA signature this
+    # docstring carves out is absent (ADVICE r4)
+    return bool(glob.glob("/dev/vfio/[0-9]*")
+                and not glob.glob("/dev/nvidia[0-9]*"))
 
 
 def _accelerator_device_present() -> bool:
