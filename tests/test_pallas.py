@@ -213,6 +213,8 @@ class TestPallasEngineBackend:
         monkeypatch.setattr(
             glob_mod, "glob",
             lambda pat, **kw: [p for p in trees.get(pat, [])])
+        # no sysfs IOMMU info: fall back to the CUDA-signature carve-out
+        monkeypatch.setattr(plat, "_iommu_group_vendors", lambda: None)
         trees = {"/dev/vfio/[0-9]*": ["/dev/vfio/0"]}
         assert plat.host_is_tpu()        # vfio group, no CUDA -> TPU
         trees = {"/dev/vfio/[0-9]*": ["/dev/vfio/0"],
@@ -221,3 +223,13 @@ class TestPallasEngineBackend:
         trees = {"/dev/accel*": ["/dev/accel0"],
                  "/dev/nvidia[0-9]*": ["/dev/nvidia0"]}
         assert plat.host_is_tpu()        # /dev/accel* decides outright
+        # sysfs IOMMU vendors available: they decide, not /dev/nvidia* —
+        # a GPU bound to vfio-pci has NO /dev/nvidia* node, so only the
+        # PCI vendor distinguishes it from a TPU (review r5)
+        trees = {"/dev/vfio/[0-9]*": ["/dev/vfio/0"]}
+        monkeypatch.setattr(plat, "_iommu_group_vendors",
+                            lambda: {"0x10de"})   # passthrough-bound GPU
+        assert not plat.host_is_tpu()
+        monkeypatch.setattr(plat, "_iommu_group_vendors",
+                            lambda: {"0x1ae0", "0x8086"})  # Google TPU
+        assert plat.host_is_tpu()

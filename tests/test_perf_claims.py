@@ -70,3 +70,34 @@ def test_scenario_headlines_match_baseline_json():
     ab = pub["ablation_mean_based_itl_only"]
     assert f"{ab['chip_hours']} chip-hours" in flat
     assert f"{ab['efficiency_vs_oracle'] * 100:.1f}%" in flat
+
+
+def test_config45_full_slo_claims_match_baseline_json():
+    """Round-5: every BASELINE config leads with a full-SLO number
+    (VERDICT r4 next #3); the README/BASELINE.md claims for configs 4
+    and 5 must equal the committed BASELINE.json entries."""
+    pub = json.loads((REPO / "BASELINE.json").read_text())["published"]
+    readme = " ".join((REPO / "README.md").read_text().split())
+    baseline_md = (REPO / "BASELINE.md").read_text()
+
+    mh = pub["multihost_full_slo"]
+    assert f"{mh['chip_hours']} chip-hours" in readme, \
+        "README's multihost full-SLO claim drifted from BASELINE.json"
+    assert f"**{mh['chip_hours']} chip-hours**" in baseline_md
+    assert f"{mh['p95_ttft_ms']} ms" in baseline_md
+    # the committed headroom sweep is the frontier evidence: every row
+    # quoted in BASELINE.md must match the artifact
+    for h, row in mh["headroom_sweep"].items():
+        assert f"| {row['chip_hours']} |" in baseline_md.replace("**", ""), \
+            f"headroom sweep row {h} drifted"
+    het = pub["hetero_full_slo"]
+    assert f"{het['chip_hours']} chip-hours" in readme, \
+        "README's hetero full-SLO claim drifted from BASELINE.json"
+    assert f"**{het['chip_hours']}**" in baseline_md
+    for v, row in het["variants"].items():
+        assert f"{row['p95_ttft_ms']}" in baseline_md, \
+            f"hetero variant {v} TTFT drifted"
+    # frontier check: the 0.08 failure is the evidence 0.13 binds
+    fc = het["frontier_check"]["headroom_0.08"]
+    assert fc["held"] is False
+    assert f"{fc['chat_8b_p95_ttft_ms']} ms" in baseline_md

@@ -91,10 +91,38 @@ def host_is_tpu() -> bool:
         return True
     # numbered /dev/vfio groups are how TPU v5p/v6e surface — but VFIO
     # is a generic passthrough interface (vfio-bound GPUs/NICs create
-    # them too), so alone it only counts when the CUDA signature this
-    # docstring carves out is absent (ADVICE r4)
-    return bool(glob.glob("/dev/vfio/[0-9]*")
-                and not glob.glob("/dev/nvidia[0-9]*"))
+    # identical nodes, and a passthrough-bound GPU has NO /dev/nvidia*).
+    # When sysfs exposes the IOMMU groups, require a Google PCI vendor
+    # (0x1ae0) behind at least one group; only fall back to the weaker
+    # "vfio and no CUDA signature" check when sysfs is unreadable
+    # (ADVICE r4 + review: the carve-out must hold for vfio-passthrough
+    # GPU hosts, not just hosts where the nvidia driver kept a device).
+    if not glob.glob("/dev/vfio/[0-9]*"):
+        return False
+    vendors = _iommu_group_vendors()
+    if vendors is not None:
+        return "0x1ae0" in vendors
+    return not glob.glob("/dev/nvidia[0-9]*")
+
+
+def _iommu_group_vendors() -> set[str] | None:
+    """PCI vendor ids (lowercase ``0x....``) of every device in every
+    IOMMU group, or None when sysfs doesn't expose them (no IOMMU, or a
+    restricted container). Lets the vfio TPU signature distinguish a
+    Google TPU (vendor 0x1ae0) from a vfio-passthrough GPU/NIC."""
+    import glob
+
+    paths = glob.glob("/sys/kernel/iommu_groups/*/devices/*/vendor")
+    if not paths:
+        return None
+    vendors: set[str] = set()
+    for p in paths:
+        try:
+            with open(p) as f:
+                vendors.add(f.read().strip().lower())
+        except OSError:
+            continue
+    return vendors or None
 
 
 def _accelerator_device_present() -> bool:
