@@ -8,6 +8,11 @@ status-subresource PUTs, merge-patch ownerReferences, resourceVersion
 conflicts, and Lease MicroTime round-trips — everything InMemoryKube can
 only approximate.
 
+The test BODIES live in tests/envtest_suite.py and also run, verbatim,
+against tools/mini_apiserver.py (tests/test_envtest_wire.py) — so this
+module's skip only withholds the real-binary fixture, not the scenario
+coverage.
+
 Skipped when the binaries are absent. Provide them via one of:
   - KUBEBUILDER_ASSETS (the `setup-envtest use -p path` convention)
   - /usr/local/kubebuilder/bin
@@ -18,7 +23,6 @@ CI runs this tier via `make test-envtest` (see .github/workflows/ci.yaml).
 from __future__ import annotations
 
 import glob
-import json
 import os
 import socket
 import subprocess
@@ -26,7 +30,6 @@ import time
 from pathlib import Path
 
 import pytest
-import yaml
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 CRD_PATH = REPO_ROOT / "deploy" / "crd" / "variantautoscaling-crd.yaml"
@@ -190,21 +193,15 @@ class EnvtestCluster:
         r.raise_for_status()
         return r.json()
 
+    def make_restkube(self):
+        from workload_variant_autoscaler_tpu.controller.kube import RestKube
+
+        return RestKube(base_url=self.base_url, token=TOKEN, verify=False)
+
     def apply_crd(self) -> None:
-        crd = yaml.safe_load(CRD_PATH.read_text())
-        self.post("/apis/apiextensions.k8s.io/v1/customresourcedefinitions", crd)
-        name = crd["metadata"]["name"]
-        deadline = time.time() + 30.0
-        while time.time() < deadline:
-            obj = self.get(
-                f"/apis/apiextensions.k8s.io/v1/customresourcedefinitions/{name}"
-            )
-            conds = obj.get("status", {}).get("conditions", [])
-            if any(c["type"] == "Established" and c["status"] == "True"
-                   for c in conds):
-                return
-            time.sleep(0.25)
-        raise RuntimeError("CRD never became Established")
+        from tests.envtest_suite import apply_crd_and_wait
+
+        apply_crd_and_wait(self, CRD_PATH)
 
     def ensure_namespace(self, name: str) -> None:
         self.post("/api/v1/namespaces",
@@ -222,208 +219,18 @@ def cluster(tmp_path_factory):
     c.stop()
 
 
-# ---------------------------------------------------------------------------
-
-from workload_variant_autoscaler_tpu.collector import (  # noqa: E402
-    FakePromAPI,
-    arrival_rate_query,
-    avg_generation_tokens_query,
-    avg_itl_query,
-    avg_prompt_tokens_query,
-    avg_ttft_query,
-    true_arrival_rate_query,
-)
-from workload_variant_autoscaler_tpu.controller import (  # noqa: E402
-    ACCELERATOR_CM_NAME,
-    CONFIG_MAP_NAME,
-    CONFIG_MAP_NAMESPACE,
-    SERVICE_CLASS_CM_NAME,
-    Reconciler,
-    crd,
-)
-from workload_variant_autoscaler_tpu.controller.kube import (  # noqa: E402
-    ConflictError,
-    InvalidError,
-    RestKube,
-)
-from workload_variant_autoscaler_tpu.controller.runtime import (  # noqa: E402
-    Lease,
-)
-from workload_variant_autoscaler_tpu.metrics import MetricsEmitter  # noqa: E402
-
-MODEL = "llama-8b"
-NS = "default"
-VARIANT = "chat-8b"
-VA_PATH = f"/apis/{crd.GROUP}/{crd.VERSION}/namespaces/{NS}/{crd.PLURAL}"
-
-
-def make_restkube(cluster) -> RestKube:
-    return RestKube(base_url=cluster.base_url, token=TOKEN, verify=False)
-
-
-def va_body(name=VARIANT) -> dict:
-    return {
-        "apiVersion": f"{crd.GROUP}/{crd.VERSION}",
-        "kind": crd.KIND,
-        "metadata": {"name": name, "namespace": NS,
-                     "labels": {crd.ACCELERATOR_LABEL: "v5e-1"}},
-        "spec": {
-            "modelID": MODEL,
-            "sloClassRef": {"name": SERVICE_CLASS_CM_NAME, "key": "premium"},
-            "modelProfile": {"accelerators": [{
-                "acc": "v5e-1", "accCount": 1, "maxBatchSize": 64,
-                "perfParms": {
-                    "decodeParms": {"alpha": "6.973", "beta": "0.027"},
-                    "prefillParms": {"gamma": "5.2", "delta": "0.1"},
-                },
-            }]},
-        },
-    }
-
-
-def deployment_body(name=VARIANT, replicas=1) -> dict:
-    return {
-        "apiVersion": "apps/v1", "kind": "Deployment",
-        "metadata": {"name": name, "namespace": NS, "labels": {"app": name}},
-        "spec": {
-            "replicas": replicas,
-            "selector": {"matchLabels": {"app": name}},
-            "template": {
-                "metadata": {"labels": {"app": name}},
-                "spec": {"containers": [
-                    {"name": "server", "image": "vllm-tpu:emulated"}
-                ]},
-            },
-        },
-    }
-
-
-def configmap_body(name, namespace, data) -> dict:
-    return {"apiVersion": "v1", "kind": "ConfigMap",
-            "metadata": {"name": name, "namespace": namespace}, "data": data}
-
-
-def loaded_prom(rps=2.0) -> FakePromAPI:
-    prom = FakePromAPI()
-    prom.set_result(true_arrival_rate_query(MODEL, NS), rps)
-    prom.set_result(arrival_rate_query(MODEL, NS), rps)
-    prom.set_result(avg_prompt_tokens_query(MODEL, NS), 128.0)
-    prom.set_result(avg_generation_tokens_query(MODEL, NS), 128.0)
-    prom.set_result(avg_ttft_query(MODEL, NS), 0.050)
-    prom.set_result(avg_itl_query(MODEL, NS), 0.009)
-    return prom
-
-
 @pytest.fixture(scope="module")
 def seeded(cluster):
-    """Namespaces, ConfigMaps, Deployment, VA — the cluster state one
-    reconcile needs."""
-    cluster.ensure_namespace(CONFIG_MAP_NAMESPACE)
-    cluster.post(f"/api/v1/namespaces/{CONFIG_MAP_NAMESPACE}/configmaps",
-                 configmap_body(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
-                                {"GLOBAL_OPT_INTERVAL": "30s"}))
-    cluster.post(f"/api/v1/namespaces/{CONFIG_MAP_NAMESPACE}/configmaps",
-                 configmap_body(ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE, {
-                     "v5e-1": json.dumps(
-                         {"chip": "v5e", "chips": "1", "cost": "20.0"}),
-                 }))
-    cluster.post(f"/api/v1/namespaces/{CONFIG_MAP_NAMESPACE}/configmaps",
-                 configmap_body(SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE, {
-                     "premium": ("name: Premium\npriority: 1\ndata:\n"
-                                 f"  - model: {MODEL}\n    slo-tpot: 24\n"
-                                 "    slo-ttft: 500\n"),
-                 }))
-    cluster.post(f"/apis/apps/v1/namespaces/{NS}/deployments",
-                 deployment_body())
-    cluster.post(VA_PATH, va_body())
-    return cluster
+    from tests.envtest_suite import seed_cluster
+
+    return seed_cluster(cluster)
 
 
-class TestCRDValidation:
-    def test_schema_rejects_missing_required_fields(self, cluster):
-        bad = va_body(name="bad-no-model")
-        del bad["spec"]["modelID"]
-        with pytest.raises(RuntimeError, match=r"422|400"):
-            cluster.post(VA_PATH, bad)
-
-    def test_schema_rejects_zero_acc_count(self, cluster):
-        bad = va_body(name="bad-acc-count")
-        bad["spec"]["modelProfile"]["accelerators"][0]["accCount"] = 0
-        with pytest.raises(RuntimeError, match=r"422|400"):
-            cluster.post(VA_PATH, bad)
-
-    def test_restkube_surfaces_invalid(self, cluster):
-        """RestKube maps 400/422 to InvalidError (terminal for backoff)."""
-        kube = make_restkube(cluster)
-        with pytest.raises(InvalidError):
-            kube._request("POST", VA_PATH, body={"apiVersion": "nope"})
-
-
-class TestReconcileAgainstRealAPIServer:
-    def test_full_cycle_publishes_status(self, seeded):
-        kube = make_restkube(seeded)
-        rec = Reconciler(kube=kube, prom=loaded_prom(rps=2.0),
-                         emitter=MetricsEmitter(), sleep=lambda _s: None)
-        result = rec.reconcile()
-        assert f"{VARIANT}:{NS}" in result.processed, result.skipped
-
-        va = kube.get_variant_autoscaling(VARIANT, NS)
-        assert va.status.desired_optimized_alloc.accelerator == "v5e-1"
-        assert va.status.desired_optimized_alloc.num_replicas >= 1
-        assert crd.is_condition_true(va, crd.TYPE_OPTIMIZATION_READY)
-        assert crd.is_condition_true(va, crd.TYPE_METRICS_AVAILABLE)
-
-        # ownerReference really landed via merge-patch (GC wiring)
-        raw = seeded.get(f"{VA_PATH}/{VARIANT}")
-        owners = raw["metadata"].get("ownerReferences", [])
-        assert owners and owners[0]["kind"] == "Deployment"
-        assert owners[0]["name"] == VARIANT
-
-    def test_status_subresource_does_not_touch_spec(self, seeded):
-        kube = make_restkube(seeded)
-        va = kube.get_variant_autoscaling(VARIANT, NS)
-        before_spec = seeded.get(f"{VA_PATH}/{VARIANT}")["spec"]
-        va.status.desired_optimized_alloc.num_replicas = 7
-        kube.update_variant_autoscaling_status(va)
-        after = seeded.get(f"{VA_PATH}/{VARIANT}")
-        assert after["spec"] == before_spec
-        assert after["status"]["desiredOptimizedAlloc"]["numReplicas"] == 7
-
-    def test_stale_resource_version_conflicts_and_retry_recovers(self, seeded):
-        kube = make_restkube(seeded)
-        stale = kube.get_variant_autoscaling(VARIANT, NS)
-        concurrent = kube.get_variant_autoscaling(VARIANT, NS)
-        concurrent.status.desired_optimized_alloc.num_replicas = 3
-        kube.update_variant_autoscaling_status(concurrent)  # bumps RV
-
-        stale.status.desired_optimized_alloc.num_replicas = 5
-        with pytest.raises(ConflictError):
-            kube.update_variant_autoscaling_status(stale)
-
-        # the reconciler's conflict-retrying status writer wins through
-        rec = Reconciler(kube=kube, prom=loaded_prom(),
-                         emitter=MetricsEmitter(), sleep=lambda _s: None)
-        rec._update_status(stale)
-        after = seeded.get(f"{VA_PATH}/{VARIANT}")
-        assert after["status"]["desiredOptimizedAlloc"]["numReplicas"] == 5
-
-
-class TestLeaseAgainstRealAPIServer:
-    def test_lease_microtime_roundtrip(self, cluster):
-        kube = make_restkube(cluster)
-        now = time.time()
-        lease = Lease(name="wva-election", namespace=NS,
-                      holder="controller-a", acquire_time=now,
-                      renew_time=now, duration_seconds=15)
-        kube.create_lease(lease)
-        got = kube.get_lease("wva-election", NS)
-        assert got.holder == "controller-a"
-        # MicroTime round-trips to microsecond precision
-        assert abs(got.renew_time - now) < 0.001
-
-        got.holder = "controller-b"
-        got.renew_time = now + 5.0
-        kube.update_lease(got)
-        again = kube.get_lease("wva-election", NS)
-        assert again.holder == "controller-b"
-        assert abs(again.renew_time - (now + 5.0)) < 0.001
+# The shared scenario bodies (one source of truth, two backends — see
+# envtest_suite's docstring). Imported names are collected by pytest
+# under this module's skipif mark.
+from tests.envtest_suite import (  # noqa: E402,F401,WVL002
+    TestCRDValidation,
+    TestLeaseAgainstRealAPIServer,
+    TestReconcileAgainstRealAPIServer,
+)

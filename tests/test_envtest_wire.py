@@ -1,0 +1,109 @@
+"""The envtest scenario bodies against the wire facade (VERDICT r4
+next #4: converge the envtest suite and tools/mini_apiserver.py onto the
+same assertions).
+
+Same test classes as tests/test_envtest.py (tests/envtest_suite.py is
+the single source of truth), driven over real HTTP against
+``tools/mini_apiserver.py`` with bearer-token auth — the conformance
+backend that ALWAYS runs in this image, while the real-binary fixture
+stays environment-gated. The CRD is applied through the same
+POST-then-poll-Established flow, VA creation goes through the facade's
+structural-schema admission (controller/schema.py against the registered
+CRD), and RestKube is the production client in both backends.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from workload_variant_autoscaler_tpu.controller.kube import (  # noqa: E402
+    InMemoryKube,
+    RestKube,
+)
+
+from tools.mini_apiserver import MiniApiServer  # noqa: E402
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+CRD_PATH = REPO_ROOT / "deploy" / "crd" / "variantautoscaling-crd.yaml"
+TOKEN = "wire-conformance-token"
+
+
+class WireCluster:
+    """mini_apiserver presented through the EnvtestCluster surface, so
+    the shared suite seeds and asserts identically on both backends."""
+
+    def __init__(self):
+        self.srv = MiniApiServer(InMemoryKube(), require_token=TOKEN)
+        self.base_url = ""
+        self._session = None
+
+    def start(self) -> None:
+        self.base_url = self.srv.start()
+
+    def stop(self) -> None:
+        if self._session is not None:
+            self._session.close()
+        self.srv.stop()
+
+    def session(self):
+        import requests
+
+        if self._session is None:
+            self._session = requests.Session()
+            self._session.headers["Authorization"] = f"Bearer {TOKEN}"
+        return self._session
+
+    def post(self, path: str, body: dict, expect=(200, 201, 202)):
+        r = self.session().post(f"{self.base_url}{path}", json=body,
+                                timeout=10)
+        if r.status_code not in expect:
+            raise RuntimeError(f"POST {path}: {r.status_code} {r.text[:300]}")
+        return r
+
+    def get(self, path: str):
+        r = self.session().get(f"{self.base_url}{path}", timeout=10)
+        r.raise_for_status()
+        return r.json()
+
+    def make_restkube(self) -> RestKube:
+        return RestKube(base_url=self.base_url, token=TOKEN)
+
+    def apply_crd(self) -> None:
+        from tests.envtest_suite import apply_crd_and_wait
+
+        apply_crd_and_wait(self, CRD_PATH, poll_s=0.05)
+
+    def ensure_namespace(self, name: str) -> None:
+        self.post("/api/v1/namespaces",
+                  {"apiVersion": "v1", "kind": "Namespace",
+                   "metadata": {"name": name}},
+                  expect=(200, 201, 409))
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = WireCluster()
+    c.start()
+    c.apply_crd()
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def seeded(cluster):
+    from tests.envtest_suite import seed_cluster
+
+    return seed_cluster(cluster)
+
+
+# the shared bodies — verbatim the envtest tier's assertions
+from tests.envtest_suite import (  # noqa: E402,F401,WVL002
+    TestCRDValidation,
+    TestLeaseAgainstRealAPIServer,
+    TestReconcileAgainstRealAPIServer,
+)

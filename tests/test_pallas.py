@@ -214,7 +214,8 @@ class TestPallasEngineBackend:
             glob_mod, "glob",
             lambda pat, **kw: [p for p in trees.get(pat, [])])
         # no sysfs IOMMU info: fall back to the CUDA-signature carve-out
-        monkeypatch.setattr(plat, "_iommu_group_vendors", lambda: None)
+        monkeypatch.setattr(plat, "_iommu_group_vendors",
+                            lambda groups: None)
         trees = {"/dev/vfio/[0-9]*": ["/dev/vfio/0"]}
         assert plat.host_is_tpu()        # vfio group, no CUDA -> TPU
         trees = {"/dev/vfio/[0-9]*": ["/dev/vfio/0"],
@@ -228,8 +229,8 @@ class TestPallasEngineBackend:
         # PCI vendor distinguishes it from a TPU (review r5)
         trees = {"/dev/vfio/[0-9]*": ["/dev/vfio/0"]}
         monkeypatch.setattr(plat, "_iommu_group_vendors",
-                            lambda: {"0x10de"})   # passthrough-bound GPU
+                            lambda groups: {"0x10de"})  # passthrough GPU
         assert not plat.host_is_tpu()
         monkeypatch.setattr(plat, "_iommu_group_vendors",
-                            lambda: {"0x1ae0", "0x8086"})  # Google TPU
+                            lambda groups: {"0x1ae0"})     # Google TPU
         assert plat.host_is_tpu()

@@ -76,12 +76,16 @@ from workload_variant_autoscaler_tpu.controller.crd import (
     va_from_dict,
 )
 from workload_variant_autoscaler_tpu.controller.kube import (
+    ConfigMap,
     ConflictError,
     Deployment,
     InMemoryKube,
     InvalidError,
     NotFoundError,
     WatchEvent,
+)
+from workload_variant_autoscaler_tpu.controller.schema import (
+    validate_va_dict,
 )
 
 WATCH_RING = 2048   # retained events; older resourceVersions get 410
@@ -162,7 +166,42 @@ class MiniApiServer:
         self._stopping = threading.Event()
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # POSTed CRDs (name -> body) and namespaces: the facade serves
+        # the llmd.ai group natively, but registering the shipped CRD
+        # must round-trip the way envtest's apply_crd expects
+        self.crds: dict[str, dict] = {}
+        self.namespaces: set[str] = {"default"}
         kube.add_watch_listener(self._on_event)
+
+    def _crd_body(self, name: str) -> dict:
+        """Stored CRD + an immediately-Established status (registration
+        in this facade is synchronous, unlike a real apiserver's
+        asynchronous name acceptance)."""
+        body = dict(self.crds[name])
+        status = dict(body.get("status") or {})
+        status["conditions"] = [
+            {"type": "NamesAccepted", "status": "True",
+             "reason": "NoConflicts"},
+            {"type": "Established", "status": "True",
+             "reason": "InitialNamesAccepted"},
+        ]
+        body["status"] = status
+        return body
+
+    def _va_schema(self) -> Optional[dict]:
+        """openAPIV3Schema for VA admission: the POSTed CRD's storage
+        version when one was registered, else None (validate_va_dict
+        falls back to the shipped manifest)."""
+        for body in self.crds.values():
+            spec = body.get("spec") or {}
+            if (spec.get("group") == GROUP
+                    and (spec.get("names") or {}).get("plural") == PLURAL):
+                versions = spec.get("versions") or []
+                v = next((x for x in versions if x.get("storage")),
+                         versions[0] if versions else None)
+                if v:
+                    return (v.get("schema") or {}).get("openAPIV3Schema")
+        return None
 
     # -- lifecycle -------------------------------------------------------
 
@@ -320,6 +359,16 @@ _LEASE_ITEM = re.compile(
     r"^/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases/([^/]+)$")
 _TOKEN_REVIEW = "/apis/authentication.k8s.io/v1/tokenreviews"
 _ACCESS_REVIEW = "/apis/authorization.k8s.io/v1/subjectaccessreviews"
+# create endpoints (the envtest suite's seeding surface, so its test
+# bodies can run verbatim against this facade as a conformance backend)
+_NS_LIST = "/api/v1/namespaces"
+_VA_NS_LIST = re.compile(
+    rf"^/apis/{GROUP}/{VERSION}/namespaces/([^/]+)/{PLURAL}$")
+_DEPLOY_LIST = re.compile(
+    r"^/apis/apps/v1/namespaces/([^/]+)/deployments$")
+_CRD_LIST = "/apis/apiextensions.k8s.io/v1/customresourcedefinitions"
+_CRD_ITEM = re.compile(
+    r"^/apis/apiextensions\.k8s\.io/v1/customresourcedefinitions/([^/]+)$")
 
 
 def _make_handler(srv: MiniApiServer):
@@ -439,6 +488,11 @@ def _make_handler(srv: MiniApiServer):
                 if m:
                     lease = srv.kube.get_lease(m.group(2), m.group(1))
                     return self._json(200, srv._lease_body(lease))
+                m = _CRD_ITEM.match(path)
+                if m:
+                    if m.group(1) not in srv.crds:
+                        raise NotFoundError(f"crd {m.group(1)} not found")
+                    return self._json(200, srv._crd_body(m.group(1)))
                 return self._error(404, "NotFound",
                                    f"unknown path {path}")
 
@@ -459,6 +513,19 @@ def _make_handler(srv: MiniApiServer):
                 m = _LEASE_LIST.match(path)
                 if m:
                     return self._lease_post(m.group(1))
+                if path == _CRD_LIST:
+                    return self._crd_post()
+                if path == _NS_LIST:
+                    return self._ns_post()
+                m = _CM_LIST.match(path)
+                if m:
+                    return self._cm_post(m.group(1))
+                m = _DEPLOY_LIST.match(path)
+                if m:
+                    return self._deploy_post(m.group(1))
+                m = _VA_NS_LIST.match(path)
+                if m:
+                    return self._va_post(m.group(1))
                 return self._error(404, "NotFound", f"unknown path {path}")
 
             if method == "PATCH":
@@ -502,6 +569,93 @@ def _make_handler(srv: MiniApiServer):
             srv.kube.update_variant_autoscaling_status(va)
             stored = srv.kube.get_variant_autoscaling(name, ns)
             self._json(200, va_to_dict(stored))
+
+        # -- create endpoints (envtest-suite seeding surface) ------------
+
+        @staticmethod
+        def _body_name(body: Any) -> str:
+            if not isinstance(body, dict):
+                raise InvalidError("request body must be an object")
+            name = ((body.get("metadata") or {}).get("name") or "")
+            if not name:
+                raise InvalidError("metadata.name: Required value")
+            return name
+
+        def _crd_post(self) -> None:
+            body = self._read_body()
+            name = self._body_name(body)
+            if body.get("kind") != "CustomResourceDefinition":
+                raise InvalidError("body must be a CustomResourceDefinition")
+            if name in srv.crds:
+                raise ConflictError(f"crd {name} already exists")
+            srv.crds[name] = body
+            self._json(201, srv._crd_body(name))
+
+        def _ns_post(self) -> None:
+            name = self._body_name(self._read_body())
+            if name in srv.namespaces:
+                raise ConflictError(f"namespace {name} already exists")
+            srv.namespaces.add(name)
+            self._json(201, {"apiVersion": "v1", "kind": "Namespace",
+                             "metadata": {"name": name}})
+
+        def _cm_post(self, ns: str) -> None:
+            body = self._read_body()
+            name = self._body_name(body)
+            try:
+                srv.kube.get_configmap(name, ns)
+            except NotFoundError:
+                pass
+            else:
+                raise ConflictError(f"configmap {ns}/{name} already exists")
+            srv.kube.put_configmap(
+                ConfigMap(name, ns, dict(body.get("data") or {})))
+            cm = srv.kube.get_configmap(name, ns)
+            self._json(201, {
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": cm.name, "namespace": cm.namespace},
+                "data": dict(cm.data),
+            })
+
+        def _deploy_post(self, ns: str) -> None:
+            body = self._read_body()
+            name = self._body_name(body)
+            try:
+                srv.kube.get_deployment(name, ns)
+            except NotFoundError:
+                pass
+            else:
+                raise ConflictError(f"deployment {ns}/{name} already exists")
+            spec = body.get("spec") or {}
+            srv.kube.put_deployment(Deployment(
+                name=name, namespace=ns,
+                spec_replicas=int(spec.get("replicas", 1)),
+                labels=dict((body.get("metadata") or {})
+                            .get("labels") or {}),
+            ))
+            d = srv.kube.get_deployment(name, ns)
+            self._json(201, srv._deployment_body(d))
+
+        def _va_post(self, ns: str) -> None:
+            body = self._read_body()
+            name = self._body_name(body)
+            # CRD admission: structural-schema validation against the
+            # registered CRD (or the shipped manifest), the same gate a
+            # real apiserver applies before persisting
+            errors = validate_va_dict(body, schema=srv._va_schema())
+            if errors:
+                raise InvalidError("; ".join(errors))
+            try:
+                srv.kube.get_variant_autoscaling(name, ns)
+            except NotFoundError:
+                pass
+            else:
+                raise ConflictError(f"{PLURAL} {ns}/{name} already exists")
+            va = va_from_dict(body)
+            va.metadata.namespace = ns   # path wins, like the apiserver
+            srv.kube.put_variant_autoscaling(va)
+            stored = srv.kube.get_variant_autoscaling(name, ns)
+            self._json(201, va_to_dict(stored))
 
         def _va_patch(self, ns: str, name: str) -> None:
             ctype = (self.headers.get("Content-Type") or "").split(";")[0]

@@ -97,22 +97,30 @@ def host_is_tpu() -> bool:
     # "vfio and no CUDA signature" check when sysfs is unreadable
     # (ADVICE r4 + review: the carve-out must hold for vfio-passthrough
     # GPU hosts, not just hosts where the nvidia driver kept a device).
-    if not glob.glob("/dev/vfio/[0-9]*"):
+    groups = glob.glob("/dev/vfio/[0-9]*")
+    if not groups:
         return False
-    vendors = _iommu_group_vendors()
+    vendors = _iommu_group_vendors(
+        [g.rsplit("/", 1)[1] for g in groups])
     if vendors is not None:
         return "0x1ae0" in vendors
     return not glob.glob("/dev/nvidia[0-9]*")
 
 
-def _iommu_group_vendors() -> set[str] | None:
-    """PCI vendor ids (lowercase ``0x....``) of every device in every
-    IOMMU group, or None when sysfs doesn't expose them (no IOMMU, or a
-    restricted container). Lets the vfio TPU signature distinguish a
-    Google TPU (vendor 0x1ae0) from a vfio-passthrough GPU/NIC."""
+def _iommu_group_vendors(groups: list[str]) -> set[str] | None:
+    """PCI vendor ids (lowercase ``0x....``) of the devices in the GIVEN
+    IOMMU groups (the ones with /dev/vfio/<N> nodes, i.e. vfio-bound),
+    or None when sysfs doesn't expose them (no IOMMU, or a restricted
+    container). Scoping to the vfio-bound groups matters: every GCE VM
+    has OTHER Google-vendor (0x1ae0) paravirt devices — gVNIC, virtio —
+    so a fleet-wide vendor scan would classify any GCE GPU-passthrough
+    host as a TPU."""
     import glob
 
-    paths = glob.glob("/sys/kernel/iommu_groups/*/devices/*/vendor")
+    paths: list[str] = []
+    for g in groups:
+        paths.extend(
+            glob.glob(f"/sys/kernel/iommu_groups/{g}/devices/*/vendor"))
     if not paths:
         return None
     vendors: set[str] = set()
