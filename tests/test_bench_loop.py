@@ -226,3 +226,22 @@ def test_hetero_p95_mechanism_discriminates_on_mini_ramp():
             == bench_loop.SCENARIOS["hetero-fleet-p95"].variants)
     assert (bench_loop.SCENARIOS["multihost-70b"].variants
             == bench_loop.SCENARIOS["multihost-70b-p95"].variants)
+
+
+def test_fleet_scale_smoke():
+    """run_fleet_scale at toy sizes: the structure BASELINE.md's
+    controller-scalability row is generated from must keep working
+    (per-size p50/p95/per-VA figures, auto-selected backend label)."""
+    r = bench_loop.run_fleet_scale(sizes=(4, 8), cycles=2)
+    assert r["metric"] == "reconcile_wall_ms_p95"
+    assert r["scenario"] == "fleet-scale"
+    assert set(r["fleets"]) == {"4", "8"}
+    for n, row in r["fleets"].items():
+        assert row["p50_ms"] > 0
+        assert row["p95_ms"] >= row["p50_ms"]
+        # p50_ms is rounded to 0.1ms independently of the per-VA figure
+        assert row["p50_ms_per_va"] == pytest.approx(
+            row["p50_ms"] / int(n), abs=0.02)
+    assert r["value"] == r["fleets"]["8"]["p95_ms"]
+    # the only values engine_backend() can return
+    assert r["backend"] in ("native", "batched", "pallas")

@@ -101,3 +101,17 @@ def test_config45_full_slo_claims_match_baseline_json():
     fc = het["frontier_check"]["headroom_0.08"]
     assert fc["held"] is False
     assert f"{fc['chat_8b_p95_ttft_ms']} ms" in baseline_md
+
+
+def test_controller_scalability_claims_match_baseline_json():
+    """Round-5 fleet-scale artifact (VERDICT r4 next #5): the BASELINE.md
+    scalability table and README cite must equal the committed entries."""
+    pub = json.loads((REPO / "BASELINE.json").read_text())["published"]
+    sc = pub["controller_scalability"]
+    baseline_md = (REPO / "BASELINE.md").read_text()
+    readme = " ".join((REPO / "README.md").read_text().split())
+    for n, row in sc["fleets"].items():
+        assert f"{row['p50_ms']} / {row['p95_ms']} ms" in baseline_md, \
+            f"fleet-scale row {n} drifted from BASELINE.json"
+        assert f"{row['p50_ms_per_va']} ms" in baseline_md
+    assert f"{sc['fleets']['512']['p95_ms']} ms at 512 VAs" in readme
