@@ -115,3 +115,19 @@ def test_controller_scalability_claims_match_baseline_json():
             f"fleet-scale row {n} drifted from BASELINE.json"
         assert f"{row['p50_ms_per_va']} ms" in baseline_md
     assert f"{sc['fleets']['512']['p95_ms']} ms at 512 VAs" in readme
+
+
+def test_cpu_tail_settle_claims_match_artifact():
+    """Round-5 tail-path settle (VERDICT r4 next #6): the BASELINE.md
+    ratios must equal the committed BENCH_cpu_tail_r05.json, and the
+    artifact must actually justify the shipped default (native wins at
+    every measured size)."""
+    art = json.loads((REPO / "BENCH_cpu_tail_r05.json").read_text())
+    baseline_md = (REPO / "BASELINE.md").read_text()
+    assert set(art["sizes"]) == {"8", "64", "512", "4096"}
+    for n, row in art["sizes"].items():
+        assert row["native_over_xla"] > 1.0, \
+            f"size {n}: artifact no longer justifies the native default"
+        assert f"**{row['native_over_xla']}×**" in baseline_md, \
+            f"size {n} ratio drifted from the artifact"
+    assert "native" in art["decision"]
