@@ -118,6 +118,7 @@ def bench_tpu(c, iters: int = 100, n_runs: int = 5):
 _XLA_STAGE = r"""
 import json
 import os
+import time
 if os.environ.get("WVA_FORCE_CPU"):
     # hermetic CPU fallback: the env var alone loses to an ambient
     # sitecustomize that already imported jax (VERDICT r2 weak #1)
@@ -126,43 +127,59 @@ if os.environ.get("WVA_FORCE_CPU"):
 import jax
 from bench import (bench_tpu, bench_native_batch, bench_sequential,
                    build_candidates)
+from workload_variant_autoscaler_tpu.ops import native as _native
 platform = jax.devices()[0].platform
 c = build_candidates(4096)
-# the CPU fallback runs the same fleet-scale batch at ~1/100000th the
-# device rate; fewer timed iterations + runs keep its wall time inside
-# the fallback reserve (WVA_BENCH_FALLBACK_RESERVE_S) — the raw runs in
-# the artifact carry the reduced protocol honestly
 if os.environ.get("WVA_FORCE_CPU"):
-    rate, runs, tail_rate, tail_runs = bench_tpu(c, iters=3, n_runs=2)
-else:
-    rate, runs, tail_rate, tail_runs = bench_tpu(c)
-out = {"rate": rate, "runs": runs, "tail_rate": tail_rate,
-       "tail_runs": tail_runs, "platform": platform}
-if os.environ.get("WVA_FORCE_CPU"):
-    # On a CPU-only host the DEFAULT engine backend is the native batch
-    # kernel (translate.engine_backend auto-selection), not batched-XLA
-    # -- report what a default config actually runs, keeping the XLA
-    # rate as an auxiliary series. The sequential baseline is measured
-    # HERE, adjacent in time AND over the SAME candidate set, so
-    # vs_baseline compares the two under identical host load and cache
-    # footprint (a 256-candidate baseline minutes apart made the ratio
-    # flicker around 1; at equal B the batch wins ~1.4x on one core)
+    # The CPU fallback MUST land a usable headline inside its reserve
+    # even on a heavily contended host (a timed-out fallback records
+    # rate 0 — the round-4 failure in miniature). So: the headline
+    # series come FIRST — the native batch kernel (the DEFAULT engine
+    # backend on CPU-only hosts, translate.engine_backend) and the
+    # sequential baseline, measured adjacent in time over the SAME
+    # candidate set so vs_baseline compares under identical host load.
+    # The auxiliary batched-XLA-on-CPU series runs only with budget
+    # headroom: its two compiles alone can eat minutes under
+    # contention, and it must never cost the headline.
+    t0 = time.monotonic()
+    stage_budget = float(os.environ.get("WVA_STAGE_BUDGET_S", "1e9"))
+    out = {"platform": platform}
     nb = bench_native_batch(c, iters=5, n=2)
+    out["sequential_rate"] = bench_sequential(
+        c if _native.available() else build_candidates(256))
     if nb is not None:
         mean_runs, nb_tail_runs = nb
-        out.update({"xla_cpu_rate": rate, "xla_cpu_runs": runs,
-                    "xla_cpu_tail_rate": tail_rate,
-                    "rate": max(mean_runs), "runs": mean_runs,
+        out.update({"rate": max(mean_runs), "runs": mean_runs,
                     "tail_rate": max(nb_tail_runs),
                     "tail_runs": nb_tail_runs,
                     "backend": "native-batch (default on CPU-only hosts)"})
-from workload_variant_autoscaler_tpu.ops import native as _native
-# sequential baseline for BOTH paths, measured inside this stage so the
-# orchestrator's budget clipping covers it: full-set through the native
-# analyzer when a compiler is present; the numpy fallback would take
-# minutes at 4096 — subsample
-out["sequential_rate"] = bench_sequential(
-    c if _native.available() else build_candidates(256))
+        # the headline is DONE — print it now, so if the auxiliary
+        # series below overruns the subprocess timeout, the parent
+        # salvages this line from the partial stdout instead of losing
+        # the whole measurement (the parser takes the LAST line)
+        print(json.dumps(out), flush=True)
+    if nb is None or time.monotonic() - t0 < stage_budget * 0.4:
+        # fewer timed iterations + runs keep the reduced protocol's
+        # wall time bounded; the raw runs carry it honestly
+        rate, runs, tail_rate, tail_runs = bench_tpu(c, iters=3, n_runs=2)
+        if nb is None:
+            # no compiler on the host: batched-XLA-on-CPU IS the
+            # headline (and the sequential baseline above used the
+            # 256-candidate numpy subsample)
+            out.update({"rate": rate, "runs": runs,
+                        "tail_rate": tail_rate, "tail_runs": tail_runs,
+                        "backend": "batched-xla-cpu (no native compiler)"})
+        else:
+            out.update({"xla_cpu_rate": rate, "xla_cpu_runs": runs,
+                        "xla_cpu_tail_rate": tail_rate})
+else:
+    rate, runs, tail_rate, tail_runs = bench_tpu(c)
+    out = {"rate": rate, "runs": runs, "tail_rate": tail_rate,
+           "tail_runs": tail_runs, "platform": platform}
+    # sequential baseline measured inside the stage so the
+    # orchestrator's budget clipping covers it
+    out["sequential_rate"] = bench_sequential(
+        c if _native.available() else build_candidates(256))
 print(json.dumps(out))
 """
 
@@ -195,8 +212,18 @@ def _subproc(src: str, env, timeout_s: float) -> tuple[str, dict | str | None]:
                            capture_output=True, text=True,
                            timeout=max(1.0, timeout_s), env=env,
                            cwd=os.path.dirname(os.path.abspath(__file__)))
-    except subprocess.TimeoutExpired:
-        return "timeout", None
+    except subprocess.TimeoutExpired as e:
+        # a stage may print a complete headline line BEFORE an optional
+        # auxiliary phase (the CPU fallback does); salvage it from the
+        # partial stdout so an overrunning extra never costs the round
+        # the already-measured result
+        tail = e.stdout or ""
+        if isinstance(tail, bytes):
+            tail = tail.decode(errors="replace")
+        try:
+            return "timeout", json.loads(tail.strip().splitlines()[-1])
+        except (json.JSONDecodeError, IndexError):
+            return "timeout", None
     if r.returncode != 0:
         return "crash", (r.stderr or r.stdout).strip()[-400:]
     try:
@@ -337,14 +364,22 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
         cpu_env["JAX_PLATFORMS"] = "cpu"
         cpu_env["WVA_FORCE_CPU"] = "1"
         fb_budget = min(reserve, hard_deadline - monotonic())
+        # the stage sheds its auxiliary XLA-CPU series when the budget
+        # is tight — the headline must land inside the reserve even on
+        # a contended host
+        cpu_env["WVA_STAGE_BUDGET_S"] = str(fb_budget)
         if fb_budget < 20:
             attempts.append({"t_s": round(monotonic() - t_start),
                              "fallback": "skipped (no budget left)"})
             return
         kind, out = attempt(cpu_env, fb_budget)
+        if kind == "timeout" and isinstance(out, dict) and "rate" in out:
+            # the stage overran its reserve mid-auxiliary but had
+            # already printed the measured headline — salvaged
+            kind = "ok (headline salvaged at timeout)"
         attempts.append({"t_s": round(monotonic() - t_start),
                          "fallback": kind})
-        if kind == "ok":
+        if kind.startswith("ok"):
             fallback = out
             if on_partial is not None:
                 partial = dict(out)
@@ -388,6 +423,9 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
                                hard_deadline - now
                                - (0.0 if fallback_done else reserve))
             kind, out = attempt(dict(os.environ), stage_budget)
+            if (kind == "timeout" and isinstance(out, dict)
+                    and "rate" in out):
+                kind = "ok"  # complete line printed before the kill
             entry["stage"] = kind
             if kind == "ok":
                 attempts.append(entry)
@@ -756,10 +794,12 @@ def _compose(xla: dict, sequential_rate: float, pallas: dict,
         rec["pallas_e2e"] = pallas_e2e
     if "backend" in xla:
         # present on the CPU fallback: which backend the headline rate
-        # measured (the default for that platform), plus the auxiliary
-        # batched-XLA-on-CPU rate for comparison
+        # measured (the default for that platform)
         rec["backend"] = xla["backend"]
-        rec["xla_cpu_rate"] = round(xla.get("xla_cpu_rate", 0.0), 1)
+        if "xla_cpu_rate" in xla:
+            # the auxiliary batched-XLA-on-CPU series, when the budget
+            # allowed it — never fabricated as a zero when shed
+            rec["xla_cpu_rate"] = round(xla["xla_cpu_rate"], 1)
     return rec
 
 
