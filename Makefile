@@ -29,6 +29,7 @@ bench-loop: ## North-star closed-loop benchmark: chip-hours to hold p95-ITL SLO 
 
 .PHONY: bench-scenarios
 bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO headlines + mean ablations, tail stress, strict SLO)
+	$(PY) bench_loop.py whole-fleet-p95
 	$(PY) bench_loop.py multi-model-p95
 	$(PY) bench_loop.py multihost-70b-p95
 	$(PY) bench_loop.py hetero-fleet-p95

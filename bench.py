@@ -197,12 +197,33 @@ print(json.dumps({"platform": jax.devices()[0].platform}))
 """
 
 
+def _salvage_json(text) -> dict | None:
+    """LAST complete JSON object line in `text`, scanning in reverse —
+    a stage may print a finished headline line before an optional
+    auxiliary phase, and a kill can land mid-write of a later line."""
+    if isinstance(text, bytes):
+        text = text.decode(errors="replace")
+    for ln in reversed((text or "").strip().splitlines()):
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            return rec
+    return None
+
+
 def _subproc(src: str, env, timeout_s: float) -> tuple[str, dict | str | None]:
     """Run a python -c stage. Returns (kind, payload):
-    ("ok", parsed-json) | ("timeout", None) — the wedge signature —
-    | ("crash", stderr-tail) | ("garbled", stdout-tail). A fast nonzero
-    exit is a diagnosable failure, NOT a wedge: callers must not burn a
-    retry window on it."""
+    ("ok", parsed-json) |
+    ("ok-salvaged:timeout"/"ok-salvaged:crash", parsed-json) — the stage
+    died AFTER printing a complete record (the CPU fallback prints its
+    headline before the optional auxiliary series precisely so an
+    overrunning/crashing extra never costs the measured result) |
+    ("timeout", None) — the wedge signature, nothing printed |
+    ("crash", stderr-tail) | ("garbled", stdout-tail). A fast nonzero
+    exit with no record is a diagnosable failure, NOT a wedge: callers
+    must not burn a retry window on it."""
     import os
     import subprocess
     import sys
@@ -213,23 +234,17 @@ def _subproc(src: str, env, timeout_s: float) -> tuple[str, dict | str | None]:
                            timeout=max(1.0, timeout_s), env=env,
                            cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired as e:
-        # a stage may print a complete headline line BEFORE an optional
-        # auxiliary phase (the CPU fallback does); salvage it from the
-        # partial stdout so an overrunning extra never costs the round
-        # the already-measured result
-        tail = e.stdout or ""
-        if isinstance(tail, bytes):
-            tail = tail.decode(errors="replace")
-        try:
-            return "timeout", json.loads(tail.strip().splitlines()[-1])
-        except (json.JSONDecodeError, IndexError):
-            return "timeout", None
+        rec = _salvage_json(e.stdout)
+        return (("ok-salvaged:timeout", rec) if rec is not None
+                else ("timeout", None))
+    rec = _salvage_json(r.stdout)
     if r.returncode != 0:
+        if rec is not None:
+            return "ok-salvaged:crash", rec
         return "crash", (r.stderr or r.stdout).strip()[-400:]
-    try:
-        return "ok", json.loads(r.stdout.strip().splitlines()[-1])
-    except (json.JSONDecodeError, IndexError):
-        return "garbled", r.stdout.strip()[-400:]
+    if rec is not None:
+        return "ok", rec
+    return "garbled", r.stdout.strip()[-400:]
 
 
 def run_canary(timeout_s: float = 45.0) -> dict:
@@ -241,7 +256,7 @@ def run_canary(timeout_s: float = 45.0) -> dict:
     import os
 
     kind, out = _subproc(_CANARY, dict(os.environ), timeout_s)
-    if kind == "ok":
+    if kind.startswith("ok"):
         return {"status": "ok", "platform": out.get("platform", "unknown")}
     if kind == "timeout":
         return {"status": "wedged"}
@@ -373,13 +388,11 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
                              "fallback": "skipped (no budget left)"})
             return
         kind, out = attempt(cpu_env, fb_budget)
-        if kind == "timeout" and isinstance(out, dict) and "rate" in out:
-            # the stage overran its reserve mid-auxiliary but had
-            # already printed the measured headline — salvaged
-            kind = "ok (headline salvaged at timeout)"
         attempts.append({"t_s": round(monotonic() - t_start),
                          "fallback": kind})
         if kind.startswith("ok"):
+            # includes ok-salvaged:* — the stage died mid-auxiliary but
+            # had already printed the measured headline
             fallback = out
             if on_partial is not None:
                 partial = dict(out)
@@ -423,11 +436,9 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
                                hard_deadline - now
                                - (0.0 if fallback_done else reserve))
             kind, out = attempt(dict(os.environ), stage_budget)
-            if (kind == "timeout" and isinstance(out, dict)
-                    and "rate" in out):
-                kind = "ok"  # complete line printed before the kill
             entry["stage"] = kind
-            if kind == "ok":
+            if kind.startswith("ok") and isinstance(out, dict) \
+                    and "rate" in out:
                 attempts.append(entry)
                 out["attempts"] = attempts
                 return out

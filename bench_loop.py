@@ -335,7 +335,11 @@ def run(ramp=None, warmup_ms: float = WARMUP_MS,
 # ONE sim-time Prometheus — the same measurement contract, summed over a
 # heterogeneous fleet.
 
-from dataclasses import dataclass, field as _field  # noqa: E402
+from dataclasses import (  # noqa: E402
+    dataclass,
+    field as _field,
+    replace as _dc_replace,
+)
 
 from workload_variant_autoscaler_tpu.emulator import MultiPromAPI  # noqa: E402
 
@@ -780,6 +784,60 @@ SCENARIOS: dict[str, Scenario] = {
         accelerators=_HF_ACCELERATORS,
         service_classes=_HF_SERVICE_CLASSES,
         variants=[_CHAT_8B, _SUM_70B_V5P4],
+    ),
+    # CAPSTONE (round 5, beyond any single BASELINE config): ONE
+    # optimizer, ONE operator ConfigMap, FOUR variants spanning every
+    # slice topology the framework supports — single-chip v5e-1,
+    # 8-chip TP v5e-8, ATOMIC multi-host v5e-16, and a v5p-4
+    # generation — all under the full-SLO guarantee (percentile sizing
+    # + 5s breakout probe per variant): EIGHT p95 tails held in one
+    # reconcile loop. The reference cannot express any part of this
+    # (mean-only sizing, fixed cadence, no slice topology model).
+    # Distinct model ids per variant: the sim Prometheus keys series by
+    # model, and these are four separate deployments with their own
+    # fitted profiles.
+    "whole-fleet-p95": Scenario(
+        key="whole-fleet-p95",
+        title="4 slice topologies, one optimizer, ALL EIGHT p95 tails held",
+        accelerators={
+            "v5e-1": {"chip": "v5e", "chips": "1", "cost": "20.0"},
+            "v5e-8": {"chip": "v5e", "chips": "8", "cost": "160.0"},
+            "v5e-16": {"chip": "v5e", "chips": "16", "cost": "320.0"},
+            "v5p-4": {"chip": "v5p", "chips": "4", "cost": "180.0"},
+        },
+        service_classes={
+            "premium": _PREMIUM_YAML,
+            "freemium": (
+                "name: Freemium\npriority: 10\ndata:\n"
+                "  - model: llama-70b-chat\n    slo-tpot: 200\n"
+                "    slo-ttft: 4000\n"
+                "  - model: llama-70b-long\n    slo-tpot: 200\n"
+                "    slo-ttft: 4000\n"
+                "  - model: llama-70b-sum\n    slo-tpot: 200\n"
+                "    slo-ttft: 4000\n"
+            ),
+        },
+        # shared per-config variant definitions under distinct model ids
+        # (the sim Prometheus keys series by model; these are four
+        # separate deployments with the same fitted physics)
+        variants=[
+            _CHAT_8B,
+            _dc_replace(_CHAT_70B_V5E8, name="chat-70b",
+                        model="llama-70b-chat",
+                        cfg=_dc_replace(_CFG_70B_V5E8,
+                                        model_name="llama-70b-chat")),
+            _dc_replace(_CHAT_70B_V5E16, name="long-70b",
+                        model="llama-70b-long",
+                        cfg=_dc_replace(_CFG_70B_V5E16,
+                                        model_name="llama-70b-long")),
+            _dc_replace(_SUM_70B_V5P4, name="sum-70b",
+                        model="llama-70b-sum",
+                        cfg=_dc_replace(_CFG_70B_V5P4,
+                                        model_name="llama-70b-sum")),
+        ],
+        operator_extra=_FULL_SLO_KNOBS,
+        judge_ttft=True,
+        fast_probe_ms=5_000.0,
     ),
     # config 5 under the FULL-SLO guarantee: all four tails (8B Premium
     # TTFT+ITL, 70B Freemium TTFT+ITL) held across heterogeneous chip

@@ -245,3 +245,29 @@ def test_fleet_scale_smoke():
     assert r["value"] == r["fleets"]["8"]["p95_ms"]
     # the only values engine_backend() can return
     assert r["backend"] in ("native", "batched", "pallas")
+
+
+def test_whole_fleet_capstone_structure():
+    """The capstone's contract: four distinct slice topologies, four
+    DISTINCT model ids (the sim Prometheus keys series by model — two
+    variants sharing an id would read each other's demand), physics
+    inherited from the shared per-config definitions, full-SLO knobs."""
+    sc = bench_loop.SCENARIOS["whole-fleet-p95"]
+    assert [v.accelerator for v in sc.variants] == [
+        "v5e-1", "v5e-8", "v5e-16", "v5p-4"]
+    models = [v.model for v in sc.variants]
+    assert len(set(models)) == 4
+    assert [v.chips_per_replica for v in sc.variants] == [1, 8, 16, 4]
+    # physics provenance: same fitted coefficients as the per-config
+    # scenarios, only the model id differs
+    for v, base in zip(sc.variants[1:],
+                       (bench_loop._CFG_70B_V5E8, bench_loop._CFG_70B_V5E16,
+                        bench_loop._CFG_70B_V5P4)):
+        for f in ("alpha", "beta", "gamma", "delta", "max_batch_size"):
+            assert getattr(v.cfg, f) == getattr(base, f), (v.name, f)
+        assert v.cfg.model_name == v.model
+    assert sc.judge_ttft and sc.fast_probe_ms == 5_000.0
+    assert sc.operator_extra == bench_loop._FULL_SLO_KNOBS
+    # every 70B model id has its own SLO row in the freemium class map
+    for m in models[1:]:
+        assert f"- model: {m}\n" in sc.service_classes["freemium"]

@@ -251,15 +251,28 @@ class TestWallTimeBound:
         def attempt(env, budget_s):
             if env.get("WVA_FORCE_CPU"):
                 clock.t += budget_s
-                return "timeout", dict(FALLBACK)   # salvaged last line
+                # _subproc's salvage contract: the stage printed its
+                # headline before the overrunning auxiliary was killed
+                return "ok-salvaged:timeout", dict(FALLBACK)
             raise AssertionError("TPU stage must not run while wedged")
 
         out = run(clock, make_env(clock, ["wedged"], attempt), attempt)
         assert out["rate"] == 5000.0
         assert out["platform"].startswith("cpu-fallback")
-        assert any(str(a.get("fallback", "")).startswith("ok (headline")
+        assert any(a.get("fallback") == "ok-salvaged:timeout"
                    for a in out["attempts"])
         assert clock.t <= WINDOW + RESERVE
+
+    def test_subproc_salvage_scans_reverse_for_complete_line(self):
+        # the kill can land mid-write of a LATER line: the last COMPLETE
+        # JSON object wins, truncated fragments are skipped
+        rec = bench._salvage_json(
+            '{"rate": 5000.0, "runs": [5000.0]}\n{"rate": 61')
+        assert rec == {"rate": 5000.0, "runs": [5000.0]}
+        assert bench._salvage_json("") is None
+        assert bench._salvage_json("Traceback ...\nValueError: x") is None
+        # bytes input (TimeoutExpired.stdout can be bytes)
+        assert bench._salvage_json(b'{"a": 1}\ngarbage') == {"a": 1}
 
     def test_compose_never_fabricates_shed_xla_series(self):
         # budget-shed auxiliary: no xla_cpu_rate key in the stage output

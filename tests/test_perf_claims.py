@@ -131,3 +131,21 @@ def test_cpu_tail_settle_claims_match_artifact():
         assert f"**{row['native_over_xla']}×**" in baseline_md, \
             f"size {n} ratio drifted from the artifact"
     assert "native" in art["decision"]
+
+
+def test_capstone_claims_match_baseline_json():
+    """Round-5 whole-fleet capstone: every quoted tail and the headline
+    must equal the committed BASELINE.json entry, and the entry itself
+    must describe a fully-held SLO set (all eight tails inside SLO)."""
+    pub = json.loads((REPO / "BASELINE.json").read_text())["published"]
+    cap = pub["capstone_whole_fleet"]
+    baseline_md = (REPO / "BASELINE.md").read_text()
+    assert f"**{cap['chip_hours']}**" in baseline_md
+    assert len(cap["variants"]) == 4
+    topologies = {v["accelerator"] for v in cap["variants"].values()}
+    assert topologies == {"v5e-1", "v5e-8", "v5e-16", "v5p-4"}
+    for name, v in cap["variants"].items():
+        assert v["p95_ttft_ms"] <= v["slo_ttft_ms"], name
+        assert v["p95_itl_ms"] <= v["slo_itl_ms"], name
+        assert f"{v['p95_ttft_ms']} / " in baseline_md, \
+            f"capstone variant {name} TTFT drifted"
