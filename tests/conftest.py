@@ -28,6 +28,13 @@ jax.config.update("jax_enable_x64", True)
 
 
 def pytest_configure(config):
+    # mirrored in pyproject.toml [tool.pytest.ini_options]; registered
+    # here too so running pytest from another rootdir stays warning-free
     config.addinivalue_line(
         "markers", "slow: multi-process / wall-clock-paced e2e tests"
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: scripted fault-injection / degradation-ladder scenarios "
+        "(deterministic, runs in tier-1)",
     )

@@ -1,0 +1,225 @@
+"""utils/backoff.py: retry ladder, deadline budget, circuit breaker.
+
+The hardening primitives every dependency call in the reconcile cycle
+runs through (docs/robustness.md). All clocks/sleeps/rngs are injected —
+nothing here touches wall time.
+"""
+
+import random
+
+import pytest
+
+from workload_variant_autoscaler_tpu.utils import (
+    Backoff,
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceeded,
+    TerminalError,
+    with_backoff,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestWithBackoff:
+    def test_returns_first_success(self):
+        sleeps = []
+        assert with_backoff(lambda: 42, sleep=sleeps.append) == 42
+        assert sleeps == []
+
+    def test_terminal_error_short_circuits(self):
+        """TerminalError must propagate on the FIRST attempt — retrying a
+        NotFound just multiplies latency on a verdict that cannot
+        change."""
+        calls = []
+
+        def op():
+            calls.append(1)
+            raise TerminalError("404")
+
+        with pytest.raises(TerminalError):
+            with_backoff(op, sleep=lambda _s: None)
+        assert len(calls) == 1
+
+    def test_transients_retried_then_last_error_raised(self):
+        calls = []
+
+        def op():
+            calls.append(1)
+            raise RuntimeError(f"boom {len(calls)}")
+
+        b = Backoff(duration=1.0, factor=2.0, steps=4)
+        sleeps = []
+        with pytest.raises(RuntimeError, match="boom 4"):
+            with_backoff(op, backoff=b, sleep=sleeps.append)
+        assert len(calls) == 4
+        assert sleeps == [1.0, 2.0, 4.0]  # no sleep after the last attempt
+
+    def test_jitter_stays_within_bounds(self):
+        """Jittered sleeps land in [delay, delay*(1+jitter)) — never
+        below the base (which would hot-loop) and never above the bound
+        (which would blow the deadline math)."""
+        b = Backoff(duration=1.0, factor=2.0, jitter=0.5, steps=6)
+        sleeps = []
+
+        def op():
+            raise RuntimeError("transient")
+
+        with pytest.raises(RuntimeError):
+            with_backoff(op, backoff=b, sleep=sleeps.append,
+                         rng=random.Random(7))
+        assert len(sleeps) == 5
+        expected_base = [1.0, 2.0, 4.0, 8.0, 16.0]
+        for base, actual in zip(expected_base, sleeps):
+            assert base <= actual < base * 1.5, (base, actual)
+
+    def test_jitter_is_deterministic_with_seeded_rng(self):
+        def run():
+            sleeps = []
+            try:
+                with_backoff(lambda: 1 / 0,
+                             backoff=Backoff(duration=0.1, jitter=0.3,
+                                             steps=4),
+                             sleep=sleeps.append, rng=random.Random(11))
+            except ZeroDivisionError:
+                pass
+            return sleeps
+
+        assert run() == run()
+
+    def test_deadline_exhaustion_raises_rather_than_spins(self):
+        """When the remaining budget cannot cover the next sleep the
+        ladder must raise DeadlineExceeded (chained to the real error)
+        immediately — not sleep through the budget and keep going."""
+        clock = FakeClock()
+        deadline = Deadline(10.0, clock=clock)
+
+        def sleep(d):
+            clock.advance(d)
+
+        def op():
+            clock.advance(3.0)  # each attempt costs 3s of 'transport'
+            raise RuntimeError("prom down")
+
+        with pytest.raises(DeadlineExceeded) as ei:
+            with_backoff(op, backoff=Backoff(duration=4.0, steps=10),
+                         sleep=sleep, deadline=deadline)
+        assert isinstance(ei.value.__cause__, RuntimeError)
+        # attempt(3s) + sleep(4s) + attempt(3s) = 10s: budget gone before
+        # the second sleep — exactly two attempts, no spin
+        assert clock.t == pytest.approx(10.0)
+
+    def test_expired_deadline_blocks_even_the_first_attempt(self):
+        clock = FakeClock()
+        deadline = Deadline(5.0, clock=clock)
+        clock.advance(6.0)
+        calls = []
+        with pytest.raises(DeadlineExceeded):
+            with_backoff(lambda: calls.append(1),
+                         sleep=lambda _s: None, deadline=deadline)
+        assert calls == []
+
+    def test_unlimited_deadline_never_trips(self):
+        deadline = Deadline.unlimited()
+        assert not deadline.expired()
+        assert with_backoff(lambda: "ok", deadline=deadline,
+                            sleep=lambda _s: None) == "ok"
+
+
+class TestCircuitBreaker:
+    def make(self, threshold=3, reset=30.0):
+        clock = FakeClock()
+        return CircuitBreaker("dep", failure_threshold=threshold,
+                              reset_after_s=reset, clock=clock), clock
+
+    def boom(self):
+        raise RuntimeError("down")
+
+    def test_opens_after_consecutive_failures_then_fails_fast(self):
+        br, _clock = self.make(threshold=3)
+        for _ in range(3):
+            with pytest.raises(RuntimeError):
+                br.call(self.boom)
+        assert br.state == CircuitBreaker.OPEN
+        # while open: the dependency is NOT called
+        calls = []
+        with pytest.raises(CircuitOpenError) as ei:
+            br.call(lambda: calls.append(1))
+        assert calls == []
+        assert ei.value.dependency == "dep"
+        assert ei.value.retry_in_s > 0
+
+    def test_success_resets_the_consecutive_count(self):
+        br, _clock = self.make(threshold=3)
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                br.call(self.boom)
+        assert br.call(lambda: "ok") == "ok"
+        # two more failures: still below threshold thanks to the reset
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                br.call(self.boom)
+        assert br.state == CircuitBreaker.CLOSED
+
+    def test_half_open_probe_success_closes(self):
+        br, clock = self.make(threshold=2, reset=30.0)
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                br.call(self.boom)
+        assert br.state == CircuitBreaker.OPEN
+        clock.advance(31.0)
+        assert br.call(lambda: "recovered") == "recovered"
+        assert br.state == CircuitBreaker.CLOSED
+        assert br.consecutive_failures == 0
+
+    def test_half_open_probe_failure_reopens_for_a_full_cooldown(self):
+        br, clock = self.make(threshold=2, reset=30.0)
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                br.call(self.boom)
+        clock.advance(31.0)
+        with pytest.raises(RuntimeError):
+            br.call(self.boom)  # the half-open probe fails
+        assert br.state == CircuitBreaker.OPEN
+        # the cooldown restarted at the probe, not at the original open
+        clock.advance(29.0)
+        with pytest.raises(CircuitOpenError):
+            br.call(lambda: "nope")
+        clock.advance(2.0)
+        assert br.call(lambda: "ok") == "ok"
+
+    def test_terminal_error_does_not_trip_the_breaker(self):
+        """A NotFound is the dependency ANSWERING — it must propagate
+        untouched and count as availability success."""
+        br, _clock = self.make(threshold=1)
+
+        def terminal():
+            raise TerminalError("404")
+
+        with pytest.raises(TerminalError):
+            br.call(terminal)
+        assert br.state == CircuitBreaker.CLOSED
+        assert br.consecutive_failures == 0
+
+    def test_state_codes_for_the_gauge(self):
+        br, clock = self.make(threshold=1, reset=30.0)
+        assert br.state_code() == 0  # closed
+        with pytest.raises(RuntimeError):
+            br.call(self.boom)
+        assert br.state_code() == 2  # open
+        clock.advance(31.0)
+        assert br.state_code() == 1  # cooldown elapsed: half-open
+
+    def test_threshold_must_be_positive(self):
+        with pytest.raises(ValueError):
+            CircuitBreaker("dep", failure_threshold=0)

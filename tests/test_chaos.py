@@ -1,0 +1,601 @@
+"""Scripted FaultPlan scenarios end-to-end: the degradation ladder under
+every fault in the matrix (docs/robustness.md).
+
+Each scenario drives the REAL reconciler through a scheduled dependency
+failure and asserts the documented landing:
+
+- the variant/cycle ends on its documented degradation-ladder rung,
+- zero scale-to-zero actuations on stale/absent metrics,
+- per-cycle replica deltas stay inside the configured step bound,
+- the whole run is deterministic across reruns (seeded FaultPlans,
+  injected clocks, no wall-clock randomness) — every scenario builds a
+  plain summary structure and is executed twice.
+
+The suite is `chaos`-marked but deliberately inside the tier-1
+`not slow` selection (pyproject.toml): robustness regressions fail the
+default gate.
+"""
+
+import json
+
+import pytest
+
+from test_scenarios import (
+    NS,
+    PROFILE_8B_V5E1,
+    SERVICE_CLASS_YAML,
+    SLICE_COSTS,
+    make_va,
+    set_load,
+)
+
+from workload_variant_autoscaler_tpu.collector import FakePromAPI
+from workload_variant_autoscaler_tpu.controller import (
+    ACCELERATOR_CM_NAME,
+    CONFIG_MAP_NAME,
+    CONFIG_MAP_NAMESPACE,
+    SERVICE_CLASS_CM_NAME,
+    ConfigMap,
+    Deployment,
+    InMemoryKube,
+    Reconciler,
+    crd,
+)
+from workload_variant_autoscaler_tpu.controller.degradation import (
+    DegradationState,
+)
+from workload_variant_autoscaler_tpu.faults import (
+    KUBE_CONFLICT,
+    KUBE_NOT_FOUND,
+    PROM_CLOCK_SKEW,
+    PROM_NAN,
+    PROM_PARTIAL,
+    PROM_TIMEOUT,
+    WATCH_DROP,
+    FaultPlan,
+    FaultRule,
+    FaultyPromAPI,
+)
+from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
+
+pytestmark = pytest.mark.chaos
+
+MODEL = "llama-8b"
+VARIANT = "chat-8b"
+FULL = f"{VARIANT}:{NS}"
+
+# every scenario runs under a configured actuation step bound, so the
+# "deltas within the bound" acceptance holds under faults, not just in
+# the dedicated ramp test
+STEP_BOUND = 3
+
+
+def make_chaos_cluster(plan, replicas=2, operator_extra=None):
+    """One-variant cluster on an injected clock, with the plan attached
+    to BOTH dependencies (kube verbs + watch via attach_fault_plan,
+    Prometheus via FaultyPromAPI)."""
+    clock = {"t": 0.0}
+
+    def now():
+        return clock["t"]
+
+    kube = InMemoryKube()
+    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE, {
+        "GLOBAL_OPT_INTERVAL": "30s",
+        "WVA_MAX_REPLICA_STEP": str(STEP_BOUND),
+        **(operator_extra or {}),
+    }))
+    kube.put_configmap(ConfigMap(
+        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {k: json.dumps(v) for k, v in SLICE_COSTS.items()},
+    ))
+    kube.put_configmap(ConfigMap(SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+                                 dict(SERVICE_CLASS_YAML)))
+    kube.put_deployment(Deployment(name=VARIANT, namespace=NS,
+                                   spec_replicas=replicas,
+                                   status_replicas=replicas))
+    kube.put_variant_autoscaling(
+        make_va(VARIANT, MODEL, "v5e-1", "premium", [PROFILE_8B_V5E1]))
+    kube.attach_fault_plan(plan)
+    prom = FakePromAPI(now=now)
+    emitter = MetricsEmitter()
+    rec = Reconciler(kube=kube, prom=FaultyPromAPI(prom, plan),
+                     emitter=emitter, now=now, sleep=lambda _s: None)
+    return kube, prom, emitter, rec, clock
+
+
+def desired(kube):
+    va = kube.get_variant_autoscaling(VARIANT, NS)
+    return va.status.desired_optimized_alloc.num_replicas
+
+
+def run_cycle(rec, plan, clock, prom, rps=20.0, dt=30.0):
+    """One reconcile cycle: advance the clock, refresh the underlying
+    scrape (fresh timestamps — faults decide what the controller SEES),
+    advance the plan's cycle axis. Returns the ReconcileResult or the
+    exception the cycle died with."""
+    clock["t"] += dt
+    set_load(prom, MODEL, rps, 128.0, 128.0)
+    plan.begin_cycle()
+    try:
+        return rec.reconcile()
+    except Exception as e:  # noqa: BLE001 — run_forever's catch, inline
+        return e
+
+
+def cycle_summary(kube, emitter, rec_result):
+    """Plain comparable snapshot of one cycle, for rerun determinism."""
+    if isinstance(rec_result, Exception):
+        outcome = {"raised": type(rec_result).__name__}
+    else:
+        outcome = {"processed": sorted(rec_result.processed),
+                   "skipped": dict(rec_result.skipped),
+                   "degraded": dict(rec_result.degraded)}
+    return {
+        **outcome,
+        "desired": desired(kube),
+        "variant_rung": emitter.value("inferno_degradation_state",
+                                      variant_name=VARIANT, namespace=NS),
+        "cycle_rung": emitter.value("inferno_cycle_degradation_state"),
+    }
+
+
+def assert_deterministic(scenario):
+    """Run the scenario twice from scratch; byte-identical summaries."""
+    first, second = scenario(), scenario()
+    assert first == second, "chaos scenario not deterministic across reruns"
+    return first
+
+
+def assert_step_bound(summaries, bound=STEP_BOUND):
+    """Published replica deltas stay inside the configured step bound
+    (from the first publish on)."""
+    published = [s["desired"] for s in summaries if s["desired"] > 0]
+    for prev, cur in zip(published, published[1:]):
+        assert abs(cur - prev) <= bound, (prev, cur)
+
+
+def assert_never_scaled_to_zero(summaries):
+    """Once published, the desired count never hits zero in any
+    scenario here (none presents live zero-demand evidence)."""
+    seen_publish = False
+    for s in summaries:
+        if s["desired"] > 0:
+            seen_publish = True
+        elif seen_publish:
+            raise AssertionError(f"scale-to-zero actuation: {s}")
+
+
+class TestPromOutage:
+    """Total Prometheus outage (timeouts) mid-run: healthy -> stale-cache
+    -> recovery, with the circuit breaker bounding the badput."""
+
+    def scenario(self):
+        plan = FaultPlan([
+            FaultRule(kind=PROM_TIMEOUT, after_cycle=3, until_cycle=7),
+        ], seed=1)
+        kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        out = []
+        for _ in range(10):
+            r = run_cycle(rec, plan, clock, prom, rps=20.0)
+            out.append(cycle_summary(kube, emitter, r))
+            out[-1]["circuit"] = emitter.value("inferno_circuit_state",
+                                               dependency="prometheus")
+        return out
+
+    def test_outage_rides_the_cache_then_recovers(self):
+        out = assert_deterministic(self.scenario)
+        assert_never_scaled_to_zero(out)
+        assert_step_bound(out)
+
+        healthy = out[1]
+        assert healthy["desired"] > 0
+        assert healthy["degraded"] == {}
+        assert healthy["variant_rung"] == int(DegradationState.HEALTHY)
+
+        # outage cycles (3-6) + the breaker's cooldown shadow: sized on
+        # the last-known-good cache, allocation held, rung exported
+        for s in out[2:6]:
+            assert s["degraded"].get(FULL) == "stale-cache"
+            assert s["processed"] == [FULL]          # still sized!
+            assert s["desired"] == healthy["desired"]
+            assert s["variant_rung"] == int(DegradationState.STALE_CACHE)
+            assert s["cycle_rung"] == int(DegradationState.STALE_CACHE)
+
+        # the breaker opened at some point during the outage (fail-fast
+        # instead of per-call backoff ladders)
+        assert any(s["circuit"] == 2 for s in out[2:7])
+
+        # fully recovered by the end: healthy rung, fresh condition
+        assert out[-1]["degraded"] == {}
+        assert out[-1]["variant_rung"] == int(DegradationState.HEALTHY)
+        assert out[-1]["circuit"] == 0
+
+    def test_outage_keeps_the_cr_condition_false(self):
+        plan = FaultPlan([FaultRule(kind=PROM_TIMEOUT, after_cycle=2)])
+        kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        run_cycle(rec, plan, clock, prom)
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert crd.is_condition_true(va, crd.TYPE_METRICS_AVAILABLE)
+        run_cycle(rec, plan, clock, prom)
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        # sized from cache, but the outage stays visible on the CR
+        assert crd.is_condition_false(va, crd.TYPE_METRICS_AVAILABLE)
+        cond = crd.get_condition(va, crd.TYPE_METRICS_AVAILABLE)
+        assert cond.reason == crd.REASON_PROMETHEUS_ERROR
+        assert desired(kube) > 0
+
+    def test_cache_expiry_degrades_to_hold(self):
+        """When the outage outlives the cache, the ladder steps down to
+        HOLD: the published allocation freezes, nothing actuates."""
+        plan = FaultPlan([FaultRule(kind=PROM_TIMEOUT, after_cycle=2)])
+        kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        run_cycle(rec, plan, clock, prom)               # healthy, cache warm
+        held = desired(kube)
+        assert held > 0
+        r = run_cycle(rec, plan, clock, prom)           # outage: stale-cache
+        assert r.degraded[FULL] == "stale-cache"
+        r = run_cycle(rec, plan, clock, prom, dt=2000.0)  # cache expired
+        assert r.degraded[FULL] == "hold"
+        assert r.skipped[FULL] == crd.REASON_PROMETHEUS_ERROR
+        assert desired(kube) == held                     # frozen, not zero
+        assert emitter.value("inferno_degradation_state",
+                             variant_name=VARIANT,
+                             namespace=NS) == int(DegradationState.HOLD)
+
+
+class TestPartialMetrics:
+    """The scrape drops the generation-tokens series while arrivals and
+    completions keep flowing: MetricsIncomplete, never a zero-fill."""
+
+    PLAN = [FaultRule(kind=PROM_PARTIAL, match="request_generation_tokens",
+                      after_cycle=2)]
+
+    def scenario(self):
+        plan = FaultPlan(list(self.PLAN), seed=2)
+        kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        return [cycle_summary(kube, emitter,
+                              run_cycle(rec, plan, clock, prom, rps=20.0))
+                for _ in range(4)]
+
+    def test_partial_scrape_rides_the_cache(self):
+        out = assert_deterministic(self.scenario)
+        assert_never_scaled_to_zero(out)
+        assert_step_bound(out)
+        healthy = out[0]
+        assert healthy["desired"] > 0
+        for s in out[1:]:
+            assert s["degraded"].get(FULL) == "stale-cache"
+            assert s["desired"] == healthy["desired"]
+            assert s["variant_rung"] == int(DegradationState.STALE_CACHE)
+
+    def test_cold_start_partial_scrape_holds(self):
+        """No healthy cycle ever ran (empty cache): the variant HOLDs —
+        skipped with MetricsIncomplete on the CR, zero actuations."""
+        plan = FaultPlan([FaultRule(kind=PROM_PARTIAL,
+                                    match="request_generation_tokens")])
+        kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        r = run_cycle(rec, plan, clock, prom)
+        assert r.skipped[FULL] == crd.REASON_METRICS_INCOMPLETE
+        assert r.degraded[FULL] == "hold"
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert crd.is_condition_false(va, crd.TYPE_METRICS_AVAILABLE)
+        # nothing was ever published or actuated
+        assert desired(kube) == 0
+        assert emitter.value("inferno_desired_replicas",
+                             variant_name=VARIANT) is None
+
+
+class TestNaNSamples:
+    """Every query answers NaN (0/0 windows during a scrape break):
+    unknown must never read as zero demand."""
+
+    def scenario(self):
+        plan = FaultPlan([FaultRule(kind=PROM_NAN, after_cycle=2)], seed=3)
+        kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        return [cycle_summary(kube, emitter,
+                              run_cycle(rec, plan, clock, prom, rps=20.0))
+                for _ in range(4)]
+
+    def test_nan_storm_is_unknown_not_idle(self):
+        out = assert_deterministic(self.scenario)
+        assert_never_scaled_to_zero(out)
+        assert_step_bound(out)
+        healthy = out[0]
+        assert healthy["desired"] > 0
+        for s in out[1:]:
+            # a NaN'd demand series parses as UNKNOWN -> incomplete ->
+            # stale cache; the zero-fill teardown (desired collapsing to
+            # the idle floor) must not happen
+            assert s["degraded"].get(FULL) == "stale-cache"
+            assert s["desired"] == healthy["desired"]
+
+
+class TestClockSkew:
+    """The scrape pipeline lags: sample timestamps slide past the
+    staleness limit and the gate must refuse them."""
+
+    def scenario(self):
+        plan = FaultPlan([
+            FaultRule(kind=PROM_CLOCK_SKEW, skew_s=400.0, after_cycle=2),
+        ], seed=4)
+        kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        return [cycle_summary(kube, emitter,
+                              run_cycle(rec, plan, clock, prom, rps=20.0))
+                for _ in range(4)]
+
+    def test_skewed_scrape_reads_as_stale(self):
+        out = assert_deterministic(self.scenario)
+        assert_never_scaled_to_zero(out)
+        healthy = out[0]
+        assert healthy["desired"] > 0
+        for s in out[1:]:
+            assert s["degraded"].get(FULL) == "stale-cache"
+            assert s["desired"] == healthy["desired"]
+
+    def test_skew_sets_the_stale_reason(self):
+        plan = FaultPlan([
+            FaultRule(kind=PROM_CLOCK_SKEW, skew_s=400.0, after_cycle=2),
+        ])
+        kube, prom, _e, rec, clock = make_chaos_cluster(plan)
+        run_cycle(rec, plan, clock, prom)
+        run_cycle(rec, plan, clock, prom)
+        cond = crd.get_condition(kube.get_variant_autoscaling(VARIANT, NS),
+                                 crd.TYPE_METRICS_AVAILABLE)
+        assert cond.status == "False"
+        assert cond.reason == crd.REASON_METRICS_STALE
+
+
+class TestKubeConflictStorm:
+    """409 storms on status writes: the conflict-retry path (RV refresh +
+    backoff) absorbs a lossy storm; a total storm never breaks the
+    scaling-signal path."""
+
+    def scenario(self):
+        plan = FaultPlan([
+            FaultRule(kind=KUBE_CONFLICT,
+                      match="update_status:VariantAutoscaling",
+                      probability=0.7, after_cycle=2, until_cycle=5),
+        ], seed=5)
+        kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        out = []
+        for _ in range(6):
+            r = run_cycle(rec, plan, clock, prom, rps=20.0)
+            s = cycle_summary(kube, emitter, r)
+            s["emitted_desired"] = emitter.value("inferno_desired_replicas",
+                                                 variant_name=VARIANT)
+            out.append(s)
+        return out
+
+    def test_lossy_storm_converges_deterministically(self):
+        out = assert_deterministic(self.scenario)
+        assert_never_scaled_to_zero(out)
+        assert_step_bound(out)
+        for s in out:
+            # the cycle always completes and always emits the scaling
+            # signal — HPA/KEDA actuation is never starved by CR-write
+            # contention
+            assert s["processed"] == [FULL]
+            assert s["emitted_desired"] is not None \
+                and s["emitted_desired"] > 0
+        # after the storm window the CR is caught up with the signal
+        assert out[-1]["desired"] == out[-1]["emitted_desired"]
+
+    def test_total_storm_still_emits_signals(self):
+        plan = FaultPlan([
+            FaultRule(kind=KUBE_CONFLICT,
+                      match="update_status:VariantAutoscaling",
+                      after_cycle=2),
+        ])
+        kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        run_cycle(rec, plan, clock, prom)
+        published = desired(kube)
+        assert published > 0
+        r = run_cycle(rec, plan, clock, prom)
+        assert not isinstance(r, Exception)
+        assert r.processed == [FULL]
+        # the CR write lost every retry, so status still shows the last
+        # successful publish — but the metric pipeline emitted
+        assert desired(kube) == published
+        assert emitter.value("inferno_desired_replicas",
+                             variant_name=VARIANT) > 0
+
+
+class TestWatchDrop:
+    """A dropped watch stream loses events, never actuations: the
+    level-triggered cadence cycle picks up whatever the watch missed."""
+
+    def test_cadence_covers_dropped_events(self):
+        import threading
+
+        plan = FaultPlan([FaultRule(kind=WATCH_DROP, until_cycle=2)])
+        kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        assert rec.start_watches(threading.Event())
+
+        # a new VA lands while the watch stream is down: no kick arrives
+        second = make_va("chat-8b-b", MODEL, "v5e-1", "premium",
+                         [PROFILE_8B_V5E1])
+        kube.put_deployment(Deployment(name="chat-8b-b", namespace=NS,
+                                       spec_replicas=1, status_replicas=1))
+        kube.put_variant_autoscaling(second)
+        assert not rec._wake.is_set(), "event should have been dropped"
+
+        # ...but the cadence cycle reconciles it anyway
+        r = run_cycle(rec, plan, clock, prom, rps=20.0)
+        assert sorted(r.processed) == sorted([FULL, f"chat-8b-b:{NS}"])
+        assert r.degraded == {}
+
+        # window over (cycle >= 2): watch events flow again
+        plan.begin_cycle()
+        cm = kube.get_configmap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE)
+        kube.put_configmap(cm)
+        assert rec._wake.is_set(), "watch must recover after the window"
+
+
+class TestConfigMapLoss:
+    """The operator ConfigMap disappears: the cycle fails fast (terminal
+    NotFound, no retry ladder), lands on cycle-level HOLD, and the next
+    cycle recovers."""
+
+    def scenario(self):
+        plan = FaultPlan([
+            FaultRule(kind=KUBE_NOT_FOUND, match="get:ConfigMap",
+                      after_cycle=2, until_cycle=3),
+        ], seed=6)
+        kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        return [cycle_summary(kube, emitter,
+                              run_cycle(rec, plan, clock, prom, rps=20.0))
+                for _ in range(4)]
+
+    def test_loss_holds_the_fleet_then_recovers(self):
+        out = assert_deterministic(self.scenario)
+        assert_never_scaled_to_zero(out)
+        assert_step_bound(out)
+        healthy = out[0]
+        assert healthy["desired"] > 0
+
+        lost = out[1]
+        assert lost["raised"] == "NotFoundError"
+        assert lost["desired"] == healthy["desired"]   # frozen, not torn down
+        assert lost["cycle_rung"] == int(DegradationState.HOLD)
+
+        assert out[-1]["raised" if "raised" in out[-1] else "desired"] \
+            == healthy["desired"]
+        assert out[-1]["cycle_rung"] == int(DegradationState.HEALTHY)
+        assert out[-1]["degraded"] == {}
+
+
+class TestReplicaStepBound:
+    """WVA_MAX_REPLICA_STEP bounds every published move — a demand jump
+    (or a corrupted solve) ramps in bounded steps instead of one leap."""
+
+    def scenario(self):
+        plan = FaultPlan([], seed=7)  # no faults: the bound is always-on
+        kube, prom, emitter, rec, clock = make_chaos_cluster(
+            plan, replicas=1, operator_extra={"WVA_MAX_REPLICA_STEP": "2"})
+        out = []
+        for _ in range(5):
+            r = run_cycle(rec, plan, clock, prom, rps=120.0)
+            out.append(cycle_summary(kube, emitter, r))
+        return out
+
+    def test_ramp_is_stepped(self):
+        out = assert_deterministic(self.scenario)
+        trace = [s["desired"] for s in out]
+        # first publish moves at most +2 from the live deployment (1)
+        assert trace[0] == 3
+        assert_step_bound(out, bound=2)
+        # the bound delays, never denies: the solver's target is reached
+        assert trace[-1] == trace[-2]  # converged
+        assert trace[-1] > 3
+
+
+class TestFaultPlanScripting:
+    """The JSON surface: what WVA_FAULT_PLAN and saved scenario files
+    parse to, and that bad plans fail loudly at load time."""
+
+    def test_json_round_trip(self):
+        plan = FaultPlan([
+            FaultRule(kind=PROM_TIMEOUT, after_cycle=3, until_cycle=6),
+            FaultRule(kind=KUBE_CONFLICT,
+                      match="update_status:VariantAutoscaling",
+                      probability=0.5),
+        ], seed=9)
+        again = FaultPlan.from_json(json.dumps(plan.to_dict()))
+        assert again.seed == 9
+        assert [vars(r) for r in again.rules] == [vars(r) for r in plan.rules]
+
+    def test_unknown_kind_and_keys_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultPlan.from_json('{"rules": [{"kind": "prom-explode"}]}')
+        with pytest.raises(ValueError, match="unknown keys"):
+            FaultPlan.from_json(
+                '{"rules": [{"kind": "prom-timeout", "after": 3}]}')
+        with pytest.raises(ValueError, match="probability"):
+            FaultRule(kind=PROM_TIMEOUT, probability=1.5)
+        with pytest.raises(ValueError, match="skew_s"):
+            FaultRule(kind=PROM_CLOCK_SKEW)
+
+    def test_probability_draws_are_seed_deterministic(self):
+        def draws(seed):
+            plan = FaultPlan([FaultRule(kind=PROM_TIMEOUT,
+                                        probability=0.5)], seed=seed)
+            plan.begin_cycle()
+            return [plan.prom_fault("q") is not None for _ in range(32)]
+
+        assert draws(1) == draws(1)
+        assert draws(1) != draws(2)
+
+    def test_server_env_hook_attaches_the_plan(self, monkeypatch, tmp_path):
+        from workload_variant_autoscaler_tpu.emulator.server import (
+            _fault_plan_from_env,
+        )
+
+        monkeypatch.delenv("WVA_FAULT_PLAN", raising=False)
+        assert _fault_plan_from_env() is None
+
+        inline = '{"seed": 4, "rules": [{"kind": "prom-timeout"}]}'
+        monkeypatch.setenv("WVA_FAULT_PLAN", inline)
+        plan = _fault_plan_from_env()
+        assert plan.seed == 4 and plan.rules[0].kind == PROM_TIMEOUT
+
+        path = tmp_path / "plan.json"
+        path.write_text(inline)
+        monkeypatch.setenv("WVA_FAULT_PLAN", str(path))
+        assert _fault_plan_from_env().seed == 4
+
+        monkeypatch.setenv("WVA_FAULT_PLAN",
+                           '{"rules": [{"kind": "nope"}]}')
+        with pytest.raises(ValueError):
+            _fault_plan_from_env()  # bad plan = startup error, not no-op
+
+
+class TestChaosClosedLoop:
+    """The SAME plan mechanism against the sim-time e2e loop: a
+    Prometheus outage window scheduled in seconds, injected through
+    SimPromAPI's fault_plan hook, while real emulated traffic flows."""
+
+    def test_outage_mid_loop_holds_replicas_and_recovers(self):
+        from tests.helpers import build_closed_loop
+        from test_e2e_loop import CFG, run_loop
+
+        from workload_variant_autoscaler_tpu.emulator import (
+            PoissonLoadGenerator,
+            TokenDistribution,
+        )
+
+        plan = FaultPlan([
+            # sim t ~125s..235s (rebased to the first 5s scrape tick):
+            # reconciles at 150/180/210 run blind
+            FaultRule(kind=PROM_TIMEOUT, after_s=120.0, until_s=230.0),
+        ], seed=8)
+        sim, fleet, prom, kube, emitter, rec = build_closed_loop(
+            CFG, model=MODEL, variant=VARIANT)
+        prom.fault_plan = plan
+        kube.attach_fault_plan(plan)
+
+        gen = PoissonLoadGenerator(
+            sim, schedule=[(360, 3600)],  # steady 60 req/s
+            tokens=TokenDistribution(avg_input_tokens=128,
+                                     avg_output_tokens=32,
+                                     distribution="deterministic"),
+            seed=11,
+        )
+        gen.start()
+        history = []
+        run_loop(sim, fleet, prom, kube, rec, until_ms=360_000.0,
+                 desired_history=history)
+
+        # pre-outage steady state
+        pre = [d for t, d in history if 60_000 <= t < 120_000]
+        assert pre and min(pre) > 0
+        held = pre[-1]
+        # outage window: replicas held at the last-known-good size —
+        # no scale-to-zero, no teardown of a loaded fleet
+        during = [d for t, d in history if 150_000 <= t < 240_000]
+        assert during and all(d == held for d in during), (held, during)
+        # recovered after the window: still serving, still sized
+        post = [d for t, d in history if t >= 300_000]
+        assert post and all(d > 0 for d in post)
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        assert crd.is_condition_true(va, crd.TYPE_OPTIMIZATION_READY)

@@ -581,6 +581,22 @@ def _make_handler(srv: MiniApiServer):
                 raise InvalidError("metadata.name: Required value")
             return name
 
+        def _check_create_namespace(self, body: dict, ns: str) -> None:
+            """Namespaced-create conformance, like a real apiserver:
+            a POST into a namespace that was never created is a 404
+            (`default` is pre-seeded), and a non-empty body namespace
+            that disagrees with the path is a 400 BadRequest — only an
+            EMPTY body namespace is defaulted from the URL, never
+            silently rewritten (ADVICE r5 #1/#3)."""
+            if ns not in srv.namespaces:
+                raise NotFoundError(f'namespaces "{ns}" not found')
+            body_ns = ((body.get("metadata") or {}).get("namespace") or "")
+            if body_ns and body_ns != ns:
+                raise InvalidError(
+                    f"the namespace of the provided object ({body_ns!r}) "
+                    f"does not match the namespace sent on the request "
+                    f"({ns!r})")
+
         def _crd_post(self) -> None:
             body = self._read_body()
             name = self._body_name(body)
@@ -602,6 +618,7 @@ def _make_handler(srv: MiniApiServer):
         def _cm_post(self, ns: str) -> None:
             body = self._read_body()
             name = self._body_name(body)
+            self._check_create_namespace(body, ns)
             try:
                 srv.kube.get_configmap(name, ns)
             except NotFoundError:
@@ -620,6 +637,7 @@ def _make_handler(srv: MiniApiServer):
         def _deploy_post(self, ns: str) -> None:
             body = self._read_body()
             name = self._body_name(body)
+            self._check_create_namespace(body, ns)
             try:
                 srv.kube.get_deployment(name, ns)
             except NotFoundError:
@@ -639,6 +657,7 @@ def _make_handler(srv: MiniApiServer):
         def _va_post(self, ns: str) -> None:
             body = self._read_body()
             name = self._body_name(body)
+            self._check_create_namespace(body, ns)
             # CRD admission: structural-schema validation against the
             # registered CRD (or the shipped manifest), the same gate a
             # real apiserver applies before persisting
@@ -652,7 +671,7 @@ def _make_handler(srv: MiniApiServer):
             else:
                 raise ConflictError(f"{PLURAL} {ns}/{name} already exists")
             va = va_from_dict(body)
-            va.metadata.namespace = ns   # path wins, like the apiserver
+            va.metadata.namespace = ns   # empty body namespace defaults
             srv.kube.put_variant_autoscaling(va)
             stored = srv.kube.get_variant_autoscaling(name, ns)
             self._json(201, va_to_dict(stored))

@@ -352,15 +352,22 @@ class IncompleteMetricsError(Exception):
         )
 
 
-def _value_or_none(prom: PromAPI, promql: str) -> float | None:
-    """One aggregate value; None when the series is absent or the sample is
-    NaN/Inf (PromQL 0/0 or overflow) — 'unknown' must stay distinguishable
-    from a genuine 0.0."""
+def _value_and_presence(prom: PromAPI, promql: str) -> tuple[float | None, bool]:
+    """(value, series_present): value is None when the series is absent OR
+    the sample is NaN/Inf (PromQL 0/0 or overflow) — 'unknown' must stay
+    distinguishable from a genuine 0.0. Presence distinguishes an absent
+    series (e.g. a variant that has never served) from a series that
+    EXISTS but answers garbage (a NaN storm), which is a scrape failure,
+    not idleness."""
     samples = prom.query(promql)
     if not samples:
-        return None
+        return None, False
     v = samples[0].value
-    return v if fix_value(v) == v else None
+    return (v if fix_value(v) == v else None), True
+
+
+def _value_or_none(prom: PromAPI, promql: str) -> float | None:
+    return _value_and_presence(prom, promql)[0]
 
 
 def validate_metrics_availability(
@@ -459,7 +466,7 @@ def collect_load(
     family = family or active_family()
     success_rps: float | None = None
     success_fetched = False
-    arrival_rps = _value_or_none(
+    arrival_rps, arrival_present = _value_and_presence(
         prom, true_arrival_rate_query(model, namespace, family))
     if (arrival_rps is not None and probe_window
             and probe_window != RATE_WINDOW):
@@ -478,11 +485,18 @@ def collect_load(
         if short is not None:
             arrival_rps = max(arrival_rps, short)
     if arrival_rps is None:
-        success_rps = _value_or_none(
+        success_rps, success_present = _value_and_presence(
             prom, arrival_rate_query(model, namespace, family))
         success_fetched = True
         arrival_rps = success_rps
         if arrival_rps is None:
+            if arrival_present or success_present:
+                # the demand series EXIST but answer NaN/Inf (a NaN
+                # storm, 0/0 windows during a scrape break): demand is
+                # UNKNOWN, not zero — zero-filling here would read a
+                # possibly-loaded variant as idle and tear it down
+                raise IncompleteMetricsError(model, namespace,
+                                             ["arrival_rate"])
             log.warning("no arrival or success rate observable; treating as idle",
                         extra=kv(model=model, namespace=namespace))
             arrival_rps = 0.0

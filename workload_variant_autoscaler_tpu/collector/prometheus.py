@@ -183,6 +183,34 @@ class HTTPPromAPI:
         ]
 
 
+class GuardedPromAPI:
+    """PromAPI behind a per-dependency CircuitBreaker (utils/backoff.py).
+
+    While the breaker is open every query fails fast with
+    CircuitOpenError instead of paying the transport timeout — the
+    collector's error handling already treats any query exception as a
+    PrometheusError condition, so callers need no special casing. The
+    breaker is single-threaded by design: clone() returns an UNguarded
+    clone of the inner client for daemon threads (their best-effort
+    queries must not race the reconcile loop's breaker state)."""
+
+    def __init__(self, inner: PromAPI, breaker):
+        self.inner = inner
+        self.breaker = breaker
+
+    def query(self, promql: str) -> list[Sample]:
+        return self.breaker.call(lambda: self.inner.query(promql))
+
+    def query_range(self, promql: str, start_s: float, end_s: float,
+                    step_s: float) -> list[Sample]:
+        return self.breaker.call(
+            lambda: self.inner.query_range(promql, start_s, end_s, step_s))
+
+    def clone(self):
+        clone = getattr(self.inner, "clone", None)
+        return clone() if callable(clone) else self.inner
+
+
 class FakePromAPI:
     """Test double keyed by exact query string (the reference's MockPromAPI
     pattern, test/utils/unitutils.go:138-243): unknown queries default to a

@@ -52,13 +52,19 @@ def abs_log(ratio: float) -> float:
 def predict_latency(
     system_spec: SystemSpec, model: str, acc_name: str, load,
     current_replicas: int, server_max_batch: int = 0,
+    stale: bool = False,
 ) -> DriftReading | None:
     """Model-predicted mean ITL/TTFT (msec) at the current allocation and
     RAW observed load (no demand headroom — prediction must match what
     the scrape measured, not what the engine sizes for). None when the
     operating point is unpredictable: no replicas, no traffic, missing
     profile, or outside the stable region (saturation legitimately blows
-    observed latency past any steady-state prediction)."""
+    observed latency past any steady-state prediction) — or when the
+    load is a last-known-good cache entry (stale=True): cached averages
+    describe an EARLIER allocation's operating point, so judging the
+    profile on them would strike it for the outage, not for drift."""
+    if stale:
+        return None
     if current_replicas <= 0 or load.arrival_rate_rpm <= 0:
         return None
     out_tokens = int(load.avg_output_tokens)

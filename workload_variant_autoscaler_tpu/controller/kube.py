@@ -136,6 +136,9 @@ class InMemoryKube:
         # (verb, kind) -> callable raising the injected error; removed after
         # `count` trips when count > 0
         self._faults: dict[tuple[str, str], tuple[Callable[[], None], int]] = {}
+        # scheduled faults (faults.FaultPlan) consulted by every verb and
+        # by watch delivery; None = no plan attached
+        self._fault_plan = None
         self.status_update_count = 0
         self._watchers: list[Callable[[WatchEvent], None]] = []
         # authn/authz fakes for the metrics endpoint's TokenReview/SAR
@@ -153,6 +156,12 @@ class InMemoryKube:
         self._watchers.append(fn)
 
     def _notify(self, event: WatchEvent) -> None:
+        plan = self._fault_plan
+        if plan is not None and plan.watch_dropping():
+            # a dropped ?watch=true stream: the mutation happened, the
+            # event never reaches the controller — the level-triggered
+            # cadence cycle is the only thing that may notice
+            return
         for fn in list(self._watchers):
             fn(event)
 
@@ -210,7 +219,23 @@ class InMemoryKube:
 
         self._faults[(verb, kind)] = (raiser, count)
 
+    def attach_fault_plan(self, plan) -> None:
+        """Drive this kube from a scheduled faults.FaultPlan: every verb
+        consults the plan (409 storms, NotFound windows, transport
+        errors) and watch delivery honors its watch-drop windows. The
+        count-based inject_fault remains for one-shot unit faults; a
+        plan expresses multi-cycle scenarios the same way for unit tests
+        and the emulator loop. Pass None to detach."""
+        self._fault_plan = plan
+
     def _trip(self, verb: str, kind: str) -> None:
+        plan = self._fault_plan
+        if plan is not None:
+            rule = plan.kube_fault(verb, kind)
+            if rule is not None:
+                from ..faults.inject import exception_for_kube_fault
+
+                raise exception_for_kube_fault(rule, verb, kind)
         entry = self._faults.get((verb, kind))
         if entry is None:
             return

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import math
 import os
+import random
 import threading
 import time
 from dataclasses import dataclass, field
@@ -22,17 +23,21 @@ from typing import Optional
 from ..actuator import Actuator
 from ..collector import (
     IncompleteMetricsError,
+    LoadCache,
     PromAPI,
     active_family,
     collect_inventory_k8s,
     collect_load,
     validate_metrics_availability,
 )
+from ..collector.prometheus import GuardedPromAPI
 from ..metrics import RECONCILE_STAGES, MetricsEmitter
 from ..models import SaturationPolicy, System
 from ..solver import Manager, Optimizer
 from ..utils import (
     STANDARD_BACKOFF,
+    CircuitBreaker,
+    Deadline,
     full_name,
     get_logger,
     kv,
@@ -40,6 +45,7 @@ from ..utils import (
     with_backoff,
 )
 from . import crd, translate
+from .degradation import DegradationState, DegradationTracker, state_for_cache_tier
 from .kube import Deployment, KubeClient
 
 log = get_logger("wva.controller")
@@ -58,10 +64,19 @@ class ReconcileResult:
     requeue_after: float
     processed: list[str] = field(default_factory=list)
     skipped: dict[str, str] = field(default_factory=dict)  # name -> reason
+    # name -> degradation-ladder rung label ("stale-cache" | "hold"; see
+    # controller/degradation.py) for variants that did not run healthy
+    degraded: dict[str, str] = field(default_factory=dict)
     error: Optional[str] = None
 
 
 class Reconciler:
+    # per-dependency breaker defaults: 5 consecutive exhausted-backoff
+    # failures open the circuit; a 60s cooldown (one default interval)
+    # passes before the single half-open probe
+    BREAKER_THRESHOLD = 5
+    BREAKER_RESET_S = 60.0
+
     def __init__(
         self,
         kube: KubeClient,
@@ -70,6 +85,7 @@ class Reconciler:
         config_namespace: str = CONFIG_MAP_NAMESPACE,
         now=time.time,
         sleep=time.sleep,
+        monotonic=time.monotonic,
     ):
         self.kube = kube
         self.prom = prom
@@ -78,6 +94,35 @@ class Reconciler:
         self.config_namespace = config_namespace
         self.now = now
         self.sleep = sleep
+        self.monotonic = monotonic
+        # per-dependency circuit breakers (utils/backoff.py): a dependency
+        # that has failed `threshold` consecutive times fails FAST instead
+        # of charging every cycle a full backoff ladder per call — badput
+        # control. Clocked on self.now so sim-time tests drive cooldowns.
+        threshold = int(parse_float_or(
+            os.environ.get("WVA_BREAKER_THRESHOLD"), self.BREAKER_THRESHOLD))
+        reset_s = parse_float_or(
+            os.environ.get("WVA_BREAKER_RESET"), self.BREAKER_RESET_S)
+        self.breakers = {
+            "kube": CircuitBreaker("kube", failure_threshold=max(threshold, 1),
+                                   reset_after_s=reset_s, clock=now),
+            "prometheus": CircuitBreaker("prometheus",
+                                         failure_threshold=max(threshold, 1),
+                                         reset_after_s=reset_s, clock=now),
+        }
+        # scrape-path Prometheus client behind the breaker; the raw
+        # client stays for the probe daemon thread (breakers are
+        # single-threaded by design)
+        self.guarded_prom = GuardedPromAPI(prom, self.breakers["prometheus"])
+        # last-known-good loads with staleness tiers — the stale-cache
+        # rung of the degradation ladder (collector/cache.py)
+        self.load_cache = LoadCache()
+        # deterministic jitter source for every retry ladder (the chaos
+        # suite's no-wall-clock-randomness rule)
+        self._rng = random.Random(0x57A)
+        # per-cycle state, rebuilt at each reconcile() entry
+        self._deadline = Deadline.unlimited()
+        self._degradation = DegradationTracker()
         # recommendation history per VA for scale-down stabilization
         # (in-memory like HPA's window; a controller restart just delays
         # one scale-down, the fail-safe direction)
@@ -100,12 +145,42 @@ class Reconciler:
         # shared requests.Session is not thread-safe under concurrency)
         self._probe_prom = None
 
+    # -- hardened dependency calls ----------------------------------------
+
+    def _kube_call(self, fn, backoff=STANDARD_BACKOFF):
+        """Every control-plane read/write: jittered exponential backoff
+        under the per-cycle deadline budget, behind the kube circuit
+        breaker. One exhausted backoff counts as ONE breaker failure;
+        while the breaker is open calls fail fast with CircuitOpenError
+        instead of paying the ladder again (badput control)."""
+        return self.breakers["kube"].call(
+            lambda: with_backoff(fn, backoff=backoff, sleep=self.sleep,
+                                 rng=self._rng, deadline=self._deadline))
+
+    def _cycle_budget_s(self) -> float:
+        """WVA_CYCLE_DEADLINE: wall-clock budget all of a cycle's retry
+        ladders share (env first, then the operator ConfigMap — standard
+        knob precedence). Unset/0 = unlimited (the reference's
+        behavior); set it below GLOBAL_OPT_INTERVAL so a cycle fails
+        into a documented degraded state instead of eating its whole
+        interval in nested backoffs."""
+        raw = (os.environ.get("WVA_CYCLE_DEADLINE")
+               or self._last_operator_cm.get("WVA_CYCLE_DEADLINE") or "")
+        if not raw.strip():
+            return math.inf
+        try:
+            budget = translate.parse_duration(raw)
+        except ValueError:
+            log.warning("bad WVA_CYCLE_DEADLINE, running unbounded",
+                        extra=kv(value=raw))
+            return math.inf
+        return budget if budget > 0 else math.inf
+
     # -- config reading (reference controller.go:490-594) ----------------
 
     def read_operator_config(self) -> dict[str, str]:
-        cm = with_backoff(
+        cm = self._kube_call(
             lambda: self.kube.get_configmap(CONFIG_MAP_NAME, self.config_namespace),
-            backoff=STANDARD_BACKOFF, sleep=self.sleep,
         )
         return cm.data
 
@@ -117,16 +192,14 @@ class Reconciler:
         return translate.parse_duration(interval)
 
     def read_accelerator_config(self) -> dict[str, dict[str, str]]:
-        cm = with_backoff(
+        cm = self._kube_call(
             lambda: self.kube.get_configmap(ACCELERATOR_CM_NAME, self.config_namespace),
-            backoff=STANDARD_BACKOFF, sleep=self.sleep,
         )
         return translate.parse_accelerator_configmap(cm.data)
 
     def read_service_class_config(self) -> dict[str, str]:
-        cm = with_backoff(
+        cm = self._kube_call(
             lambda: self.kube.get_configmap(SERVICE_CLASS_CM_NAME, self.config_namespace),
-            backoff=STANDARD_BACKOFF, sleep=self.sleep,
         )
         return cm.data
 
@@ -136,7 +209,12 @@ class Reconciler:
         """One cycle, with per-stage wall-clock timing published as
         inferno_reconcile_stage_duration_msec{stage=...} — whichever
         dependency stalls (apiserver config reads, Prometheus scrapes, the
-        sizing kernel, status writes) shows up as its stage."""
+        sizing kernel, status writes) shows up as its stage.
+
+        Every cycle also ends on a documented degradation-ladder rung
+        (controller/degradation.py), exported with the breaker states —
+        even a cycle that dies in the config stage reads as a HOLD on the
+        series, never as silence."""
         stages: dict[str, float] = {}
         t0 = time.perf_counter()
 
@@ -146,9 +224,18 @@ class Reconciler:
             stages[stage] = (t1 - t0) * 1000.0
             t0 = t1
 
+        # fresh per-cycle budget and ladder bookkeeping; the budget knob
+        # is read from the LAST seen operator CM (reading the fresh one
+        # is itself a kube call that must run under the budget)
+        self._deadline = Deadline(self._cycle_budget_s(),
+                                  clock=self.monotonic)
+        self._degradation = DegradationTracker()
         try:
             return self._reconcile_timed(mark)
         except BaseException:
+            # the cycle died before publishing anything: HOLD (the
+            # published fleet state is frozen until a cycle succeeds)
+            self._degradation.record_cycle(DegradationState.HOLD)
             # attribute in-flight time to the stage that raised (the first
             # unmarked one): a 30s apiserver backoff that ends in an
             # exception must read as 30s of config/prepare, not as an
@@ -160,6 +247,11 @@ class Reconciler:
             raise
         finally:
             self.emitter.emit_cycle_timing(stages)
+            self.emitter.emit_degradation_metrics(
+                self._degradation.gauge_samples(),
+                int(self._degradation.cycle_state()))
+            self.emitter.emit_circuit_metrics(
+                {name: b.state_code() for name, b in self.breakers.items()})
 
     def _reconcile_timed(self, mark) -> ReconcileResult:
         operator_cm = self.read_operator_config()
@@ -170,7 +262,7 @@ class Reconciler:
         accelerator_cm = self.read_accelerator_config()
         service_class_cm = self.read_service_class_config()
 
-        vas = self.kube.list_variant_autoscalings()
+        vas = self._kube_call(self.kube.list_variant_autoscalings)
         mark("config")
         active = [va for va in vas if va.is_active()]
         for va in vas:
@@ -183,6 +275,7 @@ class Reconciler:
             del self._recommendations[stale]
         for stale in [k for k in self._drift_strikes if k not in active_keys]:
             del self._drift_strikes[stale]
+        self.load_cache.prune(active_keys)
         if not active:
             log.info("no active VariantAutoscalings, skipping optimization")
             # no fleet: every per-variant/per-namespace series must read
@@ -200,13 +293,15 @@ class Reconciler:
         capacity: dict[str, int] = {}
         if limited:
             try:
-                capacity = with_backoff(
+                capacity = self._kube_call(
                     lambda: collect_inventory_k8s(self.kube),
-                    backoff=STANDARD_BACKOFF, sleep=self.sleep,
                 )
             except Exception as e:  # noqa: BLE001
                 log.error("node inventory failed; falling back to unlimited",
                           extra=kv(error=str(e)))
+                # capacity-blind allocation is reduced-capability
+                # operation: the LIMITED rung, visible on the series
+                self._degradation.record_cycle(DegradationState.LIMITED)
                 limited = False
             else:
                 if not capacity:
@@ -282,6 +377,8 @@ class Reconciler:
         except Exception as e:  # noqa: BLE001
             log.error("optimization failed, retrying next cycle", extra=kv(error=str(e)))
             result.error = str(e)
+            # conditions published, no new allocation: the LIMITED rung
+            self._degradation.record_cycle(DegradationState.LIMITED)
             for va, _deploy in prepared:
                 crd.set_condition(
                     va, crd.TYPE_OPTIMIZATION_READY, "False",
@@ -299,6 +396,7 @@ class Reconciler:
         # namespaces must not collide)
         stabilization_s = self._stabilization_window(operator_cm)
         noise_margin = self._noise_margin(operator_cm)
+        replica_step = self._replica_step(operator_cm)
         optimized: dict[str, crd.OptimizedAlloc] = {}
         for va, _deploy in prepared:
             key = full_name(va.name, va.namespace)
@@ -313,6 +411,13 @@ class Reconciler:
                 key, alloc.num_replicas, stabilization_s,
                 prev_published=va.status.desired_optimized_alloc.num_replicas,
                 guard=self._demand_guard(system, key, noise_margin),
+            )
+            alloc.num_replicas = self._guard_actuation(
+                key, alloc.num_replicas,
+                prev_published=va.status.desired_optimized_alloc.num_replicas,
+                current=_deploy.current_replicas(),
+                stale=result.degraded.get(key) == "stale-cache",
+                step=replica_step,
             )
             optimized[key] = alloc
 
@@ -441,6 +546,44 @@ class Reconciler:
                 stabilized = capped
         return stabilized
 
+    # -- actuation guardrails (degradation ladder; docs/robustness.md) ----
+
+    def _replica_step(self, operator_cm: dict[str, str]) -> int:
+        """WVA_MAX_REPLICA_STEP: hard bound on the per-cycle change of a
+        variant's published replica count (0, the default, preserves the
+        reference's unbounded behavior). At fleet scale one corrupted
+        cycle must be a bounded blip, not a mass mis-scale: whatever the
+        solver concluded, the published count moves at most `step` from
+        the previous published value per cycle."""
+        return int(self._cm_float(operator_cm, "WVA_MAX_REPLICA_STEP", 0.0))
+
+    def _guard_actuation(self, key: str, desired: int, prev_published: int,
+                         current: int, stale: bool, step: int) -> int:
+        """Final bound on what a cycle may publish:
+
+        - step bound: |published - baseline| <= step when configured,
+          where baseline is the last published count (falling back to
+          the live deployment size on the first cycle).
+        - no scale-to-zero on stale evidence: a variant sized from the
+          last-known-good cache may shrink (bounded, stabilized) but
+          never to zero — absence of fresh metrics is not evidence of
+          absent load."""
+        baseline = prev_published if prev_published > 0 else current
+        guarded = desired
+        if step > 0:
+            lo = max(baseline - step, 0)
+            hi = baseline + step
+            guarded = min(max(guarded, lo), hi)
+        if stale and guarded == 0 and baseline > 0:
+            guarded = 1
+        if guarded != desired:
+            log.warning(
+                "actuation guardrail engaged",
+                extra=kv(variant=key, desired=desired, published=guarded,
+                         baseline=baseline, step=step, stale_metrics=stale),
+            )
+        return guarded
+
     # -- preparation (reference controller.go:218-335) -------------------
 
     def _demand_headroom(self, operator_cm: dict[str, str]) -> float:
@@ -525,9 +668,8 @@ class Reconciler:
                 continue
 
             try:
-                deploy = with_backoff(
+                deploy = self._kube_call(
                     lambda: self.kube.get_deployment(name, va_listed.namespace),
-                    backoff=STANDARD_BACKOFF, sleep=self.sleep,
                 )
             except Exception as e:  # noqa: BLE001
                 log.error("failed to get Deployment", extra=kv(variant=name, error=str(e)))
@@ -535,9 +677,8 @@ class Reconciler:
                 continue
 
             try:
-                va = with_backoff(
+                va = self._kube_call(
                     lambda: self.kube.get_variant_autoscaling(name, va_listed.namespace),
-                    backoff=STANDARD_BACKOFF, sleep=self.sleep,
                 )
             except Exception as e:  # noqa: BLE001
                 result.skipped[key] = "variant not found"
@@ -547,14 +688,22 @@ class Reconciler:
             # (reference controller.go:276-293)
             if not va.is_controlled_by(deploy.uid):
                 try:
-                    self.kube.patch_owner_reference(va, deploy)
+                    self._kube_call(
+                        lambda: self.kube.patch_owner_reference(va, deploy))
                 except Exception as e:  # noqa: BLE001
                     log.error("failed to set ownerReference", extra=kv(variant=name, error=str(e)))
                     result.skipped[key] = "ownerReference patch failed"
                     continue
 
+            # metrics gate: a live scrape is HEALTHY; any dependency or
+            # evidence failure falls through to the last-known-good cache
+            # (STALE_CACHE rung) and only a cache miss/expiry HOLDs the
+            # variant — the documented degradation ladder
+            # (docs/robustness.md)
+            load = None
+            fallback = None  # (skip_reason, condition_reason, message)
             validation = validate_metrics_availability(
-                self.prom, model, deploy.namespace, now=self.now(),
+                self.guarded_prom, model, deploy.namespace, now=self.now(),
                 family=family,
             )
             if validation.available:
@@ -562,44 +711,68 @@ class Reconciler:
                     va, crd.TYPE_METRICS_AVAILABLE, "True",
                     validation.reason, validation.message, now=self.now(),
                 )
+                try:
+                    load = collect_load(self.guarded_prom, model,
+                                        deploy.namespace,
+                                        fallback=self._last_known_load(va),
+                                        family=family,
+                                        probe_window=probe_window)
+                except IncompleteMetricsError as e:
+                    # loaded variant with unusable modeling series:
+                    # scaling it on zero-filled data would tear it down
+                    # to min replicas (the reference zero-fills here)
+                    log.warning("metrics incomplete",
+                                extra=kv(variant=name, missing=e.missing))
+                    fallback = (crd.REASON_METRICS_INCOMPLETE,
+                                crd.REASON_METRICS_INCOMPLETE, str(e))
+                except Exception as e:  # noqa: BLE001
+                    log.error("failed to collect metrics",
+                              extra=kv(variant=name, error=str(e)))
+                    fallback = ("metric collection failed",
+                                crd.REASON_PROMETHEUS_ERROR,
+                                f"Failed to collect metrics: {e}")
             else:
                 log.warning(
-                    "metrics unavailable, skipping variant",
+                    "metrics unavailable",
                     extra=kv(variant=name, reason=validation.reason,
                              troubleshooting=validation.message),
                 )
-                # surface the outage on the CR: a stale MetricsAvailable=True
-                # must not outlive a broken scrape
-                crd.set_condition(
-                    va, crd.TYPE_METRICS_AVAILABLE, "False",
-                    validation.reason, validation.message, now=self.now(),
-                )
-                self._update_status(va)
-                result.skipped[key] = validation.reason
-                continue
+                fallback = (validation.reason, validation.reason,
+                            validation.message)
 
-            try:
-                load = collect_load(self.prom, model, deploy.namespace,
-                                    fallback=self._last_known_load(va),
-                                    family=family,
-                                    probe_window=probe_window)
-            except IncompleteMetricsError as e:
-                # loaded variant with unusable modeling series: scaling it
-                # on zero-filled data would tear it down to min replicas —
-                # skip and say why on the CR instead
-                log.warning("metrics incomplete, skipping variant",
-                            extra=kv(variant=name, missing=e.missing))
+            stale_load = False
+            if fallback is not None:
+                skip_reason, cond_reason, message = fallback
+                # surface the outage on the CR either way: a stale
+                # MetricsAvailable=True must not outlive a broken scrape
                 crd.set_condition(
                     va, crd.TYPE_METRICS_AVAILABLE, "False",
-                    crd.REASON_METRICS_INCOMPLETE, str(e), now=self.now(),
+                    cond_reason, message, now=self.now(),
                 )
-                self._update_status(va)
-                result.skipped[key] = crd.REASON_METRICS_INCOMPLETE
-                continue
-            except Exception as e:  # noqa: BLE001
-                log.error("failed to collect metrics", extra=kv(variant=name, error=str(e)))
-                result.skipped[key] = "metric collection failed"
-                continue
+                cached, tier = self.load_cache.get(key, self.now())
+                if cached is None:
+                    # nothing trustworthy to size on: HOLD (published
+                    # allocation frozen; zero actuations)
+                    self._update_status(va)
+                    result.skipped[key] = skip_reason
+                    result.degraded[key] = DegradationState.HOLD.label
+                    self._degradation.record(va.name, va.namespace,
+                                             DegradationState.HOLD)
+                    continue
+                state = state_for_cache_tier(tier)
+                log.warning(
+                    "sizing on last-known-good metrics",
+                    extra=kv(variant=name, reason=skip_reason, tier=tier,
+                             arrival_rpm=round(cached.arrival_rate_rpm, 2)),
+                )
+                load = cached
+                stale_load = True
+                result.degraded[key] = state.label
+                self._degradation.record(va.name, va.namespace, state)
+            else:
+                self.load_cache.put(key, load, self.now())
+                self._degradation.record(va.name, va.namespace,
+                                         DegradationState.HEALTHY)
 
             va.status.current_alloc = crd.Allocation(
                 accelerator=acc_name,
@@ -618,7 +791,8 @@ class Reconciler:
             translate.add_server_info_to_system_data(
                 system_spec, va, class_name, demand_headroom=demand_headroom)
             self._track_drift(va, acc_name, load, deploy.current_replicas(),
-                              system_spec, drift_tolerance, drift_samples)
+                              system_spec, drift_tolerance, drift_samples,
+                              stale=stale_load)
             prepared.append((va, deploy))
             result.processed.append(key)
         self.emitter.emit_drift_metrics(drift_samples)
@@ -659,7 +833,7 @@ class Reconciler:
                 self._tpu_util_misses[ns] = (misses, skipped + 1)
                 out[ns] = {}   # backed off, known-absent
                 continue
-            sample = collect_tpu_utilization(self.prom, ns)
+            sample = collect_tpu_utilization(self.guarded_prom, ns)
             out[ns] = sample
             if sample:
                 self._tpu_util_misses.pop(ns, None)
@@ -682,13 +856,15 @@ class Reconciler:
 
     def _track_drift(self, va, acc_name, load, current_replicas,
                      system_spec, tolerance: float,
-                     drift_samples: dict) -> None:
+                     drift_samples: dict, stale: bool = False) -> None:
         """Compare observed latency averages against the queueing model's
         prediction at the current operating point; persistent mismatch
         sets PerfModelAccurate=False on the CR (see controller/drift.py).
         tolerance <= 0 disables the watchdog — and removes any condition
         a previously-enabled watchdog left behind, so a stale verdict
-        can't outlive the feature."""
+        can't outlive the feature. stale=True (the load came from the
+        last-known-good cache) makes the operating point unjudgeable:
+        cached latencies are evidence about the PAST allocation."""
         from . import drift as drift_mod
 
         key = full_name(va.name, va.namespace)
@@ -699,6 +875,7 @@ class Reconciler:
         reading = drift_mod.predict_latency(
             system_spec, va.spec.model_id, acc_name, load, current_replicas,
             server_max_batch=translate.profile_max_batch(va, acc_name),
+            stale=stale,
         )
         if reading is None:
             # unjudgeable point (idle, saturated, missing profile, nothing
@@ -804,9 +981,8 @@ class Reconciler:
                         cap,
                     )
             try:
-                fresh = with_backoff(
+                fresh = self._kube_call(
                     lambda: self.kube.get_variant_autoscaling(va.name, va.namespace),
-                    backoff=STANDARD_BACKOFF, sleep=self.sleep,
                 )
             except Exception as e:  # noqa: BLE001
                 log.error("failed to re-get variant", extra=kv(variant=va.name, error=str(e)))
@@ -851,7 +1027,7 @@ class Reconciler:
                 raise
 
         try:
-            with_backoff(attempt, backoff=STANDARD_BACKOFF, sleep=self.sleep)
+            self._kube_call(attempt)
         except Exception as e:  # noqa: BLE001
             log.error("failed to update status", extra=kv(variant=va.name, error=str(e)))
 

@@ -101,6 +101,26 @@ class RealtimeEmulator:
         return req
 
 
+def _fault_plan_from_env():
+    """WVA_FAULT_PLAN: a path to a FaultPlan JSON file, or inline JSON —
+    the scripted chaos schedule (docs/robustness.md) applied to the
+    built-in PromQL shim. Same plan format the chaos test suite runs, so
+    a degradation scenario can be replayed against this live server.
+    A bad plan is a startup error, not a silent no-chaos run."""
+    raw = os.environ.get("WVA_FAULT_PLAN", "").strip()
+    if not raw:
+        return None
+    from ..faults import FaultPlan
+
+    if not raw.lstrip().startswith("{"):
+        with open(raw) as f:
+            raw = f.read()
+    plan = FaultPlan.from_json(raw)
+    log.warning("fault plan attached to the PromQL shim",
+                extra=kv(rules=len(plan.rules), seed=plan.seed))
+    return plan
+
+
 def build_app(config: SliceModelConfig | None = None, with_prom_api: bool = False,
               metric_family: str = "vllm"):
     from aiohttp import web
@@ -112,7 +132,8 @@ def build_app(config: SliceModelConfig | None = None, with_prom_api: bool = Fals
     sink = PrometheusSink(config.model_name, namespace, family=metric_family)
     emulator = RealtimeEmulator(config, sink)
     prom_shim = SimPromAPI(sink, config.model_name, namespace,
-                           family=METRIC_FAMILIES[metric_family]) \
+                           family=METRIC_FAMILIES[metric_family],
+                           fault_plan=_fault_plan_from_env()) \
         if with_prom_api else None
 
     async def chat_completions(request: web.Request):

@@ -43,7 +43,7 @@ class SimPromAPI:
     real Prometheus would: completion rate + clamped backlog derivative."""
 
     def __init__(self, sink: PrometheusSink, model: str, namespace: str,
-                 family: MetricFamily | None = None):
+                 family: MetricFamily | None = None, fault_plan=None):
         from ..collector import METRIC_FAMILIES
 
         self.sink = sink
@@ -52,6 +52,11 @@ class SimPromAPI:
         self.family = family or METRIC_FAMILIES[sink.family]
         self.history: deque[tuple[float, dict[str, float]]] = deque(maxlen=4096)
         self.now_s = 0.0
+        # scheduled Prometheus misbehavior (faults.FaultPlan): every
+        # answer passes through apply_prom_fault, and scrape() ticks the
+        # plan's time axis with the sim clock — the same plan JSON the
+        # chaos unit suite runs drives the closed loop
+        self.fault_plan = fault_plan
         self._queries: dict[str, tuple] = {}
         self._register_queries()
 
@@ -94,7 +99,16 @@ class SimPromAPI:
 
     def scrape(self, now_ms: float) -> None:
         self.now_s = now_ms / 1000.0
+        if self.fault_plan is not None:
+            self.fault_plan.tick(self.now_s)
         self.history.append((self.now_s, self.sink.counters()))
+
+    def _faulted(self, promql: str, samples: list[Sample]) -> list[Sample]:
+        if self.fault_plan is None:
+            return samples
+        from ..faults.inject import apply_prom_fault
+
+        return apply_prom_fault(self.fault_plan, promql, samples)
 
     # -- PromAPI ---------------------------------------------------------
 
@@ -260,14 +274,16 @@ class SimPromAPI:
         ):
             if not self.history:
                 return []
-            return [Sample(labels=labels,
-                           value=self.history[-1][1].get(
-                               self.family.success_total, 0.0),
-                           timestamp=self.now_s)]
+            return self._faulted(promql, [
+                Sample(labels=labels,
+                       value=self.history[-1][1].get(
+                           self.family.success_total, 0.0),
+                       timestamp=self.now_s)])
         value = self._eval(promql)
         if value is None:
-            return []
-        return [Sample(labels=labels, value=value, timestamp=self.now_s)]
+            return self._faulted(promql, [])
+        return self._faulted(
+            promql, [Sample(labels=labels, value=value, timestamp=self.now_s)])
 
     def query_range(self, promql: str, start_s: float, end_s: float,
                     step_s: float) -> list[Sample]:
@@ -282,7 +298,7 @@ class SimPromAPI:
             if value is not None:
                 out.append(Sample(labels=labels, value=value, timestamp=t))
             t += step_s
-        return out
+        return self._faulted(promql, out)
 
 
 class MultiPromAPI:

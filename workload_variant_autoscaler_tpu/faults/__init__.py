@@ -1,0 +1,59 @@
+"""Fault injection + graceful degradation support.
+
+`FaultPlan` scripts per-dependency fault schedules (plan.py); the
+injection hooks (inject.py, InMemoryKube.attach_fault_plan, SimPromAPI's
+fault_plan param, the emulator server's WVA_FAULT_PLAN env) apply them at
+call time. tests/test_chaos.py drives the scenario matrix;
+docs/robustness.md documents the degradation ladder each scenario must
+land on.
+"""
+
+from .inject import (
+    FaultyPromAPI,
+    InjectedKubeError,
+    InjectedTimeout,
+    apply_prom_fault,
+    exception_for_kube_fault,
+)
+from .plan import (
+    ALL_KINDS,
+    DEP_KUBE,
+    DEP_PROMETHEUS,
+    DEP_WATCH,
+    KUBE_CONFLICT,
+    KUBE_ERROR,
+    KUBE_KINDS,
+    KUBE_NOT_FOUND,
+    PROM_CLOCK_SKEW,
+    PROM_KINDS,
+    PROM_NAN,
+    PROM_PARTIAL,
+    PROM_TIMEOUT,
+    WATCH_DROP,
+    FaultPlan,
+    FaultRule,
+)
+
+__all__ = [
+    "ALL_KINDS",
+    "DEP_KUBE",
+    "DEP_PROMETHEUS",
+    "DEP_WATCH",
+    "FaultPlan",
+    "FaultRule",
+    "FaultyPromAPI",
+    "InjectedKubeError",
+    "InjectedTimeout",
+    "KUBE_CONFLICT",
+    "KUBE_ERROR",
+    "KUBE_KINDS",
+    "KUBE_NOT_FOUND",
+    "PROM_CLOCK_SKEW",
+    "PROM_KINDS",
+    "PROM_NAN",
+    "PROM_PARTIAL",
+    "PROM_TIMEOUT",
+    "WATCH_DROP",
+    "apply_prom_fault",
+    "exception_for_kube_fault",
+]

@@ -1,0 +1,100 @@
+"""Injection hooks: apply a FaultPlan to live dependency calls.
+
+Two mechanisms, one plan:
+
+- `FaultyPromAPI` wraps ANY PromAPI (FakePromAPI, SimPromAPI, HTTPPromAPI)
+  and corrupts/withholds answers per the plan. SimPromAPI also accepts
+  `fault_plan=` directly (emulator/simprom.py) — same helper underneath.
+- Kube faults are consulted inside InMemoryKube itself
+  (`attach_fault_plan`, controller/kube.py): every verb passes through
+  `_trip`, so plan-scheduled 409 storms / NotFound windows hit exactly
+  where count-based `inject_fault` always has, and watch-drop windows
+  swallow `_notify` events like a dropped ?watch=true stream.
+
+`exception_for_kube_fault` is the single mapping from a scheduled kube
+fault kind to the exception a real apiserver client would surface, so the
+in-memory hook and any future RestKube-level wrapper cannot diverge.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..collector.prometheus import PromAPI, Sample
+from . import plan as plan_mod
+from .plan import FaultPlan, FaultRule
+
+
+class InjectedTimeout(TimeoutError):
+    """The scheduled Prometheus timeout (transport-level failure)."""
+
+
+class InjectedKubeError(RuntimeError):
+    """The scheduled generic kube transport failure."""
+
+
+def exception_for_kube_fault(rule: FaultRule, verb: str,
+                             kind: str) -> Exception:
+    """The exception a real client surfaces for this fault kind."""
+    from ..controller.kube import ConflictError, NotFoundError
+
+    if rule.kind == plan_mod.KUBE_CONFLICT:
+        return ConflictError(
+            f"injected 409: {verb} {kind} lost a write race")
+    if rule.kind == plan_mod.KUBE_NOT_FOUND:
+        return NotFoundError(f"injected 404: {kind} vanished during {verb}")
+    return InjectedKubeError(f"injected apiserver failure on {verb} {kind}")
+
+
+def apply_prom_fault(plan: FaultPlan | None, promql: str,
+                     samples: list[Sample]) -> list[Sample]:
+    """Corrupt/withhold a query answer per the plan (shared by
+    FaultyPromAPI and SimPromAPI's built-in hook). Raises on
+    prom-timeout; returns the (possibly corrupted) samples otherwise."""
+    if plan is None:
+        return samples
+    rule = plan.prom_fault(promql)
+    if rule is None:
+        return samples
+    if rule.kind == plan_mod.PROM_TIMEOUT:
+        raise InjectedTimeout(
+            f"injected prometheus timeout for {promql[:80]!r}")
+    if rule.kind == plan_mod.PROM_PARTIAL:
+        return []  # series dropped from the scrape: empty vector
+    if rule.kind == plan_mod.PROM_NAN:
+        if not samples:
+            # the series must EXIST to carry a NaN (PromQL 0/0)
+            samples = [Sample(labels={}, value=0.0, timestamp=plan.now_s)]
+        return [Sample(labels=s.labels, value=math.nan,
+                       timestamp=s.timestamp) for s in samples]
+    # prom-clock-skew: the scrape pipeline lags — every sample's
+    # timestamp slides into the past, which the staleness gate must read
+    # as a broken scrape, not as fresh truth
+    return [Sample(labels=s.labels, value=s.value,
+                   timestamp=s.timestamp - rule.skew_s) for s in samples]
+
+
+class FaultyPromAPI:
+    """PromAPI wrapper consulting a FaultPlan on every query.
+
+    Forwards query_range too (corrupting each step's samples) so the
+    profile fitter path is injectable, and clone() (the reconciler's
+    demand-probe thread) clones the inner client while SHARING the plan —
+    a fault window covers every consumer of the dependency at once."""
+
+    def __init__(self, inner: PromAPI, plan: FaultPlan):
+        self.inner = inner
+        self.plan = plan
+
+    def query(self, promql: str) -> list[Sample]:
+        return apply_prom_fault(self.plan, promql, self.inner.query(promql))
+
+    def query_range(self, promql: str, start_s: float, end_s: float,
+                    step_s: float) -> list[Sample]:
+        samples = self.inner.query_range(promql, start_s, end_s, step_s)
+        return apply_prom_fault(self.plan, promql, samples)
+
+    def clone(self) -> "FaultyPromAPI":
+        clone = getattr(self.inner, "clone", None)
+        return FaultyPromAPI(clone() if callable(clone) else self.inner,
+                             self.plan)

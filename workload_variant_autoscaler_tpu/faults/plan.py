@@ -1,0 +1,216 @@
+"""Scriptable fault plans: one failure model for every dependency.
+
+A `FaultPlan` is a declarative schedule of dependency misbehavior —
+Prometheus timeouts, partial series, NaN samples, clock-skewed scrapes,
+kube 409-conflict storms, watch-stream drops, ConfigMap disappearance —
+that the injection hooks (faults/inject.py, InMemoryKube.attach_fault_plan,
+SimPromAPI(fault_plan=...), the emulator server's WVA_FAULT_PLAN env)
+consult at call time. The SAME plan object (or its JSON form) drives unit
+tests, the sim-time e2e closed loop, and the real-time emulator server,
+so a degradation behavior proven in tests/test_chaos.py is exercised
+end-to-end unchanged.
+
+Determinism is a hard requirement (the chaos suite asserts byte-identical
+outcomes across reruns): every probabilistic rule draws from its own
+`random.Random` seeded from (plan.seed, rule index) — never wall-clock
+randomness — and schedule windows advance only via `begin_cycle()` /
+`tick()`, both driven by the harness clock.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass
+from typing import Optional
+
+# dependencies
+DEP_PROMETHEUS = "prometheus"
+DEP_KUBE = "kube"
+DEP_WATCH = "watch"
+
+# fault kinds (the fault matrix; see docs/robustness.md)
+PROM_TIMEOUT = "prom-timeout"        # query raises TimeoutError
+PROM_PARTIAL = "prom-partial"        # matching queries return empty vectors
+PROM_NAN = "prom-nan"                # matching queries answer NaN samples
+PROM_CLOCK_SKEW = "prom-clock-skew"  # sample timestamps shifted into the past
+KUBE_CONFLICT = "kube-conflict"      # matching verbs raise 409 ConflictError
+KUBE_ERROR = "kube-error"            # matching verbs raise a transport error
+KUBE_NOT_FOUND = "kube-not-found"    # matching verbs raise 404 NotFoundError
+WATCH_DROP = "watch-drop"            # watch events silently swallowed
+
+PROM_KINDS = (PROM_TIMEOUT, PROM_PARTIAL, PROM_NAN, PROM_CLOCK_SKEW)
+KUBE_KINDS = (KUBE_CONFLICT, KUBE_ERROR, KUBE_NOT_FOUND)
+ALL_KINDS = PROM_KINDS + KUBE_KINDS + (WATCH_DROP,)
+
+_KIND_DEPS = {
+    **{k: DEP_PROMETHEUS for k in PROM_KINDS},
+    **{k: DEP_KUBE for k in KUBE_KINDS},
+    WATCH_DROP: DEP_WATCH,
+}
+
+
+@dataclass
+class FaultRule:
+    """One scheduled fault. Active while BOTH windows admit the current
+    position: `[after_cycle, until_cycle)` in reconcile cycles (advanced
+    by `FaultPlan.begin_cycle()`) and `[after_s, until_s)` in harness
+    seconds (advanced by `FaultPlan.tick()`). An unset bound is
+    unbounded, so a purely cycle-scheduled plan ignores time and vice
+    versa — unit tests script in cycles, the real-time emulator in
+    seconds, same rule type.
+
+    match: substring filter on the call being intercepted — the PromQL
+    text for prometheus kinds, "verb:Kind" (e.g. "get:ConfigMap",
+    "update_status:VariantAutoscaling") for kube kinds; "" matches
+    every call of the dependency.
+    probability: per-call trip chance, drawn from the rule's own seeded
+    rng (1.0 = always).
+    skew_s: for prom-clock-skew, how far sample timestamps are shifted
+    into the past (a skewed scrape looks stale to the collector).
+    """
+
+    kind: str
+    match: str = ""
+    after_cycle: int = 0
+    until_cycle: Optional[int] = None
+    after_s: Optional[float] = None
+    until_s: Optional[float] = None
+    probability: float = 1.0
+    skew_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.kind not in ALL_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; known: {sorted(ALL_KINDS)}")
+        if not 0.0 <= self.probability <= 1.0:
+            raise ValueError(f"probability must be in [0,1], got "
+                             f"{self.probability}")
+        if self.kind == PROM_CLOCK_SKEW and self.skew_s <= 0.0:
+            raise ValueError("prom-clock-skew needs skew_s > 0")
+
+    @property
+    def dep(self) -> str:
+        return _KIND_DEPS[self.kind]
+
+    def in_window(self, cycle: int, now_s: float) -> bool:
+        if cycle < self.after_cycle:
+            return False
+        if self.until_cycle is not None and cycle >= self.until_cycle:
+            return False
+        if self.after_s is not None and now_s < self.after_s:
+            return False
+        if self.until_s is not None and now_s >= self.until_s:
+            return False
+        return True
+
+
+class FaultPlan:
+    """A schedule of FaultRules plus the position (cycle, seconds) the
+    windows are evaluated against. Hooks ask `prom_fault(promql)` /
+    `kube_fault(verb, kind)` / `watch_dropping()` per call; the harness
+    advances position with `begin_cycle()` (once per reconcile) and/or
+    `tick(now_s)` (scrape ticks, sim clock, wall clock)."""
+
+    def __init__(self, rules: list[FaultRule] | None = None, seed: int = 0):
+        self.rules: list[FaultRule] = list(rules or [])
+        self.seed = seed
+        self.cycle = 0
+        self.now_s = 0.0
+        self._t0: Optional[float] = None
+        self._rngs = [self._rule_rng(i) for i in range(len(self.rules))]
+        # observability for tests/debugging: (cycle, kind, match-text)
+        self.trips: list[tuple[int, str, str]] = []
+
+    def _rule_rng(self, index: int) -> random.Random:
+        # one independent deterministic stream per rule: adding a rule
+        # never perturbs the draws of the ones before it
+        return random.Random((self.seed * 1_000_003 + index) & 0xFFFFFFFF)
+
+    def add(self, rule: FaultRule) -> "FaultPlan":
+        self.rules.append(rule)
+        self._rngs.append(self._rule_rng(len(self.rules) - 1))
+        return self
+
+    # -- position ---------------------------------------------------------
+
+    def begin_cycle(self) -> int:
+        """Advance to the next reconcile cycle; returns the new index.
+        The first reconcile after construction runs as cycle 1, so
+        `after_cycle=1` means 'from the first cycle on' and
+        `after_cycle=2` 'healthy first cycle, then faults'."""
+        self.cycle += 1
+        return self.cycle
+
+    def tick(self, now_s: float) -> None:
+        """Advance the time axis. The clock is rebased to the FIRST tick
+        (so `after_s: 60` always means one minute into the run, whether
+        the harness feeds sim seconds from ~0 or unix time); stale ticks
+        are ignored (monotone)."""
+        if self._t0 is None:
+            self._t0 = now_s
+        rel = now_s - self._t0
+        if rel > self.now_s:
+            self.now_s = rel
+
+    # -- lookups (called by the injection hooks) --------------------------
+
+    def _active(self, kind_filter: tuple[str, ...], text: str):
+        for i, rule in enumerate(self.rules):
+            if rule.kind not in kind_filter:
+                continue
+            if not rule.in_window(self.cycle, self.now_s):
+                continue
+            if rule.match and rule.match not in text:
+                continue
+            if rule.probability < 1.0 and \
+                    self._rngs[i].random() >= rule.probability:
+                continue
+            self.trips.append((self.cycle, rule.kind, text[:120]))
+            return rule
+        return None
+
+    def prom_fault(self, promql: str) -> Optional[FaultRule]:
+        """First active prometheus rule matching this query, or None."""
+        return self._active(PROM_KINDS, promql)
+
+    def kube_fault(self, verb: str, kind: str) -> Optional[FaultRule]:
+        """First active kube rule matching this verb:Kind, or None."""
+        return self._active(KUBE_KINDS, f"{verb}:{kind}")
+
+    def watch_dropping(self) -> bool:
+        """True while a watch-drop window is active (events swallowed)."""
+        return self._active((WATCH_DROP,), "") is not None
+
+    # -- scripting (JSON form: the emulator server's WVA_FAULT_PLAN) ------
+
+    @classmethod
+    def from_dict(cls, obj: dict) -> "FaultPlan":
+        if not isinstance(obj, dict):
+            raise ValueError("fault plan must be a JSON object")
+        rules = []
+        for i, r in enumerate(obj.get("rules") or []):
+            if not isinstance(r, dict):
+                raise ValueError(f"rules[{i}] must be an object")
+            unknown = set(r) - {
+                "kind", "match", "after_cycle", "until_cycle",
+                "after_s", "until_s", "probability", "skew_s",
+            }
+            if unknown:
+                raise ValueError(f"rules[{i}]: unknown keys {sorted(unknown)}")
+            rules.append(FaultRule(**r))
+        return cls(rules, seed=int(obj.get("seed") or 0))
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        return cls.from_dict(json.loads(text))
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "rules": [
+                {k: v for k, v in vars(r).items() if v not in (None, "", 0.0)
+                 or k in ("kind",)}
+                for r in self.rules
+            ],
+        }

@@ -115,6 +115,11 @@ INFERNO_TPU_DUTY_CYCLE = "inferno_tpu_duty_cycle_percent"
 INFERNO_TPU_HBM_USAGE = "inferno_tpu_hbm_usage_bytes"
 INFERNO_CONDITION_STATUS = "inferno_condition_status"
 INFERNO_DEMAND_PROBE_KICKS_TOTAL = "inferno_demand_probe_kicks_total"
+INFERNO_DEGRADATION_STATE = "inferno_degradation_state"
+INFERNO_CYCLE_DEGRADATION_STATE = "inferno_cycle_degradation_state"
+INFERNO_CIRCUIT_STATE = "inferno_circuit_state"
+
+LABEL_DEPENDENCY = "dependency"
 
 LABEL_CONDITION_TYPE = "type"
 
@@ -228,6 +233,31 @@ class MetricsEmitter:
             [LABEL_VARIANT_NAME, LABEL_NAMESPACE, LABEL_CONDITION_TYPE],
             registry=self.registry,
         )
+        # degradation ladder (docs/robustness.md): the rung each variant
+        # — and the whole cycle — landed on, so "fleet is degraded" is an
+        # alertable series, not a log-grep (0=healthy 1=stale-cache
+        # 2=limited 3=hold)
+        self.degradation_state = Gauge(
+            INFERNO_DEGRADATION_STATE,
+            "Degradation-ladder rung the variant's last cycle landed on "
+            "(0=healthy, 1=stale-cache, 2=limited, 3=hold)",
+            [LABEL_VARIANT_NAME, LABEL_NAMESPACE],
+            registry=self.registry,
+        )
+        self.cycle_degradation_state = Gauge(
+            INFERNO_CYCLE_DEGRADATION_STATE,
+            "Worst degradation-ladder rung of the last reconcile cycle",
+            registry=self.registry,
+        )
+        # per-dependency circuit breakers (utils/backoff.py): 0=closed,
+        # 1=half-open, 2=open
+        self.circuit_state = Gauge(
+            INFERNO_CIRCUIT_STATE,
+            "Circuit-breaker state per dependency (0=closed, 1=half-open, "
+            "2=open)",
+            [LABEL_DEPENDENCY],
+            registry=self.registry,
+        )
         # perf-model drift (beyond-reference: the reference never compares
         # its scraped latencies against its own queueing model)
         self.model_drift = Gauge(
@@ -314,6 +344,32 @@ class MetricsEmitter:
                     LABEL_NAMESPACE: namespace,
                     LABEL_METRIC: metric,
                 }).set(ratio)
+
+    def emit_degradation_metrics(
+        self, per_variant: dict[tuple[str, str], int],
+        cycle_state: int,
+    ) -> None:
+        """Replace the per-variant degradation series wholesale each
+        cycle (deleted variants' rungs disappear) and set the cycle-level
+        worst rung. Keys: (variant_name, namespace); values: the ladder
+        rung (controller/degradation.py)."""
+        with self._lock:
+            self.degradation_state.clear()
+            for (variant_name, namespace), state in per_variant.items():
+                self.degradation_state.labels(**{
+                    LABEL_VARIANT_NAME: variant_name,
+                    LABEL_NAMESPACE: namespace,
+                }).set(state)
+            self.cycle_degradation_state.set(cycle_state)
+
+    def emit_circuit_metrics(self, per_dependency: dict[str, int]) -> None:
+        """Breaker state per dependency (0=closed, 1=half-open, 2=open).
+        Not wholesale-replaced: the breaker set is fixed at construction
+        and a dependency's series must persist across cycles."""
+        with self._lock:
+            for dependency, state in per_dependency.items():
+                self.circuit_state.labels(
+                    **{LABEL_DEPENDENCY: dependency}).set(state)
 
     def emit_cycle_timing(self, stage_msec: dict[str, float]) -> None:
         """Publish per-stage durations + their total for the last cycle.

@@ -7,6 +7,10 @@ from .backoff import (
     RECONCILE_BACKOFF,
     STANDARD_BACKOFF,
     Backoff,
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceeded,
     TerminalError,
     with_backoff,
 )
@@ -38,6 +42,10 @@ def parse_float_or(s, default: float = 0.0) -> float:
 
 __all__ = [
     "Backoff",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "Deadline",
+    "DeadlineExceeded",
     "PROMETHEUS_BACKOFF",
     "RECONCILE_BACKOFF",
     "STANDARD_BACKOFF",

@@ -1,22 +1,37 @@
-"""Exponential backoff for control-plane calls.
+"""Retry, deadline, and circuit-breaker primitives for control-plane calls.
 
 Equivalent of the reference's wait.Backoff wrappers (/root/reference
-internal/utils/utils.go:31-104): a handful of presets and a retry helper
-that distinguishes terminal from transient errors.
+internal/utils/utils.go:31-104) — a handful of presets and a retry helper
+that distinguishes terminal from transient errors — extended with the two
+mechanisms the reference leaves to controller-runtime:
+
+- `Deadline`: a per-cycle retry budget. A reconcile cycle that spends its
+  whole interval inside nested backoff loops is pure badput (PAPERS.md,
+  ML Productivity Goodput): the cycle must FAIL, land in a documented
+  degraded state, and let the next cycle run, rather than spin.
+- `CircuitBreaker`: per-dependency failure isolation. When Prometheus or
+  the apiserver is down, every cycle re-paying a full backoff per call
+  turns one outage into N*steps sleeps; the breaker fails fast while
+  open and re-probes with a single half-open call.
 """
 
 from __future__ import annotations
 
+import math
 import random
 import time
 from dataclasses import dataclass
-from typing import Callable, TypeVar
+from typing import Callable, Optional, TypeVar
 
 T = TypeVar("T")
 
 
 class TerminalError(Exception):
     """Not worth retrying (e.g. NotFound on a get, Invalid on an update)."""
+
+
+class DeadlineExceeded(Exception):
+    """The retry budget for this cycle is spent: stop, don't spin."""
 
 
 @dataclass(frozen=True)
@@ -33,18 +48,58 @@ RECONCILE_BACKOFF = Backoff(duration=0.5, factor=2.0, steps=5)
 PROMETHEUS_BACKOFF = Backoff(duration=5.0, factor=2.0, jitter=0.1, steps=6)  # ~5 min
 
 
+class Deadline:
+    """Wall-clock budget shared by every retry loop in one reconcile
+    cycle. `clock` is injectable so sim-time tests stay deterministic."""
+
+    def __init__(self, budget_s: float = math.inf,
+                 clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._start = clock()
+        self.budget_s = budget_s
+
+    @classmethod
+    def unlimited(cls) -> "Deadline":
+        return cls(math.inf)
+
+    def elapsed(self) -> float:
+        return self._clock() - self._start
+
+    def remaining(self) -> float:
+        return self.budget_s - self.elapsed()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+
 def with_backoff(
     fn: Callable[[], T],
     backoff: Backoff = STANDARD_BACKOFF,
     sleep: Callable[[float], None] = time.sleep,
+    rng: Optional[random.Random] = None,
+    deadline: Optional[Deadline] = None,
 ) -> T:
-    """Run fn with exponential backoff. TerminalError propagates
+    """Run fn with jittered exponential backoff. TerminalError propagates
     immediately; other exceptions retry until steps are exhausted, then the
     last one propagates.
+
+    rng: jitter source (None = the module-level random). Injecting a
+    seeded Random makes retry timing reproducible — the chaos suite's
+    no-wall-clock-randomness rule.
+    deadline: per-cycle budget. When the budget is spent — or cannot
+    cover the next sleep — DeadlineExceeded is raised (chained to the
+    last transient error) instead of sleeping past it: a cycle must fail
+    visibly rather than eat its whole interval retrying.
     """
+    rand = rng.random if rng is not None else random.random
     delay = backoff.duration
     last: Exception | None = None
     for step in range(backoff.steps):
+        if deadline is not None and deadline.expired():
+            raise DeadlineExceeded(
+                f"cycle budget {deadline.budget_s:.1f}s spent before the "
+                "call could be attempted"
+            ) from last
         try:
             return fn()
         except TerminalError:
@@ -55,8 +110,100 @@ def with_backoff(
                 break
             d = delay
             if backoff.jitter > 0:
-                d += delay * backoff.jitter * random.random()
+                d += delay * backoff.jitter * rand()
+            if deadline is not None and d > deadline.remaining():
+                raise DeadlineExceeded(
+                    f"next retry sleep {d:.2f}s exceeds the remaining "
+                    f"cycle budget {max(deadline.remaining(), 0.0):.2f}s"
+                ) from last
             sleep(d)
             delay *= backoff.factor
     assert last is not None
     raise last
+
+
+class CircuitOpenError(Exception):
+    """The dependency's breaker is open: failing fast, not calling."""
+
+    def __init__(self, dependency: str, retry_in_s: float):
+        self.dependency = dependency
+        self.retry_in_s = retry_in_s
+        super().__init__(
+            f"circuit for {dependency!r} is open; next probe in "
+            f"{max(retry_in_s, 0.0):.1f}s"
+        )
+
+
+class CircuitBreaker:
+    """Per-dependency circuit breaker: closed -> open after
+    `failure_threshold` consecutive failures, half-open after
+    `reset_after_s` (one probe: success closes, failure re-opens).
+
+    TerminalError does NOT count as a dependency failure — a NotFound is
+    the dependency answering correctly — and propagates untouched.
+    `clock` is injectable (sim time); single-threaded use is assumed
+    (the reconcile loop), so no internal locking.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half-open"
+
+    # stable numeric encoding for the inferno_circuit_state gauge
+    STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+    def __init__(self, name: str, failure_threshold: int = 3,
+                 reset_after_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.reset_after_s = reset_after_s
+        self._clock = clock
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self._opened_at = 0.0
+
+    def state_code(self) -> int:
+        # report what the NEXT call would see: an open breaker whose
+        # cooldown has elapsed is effectively half-open
+        state = self.state
+        if state == self.OPEN and \
+                self._clock() - self._opened_at >= self.reset_after_s:
+            state = self.HALF_OPEN
+        return self.STATE_CODES[state]
+
+    def _open(self) -> None:
+        self.state = self.OPEN
+        self._opened_at = self._clock()
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        self.state = self.CLOSED
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if self.state == self.HALF_OPEN or \
+                self.consecutive_failures >= self.failure_threshold:
+            self._open()
+
+    def call(self, fn: Callable[[], T]) -> T:
+        if self.state == self.OPEN:
+            waited = self._clock() - self._opened_at
+            if waited < self.reset_after_s:
+                raise CircuitOpenError(self.name,
+                                       self.reset_after_s - waited)
+            self.state = self.HALF_OPEN  # one probe goes through
+        try:
+            result = fn()
+        except TerminalError:
+            # the dependency responded; a terminal verdict is not an
+            # availability failure, and must not trip the breaker
+            self.record_success()
+            raise
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result
