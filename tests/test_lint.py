@@ -280,6 +280,64 @@ class TestSelfAttrs:
             "    def g(self):\n        return self.__dict__\n")
 
 
+class TestMetricsDocRule:
+    """WVL301/302 — every INFERNO_* series constant must be registered
+    on MetricsEmitter AND documented in docs/metrics-health-monitoring.md
+    (PR-2 satellite: the doc table cannot silently rot)."""
+
+    SRC_OK = (
+        'INFERNO_GOOD = "inferno_good_series"\n'
+        "class MetricsEmitter:\n"
+        "    def __init__(self):\n"
+        "        self.g = Gauge(INFERNO_GOOD)\n"
+    )
+
+    def codes(self, src, doc):
+        return [f.code for f in wvalint.check_metrics_doc(src, doc)]
+
+    def test_registered_and_documented_passes(self):
+        assert self.codes(self.SRC_OK, "| `inferno_good_series` |") == []
+
+    def test_unregistered_constant_fires_wvl301(self):
+        src = ('INFERNO_ORPHAN = "inferno_orphan_series"\n'
+               "class MetricsEmitter:\n"
+               "    def __init__(self):\n"
+               "        pass\n")
+        assert self.codes(src, "`inferno_orphan_series`") == ["WVL301"]
+
+    def test_undocumented_series_fires_wvl302(self):
+        assert self.codes(self.SRC_OK, "no series here") == ["WVL302"]
+
+    def test_reference_outside_emitter_does_not_register(self):
+        src = ('INFERNO_X = "inferno_x"\n'
+               "def elsewhere():\n"
+               "    return INFERNO_X\n"
+               "class MetricsEmitter:\n"
+               "    pass\n")
+        assert "WVL301" in self.codes(src, "`inferno_x`")
+
+    def test_non_series_constants_ignored(self):
+        src = ('LABEL_STAGE = "stage"\n'
+               'OTHER = "inferno_not_a_constant"\n'
+               "class MetricsEmitter:\n"
+               "    pass\n")
+        assert self.codes(src, "") == []
+
+    def test_repo_metrics_module_is_clean(self):
+        """The real emitter module against the real doc — the gate the
+        `main()` driver also runs via test_repo_is_clean."""
+        metrics_py = os.path.join(
+            REPO, "workload_variant_autoscaler_tpu", "metrics",
+            "__init__.py")
+        doc = os.path.join(REPO, "docs", "metrics-health-monitoring.md")
+        with open(metrics_py, encoding="utf-8") as f:
+            src = f.read()
+        with open(doc, encoding="utf-8") as f:
+            doc_text = f.read()
+        findings = wvalint.check_metrics_doc(src, doc_text)
+        assert findings == [], [f.format() for f in findings]
+
+
 class TestUnpackArityEdgeCases:
     """Regressions from the round-4 review of WVL202."""
 

@@ -1,0 +1,556 @@
+"""The flight recorder (workload_variant_autoscaler_tpu/obs/): cycle
+tracing, the decision audit trail, duration histograms, and the
+/debug/* + `explain` read surfaces.
+
+Covers the PR-2 acceptance criteria:
+
+- a chaos run produces a trace whose spans record the injected fault,
+  the retries/breaker transitions it caused, and the degradation rung;
+- `explain` reproduces the published replica count for a clamped
+  variant from its DecisionRecord alone;
+- metrics/docs parity: after one e2e reconcile cycle the /metrics
+  exposition and docs/metrics-health-monitoring.md name exactly the
+  same inferno_* families (both directions), so the doc table can't rot.
+"""
+
+import json
+import logging
+import os
+import re
+
+import pytest
+
+from test_chaos import (
+    NS,
+    VARIANT,
+    make_chaos_cluster,
+    run_cycle,
+)
+from test_scenarios import PROFILE_8B_V5E1, make_fleet_cluster, set_load
+
+from workload_variant_autoscaler_tpu import obs
+from workload_variant_autoscaler_tpu.controller.degradation import (
+    DegradationState,
+)
+from workload_variant_autoscaler_tpu.faults import (
+    KUBE_CONFLICT,
+    PROM_TIMEOUT,
+    FaultPlan,
+    FaultRule,
+)
+from workload_variant_autoscaler_tpu.metrics import RECONCILE_STAGES
+from workload_variant_autoscaler_tpu.obs import (
+    CLAMP_REPLICA_STEP,
+    DecisionLog,
+    Tracer,
+    debug_middleware,
+    explain_text,
+    record_from_dict,
+)
+from workload_variant_autoscaler_tpu.utils import (
+    Backoff,
+    CircuitBreaker,
+    with_backoff,
+)
+from workload_variant_autoscaler_tpu.utils.logging import JsonFormatter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- tracer unit behavior ---------------------------------------------------
+
+
+class TestTracer:
+    def test_nesting_and_ids(self):
+        tracer = Tracer(capacity=4)
+        with tracer.span("root", cycle=1) as root:
+            assert obs.current_trace_id() == root.trace_id
+            with tracer.span("child") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+                obs.add_event("hello", n=3)
+            assert obs.current_span() is root
+        assert obs.current_span() is None
+        tr = tracer.traces()[0]
+        assert [s.name for s in tr.spans] == ["root", "child"]
+        assert tr.events("hello") == [("child", "hello", {"n": 3})]
+        assert tr.root.duration_ms is not None
+
+    def test_ids_are_deterministic_counters(self):
+        def ids():
+            tracer = Tracer(capacity=4)
+            with tracer.span("a"):
+                with tracer.span("b"):
+                    pass
+            tr = tracer.traces()[0]
+            return [tr.trace_id] + [s.span_id for s in tr.spans]
+
+        assert ids() == ids()  # no wall-clock randomness in ids
+
+    def test_ring_is_bounded(self):
+        tracer = Tracer(capacity=3)
+        for i in range(10):
+            with tracer.span(f"cycle-{i}"):
+                pass
+        names = [t.root.name for t in tracer.traces()]
+        assert names == ["cycle-9", "cycle-8", "cycle-7"]
+
+    def test_error_status_recorded(self):
+        tracer = Tracer(capacity=2)
+        with pytest.raises(RuntimeError):
+            with tracer.span("boom"):
+                raise RuntimeError("kaput")
+        root = tracer.traces()[0].root
+        assert root.status == "error"
+        assert "kaput" in root.error
+
+    def test_module_helpers_noop_outside_trace(self):
+        obs.add_event("nobody-home")
+        obs.set_attribute("k", "v")
+        with obs.span("orphan") as sp:
+            assert sp is None  # no active tracer: null context
+
+    def test_cancel_drops_span(self):
+        tracer = Tracer(capacity=2)
+        root = tracer.begin("root")
+        spec = tracer.begin("speculative")
+        spec.cancel()
+        root.finish()
+        assert [s.name for s in tracer.traces()[0].spans] == ["root"]
+
+
+# -- trace ids + timestamps in logs (satellite: record.created) -------------
+
+
+class TestLogging:
+    def _format(self, **created):
+        record = logging.LogRecord("wva.test", logging.INFO, __file__, 1,
+                                   "hello", None, None)
+        for k, v in created.items():
+            setattr(record, k, v)
+        return json.loads(JsonFormatter().format(record))
+
+    def test_ts_is_record_created_not_format_time(self):
+        entry = self._format(created=123.456)
+        assert entry["ts"] == 123.456  # buffered records keep their time
+
+    def test_trace_id_stamped_inside_cycle(self):
+        tracer = Tracer(capacity=2)
+        with tracer.span("reconcile") as sp:
+            entry = self._format()
+            assert entry["trace_id"] == sp.trace_id
+            assert entry["span_id"] == sp.span_id
+        assert "trace_id" not in self._format()
+
+
+# -- backoff/breaker instrumentation ---------------------------------------
+
+
+class TestBackoffObserver:
+    def test_retry_and_exhausted_events(self):
+        seen = []
+
+        def observer(event, **fields):
+            seen.append((event, fields.get("attempt")))
+
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            raise RuntimeError("nope")
+
+        with pytest.raises(RuntimeError):
+            with_backoff(flaky, backoff=Backoff(duration=0.001, steps=3),
+                         sleep=lambda _s: None, observer=observer)
+        assert seen == [("retry", 0), ("retry", 1), ("exhausted", 2)]
+
+    def test_events_land_on_active_span(self):
+        tracer = Tracer(capacity=2)
+        with tracer.span("cycle"):
+            with pytest.raises(RuntimeError):
+                with_backoff(lambda: (_ for _ in ()).throw(RuntimeError("x")),
+                             backoff=Backoff(duration=0.001, steps=2),
+                             sleep=lambda _s: None)
+        events = tracer.traces()[0].events()
+        names = [e[1] for e in events]
+        assert "backoff-retry" in names and "backoff-exhausted" in names
+
+    def test_breaker_transitions_fire_callback_and_span_events(self):
+        transitions = []
+        breaker = CircuitBreaker(
+            "dep", failure_threshold=2, reset_after_s=30.0,
+            clock=lambda: 0.0,
+            on_transition=lambda name, old, new: transitions.append(
+                (name, old, new)))
+        tracer = Tracer(capacity=2)
+        with tracer.span("cycle"):
+            for _ in range(2):
+                with pytest.raises(RuntimeError):
+                    breaker.call(lambda: (_ for _ in ()).throw(
+                        RuntimeError("down")))
+        assert transitions == [("dep", "closed", "open")]
+        assert any(e[1] == "breaker-transition"
+                   for e in tracer.traces()[0].events())
+
+
+# -- decision records -------------------------------------------------------
+
+
+class TestDecisionRecord:
+    def _record(self):
+        builder = obs.DecisionBuilder(
+            variant="v", namespace="ns",
+            inputs=obs.DecisionInputs(arrival_rate_rpm=600.0,
+                                      current_replicas=3, prev_published=3),
+            accelerator="v5e-1", proposed_replicas=9)
+        builder.clamp("stabilization-window", 9, 9)    # no-op: dropped
+        builder.clamp("replica-step", 9, 5, detail="baseline=3, step=2")
+        builder.published_replicas = 5
+        return builder.freeze(trace_id="t1", cycle=7, ts=100.0)
+
+    def test_replay_reproduces_published(self):
+        rec = self._record()
+        assert rec.replay() == rec.published_replicas == 5
+        assert [c.name for c in rec.clamps] == ["replica-step"]
+
+    def test_replay_detects_broken_chain(self):
+        rec = self._record()
+        bad = record_from_dict({**rec.to_dict(),
+                                "proposed_replicas": 8})
+        with pytest.raises(ValueError, match="clamp chain broken"):
+            bad.replay()
+
+    def test_dict_round_trip(self):
+        rec = self._record()
+        again = record_from_dict(json.loads(json.dumps(rec.to_dict())))
+        assert again == rec
+
+    def test_explain_text_shows_the_chain(self):
+        text = explain_text(self._record())
+        assert "proposed: 9" in text
+        assert "replica-step: 9 -> 5" in text
+        assert "published: 5 replicas" in text
+
+    def test_log_ring_bounded_and_filtered(self):
+        log = DecisionLog(capacity=4)
+        for i in range(8):
+            log.record(self._record())
+        assert len(log.records()) == 4
+        assert log.latest("v", "ns") is not None
+        assert log.latest("other") is None
+
+
+# -- the /debug/* read surface ---------------------------------------------
+
+
+def wsgi_get(app, path, query=""):
+    status = {}
+
+    def start_response(code, headers):
+        status["code"] = code
+        status["headers"] = dict(headers)
+
+    body = b"".join(app({"PATH_INFO": path, "QUERY_STRING": query},
+                        start_response))
+    return status["code"], json.loads(body)
+
+
+class TestDebugEndpoints:
+    def _app(self):
+        tracer = Tracer(capacity=4)
+        decisions = DecisionLog(capacity=4)
+        with tracer.span("reconcile", cycle=1):
+            obs.add_event("fault-injected", kind="prom-timeout")
+        builder = obs.DecisionBuilder(variant="chat-8b", namespace=NS,
+                                      proposed_replicas=2)
+        builder.published_replicas = 2
+        decisions.record(builder.freeze("t1", 1, 10.0))
+
+        def inner(environ, start_response):
+            start_response("200 OK", [("Content-Type", "text/plain")])
+            return [b"metrics-body"]
+
+        return debug_middleware(tracer, decisions)(inner)
+
+    def test_traces_endpoint(self):
+        code, body = wsgi_get(self._app(), "/debug/traces", "limit=5")
+        assert code.startswith("200")
+        assert body["traces"][0]["root"] == "reconcile"
+        events = body["traces"][0]["spans"][0]["events"]
+        assert events[0]["name"] == "fault-injected"
+
+    def test_decisions_endpoint_filters(self):
+        code, body = wsgi_get(self._app(), "/debug/decisions",
+                              f"variant=chat-8b&namespace={NS}")
+        assert code.startswith("200")
+        assert body["decisions"][0]["variant"] == "chat-8b"
+        code, body = wsgi_get(self._app(), "/debug/decisions",
+                              "variant=nope")
+        assert body["decisions"] == []
+
+    def test_unknown_debug_path_404s_and_metrics_passes_through(self):
+        code, body = wsgi_get(self._app(), "/debug/nope")
+        assert code.startswith("404")
+        status = {}
+
+        def start_response(c, h):
+            status["code"] = c
+
+        app = self._app()
+        out = b"".join(app({"PATH_INFO": "/metrics", "QUERY_STRING": ""},
+                           start_response))
+        assert out == b"metrics-body"
+
+
+# -- e2e: one reconcile cycle is one trace + one decision per variant -------
+
+
+class TestCycleTracing:
+    def _cluster(self):
+        kube, prom, emitter, rec = make_fleet_cluster([
+            ("chat-8b", "llama-8b", "v5e-1", "premium",
+             [PROFILE_8B_V5E1], 1),
+        ])
+        set_load(prom, "llama-8b", 40.0, 128.0, 128.0)
+        return kube, prom, emitter, rec
+
+    def test_stage_spans_single_sourced_from_metrics_constants(self):
+        _kube, _prom, _emitter, rec = self._cluster()
+        rec.reconcile()
+        tr = rec.tracer.traces()[0]
+        stage_names = [s.name for s in tr.find_spans("stage:")]
+        assert stage_names == [f"stage:{s}" for s in RECONCILE_STAGES]
+        assert tr.root.name == "reconcile"
+        assert tr.root.attributes["degradation"] == "healthy"
+
+    def test_dependency_and_solver_spans_present(self):
+        _kube, _prom, _emitter, rec = self._cluster()
+        rec.reconcile()
+        tr = rec.tracer.traces()[0]
+        assert tr.find_spans("kube.get:ConfigMap/operator")
+        assert tr.find_spans("kube.update_status:VariantAutoscaling")
+        assert tr.find_spans("prometheus.query")
+        assert tr.find_spans("solver.solve")
+
+    def test_one_trace_per_cycle_with_decision_linked(self):
+        _kube, _prom, _emitter, rec = self._cluster()
+        rec.reconcile()
+        rec.reconcile()
+        traces = rec.tracer.traces()
+        assert len(traces) == 2
+        decision = rec.decisions.latest("chat-8b", NS)
+        assert decision.trace_id == traces[0].trace_id
+        assert decision.cycle == 2
+        assert decision.outcome == obs.PUBLISHED
+        assert decision.replay() == decision.published_replicas > 0
+
+    def test_stage_histogram_observes_only_reached_stages(self):
+        _kube, _prom, emitter, rec = self._cluster()
+        rec.reconcile()
+        for stage in RECONCILE_STAGES:
+            count = emitter.value("inferno_reconcile_stage_seconds_count",
+                                  stage=stage)
+            assert count == 1.0, stage
+        assert emitter.value("inferno_solve_seconds_count") == 1.0
+        assert emitter.value("inferno_dependency_latency_seconds_count",
+                             dependency="kube") > 0
+        assert emitter.value("inferno_dependency_latency_seconds_count",
+                             dependency="prometheus") > 0
+
+
+# -- acceptance: chaos run -> trace records fault, retries, breaker, rung ---
+
+
+class TestChaosFlightRecorder:
+    def test_injected_fault_retries_and_rung_on_one_trace(self):
+        plan = FaultPlan([
+            FaultRule(kind=PROM_TIMEOUT, after_cycle=2),
+            FaultRule(kind=KUBE_CONFLICT,
+                      match="update_status:VariantAutoscaling",
+                      after_cycle=2),
+        ], seed=3)
+        kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        run_cycle(rec, plan, clock, prom)            # healthy, cache warm
+        run_cycle(rec, plan, clock, prom)            # faulted cycle
+        tr = rec.tracer.traces()[0]
+
+        # the injected faults are first-class events on the trace
+        fault_events = tr.events("fault-injected")
+        deps = {e[2]["dependency"] for e in fault_events}
+        assert deps == {"prometheus", "kube"}
+
+        # the kube 409 storm paid a visible retry ladder...
+        retry_events = tr.events("backoff-retry")
+        assert retry_events and all(
+            "sleep_s" in attrs for _s, _n, attrs in retry_events)
+        # ...counted on the retries series
+        assert emitter.value("inferno_dependency_retries_total",
+                             dependency="kube", outcome="retry") > 0
+
+        # the cycle's degradation rung is on the root span
+        assert tr.root.attributes["degradation"] == "stale-cache"
+        assert tr.root.attributes["degradation_rung"] == int(
+            DegradationState.STALE_CACHE)
+
+        # and the variant's decision records the stale-cache evidence
+        decision = rec.decisions.latest(VARIANT, NS)
+        assert decision.inputs.degradation == "stale-cache"
+
+    def test_breaker_transition_recorded_on_trace(self):
+        plan = FaultPlan([FaultRule(kind=PROM_TIMEOUT, after_cycle=2)],
+                         seed=4)
+        _kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        # threshold 5: outage cycles accumulate consecutive failures
+        transition = None
+        for _ in range(12):
+            run_cycle(rec, plan, clock, prom)
+            for tr in rec.tracer.traces():
+                events = tr.events("breaker-transition")
+                if any(a.get("to_state") == "open" for _s, _n, a in events):
+                    transition = events
+                    break
+            if transition:
+                break
+        assert transition, "prometheus breaker never opened on a trace"
+        assert emitter.value("inferno_circuit_state",
+                             dependency="prometheus") == 2
+
+    def test_held_variant_records_held_decision(self):
+        plan = FaultPlan([FaultRule(kind=PROM_TIMEOUT, after_cycle=1)])
+        kube, prom, _e, rec, clock = make_chaos_cluster(plan)
+        run_cycle(rec, plan, clock, prom)   # cold cache + outage: HOLD
+        decision = rec.decisions.latest(VARIANT, NS)
+        assert decision.outcome == obs.HELD
+        assert decision.inputs.degradation == "hold"
+        assert decision.published_replicas == 0  # nothing ever published
+        assert decision.replay() == 0
+
+
+# -- acceptance: explain reproduces a clamped variant's published count -----
+
+
+class TestExplain:
+    def _clamped_cluster(self):
+        """Demand jump under WVA_MAX_REPLICA_STEP=2 from 1 replica: the
+        solver proposal is clamped to baseline+2 on the first publish."""
+        plan = FaultPlan([], seed=7)
+        kube, prom, emitter, rec, clock = make_chaos_cluster(
+            plan, replicas=1, operator_extra={"WVA_MAX_REPLICA_STEP": "2"})
+        run_cycle(rec, plan, clock, prom, rps=120.0)
+        return kube, rec
+
+    def test_decision_replay_matches_published_cr(self):
+        kube, rec = self._clamped_cluster()
+        va = kube.get_variant_autoscaling(VARIANT, NS)
+        published = va.status.desired_optimized_alloc.num_replicas
+        assert published == 3  # 1 (live) + step 2
+
+        decision = rec.decisions.latest(VARIANT, NS)
+        assert decision.proposed_replicas > published
+        assert [c.name for c in decision.clamps] == [CLAMP_REPLICA_STEP]
+        # the whole acceptance: the record ALONE reproduces the CR value
+        assert decision.replay() == published == decision.published_replicas
+
+    def test_explain_cli_from_file(self, tmp_path, capsys):
+        from workload_variant_autoscaler_tpu.controller.__main__ import (
+            explain_main,
+        )
+
+        _kube, rec = self._clamped_cluster()
+        dump = tmp_path / "decisions.json"
+        dump.write_text(json.dumps({"decisions": rec.decisions.snapshot()},
+                                   default=str))
+        assert explain_main([VARIANT, "--namespace", NS,
+                             "--file", str(dump)]) == 0
+        out = capsys.readouterr().out
+        assert f"clamp {CLAMP_REPLICA_STEP}" in out
+        assert "replay check: clamp chain reproduces 3 (OK)" in out
+
+        assert explain_main(["missing-variant", "--file", str(dump)]) == 1
+
+    def test_explain_cli_json_output(self, tmp_path, capsys):
+        from workload_variant_autoscaler_tpu.controller.__main__ import (
+            explain_main,
+        )
+
+        _kube, rec = self._clamped_cluster()
+        dump = tmp_path / "decisions.json"
+        dump.write_text(json.dumps({"decisions": rec.decisions.snapshot()},
+                                   default=str))
+        assert explain_main([VARIANT, "--file", str(dump), "--json"]) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["variant"] == VARIANT
+        assert record_from_dict(parsed).replay() == 3
+
+
+# -- satellite: metrics/docs parity (the doc table is executable) -----------
+
+
+def exported_families(emitter) -> set:
+    from prometheus_client import generate_latest
+
+    text = generate_latest(emitter.registry).decode()
+    return {name for name in re.findall(r"^# TYPE (inferno_\w+) ", text,
+                                        re.M)
+            if not name.endswith("_created")}
+
+
+def documented_families() -> set:
+    doc = open(os.path.join(REPO, "docs", "metrics-health-monitoring.md"),
+               encoding="utf-8").read()
+    section = doc.split("## Emitted metrics", 1)[1].split("\n## ", 1)[0]
+    return set(re.findall(r"inferno_[a-z0-9_]+", section))
+
+
+def test_metrics_doc_parity_after_e2e_cycle():
+    """Scrape-parse /metrics after one full reconcile cycle: every series
+    in the doc's emitted-metrics section exists, and every exported
+    family is documented — in both directions, so neither side rots."""
+    _kube, prom, emitter, rec = make_fleet_cluster([
+        ("chat-8b", "llama-8b", "v5e-1", "premium", [PROFILE_8B_V5E1], 1),
+    ])
+    set_load(prom, "llama-8b", 40.0, 128.0, 128.0)
+    result = rec.reconcile()
+    assert result.processed == ["chat-8b:default"]
+
+    exported = exported_families(emitter)
+    documented = documented_families()
+    assert documented - exported == set(), \
+        f"documented but not exported: {sorted(documented - exported)}"
+    assert exported - documented == set(), \
+        f"exported but not documented: {sorted(exported - documented)}"
+
+
+def test_debug_routes_served_next_to_metrics():
+    """serve(debug_middleware=...) mounts the flight recorder on the
+    real metrics server: /debug/* answers JSON, /metrics still scrapes."""
+    from urllib.request import urlopen
+
+    _kube, prom, emitter, rec = make_fleet_cluster([
+        ("chat-8b", "llama-8b", "v5e-1", "premium", [PROFILE_8B_V5E1], 1),
+    ])
+    set_load(prom, "llama-8b", 40.0, 128.0, 128.0)
+    rec.reconcile()
+    server, _thread, _rel = emitter.serve(
+        0, addr="127.0.0.1",
+        debug_middleware=debug_middleware(rec.tracer, rec.decisions))
+    try:
+        port = server.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+        traces = json.load(urlopen(f"{base}/debug/traces?limit=2"))
+        assert traces["traces"][0]["root"] == "reconcile"
+        decisions = json.load(urlopen(f"{base}/debug/decisions"
+                                      "?variant=chat-8b"))
+        assert decisions["decisions"][0]["published_replicas"] > 0
+        scrape = urlopen(f"{base}/metrics").read().decode()
+        assert "inferno_reconcile_stage_seconds" in scrape
+    finally:
+        server.shutdown()
+
+
+def test_trace_buffer_knob(monkeypatch):
+    monkeypatch.setenv("WVA_TRACE_BUFFER", "2")
+    tracer = Tracer()
+    assert tracer.capacity == 2
+    monkeypatch.setenv("WVA_TRACE_BUFFER", "not-a-number")
+    assert Tracer().capacity == 64

@@ -34,6 +34,13 @@ Rules (suppress per-line with `# noqa` or `# noqa: WVLxxx`):
           `x` (skipped for classes with __getattr__, setattr, dynamic
           or out-of-repo bases — the self-receiver slice of mypy's
           attribute checking)
+  WVL301  metrics registry parity: an `INFERNO_*` series constant in
+          metrics/__init__.py that no code inside MetricsEmitter
+          references (declared but never registered — the series can
+          never appear on /metrics)
+  WVL302  metrics doc parity: an `INFERNO_*` series constant whose
+          series name does not appear in docs/metrics-health-monitoring.md
+          (an exported series operators can't look up)
 
 Exit status: number of findings (0 = clean).
 """
@@ -772,6 +779,73 @@ def _check_self_attrs(path: str, tree: ast.Module,
     return findings
 
 
+# -- metrics registry/doc parity (WVL301/302) -------------------------------
+
+# repo-shape anchors for the rule: the emitter module and the doc whose
+# series table must cover it
+METRICS_MODULE_SUFFIX = os.path.join("metrics", "__init__.py")
+METRICS_DOC_RELPATH = os.path.join("docs", "metrics-health-monitoring.md")
+
+
+def check_metrics_doc(metrics_source: str, doc_text: str,
+                      path: str = "metrics/__init__.py") -> list[Finding]:
+    """Every `INFERNO_* = "series"` constant must be (a) referenced
+    somewhere inside the MetricsEmitter class — a constant no registration
+    uses is a series that can never exist (WVL301) — and (b) named in the
+    metrics doc, or the doc table has rotted against the code (WVL302)."""
+    try:
+        tree = ast.parse(metrics_source, path)
+    except SyntaxError:
+        return []
+    consts: dict[str, tuple[str, int]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.startswith("INFERNO_") \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            consts[node.targets[0].id] = (node.value.value, node.lineno)
+    if not consts:
+        return []
+    referenced: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "MetricsEmitter":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and isinstance(
+                        sub.ctx, ast.Load) and sub.id in consts:
+                    referenced.add(sub.id)
+    findings: list[Finding] = []
+    for name, (value, line) in sorted(consts.items()):
+        if name not in referenced:
+            findings.append(Finding(
+                path, line, "WVL301",
+                f"{name} ({value!r}) is not registered on MetricsEmitter"))
+        if value not in doc_text:
+            findings.append(Finding(
+                path, line, "WVL302",
+                f"{name} ({value!r}) is not documented in "
+                f"{METRICS_DOC_RELPATH}"))
+    return findings
+
+
+def _metrics_doc_findings(files: list[str],
+                          sources: dict[str, str]) -> list[Finding]:
+    """Run WVL301/302 when the scan covers the emitter module and the
+    repo's metrics doc exists next to it."""
+    findings: list[Finding] = []
+    for fp in files:
+        if not os.path.abspath(fp).endswith(METRICS_MODULE_SUFFIX):
+            continue
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(fp)))
+        doc = os.path.join(os.path.dirname(pkg_root), METRICS_DOC_RELPATH)
+        if not os.path.exists(doc):
+            continue
+        with open(doc, encoding="utf-8") as f:
+            doc_text = f.read()
+        findings += check_metrics_doc(sources[fp], doc_text, fp)
+    return findings
+
+
 # -- driver ----------------------------------------------------------------
 
 
@@ -841,6 +915,7 @@ def main(argv=None) -> int:
     findings: list[Finding] = []
     for fp in files:
         findings += lint_source(fp, sources[fp], sigs, rets, classes)
+    findings += _metrics_doc_findings(files, sources)
     for f in sorted(findings, key=lambda f: (f.path, f.line)):
         print(f.format())
     if findings:

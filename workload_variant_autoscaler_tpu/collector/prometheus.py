@@ -15,7 +15,16 @@ import time
 from dataclasses import dataclass
 from typing import Optional, Protocol
 
-from ..utils import PROMETHEUS_BACKOFF, fix_value, get_logger, kv, with_backoff
+from ..obs import trace as obs_trace
+from ..utils import (
+    CIRCUIT_OPEN,
+    PROMETHEUS_BACKOFF,
+    CircuitOpenError,
+    fix_value,
+    get_logger,
+    kv,
+    with_backoff,
+)
 
 log = get_logger("wva.prometheus")
 
@@ -192,18 +201,42 @@ class GuardedPromAPI:
     PrometheusError condition, so callers need no special casing. The
     breaker is single-threaded by design: clone() returns an UNguarded
     clone of the inner client for daemon threads (their best-effort
-    queries must not race the reconcile loop's breaker state)."""
+    queries must not race the reconcile loop's breaker state).
 
-    def __init__(self, inner: PromAPI, breaker):
+    Every query runs inside a trace span (obs/trace.py; no-op outside a
+    cycle trace) and, when an emitter is attached, feeds the
+    inferno_dependency_latency_seconds histogram and the circuit-open
+    fail-fast outcome of inferno_dependency_retries_total."""
+
+    DEPENDENCY = "prometheus"
+
+    def __init__(self, inner: PromAPI, breaker, emitter=None):
         self.inner = inner
         self.breaker = breaker
+        self.emitter = emitter
+
+    def _guarded(self, op: str, promql: str, fn):
+        with obs_trace.span(f"prometheus.{op}", promql=promql[:200]):
+            t0 = time.perf_counter()
+            try:
+                return self.breaker.call(fn)
+            except CircuitOpenError:
+                if self.emitter is not None:
+                    self.emitter.emit_retry(self.DEPENDENCY, CIRCUIT_OPEN)
+                raise
+            finally:
+                if self.emitter is not None:
+                    self.emitter.emit_dependency_latency(
+                        self.DEPENDENCY, time.perf_counter() - t0)
 
     def query(self, promql: str) -> list[Sample]:
-        return self.breaker.call(lambda: self.inner.query(promql))
+        return self._guarded("query", promql,
+                             lambda: self.inner.query(promql))
 
     def query_range(self, promql: str, start_s: float, end_s: float,
                     step_s: float) -> list[Sample]:
-        return self.breaker.call(
+        return self._guarded(
+            "query_range", promql,
             lambda: self.inner.query_range(promql, start_s, end_s, step_s))
 
     def clone(self):

@@ -10,11 +10,21 @@ Usage:
     python -m workload_variant_autoscaler_tpu.controller \
         [--metrics-port 8443] [--health-port 8081] [--leader-elect] \
         [--config-namespace NS] [--allow-http-prom]
+
+    python -m workload_variant_autoscaler_tpu.controller explain <variant> \
+        [--namespace NS] [--url http://HOST:METRICS_PORT] [--json]
+
+The `explain` subcommand renders a variant's latest DecisionRecord —
+the solve inputs, every clamp applied, and the published replica count,
+reproducible from the record alone — fetched from a running
+controller's /debug/decisions endpoint (or a saved JSON dump via
+--file; see docs/observability.md).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import math
 import os
 import sys
@@ -22,6 +32,7 @@ import threading
 
 from ..collector import HTTPPromAPI, PrometheusConfig, validate_prometheus_api
 from ..metrics import MetricsEmitter
+from ..obs import debug_middleware, explain_text, record_from_dict
 from ..utils import get_logger, kv
 from ..utils.platform import pin_platform_from_env
 from .kube import RestKube, in_memory_kube_from_manifests
@@ -29,7 +40,68 @@ from .reconciler import CONFIG_MAP_NAMESPACE, Reconciler
 from .runtime import HealthServer, LeaderElector
 
 
+def explain_main(argv) -> int:
+    """The decision-audit read path: why did <variant> get its replica
+    count. Exits 0 with the rendered record, 1 when no record exists."""
+    parser = argparse.ArgumentParser(
+        prog="python -m workload_variant_autoscaler_tpu.controller explain",
+        description="Explain a variant's latest scaling decision from "
+                    "its DecisionRecord")
+    parser.add_argument("variant", help="VariantAutoscaling name")
+    parser.add_argument("--namespace", default="",
+                        help="namespace filter (default: any)")
+    parser.add_argument("--url",
+                        default=os.environ.get("WVA_DEBUG_URL",
+                                               "http://127.0.0.1:8080"),
+                        help="base URL of the controller's metrics/debug "
+                             "server (default http://127.0.0.1:8080)")
+    parser.add_argument("--file", default=None, metavar="PATH",
+                        help="read a saved /debug/decisions JSON payload "
+                             "instead of querying a live controller")
+    parser.add_argument("--json", action="store_true",
+                        help="print the raw record JSON instead of the "
+                             "rendered explanation")
+    args = parser.parse_args(argv)
+
+    if args.file:
+        with open(args.file, encoding="utf-8") as f:
+            payload = json.load(f)
+    else:
+        from urllib.parse import urlencode
+        from urllib.request import urlopen
+
+        query = urlencode({"variant": args.variant,
+                           "namespace": args.namespace, "limit": 1})
+        url = f"{args.url.rstrip('/')}/debug/decisions?{query}"
+        with urlopen(url, timeout=10.0) as resp:  # noqa: S310 — operator-supplied URL
+            payload = json.load(resp)
+
+    decisions = payload.get("decisions", payload) \
+        if isinstance(payload, dict) else payload
+    matching = [d for d in decisions
+                if d.get("variant") == args.variant
+                and (not args.namespace
+                     or d.get("namespace") == args.namespace)]
+    if not matching:
+        print(f"no DecisionRecord for variant {args.variant!r}"
+              + (f" in namespace {args.namespace!r}" if args.namespace
+                 else ""), file=sys.stderr)
+        return 1
+    record = record_from_dict(matching[0])
+    if args.json:
+        print(json.dumps(record.to_dict(), indent=2, default=str))
+    else:
+        print(explain_text(record))
+        replayed = record.replay()
+        print(f"  replay check: clamp chain reproduces {replayed} "
+              f"({'OK' if replayed == record.published_replicas else 'MISMATCH'})")
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "explain":
+        return explain_main(argv[1:])
     parser = argparse.ArgumentParser(description="TPU-native workload variant autoscaler")
     parser.add_argument("--metrics-port", type=int, default=8080,
                         help="port for the emitted /metrics endpoint")
@@ -179,21 +251,25 @@ def main(argv=None) -> int:
         from ..metrics.authz import KubeAuthGate
 
         auth_gate = KubeAuthGate(kube)
+    reconciler = Reconciler(
+        kube=kube, prom=prom, emitter=emitter,
+        config_namespace=args.config_namespace,
+    )
     try:
         emitter.serve(
             args.metrics_port, addr=args.metrics_addr,
             certfile=args.metrics_cert or None, keyfile=args.metrics_key or None,
             client_cafile=args.metrics_client_ca or None,
             auth_gate=auth_gate,
+            # the flight recorder's read surface (/debug/traces,
+            # /debug/decisions — docs/observability.md), inside the
+            # auth gate when one is configured
+            debug_middleware=debug_middleware(reconciler.tracer,
+                                              reconciler.decisions),
         )
     except ValueError as e:
         log.error("invalid metrics TLS configuration", extra=kv(error=str(e)))
         return 1
-
-    reconciler = Reconciler(
-        kube=kube, prom=prom, emitter=emitter,
-        config_namespace=args.config_namespace,
-    )
     stop = threading.Event()
     # Process is serviceable once dependencies are validated; readiness does
     # NOT gate on holding the leader lease (follower replicas must go Ready

@@ -234,7 +234,12 @@ class InMemoryKube:
             rule = plan.kube_fault(verb, kind)
             if rule is not None:
                 from ..faults.inject import exception_for_kube_fault
+                from ..obs.trace import add_event
 
+                # surface the scheduled fault on the cycle's trace span
+                # (no-op outside a trace) before raising its exception
+                add_event("fault-injected", dependency="kube",
+                          kind=rule.kind, op=f"{verb}:{kind}")
                 raise exception_for_kube_fault(rule, verb, kind)
         entry = self._faults.get((verb, kind))
         if entry is None:

@@ -31,12 +31,34 @@ from ..collector import (
     validate_metrics_availability,
 )
 from ..collector.prometheus import GuardedPromAPI
-from ..metrics import RECONCILE_STAGES, MetricsEmitter
+from ..metrics import (
+    RECONCILE_STAGES,
+    STAGE_ANALYZE,
+    STAGE_CONFIG,
+    STAGE_OPTIMIZE,
+    STAGE_PREPARE,
+    STAGE_PUBLISH,
+    MetricsEmitter,
+)
 from ..models import SaturationPolicy, System
+from ..obs import (
+    CLAMP_REPLICA_STEP,
+    CLAMP_STABILIZATION,
+    CLAMP_STALE_VETO,
+    HELD,
+    LIMITED,
+    DecisionBuilder,
+    DecisionInputs,
+    DecisionLog,
+    Tracer,
+)
+from ..obs import trace as obs_trace
 from ..solver import Manager, Optimizer
 from ..utils import (
+    CIRCUIT_OPEN,
     STANDARD_BACKOFF,
     CircuitBreaker,
+    CircuitOpenError,
     Deadline,
     full_name,
     get_logger,
@@ -86,6 +108,8 @@ class Reconciler:
         now=time.time,
         sleep=time.sleep,
         monotonic=time.monotonic,
+        tracer: Optional[Tracer] = None,
+        decisions: Optional[DecisionLog] = None,
     ):
         self.kube = kube
         self.prom = prom
@@ -95,6 +119,18 @@ class Reconciler:
         self.now = now
         self.sleep = sleep
         self.monotonic = monotonic
+        # flight recorder (obs/): one trace per cycle, one immutable
+        # DecisionRecord per variant per cycle — served by /debug/traces
+        # and /debug/decisions on the metrics server and by the
+        # `controller explain` CLI. Ring capacities from WVA_TRACE_BUFFER
+        # / WVA_TRACE_DECISIONS.
+        self.tracer = tracer or Tracer(now=now)
+        self.decisions = decisions or DecisionLog(now=now)
+        self._trace_log = os.environ.get(
+            "WVA_TRACE_LOG", "").lower() in ("1", "true")
+        self._cycle_index = 0
+        # per-cycle decision scratchpads, key -> DecisionBuilder
+        self._cycle_builders: dict[str, DecisionBuilder] = {}
         # per-dependency circuit breakers (utils/backoff.py): a dependency
         # that has failed `threshold` consecutive times fails FAST instead
         # of charging every cycle a full backoff ladder per call — badput
@@ -105,15 +141,18 @@ class Reconciler:
             os.environ.get("WVA_BREAKER_RESET"), self.BREAKER_RESET_S)
         self.breakers = {
             "kube": CircuitBreaker("kube", failure_threshold=max(threshold, 1),
-                                   reset_after_s=reset_s, clock=now),
+                                   reset_after_s=reset_s, clock=now,
+                                   on_transition=self._on_breaker_transition),
             "prometheus": CircuitBreaker("prometheus",
                                          failure_threshold=max(threshold, 1),
-                                         reset_after_s=reset_s, clock=now),
+                                         reset_after_s=reset_s, clock=now,
+                                         on_transition=self._on_breaker_transition),
         }
         # scrape-path Prometheus client behind the breaker; the raw
         # client stays for the probe daemon thread (breakers are
         # single-threaded by design)
-        self.guarded_prom = GuardedPromAPI(prom, self.breakers["prometheus"])
+        self.guarded_prom = GuardedPromAPI(prom, self.breakers["prometheus"],
+                                           emitter=self.emitter)
         # last-known-good loads with staleness tiers — the stale-cache
         # rung of the degradation ladder (collector/cache.py)
         self.load_cache = LoadCache()
@@ -147,15 +186,47 @@ class Reconciler:
 
     # -- hardened dependency calls ----------------------------------------
 
-    def _kube_call(self, fn, backoff=STANDARD_BACKOFF):
+    def _on_breaker_transition(self, name: str, old: str, new: str) -> None:
+        """Breaker state changes are logged (with the cycle's trace id
+        stamped by the formatter) on top of the span event the breaker
+        itself records."""
+        log.warning("circuit breaker transition",
+                    extra=kv(dependency=name, from_state=old, to_state=new))
+
+    def _retry_observer(self, dependency: str):
+        """with_backoff telemetry hook -> the retries counter (the span
+        events are recorded by with_backoff itself)."""
+        def observe(event: str, **_fields) -> None:
+            self.emitter.emit_retry(dependency, event)
+        return observe
+
+    def _kube_call(self, fn, backoff=STANDARD_BACKOFF, what="call"):
         """Every control-plane read/write: jittered exponential backoff
         under the per-cycle deadline budget, behind the kube circuit
         breaker. One exhausted backoff counts as ONE breaker failure;
         while the breaker is open calls fail fast with CircuitOpenError
-        instead of paying the ladder again (badput control)."""
-        return self.breakers["kube"].call(
-            lambda: with_backoff(fn, backoff=backoff, sleep=self.sleep,
-                                 rng=self._rng, deadline=self._deadline))
+        instead of paying the ladder again (badput control).
+
+        Each call runs inside a `kube.<what>` trace span carrying its
+        retries/backoff-sleeps/breaker events (a no-op child outside a
+        cycle trace, so startup/daemon-thread calls don't pollute the
+        ring), and feeds the inferno_dependency_latency_seconds histogram
+        (ladder included — the series answers 'how long did the cycle
+        wait on kube')."""
+        with obs_trace.span(f"kube.{what}"):
+            t0 = time.perf_counter()
+            try:
+                return self.breakers["kube"].call(
+                    lambda: with_backoff(
+                        fn, backoff=backoff, sleep=self.sleep,
+                        rng=self._rng, deadline=self._deadline,
+                        observer=self._retry_observer("kube")))
+            except CircuitOpenError:
+                self.emitter.emit_retry("kube", CIRCUIT_OPEN)
+                raise
+            finally:
+                self.emitter.emit_dependency_latency(
+                    "kube", time.perf_counter() - t0)
 
     def _cycle_budget_s(self) -> float:
         """WVA_CYCLE_DEADLINE: wall-clock budget all of a cycle's retry
@@ -181,6 +252,7 @@ class Reconciler:
     def read_operator_config(self) -> dict[str, str]:
         cm = self._kube_call(
             lambda: self.kube.get_configmap(CONFIG_MAP_NAME, self.config_namespace),
+            what="get:ConfigMap/operator",
         )
         return cm.data
 
@@ -194,12 +266,14 @@ class Reconciler:
     def read_accelerator_config(self) -> dict[str, dict[str, str]]:
         cm = self._kube_call(
             lambda: self.kube.get_configmap(ACCELERATOR_CM_NAME, self.config_namespace),
+            what="get:ConfigMap/accelerators",
         )
         return translate.parse_accelerator_configmap(cm.data)
 
     def read_service_class_config(self) -> dict[str, str]:
         cm = self._kube_call(
             lambda: self.kube.get_configmap(SERVICE_CLASS_CM_NAME, self.config_namespace),
+            what="get:ConfigMap/service-classes",
         )
         return cm.data
 
@@ -214,15 +288,31 @@ class Reconciler:
         Every cycle also ends on a documented degradation-ladder rung
         (controller/degradation.py), exported with the breaker states —
         even a cycle that dies in the config stage reads as a HOLD on the
-        series, never as silence."""
+        series, never as silence.
+
+        The whole cycle is ONE trace (obs/): a root `reconcile` span,
+        one child span per stage, and under those the dependency-call,
+        solver, and fault-injection spans/events — every log line inside
+        carries the cycle's trace_id."""
         stages: dict[str, float] = {}
         t0 = time.perf_counter()
+        self._cycle_index += 1
+        self._cycle_builders = {}
+        root = self.tracer.begin("reconcile", cycle=self._cycle_index)
+        # the open slot for the stage currently running; mark() names it
+        # after the stage it just completed and opens the next slot
+        stage_span = [self.tracer.begin("stage")]
 
         def mark(stage: str) -> None:
             nonlocal t0
             t1 = time.perf_counter()
             stages[stage] = (t1 - t0) * 1000.0
             t0 = t1
+            sp = stage_span[0]
+            if sp is not None:
+                sp.name = f"stage:{stage}"
+                sp.finish()
+            stage_span[0] = self.tracer.begin("stage")
 
         # fresh per-cycle budget and ladder bookkeeping; the budget knob
         # is read from the LAST seen operator CM (reading the fresh one
@@ -230,9 +320,11 @@ class Reconciler:
         self._deadline = Deadline(self._cycle_budget_s(),
                                   clock=self.monotonic)
         self._degradation = DegradationTracker()
+        err: Optional[BaseException] = None
         try:
             return self._reconcile_timed(mark)
-        except BaseException:
+        except BaseException as e:
+            err = e
             # the cycle died before publishing anything: HOLD (the
             # published fleet state is frozen until a cycle succeeds)
             self._degradation.record_cycle(DegradationState.HOLD)
@@ -246,10 +338,26 @@ class Reconciler:
                     break
             raise
         finally:
+            # drop the speculative slot opened after the last mark — it
+            # covers nothing
+            if stage_span[0] is not None:
+                stage_span[0].cancel()
+            cycle_state = self._degradation.cycle_state()
+            root.set(degradation=cycle_state.label,
+                     degradation_rung=int(cycle_state))
+            root.finish(error=err)
+            if self._trace_log:
+                log.info("reconcile cycle trace",
+                         extra=kv(trace_id=root.trace_id,
+                                  cycle=self._cycle_index,
+                                  duration_ms=round(root.duration_ms or 0, 3),
+                                  spans=len(root.trace.spans),
+                                  degradation=cycle_state.label,
+                                  status=root.status))
             self.emitter.emit_cycle_timing(stages)
             self.emitter.emit_degradation_metrics(
                 self._degradation.gauge_samples(),
-                int(self._degradation.cycle_state()))
+                int(cycle_state))
             self.emitter.emit_circuit_metrics(
                 {name: b.state_code() for name, b in self.breakers.items()})
 
@@ -262,8 +370,9 @@ class Reconciler:
         accelerator_cm = self.read_accelerator_config()
         service_class_cm = self.read_service_class_config()
 
-        vas = self._kube_call(self.kube.list_variant_autoscalings)
-        mark("config")
+        vas = self._kube_call(self.kube.list_variant_autoscalings,
+                              what="list:VariantAutoscaling")
+        mark(STAGE_CONFIG)
         active = [va for va in vas if va.is_active()]
         for va in vas:
             if not va.is_active():
@@ -295,6 +404,7 @@ class Reconciler:
             try:
                 capacity = self._kube_call(
                     lambda: collect_inventory_k8s(self.kube),
+                    what="list:Node/inventory",
                 )
             except Exception as e:  # noqa: BLE001
                 log.error("node inventory failed; falling back to unlimited",
@@ -341,7 +451,7 @@ class Reconciler:
                                  drift_tolerance=self._cm_float(
                                      operator_cm, "WVA_DRIFT_TOLERANCE", 0.5),
                                  operator_cm=operator_cm)
-        mark("prepare")
+        mark(STAGE_PREPARE)
         if not prepared:
             self.emitter.emit_power_metrics({})
             self._probe_targets = {}   # nothing published -> nothing to probe
@@ -359,7 +469,7 @@ class Reconciler:
         system.calculate(backend=engine_backend,
                          mesh=translate.engine_mesh(engine_backend),
                          ttft_percentile=ttft_percentile)
-        mark("analyze")
+        mark(STAGE_ANALYZE)
 
         # optimize (the stage mark is in a finally: a slow FAILING solve is
         # exactly the stall the stage series exists to expose)
@@ -373,7 +483,7 @@ class Reconciler:
                 if not solution.allocations:
                     raise RuntimeError("no feasible allocations found for any variant")
             finally:
-                mark("optimize")
+                mark(STAGE_OPTIMIZE)
         except Exception as e:  # noqa: BLE001
             log.error("optimization failed, retrying next cycle", extra=kv(error=str(e)))
             result.error = str(e)
@@ -386,10 +496,14 @@ class Reconciler:
                     now=self.now(),
                 )
                 self._update_status(va)
+                self._record_decision(
+                    full_name(va.name, va.namespace), outcome=LIMITED,
+                    reason=f"optimization failed: {e}",
+                    published=va.status.desired_optimized_alloc.num_replicas)
             # the OptimizationReady=False writes must reach the series
             # too, or an alert keyed on the condition never fires
             self._emit_conditions()
-            mark("publish")  # the failure-condition status writes
+            mark(STAGE_PUBLISH)  # the failure-condition status writes
             return result
 
         # publish (keyed by full name: same-named VAs in different
@@ -400,31 +514,63 @@ class Reconciler:
         optimized: dict[str, crd.OptimizedAlloc] = {}
         for va, _deploy in prepared:
             key = full_name(va.name, va.namespace)
+            builder = self._cycle_builders.get(key)
             try:
                 alloc = translate.create_optimized_alloc(
                     va.name, va.namespace, solution, now=self.now()
                 )
             except KeyError:
                 log.info("no optimized allocation for variant", extra=kv(variant=va.name))
+                self._record_decision(
+                    key, outcome=LIMITED,
+                    reason="no feasible allocation for variant",
+                    published=va.status.desired_optimized_alloc.num_replicas)
                 continue
+            proposed = alloc.num_replicas
+            if builder is not None:
+                builder.accelerator = alloc.accelerator
+                builder.proposed_replicas = proposed
             alloc.num_replicas = self._stabilize_scale_down(
                 key, alloc.num_replicas, stabilization_s,
                 prev_published=va.status.desired_optimized_alloc.num_replicas,
                 guard=self._demand_guard(system, key, noise_margin),
             )
+            if builder is not None:
+                builder.clamp(CLAMP_STABILIZATION, proposed,
+                              alloc.num_replicas,
+                              detail=f"window={stabilization_s:.0f}s, "
+                                     f"noise_margin={noise_margin}")
             alloc.num_replicas = self._guard_actuation(
                 key, alloc.num_replicas,
                 prev_published=va.status.desired_optimized_alloc.num_replicas,
                 current=_deploy.current_replicas(),
                 stale=result.degraded.get(key) == "stale-cache",
                 step=replica_step,
+                decision=builder,
             )
             optimized[key] = alloc
+            self._record_decision(key, published=alloc.num_replicas)
 
         self._apply(prepared, optimized, result, system)
         self._emit_conditions()
-        mark("publish")
+        mark(STAGE_PUBLISH)
         return result
+
+    def _record_decision(self, key: str, published: int,
+                         outcome: str = "", reason: str = "") -> None:
+        """Freeze this cycle's DecisionBuilder for `key` into the audit
+        ring (no-op when preparation never created one)."""
+        builder = self._cycle_builders.pop(key, None)
+        if builder is None:
+            return
+        builder.published_replicas = published
+        if outcome:
+            builder.outcome = outcome
+        if reason:
+            builder.reason = reason
+        self.decisions.record(builder.freeze(
+            trace_id=obs_trace.current_trace_id() or "",
+            cycle=self._cycle_index, ts=self.now()))
 
     def _emit_conditions(self) -> None:
         """CR conditions as inferno_condition_status series (post-write
@@ -558,7 +704,8 @@ class Reconciler:
         return int(self._cm_float(operator_cm, "WVA_MAX_REPLICA_STEP", 0.0))
 
     def _guard_actuation(self, key: str, desired: int, prev_published: int,
-                         current: int, stale: bool, step: int) -> int:
+                         current: int, stale: bool, step: int,
+                         decision: Optional[DecisionBuilder] = None) -> int:
         """Final bound on what a cycle may publish:
 
         - step bound: |published - baseline| <= step when configured,
@@ -567,14 +714,25 @@ class Reconciler:
         - no scale-to-zero on stale evidence: a variant sized from the
           last-known-good cache may shrink (bounded, stabilized) but
           never to zero — absence of fresh metrics is not evidence of
-          absent load."""
+          absent load.
+
+        Each engaged guardrail lands in the variant's DecisionRecord as a
+        named before/after clamp, so `explain` reproduces the published
+        count from the record alone."""
         baseline = prev_published if prev_published > 0 else current
         guarded = desired
         if step > 0:
             lo = max(baseline - step, 0)
             hi = baseline + step
-            guarded = min(max(guarded, lo), hi)
+            bounded = min(max(guarded, lo), hi)
+            if decision is not None:
+                decision.clamp(CLAMP_REPLICA_STEP, guarded, bounded,
+                               detail=f"baseline={baseline}, step={step}")
+            guarded = bounded
         if stale and guarded == 0 and baseline > 0:
+            if decision is not None:
+                decision.clamp(CLAMP_STALE_VETO, guarded, 1,
+                               detail="stale metrics: no scale-to-zero")
             guarded = 1
         if guarded != desired:
             log.warning(
@@ -670,6 +828,7 @@ class Reconciler:
             try:
                 deploy = self._kube_call(
                     lambda: self.kube.get_deployment(name, va_listed.namespace),
+                    what="get:Deployment",
                 )
             except Exception as e:  # noqa: BLE001
                 log.error("failed to get Deployment", extra=kv(variant=name, error=str(e)))
@@ -679,6 +838,7 @@ class Reconciler:
             try:
                 va = self._kube_call(
                     lambda: self.kube.get_variant_autoscaling(name, va_listed.namespace),
+                    what="get:VariantAutoscaling",
                 )
             except Exception as e:  # noqa: BLE001
                 result.skipped[key] = "variant not found"
@@ -689,7 +849,8 @@ class Reconciler:
             if not va.is_controlled_by(deploy.uid):
                 try:
                     self._kube_call(
-                        lambda: self.kube.patch_owner_reference(va, deploy))
+                        lambda: self.kube.patch_owner_reference(va, deploy),
+                        what="patch:VariantAutoscaling/ownerRef")
                 except Exception as e:  # noqa: BLE001
                     log.error("failed to set ownerReference", extra=kv(variant=name, error=str(e)))
                     result.skipped[key] = "ownerReference patch failed"
@@ -758,6 +919,21 @@ class Reconciler:
                     result.degraded[key] = DegradationState.HOLD.label
                     self._degradation.record(va.name, va.namespace,
                                              DegradationState.HOLD)
+                    prev = va.status.desired_optimized_alloc.num_replicas
+                    self._cycle_builders[key] = DecisionBuilder(
+                        variant=va.name, namespace=va.namespace,
+                        accelerator=acc_name,
+                        inputs=DecisionInputs(
+                            degradation=DegradationState.HOLD.label,
+                            cost_per_replica=cost,
+                            current_replicas=deploy.current_replicas(),
+                            prev_published=prev,
+                        ),
+                        proposed_replicas=prev,
+                    )
+                    self._record_decision(key, outcome=HELD,
+                                          reason=skip_reason,
+                                          published=prev)
                     continue
                 state = state_for_cache_tier(tier)
                 log.warning(
@@ -773,6 +949,27 @@ class Reconciler:
                 self.load_cache.put(key, load, self.now())
                 self._degradation.record(va.name, va.namespace,
                                          DegradationState.HEALTHY)
+
+            # open this cycle's decision scratchpad: the solve inputs are
+            # now known; the publish loop adds proposal + clamps and
+            # freezes it into the audit ring (obs/decision.py)
+            rung = (DegradationState.STALE_CACHE if stale_load
+                    else DegradationState.HEALTHY)
+            self._cycle_builders[key] = DecisionBuilder(
+                variant=va.name, namespace=va.namespace,
+                accelerator=acc_name,
+                inputs=DecisionInputs(
+                    arrival_rate_rpm=load.arrival_rate_rpm,
+                    avg_input_tokens=load.avg_input_tokens,
+                    avg_output_tokens=load.avg_output_tokens,
+                    avg_ttft_ms=load.avg_ttft_ms,
+                    avg_itl_ms=load.avg_itl_ms,
+                    degradation=rung.label,
+                    cost_per_replica=cost,
+                    current_replicas=deploy.current_replicas(),
+                    prev_published=va.status.desired_optimized_alloc.num_replicas,
+                ),
+            )
 
             va.status.current_alloc = crd.Allocation(
                 accelerator=acc_name,
@@ -983,6 +1180,7 @@ class Reconciler:
             try:
                 fresh = self._kube_call(
                     lambda: self.kube.get_variant_autoscaling(va.name, va.namespace),
+                    what="get:VariantAutoscaling/fresh",
                 )
             except Exception as e:  # noqa: BLE001
                 log.error("failed to re-get variant", extra=kv(variant=va.name, error=str(e)))
@@ -1027,7 +1225,7 @@ class Reconciler:
                 raise
 
         try:
-            self._kube_call(attempt)
+            self._kube_call(attempt, what="update_status:VariantAutoscaling")
         except Exception as e:  # noqa: BLE001
             log.error("failed to update status", extra=kv(variant=va.name, error=str(e)))
 

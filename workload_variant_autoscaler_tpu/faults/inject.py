@@ -21,6 +21,7 @@ from __future__ import annotations
 import math
 
 from ..collector.prometheus import PromAPI, Sample
+from ..obs.trace import add_event
 from . import plan as plan_mod
 from .plan import FaultPlan, FaultRule
 
@@ -56,6 +57,10 @@ def apply_prom_fault(plan: FaultPlan | None, promql: str,
     rule = plan.prom_fault(promql)
     if rule is None:
         return samples
+    # a chaos run's trace must SHOW the scheduled fault, not just its
+    # downstream symptoms (no-op outside an active cycle trace)
+    add_event("fault-injected", dependency=plan_mod.DEP_PROMETHEUS,
+              kind=rule.kind, match=rule.match, query=promql[:120])
     if rule.kind == plan_mod.PROM_TIMEOUT:
         raise InjectedTimeout(
             f"injected prometheus timeout for {promql[:80]!r}")

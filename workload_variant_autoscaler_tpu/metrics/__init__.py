@@ -12,7 +12,13 @@ import ssl
 import threading
 from typing import Optional
 
-from prometheus_client import CollectorRegistry, Counter, Gauge, start_http_server
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    start_http_server,
+)
 
 from ..utils import get_logger, kv
 
@@ -118,15 +124,44 @@ INFERNO_DEMAND_PROBE_KICKS_TOTAL = "inferno_demand_probe_kicks_total"
 INFERNO_DEGRADATION_STATE = "inferno_degradation_state"
 INFERNO_CYCLE_DEGRADATION_STATE = "inferno_cycle_degradation_state"
 INFERNO_CIRCUIT_STATE = "inferno_circuit_state"
+# duration HISTOGRAMS (the gauges above describe the LAST cycle; these
+# accumulate the distribution, so tail behavior — the p99 stage stall, the
+# slow 1% of apiserver calls — survives scrape intervals)
+INFERNO_RECONCILE_STAGE_SECONDS = "inferno_reconcile_stage_seconds"
+INFERNO_DEPENDENCY_LATENCY_SECONDS = "inferno_dependency_latency_seconds"
+INFERNO_SOLVE_SECONDS = "inferno_solve_seconds"
+INFERNO_DEPENDENCY_RETRIES_TOTAL = "inferno_dependency_retries_total"
 
 LABEL_DEPENDENCY = "dependency"
+LABEL_OUTCOME = "outcome"
 
 LABEL_CONDITION_TYPE = "type"
 
 LABEL_METRIC = "metric"
 
 LABEL_STAGE = "stage"
-RECONCILE_STAGES = ("config", "prepare", "analyze", "optimize", "publish")
+# the single source of truth for reconcile stage names: the reconciler's
+# stage marks, the per-stage gauge/histogram label values, and the docs
+# all draw from these constants — a literal drifting out of sync here
+# silently zeroes a stage's series
+STAGE_CONFIG = "config"
+STAGE_PREPARE = "prepare"
+STAGE_ANALYZE = "analyze"
+STAGE_OPTIMIZE = "optimize"
+STAGE_PUBLISH = "publish"
+RECONCILE_STAGES = (STAGE_CONFIG, STAGE_PREPARE, STAGE_ANALYZE,
+                    STAGE_OPTIMIZE, STAGE_PUBLISH)
+
+# histogram buckets, in seconds: stages and dependency calls span
+# sub-millisecond (in-memory fakes, warm caches) to tens of seconds
+# (backoff ladders under an outage); the solve is sub-millisecond to
+# low seconds (cold XLA compile)
+_STAGE_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                  0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+_DEPENDENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                       0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+_SOLVE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                  0.025, 0.05, 0.1, 0.25, 1.0, 5.0)
 
 LABEL_VARIANT_NAME = "variant_name"
 LABEL_NAMESPACE = "namespace"
@@ -258,6 +293,34 @@ class MetricsEmitter:
             [LABEL_DEPENDENCY],
             registry=self.registry,
         )
+        # duration histograms + the retry counter (the flight recorder's
+        # aggregate face, docs/observability.md): the stage/solve gauges
+        # above answer "what did the LAST cycle do", these answer "what
+        # does the distribution look like" — tails, not last values
+        self.stage_seconds = Histogram(
+            INFERNO_RECONCILE_STAGE_SECONDS,
+            "Distribution of reconcile stage wall time",
+            [LABEL_STAGE], buckets=_STAGE_BUCKETS, registry=self.registry,
+        )
+        self.dependency_latency = Histogram(
+            INFERNO_DEPENDENCY_LATENCY_SECONDS,
+            "Distribution of dependency call wall time (kube verbs, "
+            "Prometheus queries), retries and backoff sleeps included",
+            [LABEL_DEPENDENCY], buckets=_DEPENDENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self.solve_seconds = Histogram(
+            INFERNO_SOLVE_SECONDS,
+            "Distribution of optimization solve wall time",
+            buckets=_SOLVE_BUCKETS, registry=self.registry,
+        )
+        self.dependency_retries = Counter(
+            INFERNO_DEPENDENCY_RETRIES_TOTAL.removesuffix("_total"),
+            "Retry-ladder outcomes per dependency (retry: another attempt "
+            "scheduled; exhausted: ladder spent; deadline: cycle budget "
+            "spent; circuit-open: failed fast without calling)",
+            [LABEL_DEPENDENCY, LABEL_OUTCOME], registry=self.registry,
+        )
         # perf-model drift (beyond-reference: the reference never compares
         # its scraped latencies against its own queueing model)
         self.model_drift = Gauge(
@@ -270,6 +333,20 @@ class MetricsEmitter:
 
     def emit_solution_time(self, msec: float) -> None:
         self.solution_time.set(msec)
+        self.solve_seconds.observe(msec / 1000.0)
+
+    def emit_dependency_latency(self, dependency: str,
+                                seconds: float) -> None:
+        """One dependency call's wall time (retries + backoff sleeps
+        included: the histogram answers 'how long did the reconcile wait
+        on this dependency', not 'how fast is its transport')."""
+        self.dependency_latency.labels(
+            **{LABEL_DEPENDENCY: dependency}).observe(seconds)
+
+    def emit_retry(self, dependency: str, outcome: str) -> None:
+        self.dependency_retries.labels(
+            **{LABEL_DEPENDENCY: dependency,
+               LABEL_OUTCOME: outcome}).inc()
 
     def emit_power_metrics(
         self, per_variant: dict[tuple[str, str, str], float]
@@ -375,11 +452,16 @@ class MetricsEmitter:
         """Publish per-stage durations + their total for the last cycle.
         Stages a partial cycle never reached are zeroed, not left holding
         the previous cycle's value — the series always describes ONE
-        cycle, so sum(stages) == total."""
+        cycle, so sum(stages) == total. The histogram observes only the
+        stages the cycle actually RAN: zero-observations for unreached
+        stages would fabricate a fast-looking tail."""
         with self._lock:
             for stage in RECONCILE_STAGES:
                 self.reconcile_stage_duration.labels(
                     **{LABEL_STAGE: stage}).set(stage_msec.get(stage, 0.0))
+                if stage in stage_msec:
+                    self.stage_seconds.labels(**{LABEL_STAGE: stage}).observe(
+                        stage_msec[stage] / 1000.0)
             self.reconcile_duration.set(sum(stage_msec.values()))
 
     def emit_replica_metrics(
@@ -436,7 +518,7 @@ class MetricsEmitter:
               certfile: Optional[str] = None, keyfile: Optional[str] = None,
               client_cafile: Optional[str] = None,
               cert_poll_seconds: float = 10.0,
-              auth_gate=None):
+              auth_gate=None, debug_middleware=None):
         """Expose /metrics for Prometheus to scrape — plain HTTP, or HTTPS
         when a cert/key pair is supplied, with optional required client-CA
         verification (reference cmd/main.go:122-199: TLS-capable metrics
@@ -446,8 +528,11 @@ class MetricsEmitter:
         TokenReview+SubjectAccessReview screening — the reference's
         WithAuthenticationAndAuthorization filter, how in-cluster
         Prometheus service accounts actually authenticate — and composes
-        with either transport. Returns (server, thread, reloader);
-        reloader is None for plain HTTP."""
+        with either transport. debug_middleware (obs.debug_middleware's
+        app->app wrapper) mounts the /debug/traces + /debug/decisions
+        flight-recorder routes next to /metrics, INSIDE the auth gate —
+        decision records are not more public than the series. Returns
+        (server, thread, reloader); reloader is None for plain HTTP."""
         if bool(certfile) != bool(keyfile):
             raise ValueError("metrics TLS requires both certfile and keyfile")
         if client_cafile and not certfile:
@@ -463,6 +548,10 @@ class MetricsEmitter:
         )
 
         app = make_wsgi_app(self.registry)
+        if debug_middleware is not None:
+            # the param is the obs.debug_middleware(tracer, decisions)
+            # RESULT: an app->app wrapper
+            app = debug_middleware(app)  # noqa: WVL201
         if auth_gate is not None:
             if not certfile:
                 # bearer tokens are live apiserver credentials; over
@@ -483,7 +572,7 @@ class MetricsEmitter:
                 pass  # scrapes every 10s would spam stderr
 
         if not certfile:
-            if auth_gate is None:
+            if auth_gate is None and debug_middleware is None:
                 server, thread = start_http_server(port, addr=addr,
                                                    registry=self.registry)
             else:

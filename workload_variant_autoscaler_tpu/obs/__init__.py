@@ -1,0 +1,68 @@
+"""Flight recorder: cycle tracing + decision audit trail (no OTel dep).
+
+Three pieces, all stdlib-only (this package imports nothing from the
+rest of the repo, so utils/logging.py and the fault hooks can import it
+at module load without cycles):
+
+- `trace`: span tracer with trace/span IDs threaded through every log
+  line of a cycle, a bounded ring of finished traces.
+- `decision`: immutable per-variant DecisionRecords — solve inputs,
+  proposed count, every clamp applied, published count — replayable to
+  the published number from the record alone.
+- `debug`: the /debug/traces + /debug/decisions WSGI routes mounted on
+  the metrics server.
+"""
+
+from .decision import (
+    CLAMP_REPLICA_STEP,
+    CLAMP_STABILIZATION,
+    CLAMP_STALE_VETO,
+    HELD,
+    LIMITED,
+    PUBLISHED,
+    Clamp,
+    DecisionBuilder,
+    DecisionInputs,
+    DecisionLog,
+    DecisionRecord,
+    explain_text,
+    record_from_dict,
+)
+from .debug import debug_middleware
+from .trace import (
+    Span,
+    Trace,
+    Tracer,
+    add_event,
+    current_span,
+    current_span_id,
+    current_trace_id,
+    set_attribute,
+    span,
+)
+
+__all__ = [
+    "CLAMP_REPLICA_STEP",
+    "CLAMP_STABILIZATION",
+    "CLAMP_STALE_VETO",
+    "Clamp",
+    "DecisionBuilder",
+    "DecisionInputs",
+    "DecisionLog",
+    "DecisionRecord",
+    "HELD",
+    "LIMITED",
+    "PUBLISHED",
+    "Span",
+    "Trace",
+    "Tracer",
+    "add_event",
+    "current_span",
+    "current_span_id",
+    "current_trace_id",
+    "debug_middleware",
+    "explain_text",
+    "record_from_dict",
+    "set_attribute",
+    "span",
+]

@@ -1,0 +1,75 @@
+"""/debug/* endpoints: the flight recorder's read surface.
+
+WSGI middleware mounted on the metrics server (metrics/__init__.py
+`serve(debug_middleware=...)`), INSIDE the kube-auth gate when one is
+configured — trace and decision payloads describe the fleet and must not
+be more public than /metrics itself.
+
+Routes:
+
+- `GET /debug/traces[?limit=N]` — the last N reconcile-cycle traces
+  (newest first) from the tracer ring, full span trees with events.
+- `GET /debug/decisions[?variant=V&namespace=NS&limit=N]` — the last N
+  DecisionRecords (newest first), optionally filtered; what the
+  `explain` CLI consumes.
+
+Stdlib-only, no intra-repo imports (see obs/trace.py's import rule).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+from urllib.parse import parse_qs
+
+from .decision import DecisionLog
+from .trace import Tracer
+
+
+def _int_param(params: dict, key: str, default: Optional[int]) -> Optional[int]:
+    raw = params.get(key, [""])[0]
+    try:
+        val = int(raw)
+    except ValueError:
+        return default
+    return val if val > 0 else default
+
+
+def debug_middleware(tracer: Optional[Tracer],
+                     decisions: Optional[DecisionLog]):
+    """app -> app wrapper adding the /debug/* routes in front of
+    whatever the inner app (the Prometheus exposition) serves."""
+
+    def wrap(inner_app):
+        def app(environ, start_response):
+            path = environ.get("PATH_INFO", "") or ""
+            if not path.startswith("/debug/"):
+                return inner_app(environ, start_response)
+            params = parse_qs(environ.get("QUERY_STRING", "") or "")
+            limit = _int_param(params, "limit", None)
+            if path.rstrip("/") == "/debug/traces" and tracer is not None:
+                body = {"traces": tracer.snapshot(limit=limit or 16)}
+            elif path.rstrip("/") == "/debug/decisions" \
+                    and decisions is not None:
+                body = {"decisions": decisions.snapshot(
+                    variant=params.get("variant", [""])[0],
+                    namespace=params.get("namespace", [""])[0],
+                    limit=limit or 64,
+                )}
+            else:
+                payload = json.dumps({"error": "not found"}).encode()
+                start_response("404 Not Found", [
+                    ("Content-Type", "application/json"),
+                    ("Content-Length", str(len(payload))),
+                ])
+                return [payload]
+            payload = json.dumps(body, default=str).encode()
+            start_response("200 OK", [
+                ("Content-Type", "application/json"),
+                ("Content-Length", str(len(payload))),
+            ])
+            return [payload]
+
+        return app
+
+    return wrap

@@ -13,6 +13,7 @@ from typing import Optional
 
 from ..models import System
 from ..models.spec import OptimizerSpec
+from ..obs import trace as obs_trace
 from .solver import Solver
 
 
@@ -27,8 +28,16 @@ class Optimizer:
             raise ValueError("missing optimizer spec")
         self.solver = Solver(self.spec)
         start = time.perf_counter()
-        self.solver.solve(system)
-        self.solution_time_msec = (time.perf_counter() - start) * 1000.0
+        # the solve gets its own span under the optimize stage (no-op
+        # outside a cycle trace), so solver wall time is attributable
+        # inside the trace, not just as the stage remainder
+        with obs_trace.span("solver.solve",
+                            unlimited=self.spec.unlimited) as sp:
+            self.solver.solve(system)
+            self.solution_time_msec = (time.perf_counter() - start) * 1000.0
+            if sp is not None:
+                sp.set(servers=len(system.servers),
+                       solution_time_msec=round(self.solution_time_msec, 3))
 
 
 class Manager:

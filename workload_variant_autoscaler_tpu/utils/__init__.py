@@ -3,8 +3,12 @@
 import math
 
 from .backoff import (
+    CIRCUIT_OPEN,
+    DEADLINE,
+    EXHAUSTED,
     PROMETHEUS_BACKOFF,
     RECONCILE_BACKOFF,
+    RETRY,
     STANDARD_BACKOFF,
     Backoff,
     CircuitBreaker,
@@ -42,12 +46,16 @@ def parse_float_or(s, default: float = 0.0) -> float:
 
 __all__ = [
     "Backoff",
+    "CIRCUIT_OPEN",
     "CircuitBreaker",
     "CircuitOpenError",
+    "DEADLINE",
     "Deadline",
     "DeadlineExceeded",
+    "EXHAUSTED",
     "PROMETHEUS_BACKOFF",
     "RECONCILE_BACKOFF",
+    "RETRY",
     "STANDARD_BACKOFF",
     "TerminalError",
     "check_value",

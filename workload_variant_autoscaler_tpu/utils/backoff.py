@@ -23,7 +23,16 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional, TypeVar
 
+from ..obs.trace import add_event
+
 T = TypeVar("T")
+
+# with_backoff observer events / retry-counter outcomes
+# (inferno_dependency_retries_total{outcome=...})
+RETRY = "retry"              # transient failure, another attempt scheduled
+EXHAUSTED = "exhausted"      # backoff steps spent, last error propagates
+DEADLINE = "deadline"        # cycle budget spent, DeadlineExceeded raised
+CIRCUIT_OPEN = "circuit-open"  # breaker open, call failed fast
 
 
 class TerminalError(Exception):
@@ -78,6 +87,7 @@ def with_backoff(
     sleep: Callable[[float], None] = time.sleep,
     rng: Optional[random.Random] = None,
     deadline: Optional[Deadline] = None,
+    observer: Optional[Callable[..., None]] = None,
 ) -> T:
     """Run fn with jittered exponential backoff. TerminalError propagates
     immediately; other exceptions retry until steps are exhausted, then the
@@ -90,12 +100,25 @@ def with_backoff(
     cover the next sleep — DeadlineExceeded is raised (chained to the
     last transient error) instead of sleeping past it: a cycle must fail
     visibly rather than eat its whole interval retrying.
+    observer: ladder telemetry hook, `observer(event, **fields)` with
+    event one of RETRY/EXHAUSTED/DEADLINE — how the reconciler feeds the
+    inferno_dependency_retries_total counter without this module knowing
+    about metrics. Every event is also recorded on the active trace span
+    (obs/trace.py; no-op outside a trace), so a cycle's trace shows each
+    retry and how long its backoff slept.
     """
     rand = rng.random if rng is not None else random.random
+
+    def note(event: str, **fields) -> None:
+        add_event(f"backoff-{event}", **fields)
+        if observer is not None:
+            observer(event, **fields)
+
     delay = backoff.duration
     last: Exception | None = None
     for step in range(backoff.steps):
         if deadline is not None and deadline.expired():
+            note(DEADLINE, attempt=step, error=str(last))
             raise DeadlineExceeded(
                 f"cycle budget {deadline.budget_s:.1f}s spent before the "
                 "call could be attempted"
@@ -112,13 +135,16 @@ def with_backoff(
             if backoff.jitter > 0:
                 d += delay * backoff.jitter * rand()
             if deadline is not None and d > deadline.remaining():
+                note(DEADLINE, attempt=step, error=str(last))
                 raise DeadlineExceeded(
                     f"next retry sleep {d:.2f}s exceeds the remaining "
                     f"cycle budget {max(deadline.remaining(), 0.0):.2f}s"
                 ) from last
+            note(RETRY, attempt=step, sleep_s=round(d, 4), error=str(e))
             sleep(d)
             delay *= backoff.factor
     assert last is not None
+    note(EXHAUSTED, attempt=backoff.steps - 1, error=str(last))
     raise last
 
 
@@ -143,6 +169,11 @@ class CircuitBreaker:
     the dependency answering correctly — and propagates untouched.
     `clock` is injectable (sim time); single-threaded use is assumed
     (the reconcile loop), so no internal locking.
+
+    `on_transition(name, old_state, new_state)` fires on every state
+    change; each transition is also recorded on the active trace span,
+    so a cycle's trace shows exactly when a dependency's circuit opened,
+    half-opened, or closed.
     """
 
     CLOSED = "closed"
@@ -154,16 +185,27 @@ class CircuitBreaker:
 
     def __init__(self, name: str, failure_threshold: int = 3,
                  reset_after_s: float = 30.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Optional[Callable[[str, str, str], None]] = None):
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
         self.name = name
         self.failure_threshold = failure_threshold
         self.reset_after_s = reset_after_s
         self._clock = clock
+        self.on_transition = on_transition
         self.state = self.CLOSED
         self.consecutive_failures = 0
         self._opened_at = 0.0
+
+    def _set_state(self, state: str) -> None:
+        if state == self.state:
+            return
+        old, self.state = self.state, state
+        add_event("breaker-transition", dependency=self.name,
+                  from_state=old, to_state=state)
+        if self.on_transition is not None:
+            self.on_transition(self.name, old, state)
 
     def state_code(self) -> int:
         # report what the NEXT call would see: an open breaker whose
@@ -175,12 +217,12 @@ class CircuitBreaker:
         return self.STATE_CODES[state]
 
     def _open(self) -> None:
-        self.state = self.OPEN
+        self._set_state(self.OPEN)
         self._opened_at = self._clock()
 
     def record_success(self) -> None:
         self.consecutive_failures = 0
-        self.state = self.CLOSED
+        self._set_state(self.CLOSED)
 
     def record_failure(self) -> None:
         self.consecutive_failures += 1
@@ -192,9 +234,11 @@ class CircuitBreaker:
         if self.state == self.OPEN:
             waited = self._clock() - self._opened_at
             if waited < self.reset_after_s:
+                add_event("breaker-open-fast-fail", dependency=self.name,
+                          retry_in_s=round(self.reset_after_s - waited, 3))
                 raise CircuitOpenError(self.name,
                                        self.reset_after_s - waited)
-            self.state = self.HALF_OPEN  # one probe goes through
+            self._set_state(self.HALF_OPEN)  # one probe goes through
         try:
             result = fn()
         except TerminalError:

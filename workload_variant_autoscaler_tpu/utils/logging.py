@@ -12,7 +12,10 @@ import json
 import logging
 import os
 import sys
-import time
+
+# stdlib-only by design (obs imports nothing from the repo), so this
+# module-load import cannot cycle back through utils
+from ..obs.trace import current_span
 
 
 def _level_from_env() -> int:
@@ -28,11 +31,21 @@ def _level_from_env() -> int:
 class JsonFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         entry = {
-            "ts": round(time.time(), 3),
+            # record.created, not time.time(): a record serialized late
+            # (queued handler, slow sink) must carry the time it was
+            # LOGGED, not the time it was formatted
+            "ts": round(record.created, 3),
             "level": record.levelname.lower(),
             "logger": record.name,
             "msg": record.getMessage(),
         }
+        # every log line inside a reconcile cycle carries the cycle's
+        # trace id (obs/trace.py), so a cycle's logs, spans, and
+        # DecisionRecords correlate on one key
+        span = current_span()
+        if span is not None:
+            entry["trace_id"] = span.trace_id
+            entry["span_id"] = span.span_id
         if record.exc_info:
             entry["exc"] = self.formatException(record.exc_info)
         extra = getattr(record, "kv", None)
