@@ -1,0 +1,227 @@
+"""bench_collect: fleet-scale collection vs the sequential per-variant path.
+
+Drives a 512-variant fleet through full reconcile cycles against a
+latency-injecting Prometheus stand-in (every query pays a fixed
+round-trip, the dominant term of real in-cluster collection) and
+measures cycle wall time + queries/cycle in both modes:
+
+- fleet (WVA_FLEET_COLLECTION on, the default): ~8 grouped queries per
+  cycle, demuxed per variant; ONE Deployment LIST.
+- sequential (WVA_FLEET_COLLECTION=off): the reference shape, ~6-7
+  queries and 1-2 kube GETs per variant per cycle.
+
+Each mode pays one warm-up cycle (kernel compile) before the timed
+cycle, so the comparison is steady state. Prints ONE JSON line; the
+committed BENCH_collect_r06.json pins the claims asserted by
+tests/test_perf_claims.py (vs_baseline >= 5, queries O(families)).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("LOG_LEVEL", "error")
+
+from workload_variant_autoscaler_tpu.collector import (  # noqa: E402
+    FakePromAPI,
+    VLLM_FAMILY,
+    arrival_rate_query,
+    availability_query,
+    avg_generation_tokens_query,
+    avg_itl_query,
+    avg_prompt_tokens_query,
+    avg_ttft_query,
+    fleet_arrival_rate_query,
+    fleet_availability_query,
+    fleet_avg_generation_tokens_query,
+    fleet_avg_itl_query,
+    fleet_avg_prompt_tokens_query,
+    fleet_avg_ttft_query,
+    fleet_true_arrival_rate_query,
+    true_arrival_rate_query,
+)
+from workload_variant_autoscaler_tpu.controller import (  # noqa: E402
+    ACCELERATOR_CM_NAME,
+    CONFIG_MAP_NAME,
+    CONFIG_MAP_NAMESPACE,
+    SERVICE_CLASS_CM_NAME,
+    ConfigMap,
+    Deployment,
+    InMemoryKube,
+    Reconciler,
+    crd,
+)
+from workload_variant_autoscaler_tpu.metrics import MetricsEmitter  # noqa: E402
+
+N_VARIANTS = 512
+N_MODELS = 8          # variants share models 64:1, like real fleets
+NS = "default"
+LATENCY_S = 0.002     # per-query round-trip of the latency model
+
+
+class LatencyPromAPI:
+    """Labeled query store behind a fixed per-query latency."""
+
+    def __init__(self, store: FakePromAPI, latency_s: float = LATENCY_S):
+        self.store = store
+        self.latency_s = latency_s
+        self.count = 0
+
+    def query(self, promql: str):
+        self.count += 1
+        if self.latency_s > 0:
+            time.sleep(self.latency_s)
+        return self.store.query(promql)
+
+
+class CountingKube(InMemoryKube):
+    def __init__(self):
+        super().__init__(validate_schema=False)
+        self.verb_counts: dict[str, int] = {}
+
+    def _count(self, what: str) -> None:
+        self.verb_counts[what] = self.verb_counts.get(what, 0) + 1
+
+    def get_deployment(self, name, namespace):
+        self._count("get:Deployment")
+        return super().get_deployment(name, namespace)
+
+    def list_deployments(self, namespace=None):
+        self._count("list:Deployment")
+        return super().list_deployments(namespace)
+
+    def get_variant_autoscaling(self, name, namespace):
+        self._count("get:VariantAutoscaling")
+        return super().get_variant_autoscaling(name, namespace)
+
+    def list_variant_autoscalings(self):
+        self._count("list:VariantAutoscaling")
+        return super().list_variant_autoscalings()
+
+
+def model_name(i: int) -> str:
+    return f"llama-8b-m{i % N_MODELS}"
+
+
+def seed_prom(store: FakePromAPI, rps: float = 30.0) -> None:
+    fam = VLLM_FAMILY
+    grouped = {
+        fleet_true_arrival_rate_query(fam): rps,
+        fleet_arrival_rate_query(fam): rps,
+        fleet_avg_prompt_tokens_query(fam): 128.0,
+        fleet_avg_generation_tokens_query(fam): 128.0,
+        fleet_avg_ttft_query(fam): 0.2,
+        fleet_avg_itl_query(fam): 0.012,
+        fleet_availability_query(fam): 1.0,
+    }
+    for m_i in range(N_MODELS):
+        m = model_name(m_i)
+        labels = {"model_name": m, "namespace": NS}
+        for q, v in grouped.items():
+            store.add_result(q, v, labels=labels)
+        for q, v in (
+            (availability_query(m, NS, fam), 1.0),
+            (true_arrival_rate_query(m, NS, fam), rps),
+            (arrival_rate_query(m, NS, fam), rps),
+            (avg_prompt_tokens_query(m, NS, fam), 128.0),
+            (avg_generation_tokens_query(m, NS, fam), 128.0),
+            (avg_ttft_query(m, NS, fam), 0.2),
+            (avg_itl_query(m, NS, fam), 0.012),
+        ):
+            store.set_result(q, v, labels=labels)
+
+
+def build_cluster() -> tuple[CountingKube, LatencyPromAPI, Reconciler]:
+    kube = CountingKube()
+    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
+                                 {"GLOBAL_OPT_INTERVAL": "60s",
+                                  # measuring collection, not the drift
+                                  # watchdog (512 warnings/cycle of noise)
+                                  "WVA_DRIFT_TOLERANCE": "0"}))
+    kube.put_configmap(ConfigMap(
+        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"v5e-1": json.dumps({"chip": "v5e", "chips": "1", "cost": "20.0"})},
+    ))
+    slos = "\n".join(
+        f"  - model: {model_name(i)}\n    slo-tpot: 24\n    slo-ttft: 500"
+        for i in range(N_MODELS))
+    kube.put_configmap(ConfigMap(
+        SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"premium": f"name: Premium\npriority: 1\ndata:\n{slos}\n"},
+    ))
+    for i in range(N_VARIANTS):
+        name = f"chat-{i}"
+        kube.put_deployment(Deployment(name=name, namespace=NS,
+                                       spec_replicas=1, status_replicas=1))
+        kube.put_variant_autoscaling(crd.VariantAutoscaling(
+            metadata=crd.ObjectMeta(name=name, namespace=NS,
+                                    labels={crd.ACCELERATOR_LABEL: "v5e-1"}),
+            spec=crd.VariantAutoscalingSpec(
+                model_id=model_name(i),
+                slo_class_ref=crd.ConfigMapKeyRef(
+                    name=SERVICE_CLASS_CM_NAME, key="premium"),
+                model_profile=crd.ModelProfile(accelerators=[
+                    crd.AcceleratorProfile(
+                        acc="v5e-1", acc_count=1,
+                        perf_parms=crd.PerfParms(
+                            decode_parms={"alpha": "6.973", "beta": "0.027"},
+                            prefill_parms={"gamma": "5.2", "delta": "0.1"},
+                        ),
+                        max_batch_size=64,
+                    ),
+                ]),
+            ),
+        ))
+    store = FakePromAPI()
+    seed_prom(store)
+    prom = LatencyPromAPI(store)
+    rec = Reconciler(kube=kube, prom=prom, emitter=MetricsEmitter(),
+                     sleep=lambda _s: None)
+    return kube, prom, rec
+
+
+def timed_cycle(mode: str) -> dict:
+    os.environ["WVA_FLEET_COLLECTION"] = mode
+    kube, prom, rec = build_cluster()
+    rec.reconcile()                 # warm-up: compile + first publish
+    prom.count = 0
+    kube.verb_counts.clear()
+    t0 = time.perf_counter()
+    result = rec.reconcile()
+    wall_s = time.perf_counter() - t0
+    assert len(result.processed) == N_VARIANTS, result.skipped
+    return {
+        "wall_s": round(wall_s, 3),
+        "prom_queries": prom.count,
+        "kube_lists": sum(v for k, v in kube.verb_counts.items()
+                          if k.startswith("list:")),
+        "kube_gets": sum(v for k, v in kube.verb_counts.items()
+                         if k.startswith("get:")),
+    }
+
+
+def main() -> None:
+    fleet = timed_cycle("on")
+    sequential = timed_cycle("off")
+    out = {
+        "metric": "reconcile_cycle_wall_s",
+        "bench": "collect",
+        "variants": N_VARIANTS,
+        "models": N_MODELS,
+        "latency_ms": LATENCY_S * 1000.0,
+        "value": fleet["wall_s"],
+        "unit": "s/cycle",
+        "vs_baseline": round(sequential["wall_s"] / fleet["wall_s"], 2),
+        "fleet": fleet,
+        "sequential": sequential,
+        "fleet_queries_per_cycle": fleet["prom_queries"],
+        "sequential_queries_per_cycle": sequential["prom_queries"],
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

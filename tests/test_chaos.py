@@ -48,6 +48,7 @@ from workload_variant_autoscaler_tpu.faults import (
     KUBE_CONFLICT,
     KUBE_NOT_FOUND,
     PROM_CLOCK_SKEW,
+    PROM_LABEL_DROP,
     PROM_NAN,
     PROM_PARTIAL,
     PROM_TIMEOUT,
@@ -488,6 +489,154 @@ class TestReplicaStepBound:
         # the bound delays, never denies: the solver's target is reached
         assert trace[-1] == trace[-2]  # converged
         assert trace[-1] > 3
+
+
+class TestFleetCollectionChaos:
+    """Grouped fleet collection under faults: a variant dropped from a
+    grouped result degrades ALONE (stale-cache) while the rest of the
+    fleet stays healthy on the fleet path, and a fleet-query timeout
+    falls back through the per-variant repair ladder — never a
+    zero-fill. Scenarios rerun twice for byte-identical summaries."""
+
+    MODELS = {"llama-fa": 10.0, "llama-fb": 40.0, "llama-fc": 5.0}
+
+    def _cluster(self, plan):
+        from test_fleet_collection import (
+            make_va,
+            seed_grouped_queries,
+            seed_variant_queries,
+        )
+
+        clock = {"t": 0.0}
+
+        def now():
+            return clock["t"]
+
+        kube = InMemoryKube()
+        kube.put_configmap(ConfigMap(
+            CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
+            {"GLOBAL_OPT_INTERVAL": "30s",
+             "WVA_MAX_REPLICA_STEP": str(STEP_BOUND)}))
+        kube.put_configmap(ConfigMap(
+            ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+            {"v5e-1": json.dumps(
+                {"chip": "v5e", "chips": "1", "cost": "20.0"})},
+        ))
+        slos = "\n".join(
+            f"  - model: {m}\n    slo-tpot: 24\n    slo-ttft: 500"
+            for m in self.MODELS)
+        kube.put_configmap(ConfigMap(
+            SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+            {"premium": f"name: Premium\npriority: 1\ndata:\n{slos}\n"}))
+        for i, model in enumerate(self.MODELS):
+            kube.put_deployment(Deployment(
+                name=f"fleet-{i}", namespace=NS,
+                spec_replicas=1, status_replicas=1))
+            kube.put_variant_autoscaling(make_va(f"fleet-{i}", model))
+        kube.attach_fault_plan(plan)
+        prom = FakePromAPI(now=now)
+
+        def reseed():
+            # fresh scrape every cycle (fresh timestamps; set_result
+            # overwrites, grouped answers are rebuilt from scratch so
+            # add_result never double-appends)
+            prom.query_results.clear()
+            for model, rps in self.MODELS.items():
+                seed_variant_queries(prom, model, rps)
+                seed_grouped_queries(prom, model, rps)
+
+        emitter = MetricsEmitter()
+        rec = Reconciler(kube=kube, prom=FaultyPromAPI(prom, plan),
+                         emitter=emitter, now=now, sleep=lambda _s: None)
+        return kube, prom, emitter, rec, clock, reseed
+
+    def _run(self, rec, plan, clock, reseed, cycles):
+        out = []
+        for _ in range(cycles):
+            clock["t"] += 30.0
+            reseed()
+            plan.begin_cycle()
+            try:
+                r = rec.reconcile()
+            except Exception as e:  # noqa: BLE001 — run_forever's catch
+                out.append({"raised": type(e).__name__})
+                continue
+            out.append({
+                "processed": sorted(r.processed),
+                "skipped": dict(r.skipped),
+                "degraded": dict(r.degraded),
+                "desired": {
+                    f"fleet-{i}": rec.kube.get_variant_autoscaling(
+                        f"fleet-{i}", NS
+                    ).status.desired_optimized_alloc.num_replicas
+                    for i in range(len(self.MODELS))},
+                "modes": {
+                    f"fleet-{i}": (rec.decisions.latest(f"fleet-{i}", NS)
+                                   .inputs.collection_mode)
+                    for i in range(len(self.MODELS))},
+            })
+        return out
+
+    def test_label_drop_degrades_only_that_variant(self):
+        """fleet-1's series vanish from every answer (its exporter died):
+        it rides the stale-cache rung alone; the rest of the fleet stays
+        HEALTHY and fleet-collected."""
+        def scenario():
+            plan = FaultPlan([
+                FaultRule(kind=PROM_LABEL_DROP,
+                          labels={"model_name": "llama-fb"},
+                          after_cycle=2),
+            ], seed=21)
+            kube, prom, emitter, rec, clock, reseed = self._cluster(plan)
+            out = self._run(rec, plan, clock, reseed, cycles=4)
+            out[-1]["rung_b"] = emitter.value(
+                "inferno_degradation_state",
+                variant_name="fleet-1", namespace=NS)
+            return out
+
+        out = assert_deterministic(scenario)
+        healthy = out[0]
+        assert healthy["degraded"] == {}
+        assert all(d > 0 for d in healthy["desired"].values())
+        assert all(m == "fleet" for m in healthy["modes"].values())
+        for s in out[1:]:
+            # only fleet-1 degrades, to the stale-cache rung — its
+            # published count held, never zero-filled down
+            assert s["degraded"] == {f"fleet-1:{NS}": "stale-cache"}
+            assert s["desired"] == healthy["desired"]
+            assert sorted(s["processed"]) == sorted(
+                f"fleet-{i}:{NS}" for i in range(3))
+            # the healthy rest stayed on the grouped path
+            assert s["modes"]["fleet-0"] == "fleet"
+            assert s["modes"]["fleet-2"] == "fleet"
+        assert out[-1]["rung_b"] == int(DegradationState.STALE_CACHE)
+
+    def test_fleet_query_timeout_repairs_per_variant(self):
+        """Grouped queries time out, per-variant queries still answer:
+        every variant falls back through the repair path and stays
+        HEALTHY — the ladder, not a zero-fill."""
+        def scenario():
+            plan = FaultPlan([
+                FaultRule(kind=PROM_TIMEOUT, match="sum by (",
+                          after_cycle=2),
+            ], seed=22)
+            _kube, prom, emitter, rec, clock, reseed = self._cluster(plan)
+            out = self._run(rec, plan, clock, reseed, cycles=4)
+            out[-1]["repair_queries"] = emitter.value(
+                "inferno_collection_queries_total",
+                mode="per-variant-repair")
+            return out
+
+        out = assert_deterministic(scenario)
+        healthy = out[0]
+        assert all(m == "fleet" for m in healthy["modes"].values())
+        for s in out[1:]:
+            assert s["degraded"] == {}      # repair kept everyone healthy
+            assert s["skipped"] == {}
+            assert s["desired"] == healthy["desired"]
+            assert all(m == "per-variant-repair"
+                       for m in s["modes"].values())
+        assert out[-1]["repair_queries"] >= 3 * 6
 
 
 class TestFaultPlanScripting:

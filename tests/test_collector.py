@@ -264,3 +264,53 @@ class TestValidatePrometheusAPI:
             validate_prometheus_api(
                 Down(), backoff=Backoff(duration=0.001, steps=2), sleep=lambda _s: None
             )
+
+
+class TestQueryRangeSeriesSelection:
+    """Multi-series query_range answers resolve DETERMINISTICALLY (the
+    pre-fix behavior silently took whatever the server listed first —
+    a real ambiguity now that grouped fleet queries exist)."""
+
+    def _api(self, results):
+        from workload_variant_autoscaler_tpu.collector.prometheus import (
+            HTTPPromAPI,
+        )
+
+        api = HTTPPromAPI(PrometheusConfig(base_url="http://prom"),
+                          allow_http=True)
+        api._get = lambda _path, _params: {"resultType": "matrix",
+                                           "result": results}
+        return api
+
+    RESULTS = [
+        {"metric": {"model_name": "zeta", "namespace": "prod"},
+         "values": [[1.0, "9.0"]]},
+        {"metric": {"model_name": "alpha", "namespace": "prod"},
+         "values": [[1.0, "3.0"]]},
+    ]
+
+    def test_selection_is_label_sorted_not_server_order(self):
+        api = self._api(self.RESULTS)
+        out = api.query_range("q", 0.0, 10.0, 5.0)
+        assert out[0].labels["model_name"] == "alpha"
+        assert out[0].value == 3.0
+        # reversed server order picks the SAME series
+        api = self._api(list(reversed(self.RESULTS)))
+        out = api.query_range("q", 0.0, 10.0, 5.0)
+        assert out[0].labels["model_name"] == "alpha"
+
+    def test_series_labels_select_the_matching_series(self):
+        api = self._api(self.RESULTS)
+        out = api.query_range("q", 0.0, 10.0, 5.0,
+                              series_labels={"model_name": "zeta"})
+        assert out[0].labels["model_name"] == "zeta"
+        assert out[0].value == 9.0
+        # no match falls back to the deterministic default
+        out = api.query_range("q", 0.0, 10.0, 5.0,
+                              series_labels={"model_name": "nope"})
+        assert out[0].labels["model_name"] == "alpha"
+
+    def test_single_series_unchanged(self):
+        api = self._api([self.RESULTS[0]])
+        out = api.query_range("q", 0.0, 10.0, 5.0)
+        assert [s.value for s in out] == [9.0]

@@ -1,0 +1,106 @@
+"""The bounded fan-out executor (utils/concurrency.py): submission-order
+results, per-task error capture, contextvar/trace propagation across
+worker threads, and the WVA_COLLECT_FANOUT knob."""
+
+import threading
+import time
+
+from workload_variant_autoscaler_tpu.obs import Tracer
+from workload_variant_autoscaler_tpu.utils import (
+    DEFAULT_FANOUT_WORKERS,
+    fanout,
+    fanout_workers,
+)
+
+
+class TestFanout:
+    def test_results_in_submission_order(self):
+        # later tasks finish FIRST (inverse sleeps); results must still
+        # align with submission order
+        def task(i):
+            time.sleep((4 - i) * 0.01)
+            return i
+
+        out = fanout([lambda i=i: task(i) for i in range(5)], workers=5)
+        assert [r for r, _e in out] == [0, 1, 2, 3, 4]
+        assert all(e is None for _r, e in out)
+
+    def test_exceptions_captured_per_task(self):
+        def boom():
+            raise RuntimeError("task 1 died")
+
+        out = fanout([lambda: "ok", boom, lambda: "also ok"], workers=4)
+        assert out[0] == ("ok", None)
+        assert out[1][0] is None
+        assert isinstance(out[1][1], RuntimeError)
+        assert out[2] == ("also ok", None)
+
+    def test_empty_and_single_task(self):
+        assert fanout([], workers=8) == []
+        assert fanout([lambda: 7], workers=8) == [(7, None)]
+
+    def test_workers_one_runs_inline_in_order(self):
+        seen = []
+        main_thread = threading.current_thread().name
+
+        def task(i):
+            seen.append((i, threading.current_thread().name))
+            return i
+
+        fanout([lambda i=i: task(i) for i in range(4)], workers=1)
+        assert [i for i, _t in seen] == [0, 1, 2, 3]
+        assert all(t == main_thread for _i, t in seen)
+
+    def test_spans_propagate_to_worker_threads(self):
+        """A task's spans must nest under the span active at SUBMISSION
+        (the cycle's stage span), so a fanned-out cycle renders as one
+        trace — and concurrent span creation must not corrupt the ring
+        or duplicate ids."""
+        tracer = Tracer(capacity=4)
+        n = 32
+        with tracer.span("reconcile") as root:
+            def task(i):
+                with tracer.span(f"kube.update:{i}"):
+                    time.sleep(0.001)
+                return i
+
+            out = fanout([lambda i=i: task(i) for i in range(n)], workers=8)
+        assert [r for r, _e in out] == list(range(n))
+        tr = tracer.traces()[0]
+        children = tr.find_spans("kube.update:")
+        assert len(children) == n
+        assert {s.name for s in children} == {f"kube.update:{i}"
+                                              for i in range(n)}
+        # every fanned-out span belongs to the SAME trace, parented on
+        # the span that was active when the task was submitted
+        assert all(s.trace_id == root.trace_id for s in children)
+        assert all(s.parent_id == root.span_id for s in children)
+        # thread-safe id allocation: no duplicates under concurrency
+        ids = [s.span_id for s in tr.spans]
+        assert len(ids) == len(set(ids))
+
+    def test_worker_span_does_not_leak_into_caller(self):
+        """finish() in a worker's copied context must not deactivate the
+        caller's span."""
+        tracer = Tracer(capacity=2)
+        with tracer.span("root") as root:
+            fanout([lambda: tracer.begin("child").finish()], workers=4)
+            from workload_variant_autoscaler_tpu.obs import trace as obs_trace
+            assert obs_trace.current_span() is root
+
+
+class TestFanoutWorkersKnob:
+    def test_default(self, monkeypatch):
+        monkeypatch.delenv("WVA_COLLECT_FANOUT", raising=False)
+        assert fanout_workers() == DEFAULT_FANOUT_WORKERS
+
+    def test_env_wins_over_cm(self, monkeypatch):
+        monkeypatch.setenv("WVA_COLLECT_FANOUT", "3")
+        assert fanout_workers({"WVA_COLLECT_FANOUT": "12"}) == 3
+
+    def test_cm_fallback_and_clamp(self, monkeypatch):
+        monkeypatch.delenv("WVA_COLLECT_FANOUT", raising=False)
+        assert fanout_workers({"WVA_COLLECT_FANOUT": "12"}) == 12
+        assert fanout_workers({"WVA_COLLECT_FANOUT": "0"}) == 1
+        assert fanout_workers({"WVA_COLLECT_FANOUT": "junk"}) \
+            == DEFAULT_FANOUT_WORKERS

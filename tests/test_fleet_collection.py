@@ -1,0 +1,341 @@
+"""Fleet-scale collection: grouped PromQL demux, the per-variant repair
+path, the one-LIST kube snapshot, and the O(1)-in-V call-count proof.
+
+The acceptance claim of the fleet-collection work: a 512-variant happy
+cycle issues O(metric-families) Prometheus queries (~9, fleet-size
+independent) and at most 2 kube LISTs, where the sequential reference
+shape pays ~10 round-trips per variant — while preserving the
+per-variant semantics exactly (same validate/collect code runs against
+the demuxed view; missing labels repair through per-variant queries).
+"""
+
+import json
+
+from workload_variant_autoscaler_tpu.collector import (
+    MODE_FLEET,
+    MODE_LEGACY,
+    MODE_REPAIR,
+    FakePromAPI,
+    FleetLoadCollector,
+    VLLM_FAMILY,
+    arrival_rate_query,
+    availability_query,
+    avg_generation_tokens_query,
+    avg_itl_query,
+    avg_prompt_tokens_query,
+    avg_ttft_query,
+    fleet_arrival_rate_query,
+    fleet_availability_query,
+    fleet_avg_generation_tokens_query,
+    fleet_avg_itl_query,
+    fleet_avg_prompt_tokens_query,
+    fleet_avg_ttft_query,
+    fleet_group_by,
+    fleet_true_arrival_rate_query,
+    true_arrival_rate_query,
+)
+from workload_variant_autoscaler_tpu.controller import (
+    ACCELERATOR_CM_NAME,
+    CONFIG_MAP_NAME,
+    CONFIG_MAP_NAMESPACE,
+    SERVICE_CLASS_CM_NAME,
+    ConfigMap,
+    Deployment,
+    InMemoryKube,
+    Reconciler,
+    crd,
+)
+from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
+
+NS = "default"
+FAM = VLLM_FAMILY
+
+
+class CountingKube(InMemoryKube):
+    """InMemoryKube with per-verb call counters (schema validation off:
+    512 admissions would dominate the test's runtime, and the CRD
+    schema is covered elsewhere)."""
+
+    def __init__(self):
+        super().__init__(validate_schema=False)
+        self.verb_counts: dict[str, int] = {}
+
+    def _count(self, what: str) -> None:
+        with self._lock:   # fan-out workers call kube concurrently
+            self.verb_counts[what] = self.verb_counts.get(what, 0) + 1
+
+    def get_deployment(self, name, namespace):
+        self._count("get:Deployment")
+        return super().get_deployment(name, namespace)
+
+    def list_deployments(self, namespace=None):
+        self._count("list:Deployment")
+        return super().list_deployments(namespace)
+
+    def get_variant_autoscaling(self, name, namespace):
+        self._count("get:VariantAutoscaling")
+        return super().get_variant_autoscaling(name, namespace)
+
+    def list_variant_autoscalings(self):
+        self._count("list:VariantAutoscaling")
+        return super().list_variant_autoscalings()
+
+    def list_count(self) -> int:
+        return sum(v for k, v in self.verb_counts.items()
+                   if k.startswith("list:"))
+
+
+def labels_for(model: str) -> dict:
+    return {"model_name": model, "namespace": NS}
+
+
+def seed_variant_queries(prom: FakePromAPI, model: str, rps: float,
+                         in_tok=128.0, out_tok=128.0, ttft_s=0.2,
+                         itl_s=0.012) -> None:
+    """The per-variant query set, seeded WITH demux labels (so a
+    prom-label-drop fault covers both the grouped and repair answers)."""
+    lab = labels_for(model)
+    prom.set_result(availability_query(model, NS, FAM), 1.0, labels=lab)
+    prom.set_result(true_arrival_rate_query(model, NS, FAM), rps, labels=lab)
+    prom.set_result(arrival_rate_query(model, NS, FAM), rps, labels=lab)
+    prom.set_result(avg_prompt_tokens_query(model, NS, FAM), in_tok,
+                    labels=lab)
+    prom.set_result(avg_generation_tokens_query(model, NS, FAM), out_tok,
+                    labels=lab)
+    prom.set_result(avg_ttft_query(model, NS, FAM), ttft_s, labels=lab)
+    prom.set_result(avg_itl_query(model, NS, FAM), itl_s, labels=lab)
+    # the namespace-less availability fallback must not default-answer
+    prom.set_empty(availability_query(model, family=FAM))
+
+
+def seed_grouped_queries(prom: FakePromAPI, model: str, rps: float,
+                         in_tok=128.0, out_tok=128.0, ttft_s=0.2,
+                         itl_s=0.012) -> None:
+    """Append this model's group to every fleet-wide query's answer."""
+    lab = labels_for(model)
+    for q, v in (
+        (fleet_availability_query(FAM), 1.0),
+        (fleet_true_arrival_rate_query(FAM), rps),
+        (fleet_arrival_rate_query(FAM), rps),
+        (fleet_avg_prompt_tokens_query(FAM), in_tok),
+        (fleet_avg_generation_tokens_query(FAM), out_tok),
+        (fleet_avg_ttft_query(FAM), ttft_s),
+        (fleet_avg_itl_query(FAM), itl_s),
+    ):
+        prom.add_result(q, v, labels=lab)
+
+
+def make_cluster(models_rps: dict[str, float], grouped=True,
+                 per_variant=True):
+    """One VA per model, grouped and/or per-variant answers seeded."""
+    kube = CountingKube()
+    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
+                                 {"GLOBAL_OPT_INTERVAL": "60s"}))
+    kube.put_configmap(ConfigMap(
+        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"v5e-1": json.dumps({"chip": "v5e", "chips": "1", "cost": "20.0"})},
+    ))
+    slos = "\n".join(
+        f"  - model: {m}\n    slo-tpot: 24\n    slo-ttft: 500"
+        for m in models_rps)
+    kube.put_configmap(ConfigMap(
+        SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"premium": f"name: Premium\npriority: 1\ndata:\n{slos}\n"},
+    ))
+    prom = FakePromAPI()
+    for i, (model, rps) in enumerate(models_rps.items()):
+        name = f"chat-{i}"
+        kube.put_deployment(Deployment(name=name, namespace=NS,
+                                       spec_replicas=1, status_replicas=1))
+        kube.put_variant_autoscaling(make_va(name, model))
+        if per_variant:
+            seed_variant_queries(prom, model, rps)
+        if grouped:
+            seed_grouped_queries(prom, model, rps)
+    emitter = MetricsEmitter()
+    rec = Reconciler(kube=kube, prom=prom, emitter=emitter,
+                     sleep=lambda _s: None)
+    return kube, prom, emitter, rec
+
+
+def make_va(name: str, model: str) -> crd.VariantAutoscaling:
+    return crd.VariantAutoscaling(
+        metadata=crd.ObjectMeta(name=name, namespace=NS,
+                                labels={crd.ACCELERATOR_LABEL: "v5e-1"}),
+        spec=crd.VariantAutoscalingSpec(
+            model_id=model,
+            slo_class_ref=crd.ConfigMapKeyRef(
+                name=SERVICE_CLASS_CM_NAME, key="premium"),
+            model_profile=crd.ModelProfile(accelerators=[
+                crd.AcceleratorProfile(
+                    acc="v5e-1", acc_count=1,
+                    perf_parms=crd.PerfParms(
+                        decode_parms={"alpha": "6.973", "beta": "0.027"},
+                        prefill_parms={"gamma": "5.2", "delta": "0.1"},
+                    ),
+                    max_batch_size=64,
+                ),
+            ]),
+        ),
+    )
+
+
+def decision_mode(rec, name):
+    return rec.decisions.latest(name, NS).inputs.collection_mode
+
+
+class TestGroupedDemux:
+    """Each variant is sized on ITS group's values, from one set of
+    grouped queries."""
+
+    MODELS = {"llama-a": 10.0, "llama-b": 40.0, "llama-c": 0.5}
+
+    def test_per_variant_loads_from_grouped_result(self):
+        kube, prom, _emitter, rec = make_cluster(self.MODELS)
+        result = rec.reconcile()
+        assert sorted(result.processed) == [f"chat-{i}:{NS}"
+                                            for i in range(3)]
+        assert not result.skipped and not result.degraded
+        for i, rps in enumerate(self.MODELS.values()):
+            va = kube.get_variant_autoscaling(f"chat-{i}", NS)
+            assert va.status.current_alloc.load.arrival_rate \
+                == f"{rps * 60.0:.2f}"
+            assert crd.is_condition_true(va, crd.TYPE_METRICS_AVAILABLE)
+            assert decision_mode(rec, f"chat-{i}") == MODE_FLEET
+        # no per-variant collection queries were issued at all
+        per_variant = [q for q in prom.queries_seen
+                       if 'model_name="' in q]
+        assert per_variant == [], per_variant
+
+    def test_missing_labels_take_the_repair_path(self):
+        # llama-b's exporter labels never reach the grouped result
+        # (e.g. relabeling drift): that variant alone re-collects with
+        # per-variant queries and still sizes correctly
+        models = dict(self.MODELS)
+        kube, prom, emitter, rec = make_cluster(
+            {m: r for m, r in models.items() if m != "llama-b"})
+        # add llama-b: VA + per-variant answers, NO grouped samples
+        kube.put_deployment(Deployment(name="chat-b", namespace=NS,
+                                       spec_replicas=1, status_replicas=1))
+        kube.put_variant_autoscaling(make_va("chat-b", "llama-b"))
+        cm = kube.get_configmap(SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE)
+        slos = "\n".join(
+            f"  - model: {m}\n    slo-tpot: 24\n    slo-ttft: 500"
+            for m in models)
+        cm.data["premium"] = f"name: Premium\npriority: 1\ndata:\n{slos}\n"
+        kube.put_configmap(cm)
+        seed_variant_queries(prom, "llama-b", models["llama-b"])
+
+        result = rec.reconcile()
+        assert not result.skipped and not result.degraded
+        va = kube.get_variant_autoscaling("chat-b", NS)
+        assert va.status.current_alloc.load.arrival_rate \
+            == f"{models['llama-b'] * 60.0:.2f}"
+        assert decision_mode(rec, "chat-b") == MODE_REPAIR
+        assert decision_mode(rec, "chat-0") == MODE_FLEET
+        # repair traffic is per-variant-scoped, and counted as such
+        assert emitter.value("inferno_collection_queries_total",
+                             mode=MODE_REPAIR) >= 5.0
+        assert emitter.value("inferno_collection_queries_total",
+                             mode=MODE_FLEET) == 7.0
+
+    def test_escape_hatch_restores_legacy_path(self, monkeypatch):
+        monkeypatch.setenv("WVA_FLEET_COLLECTION", "off")
+        kube, prom, emitter, rec = make_cluster(self.MODELS)
+        result = rec.reconcile()
+        assert not result.skipped
+        # no grouped queries on the wire, per-variant gets back (one in
+        # prepare + the actuator's live re-read per variant)
+        assert not any("sum by (" in q for q in prom.queries_seen)
+        assert kube.verb_counts.get("get:Deployment") == 6
+        for i in range(3):
+            assert decision_mode(rec, f"chat-{i}") == MODE_LEGACY
+        assert emitter.value("inferno_collection_queries_total",
+                             mode=MODE_LEGACY) >= 15.0
+        assert emitter.value("inferno_collection_seconds_count") == 1.0
+
+    def test_collection_metrics_exported(self):
+        _kube, _prom, emitter, rec = make_cluster(self.MODELS)
+        rec.reconcile()
+        assert emitter.value("inferno_collection_queries_total",
+                             mode=MODE_FLEET) == 7.0
+        assert emitter.value("inferno_collection_seconds_count") == 1.0
+
+
+class TestFleetLoadCollectorUnit:
+    def test_group_by_labels(self):
+        assert fleet_group_by(FAM) == "model_name,namespace"
+
+    def test_prefetch_failure_poisons_to_repair(self):
+        prom = FakePromAPI()
+        prom.set_error(fleet_true_arrival_rate_query(FAM),
+                       TimeoutError("injected"))
+        fleet = FleetLoadCollector(prom, family=FAM)
+        client, mode = fleet.variant_prom("m", NS)
+        assert fleet.failed
+        assert mode == MODE_REPAIR
+        # the repair client counts into the collector's repair tally
+        client.query("whatever")
+        assert fleet.repair_query_count == 1
+
+    def test_demux_drops_unattributable_samples(self):
+        prom = FakePromAPI()  # default answers carry NO labels
+        fleet = FleetLoadCollector(prom, family=FAM)
+        _client, mode = fleet.variant_prom("m", NS)
+        assert mode == MODE_REPAIR   # nothing matched the demux labels
+        assert fleet.avail == {}
+
+    def test_probe_window_adds_one_grouped_query(self):
+        prom = FakePromAPI()
+        fleet = FleetLoadCollector(prom, family=FAM, probe_window="15s")
+        fleet.prefetch()
+        assert fleet.query_count == 8
+        assert fleet_true_arrival_rate_query(FAM, window="15s") \
+            in prom.queries_seen
+
+    def test_identical_probe_window_not_duplicated(self):
+        fleet = FleetLoadCollector(FakePromAPI(), family=FAM,
+                                   probe_window="1m")
+        fleet.prefetch()
+        assert fleet.query_count == 7
+
+
+class TestCallCountProof:
+    """The acceptance criterion: a 512-variant happy cycle is
+    O(metric-families) in Prometheus queries and <= 2 kube LISTs —
+    against ~10 calls/variant (6 queries + 2 gets + writes) before."""
+
+    N = 512
+    N_MODELS = 8
+
+    def test_512_variant_cycle_call_counts(self):
+        models = {f"llama-8b-m{i}": 30.0 for i in range(self.N_MODELS)}
+        kube, prom, _emitter, rec = make_cluster(models)
+        # grow the fleet to N variants over the seeded models
+        for i in range(len(models), self.N):
+            model = f"llama-8b-m{i % self.N_MODELS}"
+            name = f"chat-{i}"
+            kube.put_deployment(Deployment(
+                name=name, namespace=NS,
+                spec_replicas=1, status_replicas=1))
+            kube.put_variant_autoscaling(make_va(name, model))
+
+        rec.reconcile()   # warm-up: owner-ref patches + kernel compile
+        prom.queries_seen.clear()
+        kube.verb_counts.clear()
+        result = rec.reconcile()
+
+        assert len(result.processed) == self.N
+        assert not result.skipped and not result.degraded
+        # Prometheus: 7 grouped collection queries + 2 TPU-util gauges
+        # for the single namespace — fleet-size independent
+        assert len(prom.queries_seen) <= 12, prom.queries_seen
+        # kube: ONE VariantAutoscaling LIST + ONE Deployment LIST; zero
+        # per-variant Deployment gets in the read path
+        assert kube.list_count() <= 2, kube.verb_counts
+        assert kube.verb_counts.get("list:VariantAutoscaling") == 1
+        assert kube.verb_counts.get("list:Deployment") == 1
+        assert "get:Deployment" not in kube.verb_counts
+        # the residual per-variant traffic is the WRITE path only
+        # (fresh-get + status PUT per published variant, fanned out)
+        assert kube.status_update_count == 2 * self.N  # warm + timed

@@ -133,6 +133,29 @@ def test_cpu_tail_settle_claims_match_artifact():
     assert "native" in art["decision"]
 
 
+def test_fleet_collection_claims_match_artifact():
+    """Round-6 fleet-scale collection: the committed bench artifact must
+    (a) actually justify the claims — >= 5x cycle speedup at 512
+    variants, O(families) queries per fleet cycle vs O(V) sequential,
+    <= 2 kube LISTs — and (b) equal the numbers quoted in
+    docs/observability.md."""
+    art = _artifact("BENCH_collect_r06.json")
+    assert art["variants"] == 512
+    assert art["vs_baseline"] >= 5.0, \
+        "artifact no longer justifies the >=5x fleet-collection claim"
+    # O(metric-families), not O(variants): fleet-size independent budget
+    # (7 grouped collection queries + the namespace's 2 TPU-util gauges)
+    assert art["fleet_queries_per_cycle"] <= 16
+    assert art["sequential_queries_per_cycle"] >= 5 * art["variants"]
+    assert art["fleet"]["kube_lists"] <= 2
+    doc = (REPO / "docs" / "observability.md").read_text()
+    assert f"**{art['vs_baseline']}×**" in doc, \
+        "observability.md's fleet-collection speedup drifted from the artifact"
+    assert (f"{art['sequential_queries_per_cycle']} queries/cycle → "
+            f"{art['fleet_queries_per_cycle']}") in doc, \
+        "observability.md's query-count claim drifted from the artifact"
+
+
 def test_capstone_claims_match_baseline_json():
     """Round-5 whole-fleet capstone: every quoted tail and the headline
     must equal the committed BASELINE.json entry, and the entry itself

@@ -352,6 +352,9 @@ _CM_ITEM = re.compile(r"^/api/v1/namespaces/([^/]+)/configmaps/([^/]+)$")
 _CM_LIST = re.compile(r"^/api/v1/namespaces/([^/]+)/configmaps$")
 _DEPLOY_ITEM = re.compile(
     r"^/apis/apps/v1/namespaces/([^/]+)/deployments/([^/]+)$")
+# cluster-scoped Deployment LIST (the controller's one-LIST fleet
+# snapshot, RestKube.list_deployments)
+_DEPLOY_ALL = "/apis/apps/v1/deployments"
 _NODES = re.compile(r"^/api/v1/nodes$")
 _LEASE_LIST = re.compile(
     r"^/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases$")
@@ -477,6 +480,11 @@ def _make_handler(srv: MiniApiServer):
                                      "namespace": cm.namespace},
                         "data": dict(cm.data),
                     })
+                if path == _DEPLOY_ALL:
+                    return self._deploy_list(None)
+                m = _DEPLOY_LIST.match(path)
+                if m:
+                    return self._deploy_list(m.group(1))
                 m = _DEPLOY_ITEM.match(path)
                 if m:
                     d = srv.kube.get_deployment(m.group(2), m.group(1))
@@ -632,6 +640,17 @@ def _make_handler(srv: MiniApiServer):
                 "apiVersion": "v1", "kind": "ConfigMap",
                 "metadata": {"name": cm.name, "namespace": cm.namespace},
                 "data": dict(cm.data),
+            })
+
+        def _deploy_list(self, ns: "str | None") -> None:
+            with srv._lock:
+                seq = srv._seq
+            items = [srv._deployment_body(d)
+                     for d in srv.kube.list_deployments(ns)]
+            self._json(200, {
+                "apiVersion": "apps/v1", "kind": "DeploymentList",
+                "metadata": {"resourceVersion": str(seq)},
+                "items": items,
             })
 
         def _deploy_post(self, ns: str) -> None:

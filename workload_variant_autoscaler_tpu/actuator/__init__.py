@@ -35,7 +35,8 @@ class Actuator:
         return deploy.current_replicas()
 
     def emit_metrics(self, va: VariantAutoscaling,
-                     prev_desired: int | None = None) -> bool:
+                     prev_desired: int | None = None,
+                     current: int | None = None) -> bool:
         """Push current/desired/ratio for external autoscalers (reference
         actuator.go:50-84). Returns True when signals were emitted; metric
         emission failures never fail reconciliation.
@@ -44,13 +45,17 @@ class Actuator:
         increments inferno_replica_scaling_total (the reference registers
         that counter but never increments it, metrics.go:84-100). Counting
         decision changes, not desired!=current cycles, keeps the churn
-        rate honest while slow external actuation catches up."""
+        rate honest while slow external actuation catches up.
+        current: the live replica count when the caller already holds it
+        (the fleet-collection cycle's one-LIST Deployment snapshot) —
+        skips the per-variant Deployment re-GET; None re-reads."""
         desired = va.status.desired_optimized_alloc.num_replicas
         if desired < 0:
             log.info("skipping metric emission, negative desired replicas",
                      extra=kv(variant=va.name))
             return False
-        current = self.current_deployment_replicas(va)
+        if current is None:
+            current = self.current_deployment_replicas(va)
         try:
             self.emitter.emit_replica_metrics(
                 variant_name=va.name,

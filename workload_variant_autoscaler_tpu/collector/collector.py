@@ -16,7 +16,7 @@ import time
 from dataclasses import dataclass
 
 from ..utils import fix_value, get_logger, kv
-from .prometheus import PromAPI
+from .prometheus import PromAPI, Sample
 
 log = get_logger("wva.collector")
 
@@ -540,6 +540,313 @@ def collect_load(
         avg_ttft_ms=(ttft_s or 0.0) * 1000.0,
         avg_itl_ms=(itl_s or 0.0) * 1000.0,
     )
+
+
+# -- fleet-wide grouped queries (O(metric-families) collection) ------------
+# The per-variant builders above filter to ONE model and cost the cycle
+# ~8 Prometheus round-trips per variant. These aggregate the SAME series
+# `by (model_label, namespace_label)` instead, so one query answers the
+# whole fleet and the FleetLoadCollector demuxes samples back into
+# per-variant loads by label. The per-group value is identical to the
+# per-variant value by construction: sum(rate(x{m,ns})) == the (m,ns)
+# group of sum by (m,ns)(rate(x)).
+
+# collection modes (DecisionRecords + inferno_collection_queries_total)
+MODE_FLEET = "fleet"                    # demuxed from the grouped result
+MODE_REPAIR = "per-variant-repair"      # labels missing from the grouped
+                                        # result: single-variant queries
+MODE_LEGACY = "legacy"                  # WVA_FLEET_COLLECTION=off path
+
+
+def fleet_group_by(family: MetricFamily | None = None) -> str:
+    """The `by (...)` label list for fleet-wide aggregation; empty when
+    the dialect carries neither label (grouping impossible — the
+    collector then stays on the per-variant path)."""
+    family = family or active_family()
+    return ",".join(
+        label for label in (family.model_label, family.namespace_label)
+        if label)
+
+
+def _fleet_rate_sum(metric: str, family: MetricFamily,
+                    window: str = RATE_WINDOW) -> str:
+    return f"sum by ({fleet_group_by(family)}) (rate({metric}[{window}]))"
+
+
+def _fleet_deriv_sum(metric: str, family: MetricFamily,
+                     window: str = RATE_WINDOW) -> str:
+    return f"sum by ({fleet_group_by(family)}) (deriv({metric}[{window}]))"
+
+
+def _fleet_ratio(num: str, den: str, family: MetricFamily) -> str:
+    # PromQL matches the division on the group labels of both sides, so
+    # each (model, ns) group divides its own aggregates — and a 0/0
+    # group answers NaN with the group PRESENT, exactly like the
+    # per-variant ratio ('unknown', never a fabricated 0)
+    return (f"{_fleet_rate_sum(num, family)}/"
+            f"{_fleet_rate_sum(den, family)}")
+
+
+def fleet_true_arrival_rate_query(
+    family: MetricFamily | None = None, window: str = RATE_WINDOW,
+) -> str:
+    """Grouped form of true_arrival_rate_query (same demand semantics,
+    queue-dynamics recovery included for admission-counter-less
+    dialects)."""
+    family = family or active_family()
+    if family.arrival_total is not None:
+        return _fleet_rate_sum(family.arrival_total, family, window)
+    if family.queue_depth is not None:
+        return (
+            f"{_fleet_rate_sum(family.success_total, family, window)} + "
+            f"clamp_min({_fleet_deriv_sum(family.queue_depth, family, window)}, 0)"
+        )
+    return _fleet_rate_sum(family.success_total, family, window)
+
+
+def fleet_arrival_rate_query(family: MetricFamily | None = None) -> str:
+    family = family or active_family()
+    return _fleet_rate_sum(family.success_total, family)
+
+
+def fleet_avg_prompt_tokens_query(family: MetricFamily | None = None) -> str:
+    family = family or active_family()
+    return _fleet_ratio(f"{family.prompt_tokens}_sum",
+                        f"{family.prompt_tokens}_count", family)
+
+
+def fleet_avg_generation_tokens_query(
+    family: MetricFamily | None = None,
+) -> str:
+    family = family or active_family()
+    return _fleet_ratio(f"{family.generation_tokens}_sum",
+                        f"{family.generation_tokens}_count", family)
+
+
+def fleet_avg_ttft_query(family: MetricFamily | None = None) -> str:
+    family = family or active_family()
+    return _fleet_ratio(f"{family.ttft_seconds}_sum",
+                        f"{family.ttft_seconds}_count", family)
+
+
+def fleet_avg_itl_query(family: MetricFamily | None = None) -> str:
+    family = family or active_family()
+    return _fleet_ratio(f"{family.tpot_seconds}_sum",
+                        f"{family.tpot_seconds}_count", family)
+
+
+def fleet_availability_query(family: MetricFamily | None = None) -> str:
+    """RAW series, no matcher: every exporter's success counter with its
+    full label set and real timestamps — presence AND staleness for the
+    whole fleet from one query (the per-variant availability_query is
+    the same series filtered to one model)."""
+    family = family or active_family()
+    return family.success_total
+
+
+class CountingPromAPI:
+    """PromAPI wrapper that counts queries (the
+    inferno_collection_queries_total feed for the legacy/repair paths).
+    `on_query` lets a FleetLoadCollector share one repair counter across
+    every variant's repair client."""
+
+    def __init__(self, inner: PromAPI, on_query=None):
+        self.inner = inner
+        self.count = 0
+        self._on_query = on_query
+
+    def query(self, promql: str) -> list:
+        self.count += 1
+        if self._on_query is not None:
+            self._on_query()
+        return self.inner.query(promql)
+
+
+class _FleetView:
+    """Per-variant PromAPI answering from one variant's slice of the
+    grouped indexes — validate_metrics_availability/collect_load run
+    UNCHANGED against it, so the fleet path cannot drift from the
+    per-variant semantics (presence vs. absence, NaN-is-unknown, the
+    probe-window override, the namespace-less availability fallback).
+    Queries outside the prefetched set (none on the collect path today)
+    forward to the real client and count as repair traffic."""
+
+    def __init__(self, fleet: "FleetLoadCollector", model: str,
+                 namespace: str):
+        self.fleet = fleet
+        self.model = model
+        fam = fleet.family
+        self._key = fleet.group_key(model, namespace)
+        q: dict[str, tuple[str, str]] = {
+            availability_query(model, namespace, fam):
+                ("avail", ""),
+            true_arrival_rate_query(model, namespace, fam):
+                ("value", "demand"),
+            arrival_rate_query(model, namespace, fam):
+                ("value", "success"),
+            avg_prompt_tokens_query(model, namespace, fam):
+                ("value", "prompt_tokens"),
+            avg_generation_tokens_query(model, namespace, fam):
+                ("value", "generation_tokens"),
+            avg_ttft_query(model, namespace, fam): ("value", "ttft"),
+            avg_itl_query(model, namespace, fam): ("value", "itl"),
+        }
+        if fleet.probe_window:
+            q[true_arrival_rate_query(model, namespace, fam,
+                                      window=fleet.probe_window)] = \
+                ("value", "demand_probe")
+        # the namespace-less availability fallback (validated only while
+        # a model matcher keeps it scoped — same guard as the caller's)
+        nsless = availability_query(model, family=fam)
+        if nsless not in q and "{" in nsless:
+            q[nsless] = ("avail_nsless", "")
+        self._queries = q
+
+    def query(self, promql: str) -> list[Sample]:
+        spec = self._queries.get(promql)
+        if spec is None:
+            self.fleet.repair_query_count += 1
+            return self.fleet.prom.query(promql)
+        kind, name = spec
+        if kind == "avail":
+            return list(self.fleet.avail.get(self._key, []))
+        if kind == "avail_nsless":
+            out: list[Sample] = []
+            for key, samples in self.fleet.avail.items():
+                if self.fleet.key_matches_model(key, self.model):
+                    out.extend(samples)
+            return out
+        sample = self.fleet.values.get(name, {}).get(self._key)
+        return [sample] if sample is not None else []
+
+
+class FleetLoadCollector:
+    """O(metric-families) collection for the whole fleet.
+
+    prefetch() issues one grouped query per metric family (~7-8 total,
+    fleet-size independent), indexes the returned samples by their
+    (model_label, namespace_label) values, and variant_prom() hands each
+    variant either a _FleetView over its group (MODE_FLEET) or — when
+    the variant's labels are missing from the grouped result, or any
+    grouped query failed — the real per-variant client (MODE_REPAIR), so
+    a grouped-query quirk degrades to exactly the pre-existing
+    per-variant ladder, never to a zero-fill."""
+
+    def __init__(self, prom: PromAPI, family: MetricFamily | None = None,
+                 probe_window: str | None = None):
+        self.prom = prom
+        self.family = family or active_family()
+        self.probe_window = (probe_window if probe_window
+                             and probe_window != RATE_WINDOW else None)
+        self.enabled = bool(fleet_group_by(self.family))
+        self.failed = False
+        self.query_count = 0         # grouped (fleet-mode) queries issued
+        self.repair_query_count = 0  # per-variant repair queries issued
+        self._fetched = False
+        # group key -> samples (availability) / Sample (aggregates)
+        self.avail: dict[tuple, list[Sample]] = {}
+        self.values: dict[str, dict[tuple, Sample]] = {}
+
+    # -- label demux -----------------------------------------------------
+
+    def group_key(self, model: str, namespace: str) -> tuple:
+        key = []
+        if self.family.model_label:
+            key.append(model)
+        if self.family.namespace_label:
+            key.append(namespace)
+        return tuple(key)
+
+    def sample_key(self, labels: dict[str, str]) -> tuple | None:
+        """The group key carried by a returned sample; None when the
+        sample lacks a demux label (it can't be attributed and is
+        dropped — the owning variant then takes the repair path)."""
+        key = []
+        for label in (self.family.model_label,
+                      self.family.namespace_label):
+            if not label:
+                continue
+            value = labels.get(label)
+            if value is None:
+                return None
+            key.append(value)
+        return tuple(key)
+
+    def key_matches_model(self, key: tuple, model: str) -> bool:
+        return bool(self.family.model_label) and bool(key) \
+            and key[0] == model
+
+    # -- the grouped fetch ------------------------------------------------
+
+    def prefetch(self) -> None:
+        """Issue the grouped queries once per cycle. ANY failure poisons
+        the whole batch (failed=True): a half-fetched index could
+        misread a grouped timeout as a variant-level series absence, so
+        every variant falls back to the per-variant path, which carries
+        the existing validation/breaker/backoff ladder."""
+        if self._fetched or not self.enabled:
+            self._fetched = True
+            return
+        self._fetched = True
+        fam = self.family
+        specs: dict[str, str] = {
+            "availability": fleet_availability_query(fam),
+            "demand": fleet_true_arrival_rate_query(fam),
+            "success": fleet_arrival_rate_query(fam),
+            "prompt_tokens": fleet_avg_prompt_tokens_query(fam),
+            "generation_tokens": fleet_avg_generation_tokens_query(fam),
+            "ttft": fleet_avg_ttft_query(fam),
+            "itl": fleet_avg_itl_query(fam),
+        }
+        if self.probe_window:
+            specs["demand_probe"] = fleet_true_arrival_rate_query(
+                fam, window=self.probe_window)
+        try:
+            for name, promql in specs.items():
+                self.query_count += 1
+                samples = self.prom.query(promql)
+                if name == "availability":
+                    avail: dict[tuple, list[Sample]] = {}
+                    for s in samples:
+                        key = self.sample_key(s.labels)
+                        if key is not None:
+                            avail.setdefault(key, []).append(s)
+                    self.avail = avail
+                else:
+                    index: dict[tuple, Sample] = {}
+                    for s in samples:
+                        key = self.sample_key(s.labels)
+                        if key is not None:
+                            index[key] = s
+                    self.values[name] = index
+        except Exception as e:  # noqa: BLE001 - any failure -> repair path
+            log.warning(
+                "fleet collection prefetch failed; repairing per-variant",
+                extra=kv(family=fam.name, error=str(e)))
+            self.failed = True
+
+    def variant_prom(self, model: str, namespace: str) -> tuple[PromAPI, str]:
+        """(client, mode) for one variant: a grouped-index view when its
+        labels landed in the grouped result, the counted real client
+        otherwise."""
+        self.prefetch()
+        if not self.enabled or self.failed:
+            return self._repair_prom(), MODE_REPAIR
+        key = self.group_key(model, namespace)
+        present = (
+            key in self.avail
+            or key in self.values.get("demand", {})
+            or any(self.key_matches_model(k, model) for k in self.avail)
+        )
+        if not present:
+            return self._repair_prom(), MODE_REPAIR
+        return _FleetView(self, model, namespace), MODE_FLEET
+
+    def _repair_prom(self) -> PromAPI:
+        def bump() -> None:
+            self.repair_query_count += 1
+
+        return CountingPromAPI(self.prom, on_query=bump)
 
 
 # GKE TPU accelerator label values -> chip generation (the TPU analogue of

@@ -160,27 +160,47 @@ class HTTPPromAPI:
         return out
 
     def query_range(self, promql: str, start_s: float, end_s: float,
-                    step_s: float) -> list[Sample]:
-        """Flat time series of the FIRST result series (the collector's
+                    step_s: float,
+                    series_labels: Optional[dict] = None) -> list[Sample]:
+        """Flat time series of ONE result series (the collector's
         aggregations always reduce to one) between start and end, one
-        Sample per step — the profile fitter's data feed."""
+        Sample per step — the profile fitter's data feed.
+
+        A multi-series answer (label drift, duplicate jobs — and now a
+        real possibility with the grouped fleet queries) is resolved
+        deterministically: the series matching `series_labels` when
+        given, else the one with the lexicographically smallest sorted
+        label set — never whatever order the server happened to return —
+        and the discarded series' labels are logged."""
         data = self._get("/api/v1/query_range", {
             "query": promql, "start": start_s, "end": end_s,
             "step": step_s,
         })
         if data.get("resultType") != "matrix" or not data.get("result"):
             return []
-        if len(data["result"]) > 1:
-            # the collector's aggregations reduce to one series; several
-            # means label drift or duplicate jobs — make the truncation
-            # visible instead of silently regressing on partial data
+        results = data["result"]
+
+        def label_key(entry: dict) -> list:
+            return sorted(entry.get("metric", {}).items())
+
+        series = min(results, key=label_key)
+        if len(results) > 1:
+            if series_labels:
+                matching = [
+                    entry for entry in results
+                    if all(entry.get("metric", {}).get(k) == v
+                           for k, v in series_labels.items())
+                ]
+                if matching:
+                    series = min(matching, key=label_key)
             log.warning(
-                "query_range returned %d series; using the first "
+                "query_range returned %d series; selected %s, discarded %s "
                 "(mis-scoped query? duplicate jobs?)",
-                len(data["result"]),
+                len(results), dict(series.get("metric", {})),
+                [dict(entry.get("metric", {})) for entry in results
+                 if entry is not series],
                 extra=kv(query=promql[:200]),
             )
-        series = data["result"][0]
         labels = dict(series.get("metric", {}))
         # NaN is passed through RAW, unlike the instant query: a 0/0
         # window means 'unknown', and the fitter must be able to DROP it —
@@ -234,10 +254,16 @@ class GuardedPromAPI:
                              lambda: self.inner.query(promql))
 
     def query_range(self, promql: str, start_s: float, end_s: float,
-                    step_s: float) -> list[Sample]:
-        return self._guarded(
-            "query_range", promql,
-            lambda: self.inner.query_range(promql, start_s, end_s, step_s))
+                    step_s: float,
+                    series_labels: Optional[dict] = None) -> list[Sample]:
+        def call():
+            if series_labels is not None:
+                return self.inner.query_range(promql, start_s, end_s,
+                                              step_s,
+                                              series_labels=series_labels)
+            return self.inner.query_range(promql, start_s, end_s, step_s)
+
+        return self._guarded("query_range", promql, call)
 
     def clone(self):
         clone = getattr(self.inner, "clone", None)
@@ -261,6 +287,14 @@ class FakePromAPI:
         self.query_results[promql] = [
             Sample(labels=labels or {}, value=value, timestamp=self._now() - age_seconds)
         ]
+
+    def add_result(self, promql: str, value: float, age_seconds: float = 0.0,
+                   labels: dict | None = None) -> None:
+        """APPEND a sample to a query's answer (grouped fleet queries
+        return one sample per (model, namespace) group)."""
+        self.query_results.setdefault(promql, []).append(
+            Sample(labels=labels or {}, value=value,
+                   timestamp=self._now() - age_seconds))
 
     def set_empty(self, promql: str) -> None:
         self.query_results[promql] = []

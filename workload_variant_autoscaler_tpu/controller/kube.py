@@ -104,6 +104,10 @@ class Node:
 class KubeClient(Protocol):
     def get_configmap(self, name: str, namespace: str) -> ConfigMap: ...
     def get_deployment(self, name: str, namespace: str) -> Deployment: ...
+    # one-LIST fleet snapshot (fleet-scale collection: the reconciler
+    # indexes all Deployments once per cycle instead of V gets)
+    def list_deployments(
+        self, namespace: Optional[str] = None) -> list[Deployment]: ...
     def list_variant_autoscalings(self) -> list[VariantAutoscaling]: ...
     def get_variant_autoscaling(self, name: str, namespace: str) -> VariantAutoscaling: ...
     def update_variant_autoscaling_status(self, va: VariantAutoscaling) -> None: ...
@@ -303,6 +307,14 @@ class InMemoryKube:
             if d is None:
                 raise NotFoundError(f"deployment {namespace}/{name} not found")
             return copy.deepcopy(d)
+
+    def list_deployments(
+        self, namespace: Optional[str] = None,
+    ) -> list[Deployment]:
+        with self._lock:
+            self._trip("list", "Deployment")
+            return [copy.deepcopy(d) for d in self.deployments.values()
+                    if namespace is None or d.namespace == namespace]
 
     def list_variant_autoscalings(self) -> list[VariantAutoscaling]:
         with self._lock:
@@ -602,18 +614,36 @@ class RestKube:
         obj = self._request("GET", f"/api/v1/namespaces/{namespace}/configmaps/{name}")
         return ConfigMap(name=name, namespace=namespace, data=obj.get("data", {}))
 
+    @staticmethod
+    def _deployment_from_obj(obj: dict, name: str = "",
+                             namespace: str = "") -> Deployment:
+        meta = obj.get("metadata", {})
+        return Deployment(
+            name=name or meta.get("name", ""),
+            namespace=namespace or meta.get("namespace", ""),
+            spec_replicas=obj.get("spec", {}).get("replicas", 1),
+            status_replicas=obj.get("status", {}).get("replicas", -1),
+            uid=meta.get("uid", ""),
+            labels=meta.get("labels", {}),
+        )
+
     def get_deployment(self, name: str, namespace: str) -> Deployment:
         obj = self._request(
             "GET", f"/apis/apps/v1/namespaces/{namespace}/deployments/{name}"
         )
-        return Deployment(
-            name=name,
-            namespace=namespace,
-            spec_replicas=obj.get("spec", {}).get("replicas", 1),
-            status_replicas=obj.get("status", {}).get("replicas", -1),
-            uid=obj.get("metadata", {}).get("uid", ""),
-            labels=obj.get("metadata", {}).get("labels", {}),
-        )
+        return self._deployment_from_obj(obj, name=name, namespace=namespace)
+
+    def list_deployments(
+        self, namespace: Optional[str] = None,
+    ) -> list[Deployment]:
+        """One LIST for the fleet's Deployment snapshot (all namespaces
+        by default — the cluster-scoped /apis/apps/v1/deployments path,
+        which the controller's read RBAC must cover)."""
+        path = (f"/apis/apps/v1/namespaces/{namespace}/deployments"
+                if namespace else "/apis/apps/v1/deployments")
+        obj = self._request("GET", path)
+        return [self._deployment_from_obj(item)
+                for item in obj.get("items", [])]
 
     def list_variant_autoscalings(self) -> list[VariantAutoscaling]:
         obj = self._request("GET", f"/apis/{GROUP}/{VERSION}/{PLURAL}")

@@ -22,6 +22,11 @@ from typing import Optional
 
 from ..actuator import Actuator
 from ..collector import (
+    MODE_FLEET,
+    MODE_LEGACY,
+    MODE_REPAIR,
+    CountingPromAPI,
+    FleetLoadCollector,
     IncompleteMetricsError,
     LoadCache,
     PromAPI,
@@ -60,6 +65,8 @@ from ..utils import (
     CircuitBreaker,
     CircuitOpenError,
     Deadline,
+    fanout,
+    fanout_workers,
     full_name,
     get_logger,
     kv,
@@ -183,6 +190,29 @@ class Reconciler:
         # the probe daemon thread's private Prometheus client (lazy; a
         # shared requests.Session is not thread-safe under concurrency)
         self._probe_prom = None
+        # fleet-mode per-cycle condition source: full_name -> the VA
+        # object this cycle read/wrote, so _emit_conditions needs no
+        # extra LIST; None = legacy mode (post-publish LIST)
+        self._cycle_condition_vas: Optional[dict] = None
+
+    # -- fleet-scale collection knobs -------------------------------------
+
+    def _fleet_collection_enabled(self, operator_cm=None) -> bool:
+        """WVA_FLEET_COLLECTION: grouped O(metric-families) collection +
+        one-LIST kube snapshots (default on). `off` is the escape hatch
+        back to the per-variant reference shape — env first, then the
+        operator ConfigMap (standard knob precedence)."""
+        raw = (os.environ.get("WVA_FLEET_COLLECTION")
+               or (operator_cm if operator_cm is not None
+                   else self._last_operator_cm).get("WVA_FLEET_COLLECTION")
+               or "")
+        return raw.strip().lower() not in ("off", "false", "0", "disabled")
+
+    def _fanout_workers(self) -> int:
+        """WVA_COLLECT_FANOUT: worker threads for the residual
+        per-variant calls (status writes, owner-ref patches, TPU-util
+        probes). 1 = fully sequential (strict-determinism hatch)."""
+        return fanout_workers(self._last_operator_cm)
 
     # -- hardened dependency calls ----------------------------------------
 
@@ -374,6 +404,13 @@ class Reconciler:
                               what="list:VariantAutoscaling")
         mark(STAGE_CONFIG)
         active = [va for va in vas if va.is_active()]
+        # fleet mode: the cycle's LIST copies are the condition-metrics
+        # source of truth (updated with the fresh post-write objects in
+        # _apply), so the post-publish re-LIST is not paid; legacy keeps
+        # the LIST (None)
+        self._cycle_condition_vas = (
+            {full_name(va.name, va.namespace): va for va in active}
+            if self._fleet_collection_enabled(operator_cm) else None)
         for va in vas:
             if not va.is_active():
                 result.skipped[full_name(va.name, va.namespace)] = "deleted"
@@ -574,13 +611,20 @@ class Reconciler:
 
     def _emit_conditions(self) -> None:
         """CR conditions as inferno_condition_status series (post-write
-        truth: one LIST after publish), kube-state-metrics shape without
-        kube-state-metrics — the shipped alerts can key on
-        MetricsAvailable/OptimizationReady/PerfModelAccurate directly.
-        Observability only: a failure here never fails the cycle."""
+        truth), kube-state-metrics shape without kube-state-metrics —
+        the shipped alerts can key on MetricsAvailable/OptimizationReady/
+        PerfModelAccurate directly. Fleet mode reads the cycle's in-hand
+        VA objects (the LIST copies, overlaid with the fresh post-write
+        objects from _apply) instead of paying a third LIST per cycle;
+        legacy mode keeps the post-publish re-LIST. Observability only:
+        a failure here never fails the cycle."""
         try:
+            if self._cycle_condition_vas is not None:
+                vas = list(self._cycle_condition_vas.values())
+            else:
+                vas = self.kube.list_variant_autoscalings()
             samples: dict[tuple[str, str, str], str] = {}
-            for va in self.kube.list_variant_autoscalings():
+            for va in vas:
                 if not va.is_active():
                     continue
                 for cond in va.status.conditions:
@@ -791,6 +835,28 @@ class Reconciler:
                         if self._probe_knob(self.PROBE_ENV, 0.0) > 0
                         else None)
         self._warn_shared_namespace_aggregation(active, family)
+
+        fleet_mode = self._fleet_collection_enabled(operator_cm)
+        # one-LIST kube snapshot: the whole fleet's Deployments in one
+        # call, indexed by (namespace, name), instead of a GET per
+        # variant. A failed LIST falls back to per-variant GETs — the
+        # pre-existing ladder, not a whole-fleet skip.
+        deploy_index: Optional[dict[tuple[str, str], Deployment]] = None
+        if fleet_mode and active:
+            try:
+                deploys = self._kube_call(
+                    lambda: self.kube.list_deployments(),
+                    what="list:Deployment")
+            except Exception as e:  # noqa: BLE001
+                log.warning(
+                    "deployment LIST failed; per-variant gets this cycle",
+                    extra=kv(error=str(e)))
+            else:
+                deploy_index = {(d.namespace, d.name): d for d in deploys}
+
+        # -- pass 1: config screening + object resolution (no Prometheus)
+        candidates: list[tuple[crd.VariantAutoscaling, Deployment, str,
+                               float, str]] = []
         for va_listed in active:
             name = va_listed.name
             key = full_name(va_listed.name, va_listed.namespace)
@@ -825,36 +891,88 @@ class Reconciler:
                 result.skipped[key] = "missing accelerator cost"
                 continue
 
-            try:
-                deploy = self._kube_call(
-                    lambda: self.kube.get_deployment(name, va_listed.namespace),
-                    what="get:Deployment",
-                )
-            except Exception as e:  # noqa: BLE001
-                log.error("failed to get Deployment", extra=kv(variant=name, error=str(e)))
-                result.skipped[key] = "deployment not found"
-                continue
-
-            try:
-                va = self._kube_call(
-                    lambda: self.kube.get_variant_autoscaling(name, va_listed.namespace),
-                    what="get:VariantAutoscaling",
-                )
-            except Exception as e:  # noqa: BLE001
-                result.skipped[key] = "variant not found"
-                continue
-
-            # ownerReference first, so GC works even before metrics exist
-            # (reference controller.go:276-293)
-            if not va.is_controlled_by(deploy.uid):
-                try:
-                    self._kube_call(
-                        lambda: self.kube.patch_owner_reference(va, deploy),
-                        what="patch:VariantAutoscaling/ownerRef")
-                except Exception as e:  # noqa: BLE001
-                    log.error("failed to set ownerReference", extra=kv(variant=name, error=str(e)))
-                    result.skipped[key] = "ownerReference patch failed"
+            if deploy_index is not None:
+                deploy = deploy_index.get((va_listed.namespace, name))
+                if deploy is None:
+                    log.error("failed to get Deployment",
+                              extra=kv(variant=name,
+                                       error="not in the fleet snapshot"))
+                    result.skipped[key] = "deployment not found"
                     continue
+            else:
+                try:
+                    deploy = self._kube_call(
+                        lambda: self.kube.get_deployment(name, va_listed.namespace),
+                        what="get:Deployment",
+                    )
+                except Exception as e:  # noqa: BLE001
+                    log.error("failed to get Deployment", extra=kv(variant=name, error=str(e)))
+                    result.skipped[key] = "deployment not found"
+                    continue
+
+            if fleet_mode:
+                # the LIST copy is this cycle's working object — the
+                # per-variant re-GET was pure O(V) apiserver traffic
+                # (conflict-retried status writes already re-fetch on 409)
+                va = va_listed
+            else:
+                try:
+                    va = self._kube_call(
+                        lambda: self.kube.get_variant_autoscaling(name, va_listed.namespace),
+                        what="get:VariantAutoscaling",
+                    )
+                except Exception as e:  # noqa: BLE001
+                    result.skipped[key] = "variant not found"
+                    continue
+
+            candidates.append((va, deploy, acc_name, cost, class_name))
+
+        # -- pass 1b: ownerReference patches, fanned out (first so GC
+        # works even before metrics exist, reference controller.go:276-293)
+        need_patch = [(va, deploy) for va, deploy, _acc, _cost, _cls
+                      in candidates if not va.is_controlled_by(deploy.uid)]
+        patch_failed: set[str] = set()
+        if need_patch:
+            outcomes = fanout(
+                [lambda va=va, deploy=deploy: self._kube_call(
+                    lambda: self.kube.patch_owner_reference(va, deploy),
+                    what="patch:VariantAutoscaling/ownerRef")
+                 for va, deploy in need_patch],
+                workers=self._fanout_workers(), label="ownerref")
+            for (va, _deploy), (_res, err) in zip(need_patch, outcomes):
+                if err is not None:
+                    log.error("failed to set ownerReference",
+                              extra=kv(variant=va.name, error=str(err)))
+                    key = full_name(va.name, va.namespace)
+                    result.skipped[key] = "ownerReference patch failed"
+                    patch_failed.add(key)
+
+        # -- pass 2: load collection + decision building. Fleet mode
+        # prefetches ~8 grouped queries and demuxes per variant; labels
+        # missing from the grouped result (or a failed prefetch) repair
+        # through the per-variant queries — the exact pre-existing
+        # semantics, proven by running the SAME validate/collect code
+        # against the demux view.
+        collect_t0 = time.perf_counter()
+        fleet: Optional[FleetLoadCollector] = None
+        legacy_prom: Optional[CountingPromAPI] = None
+        if fleet_mode:
+            fleet = FleetLoadCollector(self.guarded_prom,
+                                       family=family or active_family(),
+                                       probe_window=probe_window)
+        else:
+            legacy_prom = CountingPromAPI(self.guarded_prom)
+        for va, deploy, acc_name, cost, class_name in candidates:
+            name = va.name
+            key = full_name(va.name, va.namespace)
+            model = va.spec.model_id
+            if key in patch_failed:
+                continue
+            if fleet is not None:
+                variant_prom, collection_mode = fleet.variant_prom(
+                    model, deploy.namespace)
+            else:
+                variant_prom, collection_mode = legacy_prom, MODE_LEGACY
 
             # metrics gate: a live scrape is HEALTHY; any dependency or
             # evidence failure falls through to the last-known-good cache
@@ -864,7 +982,7 @@ class Reconciler:
             load = None
             fallback = None  # (skip_reason, condition_reason, message)
             validation = validate_metrics_availability(
-                self.guarded_prom, model, deploy.namespace, now=self.now(),
+                variant_prom, model, deploy.namespace, now=self.now(),
                 family=family,
             )
             if validation.available:
@@ -873,7 +991,7 @@ class Reconciler:
                     validation.reason, validation.message, now=self.now(),
                 )
                 try:
-                    load = collect_load(self.guarded_prom, model,
+                    load = collect_load(variant_prom, model,
                                         deploy.namespace,
                                         fallback=self._last_known_load(va),
                                         family=family,
@@ -928,6 +1046,7 @@ class Reconciler:
                             cost_per_replica=cost,
                             current_replicas=deploy.current_replicas(),
                             prev_published=prev,
+                            collection_mode=collection_mode,
                         ),
                         proposed_replicas=prev,
                     )
@@ -968,6 +1087,7 @@ class Reconciler:
                     cost_per_replica=cost,
                     current_replicas=deploy.current_replicas(),
                     prev_published=va.status.desired_optimized_alloc.num_replicas,
+                    collection_mode=collection_mode,
                 ),
             )
 
@@ -992,6 +1112,16 @@ class Reconciler:
                               stale=stale_load)
             prepared.append((va, deploy))
             result.processed.append(key)
+        # collection telemetry: the query counts per path are the series
+        # that PROVE O(metric-families) collection (and flag demux rot:
+        # a repair count tracking the fleet size)
+        if fleet is not None:
+            queries_by_mode = {MODE_FLEET: fleet.query_count,
+                               MODE_REPAIR: fleet.repair_query_count}
+        else:
+            queries_by_mode = {MODE_LEGACY: legacy_prom.count}
+        self.emitter.emit_collection_metrics(
+            queries_by_mode, time.perf_counter() - collect_t0)
         self.emitter.emit_drift_metrics(drift_samples)
         self._collect_tpu_utilization(
             {deploy.namespace for _va, deploy in prepared},
@@ -1023,18 +1153,29 @@ class Reconciler:
         from ..collector import collect_tpu_utilization
 
         out: dict[str, dict[str, float]] = {}
-        for ns in namespaces:
+        probing: list[str] = []
+        for ns in sorted(namespaces):
             misses, skipped = self._tpu_util_misses.get(ns, (0, 0))
             if misses >= self.TPU_UTIL_MISS_LIMIT and \
                     skipped + 1 < self.TPU_UTIL_RETRY_EVERY:
                 self._tpu_util_misses[ns] = (misses, skipped + 1)
                 out[ns] = {}   # backed off, known-absent
                 continue
-            sample = collect_tpu_utilization(self.guarded_prom, ns)
+            probing.append(ns)
+        # two queries per probed namespace, fanned out (a many-namespace
+        # fleet must not serialize 2·N round-trips); collect_tpu_...
+        # swallows its own errors, so results are always dicts
+        outcomes = fanout(
+            [lambda ns=ns: collect_tpu_utilization(self.guarded_prom, ns)
+             for ns in probing],
+            workers=self._fanout_workers(), label="tpu-util")
+        for ns, (sample, _err) in zip(probing, outcomes):
+            sample = sample or {}
             out[ns] = sample
             if sample:
                 self._tpu_util_misses.pop(ns, None)
             else:
+                misses, _skipped = self._tpu_util_misses.get(ns, (0, 0))
                 self._tpu_util_misses[ns] = (misses + 1, 0)
         # drop back-off state for namespaces that left the fleet — under
         # namespace churn the dict would otherwise grow without bound
@@ -1150,6 +1291,8 @@ class Reconciler:
             cm=self._last_operator_cm)
         probe_targets: dict[str, tuple[str, float]] = {}
         power: dict[tuple[str, str, str], float] = {}
+        fleet_mode = self._cycle_condition_vas is not None
+        publishing: list[tuple[crd.VariantAutoscaling, Deployment]] = []
         for va, _deploy in prepared:
             key = full_name(va.name, va.namespace)
             if key not in optimized:
@@ -1177,6 +1320,16 @@ class Reconciler:
                                                 window=self.probe_window()),
                         cap,
                     )
+            publishing.append((va, _deploy))
+
+        def publish_one(va: crd.VariantAutoscaling, deploy: Deployment):
+            """Per-variant status write (re-get, signal emission, status
+            PUT) — the residual unavoidably-per-variant kube traffic,
+            fanned out over WVA_COLLECT_FANOUT workers. Returns the
+            written object (the condition-metrics source), or None when
+            the re-get failed (logged; the variant keeps its previous
+            published state)."""
+            key = full_name(va.name, va.namespace)
             try:
                 fresh = self._kube_call(
                     lambda: self.kube.get_variant_autoscaling(va.name, va.namespace),
@@ -1184,7 +1337,7 @@ class Reconciler:
                 )
             except Exception as e:  # noqa: BLE001
                 log.error("failed to re-get variant", extra=kv(variant=va.name, error=str(e)))
-                continue
+                return None
 
             fresh.status.current_alloc = va.status.current_alloc
             # the previously PUBLISHED recommendation, for the scaling-
@@ -1204,10 +1357,27 @@ class Reconciler:
                 now=self.now(),
             )
 
-            if self.actuator.emit_metrics(fresh, prev_desired=prev_desired):
+            # fleet mode reuses this cycle's Deployment snapshot for the
+            # current-replicas signal instead of a per-variant re-GET;
+            # legacy keeps the live read
+            if self.actuator.emit_metrics(
+                    fresh, prev_desired=prev_desired,
+                    current=(deploy.current_replicas()
+                             if fleet_mode else None)):
                 fresh.status.actuation.applied = True
 
             self._update_status(fresh)
+            return fresh
+
+        outcomes = fanout(
+            [lambda va=va, deploy=deploy: publish_one(va, deploy)
+             for va, deploy in publishing],
+            workers=self._fanout_workers(), label="apply")
+        if self._cycle_condition_vas is not None:
+            for fresh, _err in outcomes:
+                if fresh is not None:
+                    self._cycle_condition_vas[
+                        full_name(fresh.name, fresh.namespace)] = fresh
         self.emitter.emit_power_metrics(power)
         self._probe_targets = probe_targets
 

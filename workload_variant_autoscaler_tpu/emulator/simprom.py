@@ -24,6 +24,13 @@ from ..collector import (
     avg_itl_query,
     avg_prompt_tokens_query,
     avg_ttft_query,
+    fleet_arrival_rate_query,
+    fleet_availability_query,
+    fleet_avg_generation_tokens_query,
+    fleet_avg_itl_query,
+    fleet_avg_prompt_tokens_query,
+    fleet_avg_ttft_query,
+    fleet_true_arrival_rate_query,
     true_arrival_rate_query,
 )
 from ..collector.prometheus import Sample
@@ -94,6 +101,28 @@ class SimPromAPI:
         if fam.queue_depth:
             self._queries[avg_waiting_query(m, ns, fam)] = (
                 "avg", fam.queue_depth)
+        # grouped fleet queries (collector.FleetLoadCollector): a
+        # single-variant backend IS one (model, namespace) group, so the
+        # fleet-wide aggregate evaluates to the same value as the
+        # per-variant query — just answered under the grouped PromQL
+        # string, with the demux labels on the sample. MultiPromAPI
+        # concatenates the per-backend groups into the full fleet vector
+        # exactly like one Prometheus TSDB would.
+        self._queries[fleet_true_arrival_rate_query(fam)] = demand
+        self._queries[fleet_arrival_rate_query(fam)] = (
+            "rate", fam.success_total)
+        self._queries[fleet_avg_prompt_tokens_query(fam)] = (
+            "ratio", (f"{fam.prompt_tokens}_sum",
+                      f"{fam.prompt_tokens}_count"))
+        self._queries[fleet_avg_generation_tokens_query(fam)] = (
+            "ratio", (f"{fam.generation_tokens}_sum",
+                      f"{fam.generation_tokens}_count"))
+        self._queries[fleet_avg_ttft_query(fam)] = (
+            "ratio", (f"{fam.ttft_seconds}_sum",
+                      f"{fam.ttft_seconds}_count"))
+        self._queries[fleet_avg_itl_query(fam)] = (
+            "ratio", (f"{fam.tpot_seconds}_sum",
+                      f"{fam.tpot_seconds}_count"))
 
     # -- driven by the simulation ---------------------------------------
 
@@ -254,8 +283,11 @@ class SimPromAPI:
         if not m:
             return None
         w_str = m.group(1) + m.group(2)
-        if true_arrival_rate_query(self.model, self.namespace, self.family,
-                                   window=w_str) != promql:
+        if promql not in (
+            true_arrival_rate_query(self.model, self.namespace, self.family,
+                                    window=w_str),
+            fleet_true_arrival_rate_query(self.family, window=w_str),
+        ):
             return None
         w_s = float(m.group(1)) * {"ms": 0.001, "s": 1.0,
                                    "m": 60.0, "h": 3600.0}[m.group(2)]
@@ -271,6 +303,7 @@ class SimPromAPI:
         if promql in (
             availability_query(self.model, self.namespace, self.family),
             availability_query(self.model, family=self.family),
+            fleet_availability_query(self.family),
         ):
             if not self.history:
                 return []

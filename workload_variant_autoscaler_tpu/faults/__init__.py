@@ -26,6 +26,7 @@ from .plan import (
     KUBE_NOT_FOUND,
     PROM_CLOCK_SKEW,
     PROM_KINDS,
+    PROM_LABEL_DROP,
     PROM_NAN,
     PROM_PARTIAL,
     PROM_TIMEOUT,
@@ -50,6 +51,7 @@ __all__ = [
     "KUBE_NOT_FOUND",
     "PROM_CLOCK_SKEW",
     "PROM_KINDS",
+    "PROM_LABEL_DROP",
     "PROM_NAN",
     "PROM_PARTIAL",
     "PROM_TIMEOUT",

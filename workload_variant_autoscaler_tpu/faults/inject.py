@@ -66,6 +66,12 @@ def apply_prom_fault(plan: FaultPlan | None, promql: str,
             f"injected prometheus timeout for {promql[:80]!r}")
     if rule.kind == plan_mod.PROM_PARTIAL:
         return []  # series dropped from the scrape: empty vector
+    if rule.kind == plan_mod.PROM_LABEL_DROP:
+        # one variant's series vanish from the answer (its exporter died
+        # mid-scrape) while the rest of a grouped vector stays intact
+        want = rule.labels or {}
+        return [s for s in samples
+                if not all(s.labels.get(k) == v for k, v in want.items())]
     if rule.kind == plan_mod.PROM_NAN:
         if not samples:
             # the series must EXIST to carry a NaN (PromQL 0/0)

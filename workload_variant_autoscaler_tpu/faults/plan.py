@@ -21,7 +21,8 @@ from __future__ import annotations
 
 import json
 import random
-from dataclasses import dataclass
+import threading
+from dataclasses import dataclass, field
 from typing import Optional
 
 # dependencies
@@ -34,12 +35,17 @@ PROM_TIMEOUT = "prom-timeout"        # query raises TimeoutError
 PROM_PARTIAL = "prom-partial"        # matching queries return empty vectors
 PROM_NAN = "prom-nan"                # matching queries answer NaN samples
 PROM_CLOCK_SKEW = "prom-clock-skew"  # sample timestamps shifted into the past
+PROM_LABEL_DROP = "prom-label-drop"  # samples matching `labels` dropped from
+                                     # answers (one variant's series vanish
+                                     # from a grouped fleet result while the
+                                     # rest of the vector stays intact)
 KUBE_CONFLICT = "kube-conflict"      # matching verbs raise 409 ConflictError
 KUBE_ERROR = "kube-error"            # matching verbs raise a transport error
 KUBE_NOT_FOUND = "kube-not-found"    # matching verbs raise 404 NotFoundError
 WATCH_DROP = "watch-drop"            # watch events silently swallowed
 
-PROM_KINDS = (PROM_TIMEOUT, PROM_PARTIAL, PROM_NAN, PROM_CLOCK_SKEW)
+PROM_KINDS = (PROM_TIMEOUT, PROM_PARTIAL, PROM_NAN, PROM_CLOCK_SKEW,
+              PROM_LABEL_DROP)
 KUBE_KINDS = (KUBE_CONFLICT, KUBE_ERROR, KUBE_NOT_FOUND)
 ALL_KINDS = PROM_KINDS + KUBE_KINDS + (WATCH_DROP,)
 
@@ -68,6 +74,11 @@ class FaultRule:
     rng (1.0 = always).
     skew_s: for prom-clock-skew, how far sample timestamps are shifted
     into the past (a skewed scrape looks stale to the collector).
+    labels: for prom-label-drop, the label subset identifying the
+    samples to drop (e.g. {"model_name": "llama-8b"}) — the grouped
+    fleet queries return one sample per variant, and this models ONE
+    variant's series vanishing from the scrape while the rest of the
+    grouped vector stays healthy.
     """
 
     kind: str
@@ -78,6 +89,7 @@ class FaultRule:
     until_s: Optional[float] = None
     probability: float = 1.0
     skew_s: float = 0.0
+    labels: Optional[dict] = field(default=None)
 
     def __post_init__(self) -> None:
         if self.kind not in ALL_KINDS:
@@ -88,6 +100,8 @@ class FaultRule:
                              f"{self.probability}")
         if self.kind == PROM_CLOCK_SKEW and self.skew_s <= 0.0:
             raise ValueError("prom-clock-skew needs skew_s > 0")
+        if self.kind == PROM_LABEL_DROP and not self.labels:
+            raise ValueError("prom-label-drop needs a non-empty labels map")
 
     @property
     def dep(self) -> str:
@@ -121,6 +135,12 @@ class FaultPlan:
         self._rngs = [self._rule_rng(i) for i in range(len(self.rules))]
         # observability for tests/debugging: (cycle, kind, match-text)
         self.trips: list[tuple[int, str, str]] = []
+        # lookups may arrive concurrently from WVA_COLLECT_FANOUT worker
+        # threads; the lock keeps rng draws and the trips log coherent
+        # (draw ORDER under probability<1 rules still follows thread
+        # scheduling — for strict rerun determinism use probability 1.0
+        # or WVA_COLLECT_FANOUT=1)
+        self._lock = threading.Lock()
 
     def _rule_rng(self, index: int) -> random.Random:
         # one independent deterministic stream per rule: adding a rule
@@ -156,18 +176,19 @@ class FaultPlan:
     # -- lookups (called by the injection hooks) --------------------------
 
     def _active(self, kind_filter: tuple[str, ...], text: str):
-        for i, rule in enumerate(self.rules):
-            if rule.kind not in kind_filter:
-                continue
-            if not rule.in_window(self.cycle, self.now_s):
-                continue
-            if rule.match and rule.match not in text:
-                continue
-            if rule.probability < 1.0 and \
-                    self._rngs[i].random() >= rule.probability:
-                continue
-            self.trips.append((self.cycle, rule.kind, text[:120]))
-            return rule
+        with self._lock:
+            for i, rule in enumerate(self.rules):
+                if rule.kind not in kind_filter:
+                    continue
+                if not rule.in_window(self.cycle, self.now_s):
+                    continue
+                if rule.match and rule.match not in text:
+                    continue
+                if rule.probability < 1.0 and \
+                        self._rngs[i].random() >= rule.probability:
+                    continue
+                self.trips.append((self.cycle, rule.kind, text[:120]))
+                return rule
         return None
 
     def prom_fault(self, promql: str) -> Optional[FaultRule]:
@@ -194,7 +215,7 @@ class FaultPlan:
                 raise ValueError(f"rules[{i}] must be an object")
             unknown = set(r) - {
                 "kind", "match", "after_cycle", "until_cycle",
-                "after_s", "until_s", "probability", "skew_s",
+                "after_s", "until_s", "probability", "skew_s", "labels",
             }
             if unknown:
                 raise ValueError(f"rules[{i}]: unknown keys {sorted(unknown)}")

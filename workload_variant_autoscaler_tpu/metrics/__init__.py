@@ -131,9 +131,17 @@ INFERNO_RECONCILE_STAGE_SECONDS = "inferno_reconcile_stage_seconds"
 INFERNO_DEPENDENCY_LATENCY_SECONDS = "inferno_dependency_latency_seconds"
 INFERNO_SOLVE_SECONDS = "inferno_solve_seconds"
 INFERNO_DEPENDENCY_RETRIES_TOTAL = "inferno_dependency_retries_total"
+# fleet-scale collection (collector.FleetLoadCollector): how many
+# Prometheus queries each cycle's load collection issued per path, and
+# the collection phase's wall time — the series that PROVES collection
+# is O(metric-families), not O(variants) (a fleet/legacy ratio near V is
+# the escape hatch engaged; a repair rate near V is grouped demux rot)
+INFERNO_COLLECTION_QUERIES_TOTAL = "inferno_collection_queries_total"
+INFERNO_COLLECTION_SECONDS = "inferno_collection_seconds"
 
 LABEL_DEPENDENCY = "dependency"
 LABEL_OUTCOME = "outcome"
+LABEL_MODE = "mode"
 
 LABEL_CONDITION_TYPE = "type"
 
@@ -321,6 +329,22 @@ class MetricsEmitter:
             "spent; circuit-open: failed fast without calling)",
             [LABEL_DEPENDENCY, LABEL_OUTCOME], registry=self.registry,
         )
+        # fleet-scale collection telemetry: queries per collection path
+        # (fleet / per-variant-repair / legacy) and the phase's wall time
+        self.collection_queries = Counter(
+            INFERNO_COLLECTION_QUERIES_TOTAL.removesuffix("_total"),
+            "Prometheus queries issued by per-cycle load collection, by "
+            "path (fleet: grouped O(families) queries; "
+            "per-variant-repair: variants missing from the grouped "
+            "result; legacy: WVA_FLEET_COLLECTION=off)",
+            [LABEL_MODE], registry=self.registry,
+        )
+        self.collection_seconds = Histogram(
+            INFERNO_COLLECTION_SECONDS,
+            "Distribution of the load-collection phase wall time "
+            "(grouped prefetch + per-variant demux/repair)",
+            buckets=_STAGE_BUCKETS, registry=self.registry,
+        )
         # perf-model drift (beyond-reference: the reference never compares
         # its scraped latencies against its own queueing model)
         self.model_drift = Gauge(
@@ -347,6 +371,17 @@ class MetricsEmitter:
         self.dependency_retries.labels(
             **{LABEL_DEPENDENCY: dependency,
                LABEL_OUTCOME: outcome}).inc()
+
+    def emit_collection_metrics(self, queries_by_mode: dict[str, int],
+                                seconds: float) -> None:
+        """One cycle's collection telemetry: per-path query counts (zero
+        counts skipped — a mode's series appears once that path has ever
+        run) and the phase wall time."""
+        for mode, count in queries_by_mode.items():
+            if count > 0:
+                self.collection_queries.labels(
+                    **{LABEL_MODE: mode}).inc(count)
+        self.collection_seconds.observe(seconds)
 
     def emit_power_metrics(
         self, per_variant: dict[tuple[str, str, str], float]

@@ -65,6 +65,12 @@ class DecisionInputs:
     cost_per_replica: float = 0.0
     current_replicas: int = 0
     prev_published: int = 0
+    # which collection path produced the load inputs: "fleet" (demuxed
+    # from the grouped fleet queries), "per-variant-repair" (labels
+    # missing from the grouped result; single-variant queries), or
+    # "legacy" (WVA_FLEET_COLLECTION=off). "" on records predating the
+    # field.
+    collection_mode: str = ""
 
 
 @dataclass(frozen=True)
@@ -123,6 +129,8 @@ def explain_text(record: DecisionRecord) -> str:
         f"  outcome: {record.outcome}"
         + (f" ({record.reason})" if record.reason else ""),
         f"  degradation rung: {i.degradation}",
+        *([f"  collection path: {i.collection_mode}"]
+          if i.collection_mode else []),
         "  inputs:",
         f"    arrival rate:    {i.arrival_rate_rpm:.2f} req/min",
         f"    tokens in/out:   {i.avg_input_tokens:.1f} / "

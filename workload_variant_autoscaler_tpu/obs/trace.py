@@ -239,8 +239,14 @@ class Tracer:
         self._seq = 0
 
     def _next_id(self, prefix: str) -> str:
-        self._seq += 1
-        return f"{prefix}{self._seq:08x}"
+        # locked: fanned-out dependency calls (utils/concurrency.py)
+        # open spans from worker threads concurrently. Single-threaded
+        # runs keep fully deterministic counters; under fan-out the
+        # ASSIGNMENT ORDER follows scheduling, but the span TREE
+        # (parent/child links, names, attributes) is unchanged.
+        with self._lock:
+            self._seq += 1
+            return f"{prefix}{self._seq:08x}"
 
     def begin(self, name: str, **attrs: Any) -> Span:
         """Open and ACTIVATE a span; the caller must finish() (or

@@ -18,6 +18,12 @@ from .backoff import (
     TerminalError,
     with_backoff,
 )
+from .concurrency import (
+    DEFAULT_FANOUT_WORKERS,
+    FANOUT_ENV,
+    fanout,
+    fanout_workers,
+)
 from .logging import get_logger, kv
 
 
@@ -50,9 +56,13 @@ __all__ = [
     "CircuitBreaker",
     "CircuitOpenError",
     "DEADLINE",
+    "DEFAULT_FANOUT_WORKERS",
     "Deadline",
     "DeadlineExceeded",
     "EXHAUSTED",
+    "FANOUT_ENV",
+    "fanout",
+    "fanout_workers",
     "PROMETHEUS_BACKOFF",
     "RECONCILE_BACKOFF",
     "RETRY",

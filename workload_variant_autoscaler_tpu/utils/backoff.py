@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import math
 import random
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional, TypeVar
@@ -167,13 +168,19 @@ class CircuitBreaker:
 
     TerminalError does NOT count as a dependency failure — a NotFound is
     the dependency answering correctly — and propagates untouched.
-    `clock` is injectable (sim time); single-threaded use is assumed
-    (the reconcile loop), so no internal locking.
+    `clock` is injectable (sim time). State transitions are guarded by a
+    lock (the WVA_COLLECT_FANOUT workers call kube/prometheus through
+    the shared breakers concurrently); the wrapped call itself runs
+    OUTSIDE the lock, so the breaker never serializes the fan-out. Under
+    concurrency more than one half-open probe may slip through before
+    the first records its outcome — a bounded overshoot, not a
+    correctness issue.
 
     `on_transition(name, old_state, new_state)` fires on every state
-    change; each transition is also recorded on the active trace span,
-    so a cycle's trace shows exactly when a dependency's circuit opened,
-    half-opened, or closed.
+    change (under the lock — keep it fast, as the reconciler's
+    log-and-emit hook is); each transition is also recorded on the
+    active trace span, so a cycle's trace shows exactly when a
+    dependency's circuit opened, half-opened, or closed.
     """
 
     CLOSED = "closed"
@@ -197,6 +204,7 @@ class CircuitBreaker:
         self.state = self.CLOSED
         self.consecutive_failures = 0
         self._opened_at = 0.0
+        self._lock = threading.RLock()
 
     def _set_state(self, state: str) -> None:
         if state == self.state:
@@ -210,35 +218,41 @@ class CircuitBreaker:
     def state_code(self) -> int:
         # report what the NEXT call would see: an open breaker whose
         # cooldown has elapsed is effectively half-open
-        state = self.state
-        if state == self.OPEN and \
-                self._clock() - self._opened_at >= self.reset_after_s:
-            state = self.HALF_OPEN
-        return self.STATE_CODES[state]
+        with self._lock:
+            state = self.state
+            if state == self.OPEN and \
+                    self._clock() - self._opened_at >= self.reset_after_s:
+                state = self.HALF_OPEN
+            return self.STATE_CODES[state]
 
     def _open(self) -> None:
         self._set_state(self.OPEN)
         self._opened_at = self._clock()
 
     def record_success(self) -> None:
-        self.consecutive_failures = 0
-        self._set_state(self.CLOSED)
+        with self._lock:
+            self.consecutive_failures = 0
+            self._set_state(self.CLOSED)
 
     def record_failure(self) -> None:
-        self.consecutive_failures += 1
-        if self.state == self.HALF_OPEN or \
-                self.consecutive_failures >= self.failure_threshold:
-            self._open()
+        with self._lock:
+            self.consecutive_failures += 1
+            if self.state == self.HALF_OPEN or \
+                    self.consecutive_failures >= self.failure_threshold:
+                self._open()
 
     def call(self, fn: Callable[[], T]) -> T:
-        if self.state == self.OPEN:
-            waited = self._clock() - self._opened_at
-            if waited < self.reset_after_s:
-                add_event("breaker-open-fast-fail", dependency=self.name,
-                          retry_in_s=round(self.reset_after_s - waited, 3))
-                raise CircuitOpenError(self.name,
-                                       self.reset_after_s - waited)
-            self._set_state(self.HALF_OPEN)  # one probe goes through
+        with self._lock:
+            if self.state == self.OPEN:
+                waited = self._clock() - self._opened_at
+                if waited < self.reset_after_s:
+                    add_event("breaker-open-fast-fail",
+                              dependency=self.name,
+                              retry_in_s=round(
+                                  self.reset_after_s - waited, 3))
+                    raise CircuitOpenError(self.name,
+                                           self.reset_after_s - waited)
+                self._set_state(self.HALF_OPEN)  # one probe goes through
         try:
             result = fn()
         except TerminalError:
