@@ -39,10 +39,10 @@ bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO 
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo
 
-LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py __graft_entry__.py
+LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py __graft_entry__.py
 
 .PHONY: lint
-lint: ## Static analysis gate: ruff+mypy when installed, wvalint always
+lint: ## Static analysis gate: ruff+mypy when installed, wvalint always (rule catalog: docs/developer-guide/wvalint.md)
 	@if command -v ruff >/dev/null 2>&1; then \
 		echo "ruff check"; ruff check $(LINT_PATHS); \
 	else echo "ruff not installed; skipping (wvalint gates below)"; fi

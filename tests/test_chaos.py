@@ -656,7 +656,8 @@ class TestFaultPlanScripting:
 
     def test_unknown_kind_and_keys_rejected(self):
         with pytest.raises(ValueError, match="unknown fault kind"):
-            FaultPlan.from_json('{"rules": [{"kind": "prom-explode"}]}')
+            # deliberately invalid kind: the ValueError under test
+            FaultPlan.from_json('{"rules": [{"kind": "prom-explode"}]}')  # noqa: WVL321
         with pytest.raises(ValueError, match="unknown keys"):
             FaultPlan.from_json(
                 '{"rules": [{"kind": "prom-timeout", "after": 3}]}')
@@ -694,7 +695,8 @@ class TestFaultPlanScripting:
         assert _fault_plan_from_env().seed == 4
 
         monkeypatch.setenv("WVA_FAULT_PLAN",
-                           '{"rules": [{"kind": "nope"}]}')
+                           # deliberately invalid kind: startup must raise
+                           '{"rules": [{"kind": "nope"}]}')  # noqa: WVL321
         with pytest.raises(ValueError):
             _fault_plan_from_env()  # bad plan = startup error, not no-op
 

@@ -17,6 +17,10 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 
 import wvalint  # noqa: E402
 
+# `pytest -m lint` runs just the static-analysis gate; the module stays
+# inside tier-1's `not slow` selection regardless
+pytestmark = pytest.mark.lint
+
 
 def lint(source: str, with_sigs: bool = False):
     import ast
@@ -95,10 +99,12 @@ class TestRules:
         assert "WVL106" in lint("d = {'a': 1, 'a': 2}\n")
 
     def test_noqa_suppression(self):
-        assert lint("import os  # noqa\nprint(1)\n") == []
-        assert lint("import os  # noqa: WVL002\nprint(1)\n") == []
+        # fixture strings split mid-"noqa" so THIS file's own lint pass
+        # does not read them as (stale) suppressions on these lines
+        assert lint("import os  # noq" "a\nprint(1)\n") == []
+        assert lint("import os  # noq" "a: WVL002\nprint(1)\n") == []
         # wrong code does not suppress
-        assert "WVL002" in lint("import os  # noqa: WVL999\nprint(1)\n")
+        assert "WVL002" in lint("import os  # noq" "a: WVL999\nprint(1)\n")
 
 
 class TestCallArity:
@@ -135,13 +141,27 @@ class TestCallArity:
 
 @pytest.mark.parametrize("paths", [
     ["workload_variant_autoscaler_tpu", "tools", "tests", "bench.py",
-     "bench_loop.py", "__graft_entry__.py"],
+     "bench_loop.py", "bench_collect.py", "__graft_entry__.py"],
 ])
-def test_repo_is_clean(paths):
-    """The gate itself: the shipped source must lint clean."""
+def test_package_lints_clean(paths):
+    """The gate itself: the shipped source must lint clean — every rule
+    family including concurrency safety (WVL401-403), knob parity
+    (WVL311/312), literal validity (WVL321/322), and the stale-noqa
+    audit (WVL005)."""
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "wvalint.py"), *paths],
         capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert r.returncode == 0, f"lint findings:\n{r.stdout}"
+
+
+def test_wvalint_lints_itself_clean():
+    """Dogfood: the linter and the shared test helpers pass their own
+    gate when scanned alone (no cross-file context to lean on)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "wvalint.py"),
+         os.path.join("tools", "wvalint.py"),
+         os.path.join("tests", "helpers.py")],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
     assert r.returncode == 0, f"lint findings:\n{r.stdout}"
 
 
@@ -407,3 +427,531 @@ class TestSelfAttrsEdgeCases:
             "class C:\n"
             "    def __init__(self):\n        self.__name = 1\n"
             "    def g(self):\n        return self.__name\n")
+
+
+# -- concurrency safety (WVL401-403) ----------------------------------------
+
+
+class TestLockDiscipline:
+    """WVL401 — attributes a class guards with `with self._lock:` must
+    never be mutated lock-free (the FaultPlan.add / CircuitBreaker
+    class of bug PR-4 fixed)."""
+
+    GUARDED = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []\n"
+        "    def snapshot(self):\n"
+        "        with self._lock:\n"
+        "            return list(self.items)\n"
+    )
+
+    def test_lock_free_mutation_of_guarded_attr_fires(self):
+        src = self.GUARDED + (
+            "    def add(self, x):\n"
+            "        self.items.append(x)\n")
+        assert "WVL401" in lint(src)
+
+    def test_mutation_under_lock_passes(self):
+        src = self.GUARDED + (
+            "    def add(self, x):\n"
+            "        with self._lock:\n"
+            "            self.items.append(x)\n")
+        assert "WVL401" not in lint(src)
+
+    def test_constructor_mutation_exempt(self):
+        # __init__ runs before any thread can see the object
+        assert "WVL401" not in lint(self.GUARDED)
+
+    def test_locked_suffix_convention_exempt(self):
+        src = self.GUARDED + (
+            "    def _add_locked(self, x):\n"
+            "        self.items.append(x)\n")
+        assert "WVL401" not in lint(src)
+
+    def test_augassign_counts_as_mutation(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.n = 0\n"
+            "    def read(self):\n"
+            "        with self._lock:\n"
+            "            return self.n\n"
+            "    def bump(self):\n"
+            "        self.n += 1\n")
+        assert "WVL401" in lint(src)
+
+    def test_condition_typed_lock_under_any_name_recognised(self):
+        # the test_wire_e2e._EventLog shape: `with self.cv:` guards —
+        # lock typing is by factory, not by attribute name
+        src = (
+            "import threading\n"
+            "class EventLog:\n"
+            "    def __init__(self):\n"
+            "        self.events = []\n"
+            "        self.cv = threading.Condition()\n"
+            "    def __call__(self, ev):\n"
+            "        with self.cv:\n"
+            "            self.events.append(ev)\n"
+            "            self.cv.notify_all()\n"
+            "    def drain(self):\n"
+            "        with self.cv:\n"
+            "            return list(self.events)\n")
+        assert "WVL401" not in lint(src)
+
+    def test_unguarded_attr_not_flagged(self):
+        src = self.GUARDED + (
+            "    def note(self, x):\n"
+            "        self.free = x\n"
+            "    def read(self):\n"
+            "        return self.free\n")
+        assert "WVL401" not in lint(src)
+
+    def test_module_level_lock_discipline(self):
+        src = (
+            "import threading\n"
+            "_LOCK = threading.Lock()\n"
+            "_CACHE = {}\n"
+            "def put(k, v):\n"
+            "    with _LOCK:\n"
+            "        _CACHE[k] = v\n"
+            "def evict(k):\n"
+            "    _CACHE.pop(k, None)\n")
+        assert "WVL401" in lint(src)
+
+    def test_module_level_lock_respected_passes(self):
+        src = (
+            "import threading\n"
+            "_LOCK = threading.Lock()\n"
+            "_CACHE = {}\n"
+            "def put(k, v):\n"
+            "    with _LOCK:\n"
+            "        _CACHE[k] = v\n"
+            "def evict(k):\n"
+            "    with _LOCK:\n"
+            "        _CACHE.pop(k, None)\n")
+        assert "WVL401" not in lint(src)
+
+
+class TestThreadSharedState:
+    """WVL402 — state reachable from fanout()/Thread(target=...) must
+    be mutated under a lock (same-file reachability)."""
+
+    def test_thread_target_mutating_self_fires(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.client = None\n"
+            "    def _connect(self):\n"
+            "        if self.client is None:\n"
+            "            self.client = object()\n"
+            "        return self.client\n"
+            "    def start(self, stop):\n"
+            "        def loop():\n"
+            "            while not stop.is_set():\n"
+            "                self._connect()\n"
+            "        threading.Thread(target=loop, daemon=True).start()\n")
+        assert "WVL402" in lint(src)
+
+    def test_thread_target_mutation_under_lock_passes(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.client = None\n"
+            "        self._client_lock = threading.Lock()\n"
+            "    def _connect(self):\n"
+            "        with self._client_lock:\n"
+            "            if self.client is None:\n"
+            "                self.client = object()\n"
+            "            return self.client\n"
+            "    def start(self, stop):\n"
+            "        def loop():\n"
+            "            while not stop.is_set():\n"
+            "                self._connect()\n"
+            "        threading.Thread(target=loop, daemon=True).start()\n")
+        assert "WVL402" not in lint(src)
+
+    def test_fanout_lambda_reaching_mutation_fires(self):
+        src = (
+            "def fanout(tasks, workers=8, label=''):\n"
+            "    return [(t(), None) for t in tasks]\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.seen = []\n"
+            "    def _record(self, x):\n"
+            "        self.seen.append(x)\n"
+            "    def run(self, items):\n"
+            "        return fanout(\n"
+            "            [lambda x=x: self._record(x) for x in items],\n"
+            "            workers=4, label='rec')\n")
+        assert "WVL402" in lint(src)
+
+    def test_module_global_mutated_from_thread_fires(self):
+        src = (
+            "import threading\n"
+            "RESULTS = []\n"
+            "def worker():\n"
+            "    RESULTS.append(1)\n"
+            "def start():\n"
+            "    threading.Thread(target=worker).start()\n")
+        assert "WVL402" in lint(src)
+
+    def test_local_and_foreign_mutations_not_flagged(self):
+        # locals and other objects' attributes are out of scope
+        src = (
+            "import threading\n"
+            "def start(sink):\n"
+            "    def loop():\n"
+            "        buf = []\n"
+            "        buf.append(1)\n"
+            "        sink.out = buf\n"
+            "    threading.Thread(target=loop).start()\n")
+        assert "WVL402" not in lint(src)
+
+    # the three real fanout call shapes from controller/reconciler.py
+    # (ownerRef patches :936, TPU-util probes :1168, publish :1372) —
+    # the fixed codebase pattern must stay silent
+    RECONCILER_SHAPES = (
+        "import threading\n"
+        "def fanout(tasks, workers=8, label=''):\n"
+        "    return [(t(), None) for t in tasks]\n"
+        "def collect_tpu_utilization(prom, ns):\n"
+        "    return {}\n"
+        "class Reconciler:\n"
+        "    def __init__(self, kube, prom):\n"
+        "        self.kube = kube\n"
+        "        self.guarded_prom = prom\n"
+        "        self.prom = prom\n"
+        "        self._probe_prom = None\n"
+        "        self._probe_prom_lock = threading.Lock()\n"
+        "    def _fanout_workers(self):\n"
+        "        return 8\n"
+        "    def _kube_call(self, fn, what='call'):\n"
+        "        return fn()\n"
+        "    def _update_status(self, va):\n"
+        "        self._kube_call(lambda: va, what='update_status')\n"
+        "    def patch_owner_refs(self, need_patch):\n"
+        "        return fanout(\n"
+        "            [lambda va=va, deploy=deploy: self._kube_call(\n"
+        "                lambda: (va, deploy), what='patch')\n"
+        "             for va, deploy in need_patch],\n"
+        "            workers=self._fanout_workers(), label='ownerref')\n"
+        "    def probe_tpu(self, probing):\n"
+        "        return fanout(\n"
+        "            [lambda ns=ns: collect_tpu_utilization("
+        "self.guarded_prom, ns)\n"
+        "             for ns in probing],\n"
+        "            workers=self._fanout_workers(), label='tpu-util')\n"
+        "    def apply(self, publishing):\n"
+        "        def publish_one(va, deploy):\n"
+        "            fresh = self._kube_call(lambda: va, what='get')\n"
+        "            fresh.applied = True\n"
+        "            self._update_status(fresh)\n"
+        "            return fresh\n"
+        "        return fanout(\n"
+        "            [lambda va=va, deploy=deploy: publish_one(va, deploy)\n"
+        "             for va, deploy in publishing],\n"
+        "            workers=self._fanout_workers(), label='apply')\n"
+        "    def _probe_client(self):\n"
+        "        with self._probe_prom_lock:\n"
+        "            if self._probe_prom is None:\n"
+        "                self._probe_prom = object()\n"
+        "            return self._probe_prom\n"
+        "    def start_probe(self, stop):\n"
+        "        def loop():\n"
+        "            while not stop.is_set():\n"
+        "                self._probe_client()\n"
+        "        threading.Thread(target=loop, daemon=True).start()\n"
+    )
+
+    def test_reconciler_fanout_shapes_pass(self):
+        codes = lint(self.RECONCILER_SHAPES)
+        assert "WVL402" not in codes and "WVL401" not in codes
+
+    def test_reconciler_shape_with_unlocked_probe_fires(self):
+        # the pre-fix _probe_client: lazy init with no lock
+        bad = self.RECONCILER_SHAPES.replace(
+            "        with self._probe_prom_lock:\n"
+            "            if self._probe_prom is None:\n"
+            "                self._probe_prom = object()\n"
+            "            return self._probe_prom\n",
+            "        if self._probe_prom is None:\n"
+            "            self._probe_prom = object()\n"
+            "        return self._probe_prom\n")
+        assert "WVL402" in lint(bad)
+
+
+class TestSelfDeadlock:
+    """WVL403 — re-acquiring a held non-reentrant lock."""
+
+    def test_nested_with_same_lock_fires(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            with self._lock:\n"
+            "                return 1\n")
+        assert "WVL403" in lint(src)
+
+    def test_locking_method_called_under_lock_fires(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.n = 0\n"
+            "    def inc(self):\n"
+            "        with self._lock:\n"
+            "            self.n += 1\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self.inc()\n")
+        assert "WVL403" in lint(src)
+
+    def test_rlock_reentry_passes(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self.n = 0\n"
+            "    def inc(self):\n"
+            "        with self._lock:\n"
+            "            self.n += 1\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self.inc()\n")
+        assert "WVL403" not in lint(src)
+
+    def test_distinct_locks_pass(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._other = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            with self._other:\n"
+            "                return 1\n")
+        assert "WVL403" not in lint(src)
+
+    def test_call_after_lock_released_passes(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.n = 0\n"
+            "    def inc(self):\n"
+            "        with self._lock:\n"
+            "            self.n += 1\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+            "        self.inc()\n")
+        assert "WVL403" not in lint(src)
+
+
+# -- config-knob parity (WVL311/312) -----------------------------------------
+
+
+class TestKnobParity:
+    """WVL311/312 — the two-way WVA_* registry check against
+    docs/user-guide/configuration.md (the WVL301/302 shape for config;
+    PR-4 satellite: WVA_CAPTURE_POLL_S / WVA_NATIVE_LIB were read but
+    undocumented)."""
+
+    def codes(self, reads, literals, doc):
+        return [f.code for f in wvalint.check_knob_parity(
+            reads, literals, doc)]
+
+    def test_undocumented_read_fires_wvl311(self):
+        assert self.codes({"WVA_MYSTERY": ("x.py", 3)},
+                          {"WVA_MYSTERY"}, "no knobs here") == ["WVL311"]
+
+    def test_documented_read_passes(self):
+        assert self.codes({"WVA_KNOB": ("x.py", 3)}, {"WVA_KNOB"},
+                          "| `WVA_KNOB` | documented |") == []
+
+    def test_documented_but_dead_fires_wvl312(self):
+        assert self.codes({}, set(),
+                          "| `WVA_GONE` | rotted row |") == ["WVL312"]
+
+    def test_literal_anywhere_counts_as_alive(self):
+        # liveness is the generous set: aliases, ConfigMap keys, tests
+        assert self.codes({}, {"WVA_TESTED"},
+                          "| `WVA_TESTED` | set by tests |") == []
+
+    def test_env_read_detection_shapes(self):
+        import ast as ast_mod
+
+        tree = ast_mod.parse(
+            "import os\n"
+            "from os import environ\n"
+            "KNOB = 'WVA_ALIASED'\n"
+            "class K:\n"
+            "    ENV = 'WVA_CLASS_ATTR'\n"
+            "    def read(self):\n"
+            "        return os.environ.get(self.ENV)\n"
+            "a = os.environ.get(KNOB)\n"
+            "b = os.environ['WVA_SUBSCRIPT']\n"
+            "c = os.getenv('WVA_GETENV')\n"
+            "d = environ.get('WVA_BARE_ENVIRON', '1')\n"
+            "e = {'WVA_NOT_A_READ': 1}\n")
+        reads = wvalint._env_read_knobs(tree)
+        assert set(reads) == {"WVA_ALIASED", "WVA_CLASS_ATTR",
+                              "WVA_SUBSCRIPT", "WVA_GETENV",
+                              "WVA_BARE_ENVIRON"}
+
+    def test_repo_knob_registry_is_clean(self):
+        """The real package+tools+tests scan against the real doc —
+        what test_package_lints_clean also enforces via main()."""
+        files, sources, trees = [], {}, {}
+        import ast as ast_mod
+        for sub in ("workload_variant_autoscaler_tpu", "tools", "tests"):
+            for fp in wvalint.iter_py_files([os.path.join(REPO, sub)]):
+                files.append(fp)
+                with open(fp, encoding="utf-8") as f:
+                    sources[fp] = f.read()
+                try:
+                    trees[fp] = ast_mod.parse(sources[fp], fp)
+                except SyntaxError:
+                    pass
+        findings = wvalint._knob_parity_findings(files, sources, trees)
+        assert findings == [], [f.format() for f in findings]
+
+
+# -- cross-module literal validity (WVL321/322) ------------------------------
+
+KINDS = frozenset({"prom-timeout", "kube-conflict", "watch-drop"})
+STAGES = frozenset({"config", "prepare", "analyze", "optimize", "publish"})
+
+
+def lint_vocab(source: str):
+    return [f.code for f in wvalint.lint_source(
+        "x.py", source, fault_kinds=KINDS, stages=STAGES)]
+
+
+class TestFaultKindLiterals:
+    """WVL321 — fault-kind strings must be members of
+    faults.plan.ALL_KINDS wherever they appear."""
+
+    def test_bad_kind_kwarg_fires(self):
+        assert "WVL321" in lint_vocab(
+            "r = FaultRule(kind='prom-explode')\n")
+
+    def test_good_kind_kwarg_passes(self):
+        assert "WVL321" not in lint_vocab(
+            "r = FaultRule(kind='prom-timeout')\n")
+
+    def test_positional_kind_checked(self):
+        assert "WVL321" in lint_vocab("r = FaultRule('kube-conflictt')\n")
+
+    def test_rules_dict_literal_checked(self):
+        assert "WVL321" in lint_vocab(
+            "plan = {'rules': [{'kind': 'watch-dropp'}]}\n")
+        assert "WVL321" not in lint_vocab(
+            "plan = {'rules': [{'kind': 'watch-drop'}]}\n")
+
+    def test_inline_json_plan_checked(self):
+        # the WVA_FAULT_PLAN surface: a JSON string literal
+        bad = 'x = \'{"rules": [{"kind": "prom-explode"}]}\'\n'
+        good = 'x = \'{"rules": [{"kind": "prom-timeout"}]}\'\n'
+        assert "WVL321" in lint_vocab(bad)
+        assert "WVL321" not in lint_vocab(good)
+
+    def test_unrelated_kind_keys_ignored(self):
+        # k8s object dicts use "kind" too — only plan shapes are checked
+        assert "WVL321" not in lint_vocab(
+            "obj = {'apiVersion': 'apps/v1', 'kind': 'Deployment'}\n")
+
+    def test_repo_vocab_extraction(self):
+        import ast as ast_mod
+
+        plan_py = os.path.join(REPO, "workload_variant_autoscaler_tpu",
+                               "faults", "plan.py")
+        with open(plan_py, encoding="utf-8") as f:
+            tree = ast_mod.parse(f.read(), plan_py)
+        kinds = wvalint._vocab_from_trees(
+            {plan_py: tree}, os.path.join("faults", "plan.py"),
+            "ALL_KINDS")
+        assert kinds is not None and "prom-timeout" in kinds \
+            and "watch-drop" in kinds and len(kinds) == 9
+
+
+class TestStageLiterals:
+    """WVL322 — reconcile-stage strings must be members of
+    metrics.RECONCILE_STAGES at the mark()/labels seams."""
+
+    def test_bad_mark_literal_fires(self):
+        assert "WVL322" in lint_vocab("mark('colect')\n")
+
+    def test_good_mark_literal_passes(self):
+        assert "WVL322" not in lint_vocab("mark('config')\n")
+
+    def test_stage_kwarg_checked(self):
+        assert "WVL322" in lint_vocab("emitter.value(s, stage='anaylze')\n")
+        assert "WVL322" not in lint_vocab("emitter.value(s, stage='analyze')\n")
+
+    def test_label_stage_dict_checked(self):
+        assert "WVL322" in lint_vocab(
+            "g.labels(**{LABEL_STAGE: 'optimizee'})\n")
+        assert "WVL322" not in lint_vocab(
+            "g.labels(**{LABEL_STAGE: 'optimize'})\n")
+
+    def test_variable_stage_not_checked(self):
+        assert "WVL322" not in lint_vocab(
+            "for s in stages:\n    mark(s)\n")
+
+    def test_repo_vocab_extraction(self):
+        import ast as ast_mod
+
+        metrics_py = os.path.join(REPO, "workload_variant_autoscaler_tpu",
+                                  "metrics", "__init__.py")
+        with open(metrics_py, encoding="utf-8") as f:
+            tree = ast_mod.parse(f.read(), metrics_py)
+        stages = wvalint._vocab_from_trees(
+            {metrics_py: tree}, os.path.join("metrics", "__init__.py"),
+            "RECONCILE_STAGES")
+        assert stages == STAGES
+
+
+class TestStaleNoqa:
+    """WVL005 — `# noqa: WVLxxx` comments naming rules that do not fire
+    on that line (PR-4 satellite: the suppression audit). Fixture
+    strings split mid-"noqa" so this file's own gate pass does not read
+    them as suppressions here."""
+
+    def test_stale_wvl_code_fires(self):
+        src = "import os  # noq" "a: WVL103\nprint(1)\n"
+        codes = lint(src)
+        assert "WVL005" in codes
+        assert "WVL002" in codes  # the wrong code suppresses nothing
+
+    def test_live_suppression_not_stale(self):
+        assert "WVL005" not in lint(
+            "import os  # noq" "a: WVL002\nprint(1)\n")
+
+    def test_foreign_codes_not_audited(self):
+        assert "WVL005" not in lint(
+            "import os  # noq" "a: BLE001\nos.getcwd()\n")
+
+    def test_blanket_noqa_not_audited(self):
+        assert "WVL005" not in lint("import os  # noq" "a\nprint(1)\n")
+
+    def test_inactive_rule_family_not_audited(self):
+        # WVL321 only runs when a fault-kind vocabulary is in scope;
+        # without it the suppression cannot be judged
+        src = "x = 1  # noq" "a: WVL321\n"
+        assert "WVL005" not in lint(src)
+        assert "WVL005" in lint_vocab(src)

@@ -41,6 +41,40 @@ Rules (suppress per-line with `# noqa` or `# noqa: WVLxxx`):
   WVL302  metrics doc parity: an `INFERNO_*` series constant whose
           series name does not appear in docs/metrics-health-monitoring.md
           (an exported series operators can't look up)
+  WVL311  config-knob doc parity: a `WVA_*` knob read from os.environ in
+          package/tools code with no row in docs/user-guide/configuration.md
+          (a knob operators can't discover)
+  WVL312  config-knob code parity: a `WVA_*` knob documented in
+          docs/user-guide/configuration.md that no scanned code ever
+          names (a doc row that rotted — the knob silently stopped
+          existing)
+  WVL321  fault-kind literal validity: a string literal naming a fault
+          kind (FaultRule(kind=...), {"rules": [{"kind": ...}]} plan
+          dicts, inline WVA_FAULT_PLAN JSON) that is not a member of
+          faults.plan.ALL_KINDS
+  WVL322  stage literal validity: a reconcile-stage string literal
+          (mark("..."), stage=..., {LABEL_STAGE: ...}) that is not a
+          member of metrics.RECONCILE_STAGES — a drifted literal
+          silently zeroes that stage's series
+  WVL401  lock discipline: a `self.` attribute the class elsewhere
+          accesses under `with self._lock:` (any lock-typed attribute)
+          is also mutated lock-free — a data race once any thread pool
+          or daemon thread touches the object. Constructors are exempt
+          (construction is single-threaded); methods named `*_locked`
+          are assumed called with the lock held.
+  WVL402  thread-shared mutation: `self.` or module-level mutable state
+          mutated, without a lock in scope, inside code reachable from a
+          callable handed to `utils.concurrency.fanout()` or
+          `threading.Thread(target=...)` (same-file reachability:
+          lambdas, nested defs, same-class methods, module functions)
+  WVL403  self-deadlock: acquiring a class's non-reentrant lock (a
+          nested `with self._lock:` or a call to a method that takes it)
+          while already holding that same lock
+
+  WVL005  stale suppression: a `# noqa: WVLxxx` comment naming a rule
+          that does not fire on that line (audited only for rule
+          families active in the current run; foreign codes like BLE001
+          are left to the tools that own them)
 
 Exit status: number of findings (0 = clean).
 """
@@ -49,6 +83,7 @@ from __future__ import annotations
 
 import ast
 import builtins
+import json
 import os
 import re
 import symtable
@@ -846,13 +881,792 @@ def _metrics_doc_findings(files: list[str],
     return findings
 
 
+# -- concurrency safety (WVL401-403) ----------------------------------------
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_REENTRANT_FACTORIES = {"RLock"}
+# method names that mutate their receiver in place (list/dict/set/deque
+# protocol); deliberately excludes `set` (threading.Event.set,
+# prometheus Gauge.set) and `inc`/`observe` (prometheus primitives are
+# internally locked)
+_MUTATING_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault", "add",
+    "discard",
+}
+_CTOR_METHODS = {"__init__", "__new__", "__post_init__",
+                 "__init_subclass__", "__set_name__"}
+
+
+def _dotted(node) -> str | None:
+    """`a.b.c` -> "a.b.c" for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_tail(call: ast.Call) -> str | None:
+    """The called name: `f(...)` -> "f", `x.y.f(...)` -> "f"."""
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _lock_factory(value) -> str | None:
+    """The factory name when `value` is threading.Lock()/RLock()/... ."""
+    if isinstance(value, ast.Call):
+        tail = _call_tail(value)
+        if tail in _LOCK_FACTORIES:
+            return tail
+    return None
+
+
+def _self_attr_base(node) -> str | None:
+    """The first attribute after `self` in a receiver chain:
+    self.x -> x, self.x[k] -> x, self.x.y -> x."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    base = None
+    while isinstance(node, ast.Attribute):
+        base = node.attr
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self":
+        return base
+    return None
+
+
+def _name_base(node) -> str | None:
+    """The root bare name of a receiver chain: x[k].y -> x."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _with_mentions_lock(with_node: ast.With) -> bool:
+    """True when any context expr's dotted text names a lock-ish object
+    — the generous exemption: mutations inside ANY `with ...lock...:`
+    are treated as disciplined (which specific lock is right is beyond
+    static reach)."""
+    for item in with_node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        text = _dotted(expr) or ""
+        if "lock" in text.lower() or "cond" in text.lower() or \
+                "mutex" in text.lower():
+            return True
+    return False
+
+
+def _self_mutations(fn, *, include_globals: set | None = None,
+                    local_names: set | None = None,
+                    lock_attrs: set | None = None):
+    """Yield (lineno, receiver_attr_or_name, is_self, locked) mutation
+    events in `fn`'s body. Nested ClassDefs are pruned (their `self` is
+    theirs); nested FunctionDefs/Lambdas are walked with locked=False
+    (a closure may run on another thread after the lock is released).
+    `locked` is True inside any `with ...lock...:` block or a `with
+    self.X:` where X is a known lock-typed attribute (`lock_attrs`)."""
+    def takes_known_lock(with_node: ast.With) -> bool:
+        if not lock_attrs:
+            return False
+        for item in with_node.items:
+            text = _dotted(item.context_expr) or ""
+            if text.startswith("self.") and \
+                    text[len("self."):] in lock_attrs:
+                return True
+        return False
+
+    def walk(node, locked: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue
+            child_locked = locked
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                child_locked = False
+            if isinstance(child, ast.With):
+                child_locked = (locked or _with_mentions_lock(child)
+                                or takes_known_lock(child))
+            # direct store/del on self.X or a subscript rooted at it
+            if isinstance(child, (ast.Attribute, ast.Subscript)) and \
+                    isinstance(getattr(child, "ctx", None),
+                               (ast.Store, ast.Del)):
+                attr = _self_attr_base(child)
+                if attr is not None:
+                    yield (child.lineno, attr, True, locked)
+                elif include_globals is not None and \
+                        isinstance(child, ast.Subscript):
+                    name = _name_base(child)
+                    if name in include_globals and \
+                            name not in (local_names or set()):
+                        yield (child.lineno, name, False, locked)
+            # in-place mutator call on self.X / a module-global receiver
+            elif isinstance(child, ast.Call) and \
+                    isinstance(child.func, ast.Attribute) and \
+                    child.func.attr in _MUTATING_METHODS:
+                recv = child.func.value
+                attr = _self_attr_base(recv)
+                if attr is not None:
+                    yield (child.lineno, attr, True, locked)
+                elif include_globals is not None:
+                    name = _name_base(recv)
+                    if name in include_globals and \
+                            name not in (local_names or set()):
+                        yield (child.lineno, name, False, locked)
+            yield from walk(child, child_locked)
+
+    yield from walk(fn, False)
+
+
+def _class_lock_attrs(cls_node: ast.ClassDef) -> dict[str, bool]:
+    """lock-typed self attributes -> reentrant? (nested classes pruned)."""
+    locks: dict[str, bool] = {}
+    stack = list(cls_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            continue
+        if isinstance(node, ast.Assign):
+            factory = _lock_factory(node.value)
+            if factory:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        locks[t.attr] = factory in _REENTRANT_FACTORIES
+        stack.extend(ast.iter_child_nodes(node))
+    return locks
+
+
+def _acquired_lock_attrs(with_node: ast.With, locks: dict) -> set:
+    """Which of the class's lock attrs a `with` statement takes."""
+    out: set = set()
+    for item in with_node.items:
+        expr = item.context_expr
+        text = _dotted(expr) or ""
+        if text.startswith("self."):
+            attr = text[len("self."):]
+            if attr in locks:
+                out.add(attr)
+    return out
+
+
+def _check_class_concurrency(path: str, cls: ast.ClassDef) -> list[Finding]:
+    """WVL401 (guarded attr mutated lock-free) and WVL403
+    (self-deadlock on a non-reentrant lock) for one class."""
+    locks = _class_lock_attrs(cls)
+    if not locks:
+        return []
+    findings: list[Finding] = []
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    # pass 0: which methods acquire which lock in their OWN statements
+    # (nested defs excluded: a closure acquiring later is not the method
+    # acquiring now)
+    method_acquires: dict[str, set] = {}
+    for m in methods:
+        acq: set = set()
+        stack = list(m.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(node, ast.With):
+                acq |= _acquired_lock_attrs(node, locks)
+            stack.extend(ast.iter_child_nodes(node))
+        method_acquires[m.name] = acq
+
+    # pass 1: the lock-discipline inventory — self attrs ever touched
+    # inside a recognised `with self.<lock>:` block
+    guarded: set = set()
+
+    def inventory(node, held: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue
+            child_held = held
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                child_held = False
+            if isinstance(child, ast.With) and \
+                    _acquired_lock_attrs(child, locks):
+                child_held = True
+            if held and isinstance(child, ast.Attribute) and \
+                    isinstance(child.value, ast.Name) and \
+                    child.value.id == "self":
+                guarded.add(child.attr)
+            inventory(child, child_held)
+
+    for m in methods:
+        inventory(m, False)
+    guarded -= set(locks)
+
+    # pass 2a: WVL401 — guarded attrs mutated with no lock in scope
+    for m in methods:
+        if m.name in _CTOR_METHODS or m.name.endswith("_locked"):
+            continue
+        for lineno, attr, is_self, locked in _self_mutations(
+                m, lock_attrs=set(locks)):
+            if is_self and not locked and attr in guarded:
+                findings.append(Finding(
+                    path, lineno, "WVL401",
+                    f"{cls.name}.{attr} is lock-guarded elsewhere but "
+                    f"mutated lock-free in {m.name}()"))
+
+    # pass 2b: WVL403 — re-acquiring a held non-reentrant lock, directly
+    # or through a same-class method call
+    def deadlocks(node, held: set) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            child_held = held
+            if isinstance(child, ast.With):
+                acq = _acquired_lock_attrs(child, locks)
+                again = {a for a in acq & held if not locks[a]}
+                for a in sorted(again):
+                    findings.append(Finding(
+                        path, child.lineno, "WVL403",
+                        f"{cls.name} re-acquires self.{a} while already "
+                        "holding it (non-reentrant Lock: self-deadlock)"))
+                child_held = held | acq
+            elif isinstance(child, ast.Call) and \
+                    isinstance(child.func, ast.Attribute) and \
+                    isinstance(child.func.value, ast.Name) and \
+                    child.func.value.id == "self":
+                callee = child.func.attr
+                for a in sorted(method_acquires.get(callee, set()) & held):
+                    if not locks[a]:
+                        findings.append(Finding(
+                            path, child.lineno, "WVL403",
+                            f"{cls.name}.{callee}() takes self.{a}, "
+                            f"called while already holding it "
+                            "(self-deadlock)"))
+            deadlocks(child, child_held)
+
+    for m in methods:
+        deadlocks(m, set())
+    return findings
+
+
+def _check_module_lock_discipline(path: str,
+                                  tree: ast.Module) -> list[Finding]:
+    """WVL401 at module scope: globals touched under `with <module
+    lock>:` in one function, mutated lock-free in another (module
+    top-level mutations are import-time, single-threaded, exempt)."""
+    module_locks = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and _lock_factory(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    module_locks.add(t.id)
+    if not module_locks:
+        return []
+    module_names = _module_bindings(tree)
+
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    guarded: set = set()
+
+    def inventory(node, held: bool, local: set) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue
+            child_held = held
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                child_held = False
+            if isinstance(child, ast.With) and any(
+                    isinstance(i.context_expr, ast.Name)
+                    and i.context_expr.id in module_locks
+                    for i in child.items):
+                child_held = True
+            if held and isinstance(child, ast.Name) and \
+                    child.id in module_names and child.id not in local:
+                guarded.add(child.id)
+            inventory(child, child_held, local)
+
+    for fn in funcs:
+        inventory(fn, False, _fn_local_bindings(fn))
+    guarded -= module_locks
+
+    findings: list[Finding] = []
+    for fn in funcs:
+        if fn.name.endswith("_locked"):
+            continue
+        local = _fn_local_bindings(fn) - _global_decls(fn)
+        for lineno, name, is_self, locked in _self_mutations(
+                fn, include_globals=guarded, local_names=local):
+            if not is_self and not locked:
+                findings.append(Finding(
+                    path, lineno, "WVL401",
+                    f"module global {name!r} is lock-guarded elsewhere "
+                    f"but mutated lock-free in {fn.name}()"))
+        # `global x; x = ...` stores
+        decls = _global_decls(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Store) and \
+                    node.id in decls and node.id in guarded:
+                if not _store_is_locked(fn, node):
+                    findings.append(Finding(
+                        path, node.lineno, "WVL401",
+                        f"module global {node.id!r} is lock-guarded "
+                        f"elsewhere but reassigned lock-free in "
+                        f"{fn.name}()"))
+    return findings
+
+
+def _global_decls(fn) -> set:
+    out: set = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+def _store_is_locked(fn, target) -> bool:
+    """Whether `target` sits inside a lock-mentioning `with` in fn."""
+    def walk(node, locked):
+        for child in ast.iter_child_nodes(node):
+            child_locked = locked or (isinstance(child, ast.With)
+                                      and _with_mentions_lock(child))
+            if child is target:
+                return locked
+            found = walk(child, child_locked)
+            if found is not None:
+                return found
+        return None
+
+    return bool(walk(fn, False))
+
+
+# -- thread-reachable shared-state mutation (WVL402) -------------------------
+
+
+def _check_thread_shared_state(path: str,
+                               tree: ast.Module) -> list[Finding]:
+    """Mutations of `self.` attributes or module globals, with no lock
+    in scope, in code reachable from a callable handed to `fanout()` or
+    `threading.Thread(target=...)`. Reachability is same-file and
+    conservative: inline lambdas, nested defs, same-class methods
+    (self.m()), and module-level functions; calls through imports,
+    attributes of other objects, or dynamic dispatch are pruned."""
+    module_funcs = {n.name: n for n in tree.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+    module_names = _module_bindings(tree)
+
+    # entry points: (callable node, owner class node or None, origin line)
+    entries: list[tuple] = []
+
+    def nested_defs(fn) -> dict:
+        out = {}
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[node.name] = node
+                continue  # deeper nesting resolved when that def is reached
+            if isinstance(node, (ast.Lambda, ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def resolve_callable(node, cls, fn_stack):
+        """A task expression -> callable def node, or None."""
+        if isinstance(node, ast.Lambda):
+            return node
+        if isinstance(node, ast.Name):
+            for fn in reversed(fn_stack):
+                hit = nested_defs(fn).get(node.id)
+                if hit is not None:
+                    return hit
+            return module_funcs.get(node.id)
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and cls is not None:
+            for m in cls.body:
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and m.name == node.attr:
+                    return m
+        return None
+
+    def collect_entries(node, cls, fn_stack):
+        for child in ast.iter_child_nodes(node):
+            child_cls, child_stack = cls, fn_stack
+            if isinstance(child, ast.ClassDef):
+                child_cls = child
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_stack = fn_stack + [child]
+            if isinstance(child, ast.Call):
+                tail = _call_tail(child)
+                if tail == "fanout" and child.args:
+                    tasks = child.args[0]
+                    elts = []
+                    if isinstance(tasks, (ast.List, ast.Tuple)):
+                        elts = tasks.elts
+                    elif isinstance(tasks, (ast.ListComp, ast.GeneratorExp)):
+                        elts = [tasks.elt]
+                    for e in elts:
+                        target = resolve_callable(e, cls, fn_stack)
+                        if target is not None:
+                            entries.append((target, cls, child.lineno))
+                elif tail == "Thread":
+                    for kw in child.keywords:
+                        if kw.arg == "target":
+                            target = resolve_callable(kw.value, cls, fn_stack)
+                            if target is not None:
+                                entries.append((target, cls, child.lineno))
+            collect_entries(child, child_cls, child_stack)
+
+    collect_entries(tree, None, [])
+    if not entries:
+        return []
+
+    # transitive closure over same-file callees
+    findings: list[Finding] = []
+    seen_mutations: set = set()
+    visited: set = set()
+    work = list(entries)
+    while work:
+        fn, cls, origin = work.pop()
+        if id(fn) in visited:
+            continue
+        visited.add(id(fn))
+
+        is_def = isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+        local = (_fn_local_bindings(fn) - _global_decls(fn)) if is_def \
+            else set()
+        fname = fn.name if is_def else "<lambda>"
+        owner_locks = set(_class_lock_attrs(cls)) if cls is not None \
+            else set()
+        for lineno, recv, is_self, locked in _self_mutations(
+                fn, include_globals=module_names, local_names=local,
+                lock_attrs=owner_locks):
+            if locked:
+                continue
+            key = (lineno, recv)
+            if key in seen_mutations:
+                continue
+            seen_mutations.add(key)
+            what = f"self.{recv}" if is_self else f"module global {recv!r}"
+            findings.append(Finding(
+                path, lineno, "WVL402",
+                f"{what} mutated without a lock in {fname}(), reachable "
+                f"from the thread/fanout entry at line {origin}"))
+
+        # follow same-file callees
+        own_nested = nested_defs(fn) if is_def else {}
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.ClassDef):
+                continue
+            if isinstance(node, ast.Call):
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = (own_nested.get(node.func.id)
+                              or module_funcs.get(node.func.id))
+                elif isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self" and cls is not None:
+                    for m in cls.body:
+                        if isinstance(m, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+                                and m.name == node.func.attr:
+                            callee = m
+                            break
+                if callee is not None:
+                    work.append((callee, cls, origin))
+            stack.extend(ast.iter_child_nodes(node))
+    return findings
+
+
+# -- config-knob parity (WVL311/312) -----------------------------------------
+
+KNOB_RE = re.compile(r"WVA_[A-Z][A-Z0-9_]*")
+CONFIG_DOC_RELPATH = os.path.join("docs", "user-guide", "configuration.md")
+
+
+def _env_read_knobs(tree: ast.Module) -> dict[str, int]:
+    """WVA_* names read from os.environ (get/getenv/subscript), including
+    reads through a constant alias (`FANOUT_ENV = "WVA_..."`). Returns
+    knob -> first read line."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str) and \
+                KNOB_RE.fullmatch(node.value.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    aliases[t.id] = node.value.value
+                elif isinstance(t, ast.Attribute):
+                    aliases[t.attr] = node.value.value
+
+    def knob_of(arg) -> str | None:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and KNOB_RE.fullmatch(arg.value):
+            return arg.value
+        if isinstance(arg, ast.Name):
+            return aliases.get(arg.id)
+        if isinstance(arg, ast.Attribute):
+            return aliases.get(arg.attr)
+        return None
+
+    reads: dict[str, int] = {}
+    for node in ast.walk(tree):
+        knob = None
+        if isinstance(node, ast.Call):
+            tail = _call_tail(node)
+            recv = (_dotted(node.func.value) or ""
+                    if isinstance(node.func, ast.Attribute) else "")
+            if (tail == "get" and "environ" in recv) or tail == "getenv":
+                if node.args:
+                    knob = knob_of(node.args[0])
+        elif isinstance(node, ast.Subscript):
+            if "environ" in (_dotted(node.value) or ""):
+                knob = knob_of(node.slice)
+        if knob is not None:
+            reads.setdefault(knob, node.lineno)
+    return reads
+
+
+def check_knob_parity(reads: dict[str, tuple[str, int]],
+                      literals: set[str], doc_text: str,
+                      doc_path: str = CONFIG_DOC_RELPATH) -> list[Finding]:
+    """Two-way WVA_* registry check (the WVL301/302 shape for config):
+    every env-read knob needs a row in the configuration doc (WVL311),
+    and every documented knob must still be named somewhere in the
+    scanned code (WVL312). `reads`: knob -> (path, line) of an actual
+    os.environ read; `literals`: every WVA_* literal the scan saw (the
+    generous liveness set — aliases, ConfigMap keys, test fixtures)."""
+    findings: list[Finding] = []
+    documented = set(KNOB_RE.findall(doc_text))
+    for knob, (path, line) in sorted(reads.items()):
+        if knob not in documented:
+            findings.append(Finding(
+                path, line, "WVL311",
+                f"{knob} is read from the environment but has no row in "
+                f"{doc_path}"))
+    doc_lines = {}
+    for i, line_text in enumerate(doc_text.splitlines(), 1):
+        for knob in KNOB_RE.findall(line_text):
+            doc_lines.setdefault(knob, i)
+    for knob in sorted(documented - literals):
+        findings.append(Finding(
+            doc_path, doc_lines.get(knob, 1), "WVL312",
+            f"{knob} is documented but nothing in the scanned code "
+            "reads or names it (rotted row?)"))
+    return findings
+
+
+def _knob_parity_findings(files: list[str], sources: dict[str, str],
+                          trees: dict[str, ast.Module]) -> list[Finding]:
+    """Wire WVL311/312 when the scan plausibly covers the whole knob
+    surface: it must include package files AND tools/ (the two homes of
+    env reads) and the configuration doc must exist at the repo root.
+    Partial scans skip the check rather than report phantom rot."""
+    pkg_files = [fp for fp in files
+                 if "workload_variant_autoscaler_tpu" in os.path.abspath(fp)]
+    tool_files = [fp for fp in files
+                  if f"{os.sep}tools{os.sep}" in os.path.abspath(fp)]
+    if not pkg_files or not tool_files:
+        return []
+    root = os.path.abspath(pkg_files[0])
+    while root != os.path.dirname(root) and \
+            os.path.basename(root) != "workload_variant_autoscaler_tpu":
+        root = os.path.dirname(root)
+    root = os.path.dirname(root)
+    doc = os.path.join(root, CONFIG_DOC_RELPATH)
+    if not os.path.exists(doc):
+        return []
+    with open(doc, encoding="utf-8") as f:
+        doc_text = f.read()
+
+    reads: dict[str, tuple[str, int]] = {}
+    literals: set[str] = set()
+    for fp in files:
+        literals |= set(KNOB_RE.findall(sources[fp]))
+        tree = trees.get(fp)
+        if tree is None:
+            continue
+        base = os.path.basename(fp)
+        is_test = (f"{os.sep}tests{os.sep}" in os.path.abspath(fp)
+                   or base.startswith("test_") or base == "conftest.py")
+        if is_test:
+            continue  # tests set knobs; operators read the doc for code
+        for knob, line in _env_read_knobs(tree).items():
+            reads.setdefault(knob, (fp, line))
+    rel_doc = os.path.relpath(doc) if not os.path.isabs(files[0]) else doc
+    return check_knob_parity(reads, literals, doc_text, rel_doc)
+
+
+# -- cross-module literal validity (WVL321/322) ------------------------------
+
+
+def _module_consts(tree: ast.Module) -> dict:
+    """Statically evaluate simple module-level constants: strings,
+    tuples of them, and tuple concatenation (the ALL_KINDS /
+    RECONCILE_STAGES shapes)."""
+    consts: dict = {}
+
+    def ev(node):
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, ast.Name):
+            return consts.get(node.id)
+        if isinstance(node, ast.Tuple):
+            vals = [ev(e) for e in node.elts]
+            return None if any(v is None for v in vals) else tuple(vals)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            left, right = ev(node.left), ev(node.right)
+            if isinstance(left, tuple) and isinstance(right, tuple):
+                return left + right
+        return None
+
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            val = ev(node.value)
+            if val is not None:
+                consts[node.targets[0].id] = val
+    return consts
+
+
+def _vocab_from_trees(trees: dict[str, ast.Module], path_suffix: str,
+                      const_name: str) -> frozenset | None:
+    for fp, tree in trees.items():
+        if os.path.abspath(fp).endswith(path_suffix):
+            val = _module_consts(tree).get(const_name)
+            if isinstance(val, tuple) and all(
+                    isinstance(v, str) for v in val):
+                return frozenset(val)
+    return None
+
+
+def _check_fault_kinds(path: str, tree: ast.Module,
+                       kinds: frozenset) -> list[Finding]:
+    """WVL321 — literals at the stringly-typed fault seam: FaultRule
+    kind args, {"rules": [{"kind": ...}]} plan dicts, and inline
+    WVA_FAULT_PLAN-style JSON strings."""
+    findings: list[Finding] = []
+
+    def bad(node, value: str) -> None:
+        findings.append(Finding(
+            path, node.lineno, "WVL321",
+            f"unknown fault kind {value!r} (not in faults.plan."
+            f"ALL_KINDS: {sorted(kinds)})"))
+
+    def check_rule_dict(d: ast.Dict) -> None:
+        for k, v in zip(d.keys, d.values):
+            if isinstance(k, ast.Constant) and k.value == "kind" and \
+                    isinstance(v, ast.Constant) and \
+                    isinstance(v.value, str) and v.value not in kinds:
+                bad(v, v.value)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _call_tail(node) == "FaultRule":
+            arg = None
+            if node.args and isinstance(node.args[0], ast.Constant):
+                arg = node.args[0]
+            for kw in node.keywords:
+                if kw.arg == "kind" and isinstance(kw.value, ast.Constant):
+                    arg = kw.value
+            if arg is not None and isinstance(arg.value, str) and \
+                    arg.value not in kinds:
+                bad(arg, arg.value)
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and k.value == "rules" and \
+                        isinstance(v, (ast.List, ast.Tuple)):
+                    for elt in v.elts:
+                        if isinstance(elt, ast.Dict):
+                            check_rule_dict(elt)
+        elif isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and '"rules"' in node.value:
+            # inline JSON plan (the WVA_FAULT_PLAN surface)
+            try:
+                obj = json.loads(node.value)
+            except ValueError:
+                continue
+            if not isinstance(obj, dict):
+                continue
+            for rule in obj.get("rules") or []:
+                if isinstance(rule, dict):
+                    kind = rule.get("kind")
+                    if isinstance(kind, str) and kind not in kinds:
+                        bad(node, kind)
+    return findings
+
+
+def _check_stage_literals(path: str, tree: ast.Module,
+                          stages: frozenset) -> list[Finding]:
+    """WVL322 — literals at the stage seam: mark("..."), stage=...
+    keywords, and {LABEL_STAGE: "..."} label dicts must name a member
+    of metrics.RECONCILE_STAGES."""
+    findings: list[Finding] = []
+
+    def bad(node, value: str) -> None:
+        findings.append(Finding(
+            path, node.lineno, "WVL322",
+            f"unknown reconcile stage {value!r} (not in metrics."
+            f"RECONCILE_STAGES: {sorted(stages)})"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            if _call_tail(node) == "mark" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str) and \
+                    node.args[0].value not in stages:
+                bad(node.args[0], node.args[0].value)
+            for kw in node.keywords:
+                if kw.arg == "stage" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, str) and \
+                        kw.value.value not in stages:
+                    bad(kw.value, kw.value.value)
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                is_stage_key = (
+                    (isinstance(k, ast.Name) and k.id == "LABEL_STAGE")
+                    or (isinstance(k, ast.Attribute)
+                        and k.attr == "LABEL_STAGE"))
+                if is_stage_key and isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str) and v.value not in stages:
+                    bad(v, v.value)
+    return findings
+
+
 # -- driver ----------------------------------------------------------------
+
+
+_STRUCTURAL_CODES = frozenset({
+    "WVL001", "WVL002", "WVL003", "WVL101", "WVL102", "WVL103", "WVL104",
+    "WVL105", "WVL106", "WVL401", "WVL402", "WVL403",
+})
 
 
 def lint_source(path: str, source: str,
                 sigs: dict[str, list[_Sig]] | None = None,
                 rets: dict[str, list[frozenset | None]] | None = None,
                 classes: dict[str, tuple[set, bool]] | None = None,
+                fault_kinds: frozenset | None = None,
+                stages: frozenset | None = None,
                 ) -> list[Finding]:
     try:
         tree = ast.parse(source, path)
@@ -864,14 +1678,32 @@ def lint_source(path: str, source: str,
     findings = v.findings
     findings += _undefined_names(path, source, tree)
     findings += _unused(path, source, tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            findings += _check_class_concurrency(path, node)
+    findings += _check_module_lock_discipline(path, tree)
+    findings += _check_thread_shared_state(path, tree)
+    active = set(_STRUCTURAL_CODES)
     if sigs:
         findings += _check_calls(path, tree, sigs)
+        active.add("WVL201")
     if rets:
         findings += _check_unpack_arity(path, tree, rets)
+        active.add("WVL202")
     if classes:
         findings += _check_self_attrs(path, tree, classes)
+        active.add("WVL203")
+    if fault_kinds:
+        findings += _check_fault_kinds(path, tree, fault_kinds)
+        active.add("WVL321")
+    if stages:
+        findings += _check_stage_literals(path, tree, stages)
+        active.add("WVL322")
 
     noqa = _noqa_lines(source)
+    fired_by_line: dict[int, set[str]] = {}
+    for f in findings:
+        fired_by_line.setdefault(f.line, set()).add(f.code.upper())
     out = []
     for f in findings:
         codes = noqa.get(f.line, "missing")
@@ -881,6 +1713,19 @@ def lint_source(path: str, source: str,
             continue  # blanket noqa
         elif f.code.upper() not in codes:
             out.append(f)
+    # WVL005 — stale suppressions: a noqa naming a WVL rule that ran in
+    # this pass but does not fire on that line. Blanket noqas and
+    # foreign codes (BLE001, E402, ...) are not audited; not itself
+    # noqa-suppressible (put WVL005 in the list to opt a line out).
+    for line, codes in sorted(noqa.items()):
+        if codes is None or "WVL005" in codes:
+            continue
+        for code in sorted(codes):
+            if code.startswith("WVL") and code in active and \
+                    code not in fired_by_line.get(line, set()):
+                out.append(Finding(
+                    path, line, "WVL005",
+                    f"stale noqa: {code} does not fire on this line"))
     return out
 
 
@@ -912,10 +1757,16 @@ def main(argv=None) -> int:
     sigs = _collect_signatures(trees)
     rets = _collect_return_arities(trees)
     classes = _resolve_classes(_collect_classes(trees))
+    fault_kinds = _vocab_from_trees(
+        trees, os.path.join("faults", "plan.py"), "ALL_KINDS")
+    stages = _vocab_from_trees(
+        trees, os.path.join("metrics", "__init__.py"), "RECONCILE_STAGES")
     findings: list[Finding] = []
     for fp in files:
-        findings += lint_source(fp, sources[fp], sigs, rets, classes)
+        findings += lint_source(fp, sources[fp], sigs, rets, classes,
+                                fault_kinds, stages)
     findings += _metrics_doc_findings(files, sources)
+    findings += _knob_parity_findings(files, sources, trees)
     for f in sorted(findings, key=lambda f: (f.path, f.line)):
         print(f.format())
     if findings:

@@ -188,8 +188,11 @@ class Reconciler:
         # (warn on change, not every cycle)
         self._shared_ns_warned: tuple[str, ...] = ()
         # the probe daemon thread's private Prometheus client (lazy; a
-        # shared requests.Session is not thread-safe under concurrency)
+        # shared requests.Session is not thread-safe under concurrency).
+        # The lock covers the lazy init: demand_probe() can be called
+        # from the daemon thread and directly by tests/kick paths
         self._probe_prom = None
+        self._probe_prom_lock = threading.Lock()
         # fleet-mode per-cycle condition source: full_name -> the VA
         # object this cycle read/wrote, so _emit_conditions needs no
         # extra LIST; None = legacy mode (post-publish LIST)
@@ -1464,10 +1467,11 @@ class Reconciler:
         gets its own clone (own Session / connection pool). Clients
         without clone() (in-memory fakes, sim-time shims) are assumed
         re-entrant and shared as-is."""
-        if self._probe_prom is None:
-            clone = getattr(self.prom, "clone", None)
-            self._probe_prom = clone() if callable(clone) else self.prom
-        return self._probe_prom
+        with self._probe_prom_lock:
+            if self._probe_prom is None:
+                clone = getattr(self.prom, "clone", None)
+                self._probe_prom = clone() if callable(clone) else self.prom
+            return self._probe_prom
 
     def _start_demand_probe(self, stop: threading.Event) -> None:
         """Poll demand on a daemon thread at the configured period; a

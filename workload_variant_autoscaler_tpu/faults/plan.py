@@ -148,8 +148,11 @@ class FaultPlan:
         return random.Random((self.seed * 1_000_003 + index) & 0xFFFFFFFF)
 
     def add(self, rule: FaultRule) -> "FaultPlan":
-        self.rules.append(rule)
-        self._rngs.append(self._rule_rng(len(self.rules) - 1))
+        # locked: a scenario may add rules while fanned-out hooks are
+        # mid-lookup in _active (rules/_rngs iterate under the lock)
+        with self._lock:
+            self.rules.append(rule)
+            self._rngs.append(self._rule_rng(len(self.rules) - 1))
         return self
 
     # -- position ---------------------------------------------------------
@@ -159,19 +162,21 @@ class FaultPlan:
         The first reconcile after construction runs as cycle 1, so
         `after_cycle=1` means 'from the first cycle on' and
         `after_cycle=2` 'healthy first cycle, then faults'."""
-        self.cycle += 1
-        return self.cycle
+        with self._lock:
+            self.cycle += 1
+            return self.cycle
 
     def tick(self, now_s: float) -> None:
         """Advance the time axis. The clock is rebased to the FIRST tick
         (so `after_s: 60` always means one minute into the run, whether
         the harness feeds sim seconds from ~0 or unix time); stale ticks
         are ignored (monotone)."""
-        if self._t0 is None:
-            self._t0 = now_s
-        rel = now_s - self._t0
-        if rel > self.now_s:
-            self.now_s = rel
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = now_s
+            rel = now_s - self._t0
+            if rel > self.now_s:
+                self.now_s = rel
 
     # -- lookups (called by the injection hooks) --------------------------
 

@@ -59,6 +59,9 @@ class CertReloader:
         self.context = _build_server_context(certfile, keyfile, client_cafile)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # serializes check_now(): the poll thread and direct callers
+        # (tests, an admin hook) may race the stat->rebuild->swap
+        self._reload_lock = threading.Lock()
         self._mtimes = self._stat()
 
     def _paths(self):
@@ -77,21 +80,22 @@ class CertReloader:
         """Swap in a fresh context if the files changed; returns True when
         a swap happened. Safe against half-written pairs: a build failure
         keeps the previous context serving and retries on the next poll."""
-        mtimes = self._stat()
-        if mtimes == self._mtimes or None in mtimes:
-            return False
-        try:
-            fresh = _build_server_context(self.certfile, self.keyfile,
-                                          self.client_cafile)
-        except (OSError, ssl.SSLError) as e:
-            log.error("metrics TLS reload failed; keeping previous certs",
-                      extra=kv(error=str(e)))
-            return False
-        self.context = fresh
-        self._mtimes = mtimes
-        log.info("metrics TLS certificates reloaded",
-                 extra=kv(certfile=self.certfile))
-        return True
+        with self._reload_lock:
+            mtimes = self._stat()
+            if mtimes == self._mtimes or None in mtimes:
+                return False
+            try:
+                fresh = _build_server_context(self.certfile, self.keyfile,
+                                              self.client_cafile)
+            except (OSError, ssl.SSLError) as e:
+                log.error("metrics TLS reload failed; keeping previous certs",
+                          extra=kv(error=str(e)))
+                return False
+            self.context = fresh
+            self._mtimes = mtimes
+            log.info("metrics TLS certificates reloaded",
+                     extra=kv(certfile=self.certfile))
+            return True
 
     def start(self) -> None:
         def loop():

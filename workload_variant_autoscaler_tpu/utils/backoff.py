@@ -206,7 +206,9 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._lock = threading.RLock()
 
-    def _set_state(self, state: str) -> None:
+    def _set_state_locked(self, state: str) -> None:
+        # caller holds self._lock (the *_locked naming convention the
+        # lint gate's WVL401 lock-discipline check recognises)
         if state == self.state:
             return
         old, self.state = self.state, state
@@ -225,21 +227,21 @@ class CircuitBreaker:
                 state = self.HALF_OPEN
             return self.STATE_CODES[state]
 
-    def _open(self) -> None:
-        self._set_state(self.OPEN)
+    def _open_locked(self) -> None:
+        self._set_state_locked(self.OPEN)
         self._opened_at = self._clock()
 
     def record_success(self) -> None:
         with self._lock:
             self.consecutive_failures = 0
-            self._set_state(self.CLOSED)
+            self._set_state_locked(self.CLOSED)
 
     def record_failure(self) -> None:
         with self._lock:
             self.consecutive_failures += 1
             if self.state == self.HALF_OPEN or \
                     self.consecutive_failures >= self.failure_threshold:
-                self._open()
+                self._open_locked()
 
     def call(self, fn: Callable[[], T]) -> T:
         with self._lock:
@@ -252,7 +254,7 @@ class CircuitBreaker:
                                   self.reset_after_s - waited, 3))
                     raise CircuitOpenError(self.name,
                                            self.reset_after_s - waited)
-                self._set_state(self.HALF_OPEN)  # one probe goes through
+                self._set_state_locked(self.HALF_OPEN)  # one probe goes through
         try:
             result = fn()
         except TerminalError:
