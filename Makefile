@@ -27,6 +27,10 @@ bench: ## Run the kernel benchmark (one JSON line; uses a real TPU when present)
 bench-loop: ## North-star closed-loop benchmark: chip-hours to hold p95-ITL SLO (sim-time, CPU, ~2 min)
 	$(PY) bench_loop.py
 
+.PHONY: bench-loop-churn
+bench-loop-churn: ## Steady-state incremental-solve bench: 512 variants, 1% churn/cycle, WVA_INCREMENTAL_SOLVE on vs off (BENCH_solve artifact)
+	$(PY) bench_loop.py solve-churn
+
 .PHONY: bench-scenarios
 bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO headlines + mean ablations, tail stress, strict SLO)
 	$(PY) bench_loop.py whole-fleet-p95

@@ -333,7 +333,10 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
        fallback IMMEDIATELY (once) so a result is in hand, then keep
        retrying the TPU on a stagger (WVA_BENCH_RETRY_INTERVAL_S,
        default 120 s) while budget remains; a late TPU success replaces
-       the fallback.
+       the fallback. TWO CONSECUTIVE wedged canaries end the schedule
+       early (recovery takes tens of minutes — further probes only burn
+       the pallas stages' budget); the abbreviation is recorded in the
+       `attempts` trail.
     4. healthy but CPU-only ambient env -> no accelerator will appear;
        fallback and return.
     5. total wall time never exceeds window_s + fallback reserve: every
@@ -364,6 +367,7 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
     hard_deadline = t_start + window_s + reserve
     attempts: list[dict] = []
     crashes = 0  # CONSECUTIVE fast failures (crash/garbled, not hangs)
+    wedges = 0   # CONSECUTIVE wedged canaries (reset by any other verdict)
     no_accelerator = False
     fallback: dict | None = None
     fallback_done = False
@@ -420,9 +424,11 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
             # staggered retry schedule will not fix an ImportError
             entry["detail"] = str(c.get("detail", ""))[:200]
             crashes += 1
+            wedges = 0
             attempts.append(entry)
             ensure_fallback()
         elif c["status"] == "ok":
+            wedges = 0
             entry["platform"] = c.get("platform")
             if c.get("platform") in ("cpu", "unknown"):
                 # healthy backend, but the ambient env simply has no
@@ -453,10 +459,26 @@ def run_xla_stage(timeout_s: float = 540.0, window_s: float | None = None,
             ensure_fallback()
         else:
             crashes = 0  # wedged: retryable, resets the crash streak
+            wedges += 1
             attempts.append(entry)
             ensure_fallback()
         if crashes >= 2:
             break  # deterministic failure: fail fast, don't burn budget
+        if wedges >= 2:
+            # two consecutive wedged canaries: the tunnel is down for
+            # this round's window (observed recovery times are tens of
+            # minutes, BENCH_r05 burned ~9 min on a third and fourth
+            # probe that told us nothing new) — stop re-probing and
+            # leave the budget to the pallas stages. The abbreviation
+            # is recorded so the artifact shows the schedule was cut
+            # short deliberately, not killed.
+            attempts.append({
+                "t_s": round(monotonic() - t_start),
+                "abbreviated": (
+                    f"2 consecutive wedged canaries — remaining "
+                    f"retries skipped (stagger {retry_interval_s:.0f}s)"),
+            })
+            break
         remaining = (hard_deadline - monotonic()
                      - (0.0 if fallback_done else reserve))
         if remaining - retry_interval_s < _TRY_FLOOR_S:

@@ -1001,22 +1001,247 @@ def run_fleet_scale(sizes=(64, 256, 512), cycles: int = 30) -> dict:
     }
 
 
+def run_solve_churn(n: int = 512, cycles: int = 24,
+                    churn_frac: float = 0.01,
+                    seed: int = 20260804) -> dict:
+    """Steady-state incremental solve (PR 5 tentpole claim): a
+    512-variant fleet where ~1% of variants change load per cycle,
+    reconciled with `WVA_INCREMENTAL_SOLVE=on` vs `off`.
+
+    In steady state the legacy path re-solves every candidate lane of
+    every variant every cycle; the incremental engine re-solves only the
+    signature-changed sub-batch and reuses cached allocations for the
+    rest (solver/incremental.py). Measured here per mode, identical
+    seeded churn schedule for both:
+
+      - kernel lanes solved per cycle (`inferno_solve_lanes{state}`) —
+        the O(fleet) -> O(changed) claim; `vs_baseline` is the ratio;
+      - analyze+optimize stage wall per cycle (the stages the engine
+        touches) and full cycle wall.
+
+    Each variant is its own model (independent Prometheus series), so
+    per-variant churn is real. Loads stay strictly positive and the
+    churn factor (x1.35 / x0.7) always crosses a WVA_SOLVE_EPSILON=0.02
+    bucket, so "changed" truly means re-solved.
+    """
+    import random as _random
+
+    from workload_variant_autoscaler_tpu.collector import (
+        FakePromAPI,
+        arrival_rate_query,
+        availability_query,
+        avg_generation_tokens_query,
+        avg_itl_query,
+        avg_prompt_tokens_query,
+        avg_ttft_query,
+        true_arrival_rate_query,
+    )
+    from workload_variant_autoscaler_tpu.collector.collector import (
+        VLLM_FAMILY,
+        fleet_arrival_rate_query,
+        fleet_availability_query,
+        fleet_avg_generation_tokens_query,
+        fleet_avg_itl_query,
+        fleet_avg_prompt_tokens_query,
+        fleet_avg_ttft_query,
+        fleet_true_arrival_rate_query,
+    )
+    from workload_variant_autoscaler_tpu.controller.translate import (
+        engine_backend,
+    )
+    from workload_variant_autoscaler_tpu.metrics import (
+        INFERNO_RECONCILE_STAGE_DURATION_MSEC,
+        INFERNO_SOLVE_LANES,
+        STAGE_ANALYZE,
+        STAGE_OPTIMIZE,
+        STATE_SKIPPED,
+        STATE_SOLVED,
+    )
+
+    def model_name(i: int) -> str:
+        return f"llama-8b-m{i}"
+
+    def seed_prom(store: FakePromAPI, loads: dict[int, float]) -> None:
+        """Rewrite every series from the loads dict (grouped fleet
+        vectors AND the per-variant repair queries, so both collection
+        paths see the same fleet)."""
+        fam = VLLM_FAMILY
+        grouped = (
+            fleet_true_arrival_rate_query(fam),
+            fleet_arrival_rate_query(fam),
+            fleet_avg_prompt_tokens_query(fam),
+            fleet_avg_generation_tokens_query(fam),
+            fleet_avg_ttft_query(fam),
+            fleet_avg_itl_query(fam),
+            fleet_availability_query(fam),
+        )
+        for q in grouped:
+            store.set_empty(q)
+        for i, rps in loads.items():
+            m = model_name(i)
+            labels = {"model_name": m, "namespace": NS}
+            per_model = {
+                fleet_true_arrival_rate_query(fam): rps,
+                fleet_arrival_rate_query(fam): rps,
+                fleet_avg_prompt_tokens_query(fam): 128.0,
+                fleet_avg_generation_tokens_query(fam): 128.0,
+                fleet_avg_ttft_query(fam): 0.2,
+                fleet_avg_itl_query(fam): 0.012,
+                fleet_availability_query(fam): 1.0,
+            }
+            for q, v in per_model.items():
+                store.add_result(q, v, labels=labels)
+            for q, v in (
+                (availability_query(m, NS, fam), 1.0),
+                (true_arrival_rate_query(m, NS, fam), rps),
+                (arrival_rate_query(m, NS, fam), rps),
+                (avg_prompt_tokens_query(m, NS, fam), 128.0),
+                (avg_generation_tokens_query(m, NS, fam), 128.0),
+                (avg_ttft_query(m, NS, fam), 0.2),
+                (avg_itl_query(m, NS, fam), 0.012),
+            ):
+                store.set_result(q, v, labels=labels)
+
+    def build():
+        kube = InMemoryKube()
+        kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
+                                     {"GLOBAL_OPT_INTERVAL": "60s",
+                                      # measuring the solve, not 512
+                                      # drift warnings/cycle of noise
+                                      "WVA_DRIFT_TOLERANCE": "0"}))
+        kube.put_configmap(ConfigMap(
+            ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+            {"v5e-1": json.dumps({"chip": "v5e", "chips": "1",
+                                  "cost": "20.0"})},
+        ))
+        slos = "\n".join(
+            f"  - model: {model_name(i)}\n    slo-tpot: 24\n"
+            f"    slo-ttft: 500" for i in range(n))
+        kube.put_configmap(ConfigMap(
+            SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+            {"premium": f"name: Premium\npriority: 1\ndata:\n{slos}\n"},
+        ))
+        for i in range(n):
+            name = f"chat-{i}"
+            kube.put_deployment(Deployment(name=name, namespace=NS,
+                                           spec_replicas=1,
+                                           status_replicas=1))
+            kube.put_variant_autoscaling(crd.VariantAutoscaling(
+                metadata=crd.ObjectMeta(
+                    name=name, namespace=NS,
+                    labels={crd.ACCELERATOR_LABEL: "v5e-1"}),
+                spec=crd.VariantAutoscalingSpec(
+                    model_id=model_name(i),
+                    slo_class_ref=crd.ConfigMapKeyRef(
+                        name=SERVICE_CLASS_CM_NAME, key="premium"),
+                    model_profile=crd.ModelProfile(accelerators=[
+                        crd.AcceleratorProfile(
+                            acc="v5e-1", acc_count=1,
+                            perf_parms=crd.PerfParms(
+                                decode_parms={"alpha": "6.973",
+                                              "beta": "0.027"},
+                                prefill_parms={"gamma": "5.2",
+                                               "delta": "0.1"}),
+                            max_batch_size=64),
+                    ]),
+                )))
+        store = FakePromAPI()
+        emitter = MetricsEmitter()
+        rec = Reconciler(kube=kube, prom=store, emitter=emitter,
+                         sleep=lambda _s: None)
+        return store, emitter, rec
+
+    per_cycle_churn = max(int(round(n * churn_frac)), 1)
+
+    def run_mode(mode: str) -> dict:
+        os.environ["WVA_INCREMENTAL_SOLVE"] = mode
+        try:
+            rng = _random.Random(seed)   # identical schedule per mode
+            loads = {i: 10.0 + (i % 47) for i in range(n)}
+            store, emitter, rec = build()
+            seed_prom(store, loads)
+            # warm-up: first (full) solve + compile/build, plus one
+            # steady cycle so the warm-start seed is committed
+            for _ in range(2):
+                result = rec.reconcile()
+                if len(result.processed) != n:
+                    raise RuntimeError(
+                        f"solve-churn: {len(result.processed)} processed, "
+                        f"skipped={result.skipped}")
+            walls, stage_walls, solved, skipped = [], [], [], []
+            for _c in range(cycles):
+                for i in rng.sample(range(n), per_cycle_churn):
+                    loads[i] *= rng.choice((1.35, 0.7))
+                seed_prom(store, loads)
+                t0 = _time.perf_counter()
+                rec.reconcile()
+                walls.append((_time.perf_counter() - t0) * 1000.0)
+                stage_walls.append(sum(
+                    emitter.value(INFERNO_RECONCILE_STAGE_DURATION_MSEC,
+                                  stage=s) or 0.0
+                    for s in (STAGE_ANALYZE, STAGE_OPTIMIZE)))
+                solved.append(emitter.value(INFERNO_SOLVE_LANES,
+                                            state=STATE_SOLVED) or 0.0)
+                skipped.append(emitter.value(INFERNO_SOLVE_LANES,
+                                             state=STATE_SKIPPED) or 0.0)
+            walls.sort()
+            stage_walls.sort()
+            return {
+                "lanes_solved_per_cycle": round(sum(solved) / cycles, 1),
+                "lanes_skipped_per_cycle": round(sum(skipped) / cycles, 1),
+                "cycle_wall_ms_p50": round(walls[len(walls) // 2], 1),
+                "cycle_wall_ms_max": round(walls[-1], 1),
+                "analyze_optimize_ms_p50": round(
+                    stage_walls[len(stage_walls) // 2], 2),
+                "cycles": cycles,
+            }
+        finally:
+            os.environ.pop("WVA_INCREMENTAL_SOLVE", None)
+
+    incremental = run_mode("on")
+    full = run_mode("off")
+    lanes_ratio = (full["lanes_solved_per_cycle"]
+                   / max(incremental["lanes_solved_per_cycle"], 1e-9))
+    return {
+        "metric": "steady_state_lanes_solved_per_cycle",
+        "value": incremental["lanes_solved_per_cycle"],
+        "unit": "lanes/cycle",
+        # the headline: how many fewer kernel lanes a steady-state
+        # cycle solves with the incremental engine on
+        "vs_baseline": round(lanes_ratio, 1),
+        "slo_held": True,
+        "scenario": "solve-churn",
+        "n_variants": n,
+        "churn_per_cycle": per_cycle_churn,
+        "backend": engine_backend(),
+        "wall_speedup_p50": round(full["cycle_wall_ms_p50"]
+                                  / incremental["cycle_wall_ms_p50"], 2),
+        "analyze_optimize_speedup_p50": round(
+            full["analyze_optimize_ms_p50"]
+            / max(incremental["analyze_optimize_ms_p50"], 1e-9), 2),
+        "incremental": incremental,
+        "full": full,
+    }
+
+
 def main(argv=None) -> int:
     args = sys.argv[1:] if argv is None else argv
     key = args[0] if args else "sharegpt-ramp"
     if key in ("-h", "--help", "list"):
         print("scenarios: sharegpt-ramp (default), fleet-scale, "
-              + ", ".join(SCENARIOS), file=sys.stderr)
+              "solve-churn, " + ", ".join(SCENARIOS), file=sys.stderr)
         return 0
     if key == "sharegpt-ramp":
         result = run()
     elif key == "fleet-scale":
         result = run_fleet_scale()
+    elif key == "solve-churn":
+        result = run_solve_churn()
     elif key in SCENARIOS:
         result = run_scenario(SCENARIOS[key])
     else:
         print(f"unknown scenario {key!r}; try: sharegpt-ramp, fleet-scale, "
-              + ", ".join(SCENARIOS), file=sys.stderr)
+              "solve-churn, " + ", ".join(SCENARIOS), file=sys.stderr)
         return 2
     print(json.dumps(result))
     return 0 if result["slo_held"] else 1

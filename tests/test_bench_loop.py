@@ -247,6 +247,28 @@ def test_fleet_scale_smoke():
     assert r["backend"] in ("native", "batched", "pallas")
 
 
+def test_solve_churn_smoke():
+    """run_solve_churn at toy size: the structure BENCH_solve_r07.json
+    is generated from must keep working, and even at toy scale the
+    incremental path must solve strictly fewer lanes than the full path
+    under the same seeded churn."""
+    r = bench_loop.run_solve_churn(n=8, cycles=3)
+    assert r["metric"] == "steady_state_lanes_solved_per_cycle"
+    assert r["scenario"] == "solve-churn"
+    assert r["churn_per_cycle"] == 1   # max(1% of 8, 1)
+    inc, full = r["incremental"], r["full"]
+    assert full["lanes_solved_per_cycle"] == 8.0
+    assert full["lanes_skipped_per_cycle"] == 0.0
+    assert inc["lanes_solved_per_cycle"] < full["lanes_solved_per_cycle"]
+    assert (inc["lanes_solved_per_cycle"] + inc["lanes_skipped_per_cycle"]
+            == full["lanes_solved_per_cycle"])
+    assert r["vs_baseline"] > 1.0
+    assert inc["cycle_wall_ms_p50"] > 0 and full["cycle_wall_ms_p50"] > 0
+    # the env knob is restored whatever happened inside
+    import os
+    assert "WVA_INCREMENTAL_SOLVE" not in os.environ
+
+
 def test_whole_fleet_capstone_structure():
     """The capstone's contract: four distinct slice topologies, four
     DISTINCT model ids (the sim Prometheus keys series by model — two

@@ -149,13 +149,13 @@ class TestHealthyPath:
 
 class TestWedgedTunnel:
     def test_fallback_runs_on_first_wedge_then_recovery_replaces_it(self):
-        # wedged twice, then the tunnel recovers — the round-3 scenario.
+        # wedged once, then the tunnel recovers — the round-3 scenario.
         # NEW in r5: the fallback lands at the FIRST wedge (result in
         # hand early), and the later TPU success replaces it.
         clock = Clock()
         partials = []
         attempt = fallback_aware(clock)
-        out = run(clock, make_env(clock, ["wedged", "wedged", "ok-tpu"],
+        out = run(clock, make_env(clock, ["wedged", "ok-tpu"],
                                   attempt), attempt,
                   on_partial=partials.append)
         assert out["platform"] == "tpu"
@@ -166,7 +166,51 @@ class TestWedgedTunnel:
         # print mid-retry keeps the diagnostics
         assert partials[0]["attempts"][0]["canary"] == "wedged"
         assert [a.get("canary") for a in out["attempts"]
-                if "canary" in a] == ["wedged", "wedged", "ok"]
+                if "canary" in a] == ["wedged", "ok"]
+
+    def test_two_consecutive_wedges_abbreviate_the_schedule(self):
+        # NEW in r7: two consecutive wedged canaries end the retry
+        # schedule — a third probe never runs even though the script
+        # says the tunnel would have recovered (BENCH_r05 burned ~9 min
+        # on probes 3 and 4), and the abbreviation is recorded
+        clock = Clock()
+        attempt = fallback_aware(clock)
+        out = run(clock, make_env(clock, ["wedged", "wedged", "ok-tpu"],
+                                  attempt), attempt)
+        assert out["platform"].startswith("cpu-fallback (TPU wedged")
+        assert [a.get("canary") for a in out["attempts"]
+                if "canary" in a] == ["wedged", "wedged"]
+        assert attempt.calls["budgets"] == []   # TPU stage never ran
+        # exactly one stagger paid (between the two wedges), at the knob
+        assert clock.sleeps == [INTERVAL]
+        abbrev = [a for a in out["attempts"] if "abbreviated" in a]
+        assert len(abbrev) == 1
+        assert "2 consecutive wedged" in abbrev[0]["abbreviated"]
+        assert f"{INTERVAL:.0f}s" in abbrev[0]["abbreviated"]
+
+    def test_wedge_streak_resets_on_recovery(self):
+        # wedge, recover-but-hang, wedge, recover-and-measure: the
+        # consecutive-wedge counter resets on every non-wedged verdict,
+        # so an intermittent tunnel still gets its retries
+        clock = Clock()
+        seen = {"n": 0}
+
+        def attempt(env, budget_s):
+            if env.get("WVA_FORCE_CPU"):
+                clock.t += FALLBACK_COST
+                return "ok", dict(FALLBACK)
+            seen["n"] += 1
+            if seen["n"] == 1:
+                clock.t += budget_s
+                return "timeout", None
+            clock.t += 30.0
+            return "ok", dict(GOOD)
+
+        out = run(clock,
+                  make_env(clock, ["wedged", "ok-tpu", "wedged", "ok-tpu"],
+                           attempt), attempt, window_s=1200.0)
+        assert out["platform"] == "tpu"
+        assert seen["n"] == 2
 
     def test_wedged_forever_ends_in_labeled_cpu_fallback(self):
         clock = Clock()

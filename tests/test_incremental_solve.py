@@ -1,0 +1,504 @@
+"""Incremental steady-state solve engine (solver/incremental.py).
+
+The load-bearing property: an incremental cycle publishes BIT-IDENTICAL
+allocations to a from-scratch solve over the same (quantized) inputs —
+signature-gated lane skipping, the resident candidate arena, and the
+warm-started greedy are pure optimizations, never semantics. The
+randomized-churn suite drives ≥200 cycles of fleet grow/shrink,
+epsilon-straddling load jitter, capacity changes, degradation-rung
+transitions, and forced-full boundaries through BOTH pipelines and
+requires exact equality every cycle.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from workload_variant_autoscaler_tpu.models import System, make_slice
+from workload_variant_autoscaler_tpu.models.spec import (
+    ModelSliceProfile,
+    ModelTarget,
+    OptimizerSpec,
+    ServerLoadSpec,
+    ServiceClassSpec,
+    SystemSpec,
+)
+from workload_variant_autoscaler_tpu.ops.arena import CandidateArena
+from workload_variant_autoscaler_tpu.ops.batched import (
+    SLOTargets,
+    make_queue_batch,
+)
+from workload_variant_autoscaler_tpu.solver import (
+    SOLVE_CACHED,
+    SOLVE_FULL,
+    SOLVE_INCREMENTAL,
+    IncrementalSolveEngine,
+    Manager,
+    Optimizer,
+    quantize,
+    quantize_load,
+)
+
+import helpers
+
+# Small-batch profiles keep the padded state axis at the 256 floor, so
+# the 400+ kernel dispatches of the churn suite stay fast on CPU.
+PROFILES = [
+    ModelSliceProfile(model="m-a", accelerator="v5e-1",
+                      alpha=6.973, beta=0.027, gamma=5.2, delta=0.1,
+                      max_batch_size=16, at_tokens=128),
+    ModelSliceProfile(model="m-a", accelerator="v5e-4",
+                      alpha=3.2, beta=0.012, gamma=2.4, delta=0.04,
+                      max_batch_size=23, at_tokens=128),
+    ModelSliceProfile(model="m-b", accelerator="v5e-4",
+                      alpha=9.0, beta=0.06, gamma=7.0, delta=0.15,
+                      max_batch_size=20, at_tokens=256),
+    ModelSliceProfile(model="m-b", accelerator="v5p-4",
+                      alpha=5.0, beta=0.03, gamma=4.0, delta=0.08,
+                      max_batch_size=23, at_tokens=256),
+]
+SERVICE_CLASSES = [
+    ServiceClassSpec(name="Premium", priority=1, model_targets=(
+        ModelTarget(model="m-a", slo_itl=24.0, slo_ttft=500.0),
+        ModelTarget(model="m-b", slo_itl=80.0, slo_ttft=2000.0),
+    )),
+    ServiceClassSpec(name="Freemium", priority=10, model_targets=(
+        ModelTarget(model="m-a", slo_itl=150.0, slo_ttft=1500.0),
+        ModelTarget(model="m-b", slo_itl=200.0, slo_ttft=4000.0),
+    )),
+]
+SLICES = [make_slice("v5e", 1, "1x1"), make_slice("v5e", 4, "2x2"),
+          make_slice("v5p", 4, "2x2x1")]
+
+
+def make_spec(servers, capacity, unlimited=True, policy="None"):
+    return SystemSpec(
+        accelerators=list(SLICES), profiles=list(PROFILES),
+        service_classes=list(SERVICE_CLASSES), servers=list(servers),
+        capacity=dict(capacity),
+        optimizer=OptimizerSpec(unlimited=unlimited,
+                                saturation_policy=policy),
+    )
+
+
+def run_cycle(spec, engine, rungs=None, cycle_rung="healthy"):
+    """One analyze+optimize pass through the engine; returns the
+    published AllocationSolution and the cycle's SolveStats."""
+    system = System()
+    opt_spec = system.set_from_spec(spec)
+    stats = engine.calculate(system, backend="batched",
+                             optimizer_spec=opt_spec, rungs=rungs,
+                             cycle_rung=cycle_rung)
+    optimizer = Optimizer(opt_spec)
+    Manager(system, optimizer).optimize(warm=engine.warm_start())
+    solution = system.generate_solution()
+    engine.finish_cycle(system)
+    return solution, stats
+
+
+# ---------------------------------------------------------------------------
+# quantization
+# ---------------------------------------------------------------------------
+
+class TestQuantize:
+    def test_pure_and_bucket_stable(self):
+        eps = 0.05
+        a, b = quantize(96.0, eps), quantize(100.0, eps)
+        assert a == b  # inside one bucket -> identical representative
+        assert quantize(96.0, eps) == a  # pure
+        assert abs(a - 96.0) / 96.0 <= eps
+
+    def test_straddle_changes_bucket(self):
+        eps = 0.02
+        assert quantize(100.0, eps) != quantize(110.0, eps)
+
+    def test_zero_epsilon_and_zero_value_pass_through(self):
+        assert quantize(123.456, 0.0) == 123.456
+        assert quantize(0.0, 0.05) == 0.0
+        assert quantize(-1.0, 0.05) == -1.0
+
+    def test_quantize_load_keeps_zero_load_exact(self):
+        load = ServerLoadSpec(arrival_rate=0.0, avg_in_tokens=128,
+                              avg_out_tokens=0)
+        q = quantize_load(load, 0.05)
+        assert q.arrival_rate == 0.0 and q.avg_out_tokens == 0
+        assert isinstance(q.avg_in_tokens, int)
+
+
+# ---------------------------------------------------------------------------
+# resident arena: bit-identical to the list + pad path
+# ---------------------------------------------------------------------------
+
+class TestArenaParity:
+    ROWS = dict(
+        alpha=[6.973, 3.2, 9.0], beta=[0.027, 0.012, 0.06],
+        gamma=[5.2, 2.4, 7.0], delta=[0.1, 0.04, 0.15],
+        in_tokens=[128.0, 128.0, 256.0], out_tokens=[128.0, 128.0, 200.0],
+        max_batch=[16, 23, 20],
+        ttft=[500.0, 500.0, 2000.0], itl=[24.0, 24.0, 80.0],
+        tps=[0.0, 0.0, 0.0],
+    )
+
+    def test_pack_matches_make_queue_batch_plus_pad(self):
+        from workload_variant_autoscaler_tpu.parallel import pad_to_multiple
+
+        r = self.ROWS
+        q_ref = make_queue_batch(r["alpha"], r["beta"], r["gamma"],
+                                 r["delta"], r["in_tokens"],
+                                 r["out_tokens"], r["max_batch"])
+        slo_ref = SLOTargets(
+            ttft=np.asarray(r["ttft"], q_ref.alpha.dtype),
+            itl=np.asarray(r["itl"], q_ref.alpha.dtype),
+            tps=np.asarray(r["tps"], q_ref.alpha.dtype))
+        q_ref, slo_ref, _ = pad_to_multiple(q_ref, slo_ref, 16)
+
+        arena = CandidateArena()
+        q, slo = arena.pack(dict(r))
+        for name in q._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(q, name)),
+                np.asarray(getattr(q_ref, name)), err_msg=name)
+            assert getattr(q, name).dtype == getattr(q_ref, name).dtype
+        for name in slo._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(slo, name)),
+                np.asarray(getattr(slo_ref, name)), err_msg=name)
+
+    def test_buffers_resident_and_stale_lanes_reset(self):
+        arena = CandidateArena()
+        arena.pack(dict(self.ROWS))
+        assert arena.slab_allocs == 1
+        # a smaller pack reuses the slab and resets the stale lanes
+        small = {k: v[:1] for k, v in self.ROWS.items()}
+        q, _slo = arena.pack(small)
+        assert arena.slab_allocs == 1  # same bucket shape -> no realloc
+        valid = np.asarray(q.valid)
+        assert valid[0] and not valid[1:].any()
+        assert float(np.asarray(q.alpha)[1]) == 1.0  # benign fill restored
+
+
+# ---------------------------------------------------------------------------
+# the randomized-churn equivalence suite
+# ---------------------------------------------------------------------------
+
+class ChurnDriver:
+    """Seeded fleet churn: grow/shrink, epsilon-straddling load jitter,
+    capacity changes, degradation-rung transitions."""
+
+    def __init__(self, seed: int, epsilon: float):
+        self.rng = random.Random(seed)
+        self.epsilon = epsilon
+        self.names = [f"v{i}:ns" for i in range(12)]
+        self.live = set(self.names[:8])
+        self.loads = {n: 300.0 + 40.0 * i
+                      for i, n in enumerate(self.names)}
+        self.capacity = {"v5e": 400, "v5p": 120}
+        self.rungs: dict[str, str] = {}
+
+    def servers(self):
+        out = []
+        for n in sorted(self.live):
+            i = int(n[1:].split(":")[0])
+            out.append(helpers.server_spec(
+                name=n,
+                model="m-b" if i % 3 == 0 else "m-a",
+                service_class="Premium" if i % 2 else "Freemium",
+                accelerator="v5e-1",
+                arrival_rpm=self.loads[n],
+                in_tokens=128, out_tokens=128,
+                num_replicas=1))
+        return out
+
+    def churn(self):
+        rng = self.rng
+        for n in rng.sample(sorted(self.live), 2):
+            # mix of bucket-crossing steps, sub-epsilon jitter that
+            # straddles bucket edges over time, zero-load transitions
+            f = rng.choice([1.0, 1.3, 0.7, 1.0 + self.epsilon / 4,
+                            1.0 - self.epsilon / 4, 0.0])
+            self.loads[n] = self.loads[n] * f if f else 0.0
+            if self.loads[n] == 0.0 and rng.random() < 0.5:
+                self.loads[n] = 200.0 + rng.randrange(10) * 37.0
+        if rng.random() < 0.15:
+            pick = rng.choice(self.names)
+            if pick in self.live and len(self.live) > 4:
+                self.live.discard(pick)
+            else:
+                self.live.add(pick)
+        if rng.random() < 0.08:
+            self.capacity = dict(self.capacity)
+            self.capacity["v5e"] = self.rng.choice([300, 400, 600])
+        if rng.random() < 0.1:
+            n = rng.choice(sorted(self.live))
+            if self.rungs.get(n):
+                self.rungs.pop(n)
+            else:
+                self.rungs[n] = "stale-cache"
+        self.rungs = {n: r for n, r in self.rungs.items()
+                      if n in self.live}
+
+
+def assert_solutions_equal(a, b, cycle):
+    assert set(a.allocations) == set(b.allocations), \
+        f"cycle {cycle}: allocated variant sets differ"
+    for name in b.allocations:
+        assert a.allocations[name] == b.allocations[name], (
+            f"cycle {cycle}, {name}:\n  incremental: "
+            f"{a.allocations[name]}\n  from-scratch: {b.allocations[name]}")
+
+
+@pytest.mark.parametrize("unlimited,policy", [
+    (True, "None"),
+    (False, "RoundRobin"),
+])
+def test_randomized_churn_equivalence(unlimited, policy):
+    """≥200 cycles of seeded churn: every cycle's incremental solution
+    must equal a from-scratch solve of the same (quantized) inputs —
+    including forced-full boundary cycles (full_every=7) and
+    degradation-rung transitions."""
+    eps = 0.05
+    driver = ChurnDriver(seed=0x17C, epsilon=eps)
+    engine = IncrementalSolveEngine(epsilon=eps, full_every=7)
+    cached_cycles = forced_full = 0
+    for cycle in range(210):
+        driver.churn()
+        servers = driver.servers()
+        cycle_rung = ("stale-cache" if driver.rungs else "healthy")
+        sol_inc, stats = run_cycle(
+            make_spec(servers, driver.capacity, unlimited, policy),
+            engine, rungs=dict(driver.rungs), cycle_rung=cycle_rung)
+        scratch = IncrementalSolveEngine(epsilon=eps, full_every=1)
+        sol_ref, _ = run_cycle(
+            make_spec(servers, driver.capacity, unlimited, policy),
+            scratch, rungs=dict(driver.rungs), cycle_rung=cycle_rung)
+        assert_solutions_equal(sol_inc, sol_ref, cycle)
+        if stats.lanes_skipped:
+            cached_cycles += 1
+        if stats.full and "forced" in stats.reason:
+            forced_full += 1
+    # the machinery must actually have engaged, or the suite proves
+    # nothing: most cycles reuse lanes, and the forced-full cadence fired
+    assert cached_cycles > 150
+    assert forced_full >= 25
+
+
+def test_steady_state_skips_every_lane():
+    """Zero churn: after the first cycle every lane is skipped — the
+    zero-load fast path included."""
+    eps = 0.02
+    engine = IncrementalSolveEngine(epsilon=eps, full_every=0)
+    servers = [
+        helpers.server_spec(name="busy:ns", model="m-a",
+                            arrival_rpm=600.0),
+        helpers.server_spec(name="idle:ns", model="m-a", arrival_rpm=0.0),
+    ]
+    _sol, first = run_cycle(make_spec(servers, {}), engine)
+    assert first.full and first.lanes_solved > 0
+    for _ in range(3):
+        _sol, stats = run_cycle(make_spec(servers, {}), engine)
+        assert not stats.full
+        assert stats.lanes_solved == 0
+        assert stats.lanes_skipped == first.lanes_solved
+        assert stats.modes == {SOLVE_INCREMENTAL: 0, SOLVE_CACHED: 2}
+
+
+def test_sub_epsilon_jitter_reads_as_unchanged():
+    eps = 0.05
+    engine = IncrementalSolveEngine(epsilon=eps, full_every=0)
+    base = 600.0
+    servers = [helpers.server_spec(name="v:ns", model="m-a",
+                                   arrival_rpm=base)]
+    run_cycle(make_spec(servers, {}), engine)
+    # jitter well inside the bucket: same quantized inputs, lane skipped
+    jittered = [helpers.server_spec(name="v:ns", model="m-a",
+                                    arrival_rpm=base * 1.001)]
+    _sol, stats = run_cycle(make_spec(jittered, {}), engine)
+    assert stats.lanes_solved == 0 and stats.lanes_skipped > 0
+    # a 30% step crosses buckets: re-solved
+    stepped = [helpers.server_spec(name="v:ns", model="m-a",
+                                   arrival_rpm=base * 1.3)]
+    _sol, stats = run_cycle(make_spec(stepped, {}), engine)
+    assert stats.lanes_solved > 0
+    assert stats.modes[SOLVE_INCREMENTAL] == 1
+
+
+def test_full_every_zero_disables_forced_full():
+    engine = IncrementalSolveEngine(epsilon=0.02, full_every=0)
+    servers = [helpers.server_spec(name="v:ns", model="m-a",
+                                   arrival_rpm=600.0)]
+    run_cycle(make_spec(servers, {}), engine)
+    for _ in range(5):
+        _sol, stats = run_cycle(make_spec(servers, {}), engine)
+        assert not stats.full
+
+
+# ---------------------------------------------------------------------------
+# reconciler integration: solve_mode on records, metrics, the off switch
+# ---------------------------------------------------------------------------
+
+import json  # noqa: E402
+
+from workload_variant_autoscaler_tpu.collector import (  # noqa: E402
+    FakePromAPI,
+    arrival_rate_query,
+    avg_generation_tokens_query,
+    avg_itl_query,
+    avg_prompt_tokens_query,
+    avg_ttft_query,
+    true_arrival_rate_query,
+)
+from workload_variant_autoscaler_tpu.controller import (  # noqa: E402
+    ACCELERATOR_CM_NAME,
+    CONFIG_MAP_NAME,
+    CONFIG_MAP_NAMESPACE,
+    SERVICE_CLASS_CM_NAME,
+    ConfigMap,
+    Deployment,
+    InMemoryKube,
+    Reconciler,
+    crd,
+)
+from workload_variant_autoscaler_tpu.metrics import MetricsEmitter  # noqa: E402
+from workload_variant_autoscaler_tpu.obs.decision import explain_text  # noqa: E402
+
+NS = "default"
+
+
+def make_cluster(models=("llama-8b",), extra_cm=None):
+    kube = InMemoryKube()
+    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
+                                 {"GLOBAL_OPT_INTERVAL": "60s",
+                                  **(extra_cm or {})}))
+    kube.put_configmap(ConfigMap(
+        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"v5e-1": json.dumps({"chip": "v5e", "chips": "1",
+                              "cost": "20.0"})}))
+    slos = "\n".join(f"  - model: {m}\n    slo-tpot: 24\n    slo-ttft: 500"
+                     for m in models)
+    kube.put_configmap(ConfigMap(
+        SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"premium": f"name: Premium\npriority: 1\ndata:\n{slos}\n"}))
+    for i, m in enumerate(models):
+        name = f"chat-{i}"
+        kube.put_deployment(Deployment(name=name, namespace=NS,
+                                       spec_replicas=1, status_replicas=1))
+        kube.put_variant_autoscaling(crd.VariantAutoscaling(
+            metadata=crd.ObjectMeta(name=name, namespace=NS,
+                                    labels={crd.ACCELERATOR_LABEL: "v5e-1"}),
+            spec=crd.VariantAutoscalingSpec(
+                model_id=m,
+                slo_class_ref=crd.ConfigMapKeyRef(
+                    name=SERVICE_CLASS_CM_NAME, key="premium"),
+                model_profile=crd.ModelProfile(accelerators=[
+                    crd.AcceleratorProfile(
+                        acc="v5e-1", acc_count=1,
+                        perf_parms=crd.PerfParms(
+                            decode_parms={"alpha": "6.973", "beta": "0.027"},
+                            prefill_parms={"gamma": "5.2", "delta": "0.1"}),
+                        max_batch_size=64),
+                ]),
+            )))
+    prom = FakePromAPI()
+    emitter = MetricsEmitter()
+    rec = Reconciler(kube=kube, prom=prom, emitter=emitter,
+                     sleep=lambda _s: None)
+    return kube, prom, emitter, rec
+
+
+def set_load(prom, model, rps, in_tok=128.0, out_tok=128.0):
+    prom.set_result(true_arrival_rate_query(model, NS), rps)
+    prom.set_result(arrival_rate_query(model, NS), rps)
+    prom.set_result(avg_prompt_tokens_query(model, NS), in_tok)
+    prom.set_result(avg_generation_tokens_query(model, NS), out_tok)
+    prom.set_result(avg_ttft_query(model, NS), 0.05)
+    prom.set_result(avg_itl_query(model, NS), 0.009)
+
+
+class TestReconcilerIntegration:
+    def test_solve_mode_on_decision_records_and_series(self):
+        _kube, prom, emitter, rec = make_cluster(("llama-8b", "llama-8x"))
+        set_load(prom, "llama-8b", 40.0)
+        set_load(prom, "llama-8x", 25.0)
+        rec.reconcile()
+        recs = {r.variant: r for r in rec.decisions.records()}
+        assert recs["chat-0"].inputs.solve_mode == SOLVE_FULL
+        assert recs["chat-1"].inputs.solve_mode == SOLVE_FULL
+        assert "solve path: full" in explain_text(recs["chat-0"])
+
+        # steady state: both variants cached, zero lanes solved
+        rec.reconcile()
+        recs = {r.variant: r for r in rec.decisions.records(limit=2)}
+        assert recs["chat-0"].inputs.solve_mode == SOLVE_CACHED
+        assert emitter.value("inferno_solve_lanes", state="solved") == 0
+        assert emitter.value("inferno_solve_lanes", state="skipped") >= 2
+
+        # one model's load steps: exactly that variant re-solves
+        set_load(prom, "llama-8x", 90.0)
+        rec.reconcile()
+        recs = {r.variant: r for r in rec.decisions.records(limit=2)}
+        assert recs["chat-0"].inputs.solve_mode == SOLVE_CACHED
+        assert recs["chat-1"].inputs.solve_mode == SOLVE_INCREMENTAL
+        assert emitter.value("inferno_solve_mode_total",
+                             mode="cached") >= 1
+        assert emitter.value("inferno_solve_mode_total",
+                             mode="incremental") >= 1
+
+    def test_off_switch_restores_legacy_full_solves(self, monkeypatch):
+        monkeypatch.setenv("WVA_INCREMENTAL_SOLVE", "off")
+        _kube, prom, emitter, rec = make_cluster()
+        set_load(prom, "llama-8b", 40.0)
+        rec.reconcile()
+        rec.reconcile()
+        assert rec._solve_engine_obj is None
+        rec_last = rec.decisions.records(limit=1)[0]
+        assert rec_last.inputs.solve_mode == SOLVE_FULL
+        # every cycle solves every lane
+        assert emitter.value("inferno_solve_lanes", state="solved") >= 1
+        assert emitter.value("inferno_solve_lanes", state="skipped") == 0
+
+    def test_on_off_publish_identical_counts(self, monkeypatch):
+        """The quantized incremental path and the legacy path agree on
+        the published counts for steady loads (epsilon is inside the
+        sizing's ceil() slack at these operating points)."""
+        outcomes = {}
+        for mode in ("on", "off"):
+            monkeypatch.setenv("WVA_INCREMENTAL_SOLVE", mode)
+            kube, prom, _em, rec = make_cluster()
+            set_load(prom, "llama-8b", 40.0)
+            rec.reconcile()
+            set_load(prom, "llama-8b", 90.0)
+            rec.reconcile()
+            va = kube.get_variant_autoscaling("chat-0", NS)
+            outcomes[mode] = va.status.desired_optimized_alloc.num_replicas
+        assert outcomes["on"] == outcomes["off"]
+
+    def test_knob_change_rebuilds_engine(self, monkeypatch):
+        _kube, prom, _em, rec = make_cluster()
+        set_load(prom, "llama-8b", 40.0)
+        rec.reconcile()
+        first = rec._solve_engine_obj
+        assert first is not None and first.epsilon == 0.02
+        monkeypatch.setenv("WVA_SOLVE_EPSILON", "0.1")
+        rec.reconcile()
+        assert rec._solve_engine_obj is not first
+        assert rec._solve_engine_obj.epsilon == 0.1
+
+
+def test_mode_labels_cover_all_variants():
+    engine = IncrementalSolveEngine(epsilon=0.05, full_every=0)
+    servers = [
+        helpers.server_spec(name="a:ns", model="m-a", arrival_rpm=600.0),
+        helpers.server_spec(name="b:ns", model="m-a", arrival_rpm=900.0),
+    ]
+    run_cycle(make_spec(servers, {}), engine)
+    assert set(engine.solve_modes.values()) == {SOLVE_FULL}
+    changed = [
+        helpers.server_spec(name="a:ns", model="m-a", arrival_rpm=600.0),
+        helpers.server_spec(name="b:ns", model="m-a", arrival_rpm=1400.0),
+    ]
+    run_cycle(make_spec(changed, {}), engine)
+    assert engine.solve_modes == {"a:ns": SOLVE_CACHED,
+                                  "b:ns": SOLVE_INCREMENTAL}

@@ -674,6 +674,88 @@ class TestThreadSharedState:
         codes = lint(self.RECONCILER_SHAPES)
         assert "WVL402" not in codes and "WVL401" not in codes
 
+    # resident arena/cache objects (PR 5): shared-across-cycles state
+    # held in a self attribute of a SAME-FILE class, reached through
+    # `self.<attr>.<method>()` from a fanout'd callable
+    ARENA_SHAPE = (
+        "import threading\n"
+        "def fanout(tasks, workers=8, label=''):\n"
+        "    return [(t(), None) for t in tasks]\n"
+        "class Arena:\n"
+        "    def __init__(self):\n"
+        "        self._slabs = {}\n"
+        "        self.packs = 0\n"
+        "    def pack(self, rows):\n"
+        "        b = len(rows)\n"
+        "        if b not in self._slabs:\n"
+        "            self._slabs[b] = [0.0] * b\n"
+        "        self.packs += 1\n"
+        "        return self._slabs[b]\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self.arena = Arena()\n"
+        "    def solve_all(self, groups):\n"
+        "        return fanout(\n"
+        "            [lambda g=g: self.arena.pack(g) for g in groups],\n"
+        "            workers=4, label='solve')\n")
+
+    def test_unlocked_arena_mutation_from_fanout_fires(self):
+        # the positive fixture the arena docstrings promise: an arena
+        # mutated through self.arena.pack() from a fanned-out callable
+        # is a data race, and WVL402 follows the attribute call into
+        # the same-file class to see it
+        out = lint(self.ARENA_SHAPE)
+        assert "WVL402" in out
+
+    def test_locked_arena_mutation_from_fanout_passes(self):
+        locked = self.ARENA_SHAPE.replace(
+            "    def __init__(self):\n"
+            "        self._slabs = {}\n"
+            "        self.packs = 0\n",
+            "    def __init__(self):\n"
+            "        self._slabs = {}\n"
+            "        self.packs = 0\n"
+            "        self._lock = threading.Lock()\n",
+        ).replace(
+            "    def pack(self, rows):\n"
+            "        b = len(rows)\n"
+            "        if b not in self._slabs:\n"
+            "            self._slabs[b] = [0.0] * b\n"
+            "        self.packs += 1\n"
+            "        return self._slabs[b]\n",
+            "    def pack(self, rows):\n"
+            "        with self._lock:\n"
+            "            b = len(rows)\n"
+            "            if b not in self._slabs:\n"
+            "                self._slabs[b] = [0.0] * b\n"
+            "            self.packs += 1\n"
+            "            return self._slabs[b]\n",
+        )
+        assert "WVL402" not in lint(locked)
+
+    def test_arena_on_reconcile_loop_only_passes(self):
+        # the REAL shape: the engine/arena is touched only from the
+        # single-threaded reconcile loop; the fanout'd writers never
+        # reach it — no finding
+        src = (
+            "def fanout(tasks, workers=8, label=''):\n"
+            "    return [(t(), None) for t in tasks]\n"
+            "class Arena:\n"
+            "    def __init__(self):\n"
+            "        self._slabs = {}\n"
+            "    def pack(self, rows):\n"
+            "        self._slabs[len(rows)] = rows\n"
+            "        return rows\n"
+            "class Reconciler:\n"
+            "    def __init__(self):\n"
+            "        self.arena = Arena()\n"
+            "    def reconcile(self, groups, statuses):\n"
+            "        packed = [self.arena.pack(g) for g in groups]\n"
+            "        fanout([lambda s=s: s for s in statuses],\n"
+            "               workers=4, label='status')\n"
+            "        return packed\n")
+        assert "WVL402" not in lint(src)
+
     def test_reconciler_shape_with_unlocked_probe_fires(self):
         # the pre-fix _probe_client: lazy init with no lock
         bad = self.RECONCILER_SHAPES.replace(

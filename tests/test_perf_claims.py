@@ -156,6 +156,37 @@ def test_fleet_collection_claims_match_artifact():
         "observability.md's query-count claim drifted from the artifact"
 
 
+def test_incremental_solve_claims_match_artifact():
+    """Round-7 incremental steady-state solve: the committed bench
+    artifact must (a) justify the claims — at a 512-variant fleet with
+    1% churn/cycle, the incremental engine solves >= 10x fewer kernel
+    lanes per cycle AND measures a cycle wall-time reduction vs
+    `WVA_INCREMENTAL_SOLVE=off` — and (b) be internally consistent
+    (every lane is either solved or served from the signature cache)."""
+    art = _artifact("BENCH_solve_r07.json")
+    assert art["scenario"] == "solve-churn"
+    assert art["n_variants"] == 512
+    assert art["churn_per_cycle"] == 5    # 1% of the fleet
+    assert art["vs_baseline"] >= 10.0, \
+        "artifact no longer justifies the >=10x fewer-lanes claim"
+    inc, full = art["incremental"], art["full"]
+    # lane ledger consistency: the skipped lanes are exactly the fleet
+    # minus the churned sub-batch, and the full path never skips
+    assert inc["lanes_solved_per_cycle"] + inc["lanes_skipped_per_cycle"] \
+        == full["lanes_solved_per_cycle"]
+    assert full["lanes_skipped_per_cycle"] == 0.0
+    # the measured wall-time reduction (cycle AND the analyze+optimize
+    # stages the engine actually touches)
+    assert art["wall_speedup_p50"] > 1.0, \
+        "artifact no longer shows a cycle wall-time reduction"
+    assert inc["cycle_wall_ms_p50"] < full["cycle_wall_ms_p50"]
+    assert art["analyze_optimize_speedup_p50"] >= 2.0
+    doc = (REPO / "docs" / "observability.md").read_text()
+    flat = " ".join(doc.split())
+    assert f"**{art['vs_baseline']}×**" in flat, \
+        "observability.md's incremental-solve lane claim drifted"
+
+
 def test_capstone_claims_match_baseline_json():
     """Round-5 whole-fleet capstone: every quoted tail and the headline
     must equal the committed BASELINE.json entry, and the entry itself

@@ -42,6 +42,45 @@ class TestRegistry:
         assert system.model("llama-8b").num_instances("v5e-1") == 1
         assert system.model("llama-8b").num_instances("v5e-16") == 0  # no profile
 
+    def test_reingestion_replaces_instead_of_merging(self):
+        """A System that persists across reconcile cycles must describe
+        exactly the spec it was LAST given: re-ingesting a smaller spec
+        drops entities deleted from it (servers, capacity entries) and
+        clears derived solve state — the old dict-merge behavior kept
+        them alive forever."""
+        from workload_variant_autoscaler_tpu.models.spec import (
+            OptimizerSpec,
+            SystemSpec,
+        )
+
+        import helpers
+
+        system, _ = make_system(
+            servers=[server_spec(name="a:ns"), server_spec(name="b:ns")],
+            capacity={"v5e": 100, "v5p": 40})
+        system.calculate(backend="batched")
+        system.generate_solution()
+        assert system.servers["a:ns"].all_allocations
+        assert system.allocation_solution is not None
+
+        smaller = SystemSpec(
+            accelerators=[make_slice("v5e", 1, "1x1")],
+            profiles=[p for p in helpers.PROFILES
+                      if p.accelerator == "v5e-1"],
+            service_classes=list(helpers.SERVICE_CLASSES),
+            servers=[server_spec(name="b:ns")],
+            capacity={"v5e": 64},
+            optimizer=OptimizerSpec(unlimited=True),
+        )
+        system.set_from_spec(smaller)
+        assert set(system.servers) == {"b:ns"}          # a:ns deleted
+        assert set(system.accelerators) == {"v5e-1"}    # catalog replaced
+        assert system.capacity == {"v5e": 64}           # no stale v5p merge
+        # derived solve state cleared with the registries
+        assert system.allocation_solution is None
+        assert system.allocation_by_type == {}
+        assert system.servers["b:ns"].all_allocations == {}
+
 
 class TestPowerModel:
     def test_piecewise_linear(self):

@@ -66,7 +66,10 @@ Rules (suppress per-line with `# noqa` or `# noqa: WVLxxx`):
           mutated, without a lock in scope, inside code reachable from a
           callable handed to `utils.concurrency.fanout()` or
           `threading.Thread(target=...)` (same-file reachability:
-          lambdas, nested defs, same-class methods, module functions)
+          lambdas, nested defs, same-class methods, module functions,
+          and methods of same-file-class instances held in self
+          attributes — the resident arena/cache objects that persist
+          across reconcile cycles, e.g. `self.arena.pack()`)
   WVL403  self-deadlock: acquiring a class's non-reentrant lock (a
           nested `with self._lock:` or a call to a method that takes it)
           while already holding that same lock
@@ -1263,12 +1266,61 @@ def _check_thread_shared_state(path: str,
     in scope, in code reachable from a callable handed to `fanout()` or
     `threading.Thread(target=...)`. Reachability is same-file and
     conservative: inline lambdas, nested defs, same-class methods
-    (self.m()), and module-level functions; calls through imports,
-    attributes of other objects, or dynamic dispatch are pruned."""
+    (self.m()), module-level functions, and methods of same-file-class
+    instances held in self attributes (`self.arena.pack()` where
+    `self.arena = CandidateArena()` — the resident arena/cache objects
+    that persist across reconcile cycles); calls through imports,
+    attributes of unknown objects, or dynamic dispatch are pruned."""
     module_funcs = {n.name: n for n in tree.body
                     if isinstance(n, (ast.FunctionDef,
                                       ast.AsyncFunctionDef))}
+    module_classes = {n.name: n for n in tree.body
+                     if isinstance(n, ast.ClassDef)}
     module_names = _module_bindings(tree)
+
+    def class_attr_types(cls_node) -> dict:
+        """self attrs holding instances of same-file classes
+        (`self.arena = CandidateArena()` anywhere in the class) — the
+        persistent arena/cache objects whose methods a thread-reachable
+        callable may invoke through `self.<attr>.<method>()`."""
+        if cls_node is None:
+            return {}
+        out: dict = {}
+        stack = list(cls_node.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.ClassDef):
+                continue
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                owner = (module_classes.get(node.value.func.id)
+                         if isinstance(node.value.func, ast.Name) else None)
+                if owner is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            out.setdefault(t.attr, owner)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def attr_method(cls_node, func_node):
+        """`self.<attr>.<m>` -> (method def, owning class) when <attr>
+        is a same-file-class instance of the owner class and <m> one of
+        its methods; else (None, None)."""
+        if not isinstance(func_node, ast.Attribute):
+            return None, None
+        base = _self_attr_base(func_node.value)
+        if base is None:
+            return None, None
+        owner = class_attr_types(cls_node).get(base)
+        if owner is None:
+            return None, None
+        for m in owner.body:
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and m.name == func_node.attr:
+                return m, owner
+        return None, None
 
     # entry points: (callable node, owner class node or None, origin line)
     entries: list[tuple] = []
@@ -1287,23 +1339,29 @@ def _check_thread_shared_state(path: str,
         return out
 
     def resolve_callable(node, cls, fn_stack):
-        """A task expression -> callable def node, or None."""
+        """A task expression -> (callable def node, owner class), or
+        (None, None)."""
         if isinstance(node, ast.Lambda):
-            return node
+            return node, cls
         if isinstance(node, ast.Name):
             for fn in reversed(fn_stack):
                 hit = nested_defs(fn).get(node.id)
                 if hit is not None:
-                    return hit
-            return module_funcs.get(node.id)
+                    return hit, cls
+            return module_funcs.get(node.id), cls
         if isinstance(node, ast.Attribute) and \
                 isinstance(node.value, ast.Name) and \
                 node.value.id == "self" and cls is not None:
             for m in cls.body:
                 if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                         and m.name == node.attr:
-                    return m
-        return None
+                    return m, cls
+        # `self.<attr>.<m>` where <attr> is a same-file-class instance
+        # (a resident arena/cache object) — follow into that class
+        m, owner = attr_method(cls, node)
+        if m is not None:
+            return m, owner
+        return None, None
 
     def collect_entries(node, cls, fn_stack):
         for child in ast.iter_child_nodes(node):
@@ -1322,15 +1380,17 @@ def _check_thread_shared_state(path: str,
                     elif isinstance(tasks, (ast.ListComp, ast.GeneratorExp)):
                         elts = [tasks.elt]
                     for e in elts:
-                        target = resolve_callable(e, cls, fn_stack)
+                        target, owner = resolve_callable(e, cls, fn_stack)
                         if target is not None:
-                            entries.append((target, cls, child.lineno))
+                            entries.append((target, owner, child.lineno))
                 elif tail == "Thread":
                     for kw in child.keywords:
                         if kw.arg == "target":
-                            target = resolve_callable(kw.value, cls, fn_stack)
+                            target, owner = resolve_callable(
+                                kw.value, cls, fn_stack)
                             if target is not None:
-                                entries.append((target, cls, child.lineno))
+                                entries.append((target, owner,
+                                                child.lineno))
             collect_entries(child, child_cls, child_stack)
 
     collect_entries(tree, None, [])
@@ -1377,7 +1437,7 @@ def _check_thread_shared_state(path: str,
             if isinstance(node, ast.ClassDef):
                 continue
             if isinstance(node, ast.Call):
-                callee = None
+                callee, callee_cls = None, cls
                 if isinstance(node.func, ast.Name):
                     callee = (own_nested.get(node.func.id)
                               or module_funcs.get(node.func.id))
@@ -1390,8 +1450,16 @@ def _check_thread_shared_state(path: str,
                                 and m.name == node.func.attr:
                             callee = m
                             break
+                elif isinstance(node.func, ast.Attribute):
+                    # self.<attr>.<m>(): a method on a persistent
+                    # same-file-class instance (resident arena /
+                    # signature cache) — its self-state is shared
+                    # through the owning object, so follow into it
+                    callee, owner = attr_method(cls, node.func)
+                    if callee is not None:
+                        callee_cls = owner
                 if callee is not None:
-                    work.append((callee, cls, origin))
+                    work.append((callee, callee_cls, origin))
             stack.extend(ast.iter_child_nodes(node))
     return findings
 

@@ -17,7 +17,7 @@ import os
 import random
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as dc_replace
 from typing import Optional
 
 from ..actuator import Actuator
@@ -58,7 +58,13 @@ from ..obs import (
     Tracer,
 )
 from ..obs import trace as obs_trace
-from ..solver import Manager, Optimizer
+from ..solver import (
+    SOLVE_FULL,
+    IncrementalSolveEngine,
+    Manager,
+    Optimizer,
+)
+from ..solver.incremental import DEFAULT_EPSILON, DEFAULT_FULL_EVERY
 from ..utils import (
     CIRCUIT_OPEN,
     STANDARD_BACKOFF,
@@ -197,6 +203,11 @@ class Reconciler:
         # object this cycle read/wrote, so _emit_conditions needs no
         # extra LIST; None = legacy mode (post-publish LIST)
         self._cycle_condition_vas: Optional[dict] = None
+        # incremental solve engine (solver/incremental.py): persists the
+        # signature cache / resident arena / warm-start seed across
+        # cycles; (re)built lazily from the WVA_SOLVE_* knobs and
+        # dropped when WVA_INCREMENTAL_SOLVE turns off
+        self._solve_engine_obj: Optional[IncrementalSolveEngine] = None
 
     # -- fleet-scale collection knobs -------------------------------------
 
@@ -216,6 +227,45 @@ class Reconciler:
         per-variant calls (status writes, owner-ref patches, TPU-util
         probes). 1 = fully sequential (strict-determinism hatch)."""
         return fanout_workers(self._last_operator_cm)
+
+    # -- incremental solve knobs ------------------------------------------
+
+    def _solve_knob(self, key: str, operator_cm=None) -> str:
+        return (os.environ.get(key)
+                or (operator_cm if operator_cm is not None
+                    else self._last_operator_cm).get(key)
+                or "")
+
+    def _incremental_solve_enabled(self, operator_cm=None) -> bool:
+        """WVA_INCREMENTAL_SOLVE: signature-gated steady-state solving
+        (default on). `off` restores the legacy full re-solve path
+        byte-for-byte — env first, then the operator ConfigMap."""
+        raw = self._solve_knob("WVA_INCREMENTAL_SOLVE", operator_cm)
+        return raw.strip().lower() not in ("off", "false", "0", "disabled")
+
+    def _solve_engine(self, operator_cm=None) -> Optional[IncrementalSolveEngine]:
+        """The cycle's incremental solve engine, or None when disabled.
+        A knob change (epsilon / forced-full cadence) rebuilds the
+        engine — the next cycle runs full, which is exactly what a
+        changed quantization requires."""
+        if not self._incremental_solve_enabled(operator_cm):
+            self._solve_engine_obj = None
+            return None
+        epsilon = parse_float_or(
+            self._solve_knob("WVA_SOLVE_EPSILON", operator_cm),
+            DEFAULT_EPSILON)
+        full_every = int(parse_float_or(
+            self._solve_knob("WVA_SOLVE_FULL_EVERY", operator_cm),
+            DEFAULT_FULL_EVERY))
+        if epsilon < 0:
+            epsilon = DEFAULT_EPSILON
+        engine = self._solve_engine_obj
+        if engine is None or engine.epsilon != epsilon \
+                or engine.full_every != max(full_every, 0):
+            engine = IncrementalSolveEngine(epsilon=epsilon,
+                                            full_every=full_every)
+            self._solve_engine_obj = engine
+        return engine
 
     # -- hardened dependency calls ----------------------------------------
 
@@ -501,14 +551,39 @@ class Reconciler:
             return result
 
         # analyze: ONE batched kernel call across all candidates (JAX by
-        # default; the C++ kernel under WVA_NATIVE_KERNEL)
+        # default; the C++ kernel under WVA_NATIVE_KERNEL). With the
+        # incremental engine (WVA_INCREMENTAL_SOLVE, default on) only the
+        # signature-changed sub-batch is solved; unchanged variants reuse
+        # cached allocations and skip their kernel lanes entirely.
         system = System()
         optimizer_spec = system.set_from_spec(system_spec)
         engine_backend = translate.engine_backend()
         ttft_percentile = translate.ttft_percentile(operator_cm)
-        system.calculate(backend=engine_backend,
-                         mesh=translate.engine_mesh(engine_backend),
-                         ttft_percentile=ttft_percentile)
+        engine_mesh = translate.engine_mesh(engine_backend)
+        solve_engine = self._solve_engine(operator_cm)
+        if solve_engine is not None:
+            stats = solve_engine.calculate(
+                system, backend=engine_backend, mesh=engine_mesh,
+                ttft_percentile=ttft_percentile,
+                optimizer_spec=optimizer_spec,
+                rungs=dict(result.degraded),
+                cycle_rung=self._degradation.cycle_state().label)
+            solve_modes = solve_engine.solve_modes
+            self.emitter.emit_solve_metrics(
+                stats.modes, stats.lanes_solved, stats.lanes_skipped)
+        else:
+            system.calculate(backend=engine_backend, mesh=engine_mesh,
+                             ttft_percentile=ttft_percentile)
+            solve_modes = dict.fromkeys(system.servers, SOLVE_FULL)
+            self.emitter.emit_solve_metrics(
+                {SOLVE_FULL: len(system.servers)},
+                system.last_solve_lanes, 0)
+        # stamp how each variant's sizing was produced onto its
+        # DecisionRecord-in-progress (rendered by `controller explain`)
+        for key, builder in self._cycle_builders.items():
+            mode = solve_modes.get(key)
+            if mode:
+                builder.inputs = dc_replace(builder.inputs, solve_mode=mode)
         mark(STAGE_ANALYZE)
 
         # optimize (the stage mark is in a finally: a slow FAILING solve is
@@ -517,14 +592,19 @@ class Reconciler:
             try:
                 optimizer = Optimizer(optimizer_spec)
                 manager = Manager(system, optimizer)
-                manager.optimize()
+                manager.optimize(warm=(solve_engine.warm_start()
+                                       if solve_engine is not None else None))
                 self.emitter.emit_solution_time(optimizer.solution_time_msec)
                 solution = system.generate_solution()
                 if not solution.allocations:
                     raise RuntimeError("no feasible allocations found for any variant")
+                if solve_engine is not None:
+                    solve_engine.finish_cycle(system)
             finally:
                 mark(STAGE_OPTIMIZE)
         except Exception as e:  # noqa: BLE001
+            if solve_engine is not None:
+                solve_engine.note_failure()
             log.error("optimization failed, retrying next cycle", extra=kv(error=str(e)))
             result.error = str(e)
             # conditions published, no new allocation: the LIMITED rung

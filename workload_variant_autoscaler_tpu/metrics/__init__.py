@@ -142,10 +142,19 @@ INFERNO_DEPENDENCY_RETRIES_TOTAL = "inferno_dependency_retries_total"
 # the escape hatch engaged; a repair rate near V is grouped demux rot)
 INFERNO_COLLECTION_QUERIES_TOTAL = "inferno_collection_queries_total"
 INFERNO_COLLECTION_SECONDS = "inferno_collection_seconds"
+# incremental solve (solver/incremental.py): how each variant's sizing
+# was produced this cycle (full / incremental / cached) and how many
+# kernel lanes the analyze step actually solved vs skipped — the series
+# that PROVE steady-state analyze+optimize is O(changed-variants)
+INFERNO_SOLVE_MODE_TOTAL = "inferno_solve_mode_total"
+INFERNO_SOLVE_LANES = "inferno_solve_lanes"
 
 LABEL_DEPENDENCY = "dependency"
 LABEL_OUTCOME = "outcome"
 LABEL_MODE = "mode"
+LABEL_STATE = "state"
+STATE_SOLVED = "solved"
+STATE_SKIPPED = "skipped"
 
 LABEL_CONDITION_TYPE = "type"
 
@@ -349,6 +358,22 @@ class MetricsEmitter:
             "(grouped prefetch + per-variant demux/repair)",
             buckets=_STAGE_BUCKETS, registry=self.registry,
         )
+        # incremental solve telemetry (solver/incremental.py): variants
+        # per solve path, and the last cycle's kernel-lane ledger
+        self.solve_mode_total = Counter(
+            INFERNO_SOLVE_MODE_TOTAL.removesuffix("_total"),
+            "Variants sized per solve path each cycle (full: every lane "
+            "re-solved; incremental: changed signature, lanes re-solved; "
+            "cached: unchanged signature, cached allocations reused)",
+            [LABEL_MODE], registry=self.registry,
+        )
+        self.solve_lanes = Gauge(
+            INFERNO_SOLVE_LANES,
+            "Candidate kernel lanes of the last analyze step "
+            "(solved: dispatched to the sizing kernel or the zero-load "
+            "fast path; skipped: reused from the signature cache)",
+            [LABEL_STATE], registry=self.registry,
+        )
         # perf-model drift (beyond-reference: the reference never compares
         # its scraped latencies against its own queueing model)
         self.model_drift = Gauge(
@@ -386,6 +411,21 @@ class MetricsEmitter:
                 self.collection_queries.labels(
                     **{LABEL_MODE: mode}).inc(count)
         self.collection_seconds.observe(seconds)
+
+    def emit_solve_metrics(self, modes: dict[str, int],
+                           lanes_solved: int, lanes_skipped: int) -> None:
+        """One cycle's incremental-solve telemetry: per-mode variant
+        counts (zero counts skipped — a mode's series appears once that
+        path has ever run) and the lane ledger gauges."""
+        with self._lock:
+            for mode, count in modes.items():
+                if count > 0:
+                    self.solve_mode_total.labels(
+                        **{LABEL_MODE: mode}).inc(count)
+            self.solve_lanes.labels(
+                **{LABEL_STATE: STATE_SOLVED}).set(lanes_solved)
+            self.solve_lanes.labels(
+                **{LABEL_STATE: STATE_SKIPPED}).set(lanes_skipped)
 
     def emit_power_metrics(
         self, per_variant: dict[tuple[str, str, str], float]

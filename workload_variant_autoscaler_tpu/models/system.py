@@ -71,10 +71,34 @@ class System:
         self.capacity: dict[str, int] = {}  # chip generation -> chips
         self.allocation_by_type: dict[str, AllocationByType] = {}
         self.allocation_solution: Optional[AllocationSolution] = None
+        # optional resident packing buffers (ops/arena.py), attached by
+        # the incremental solve engine so steady-state cycles scatter
+        # only changed lanes instead of re-packing the whole fleet
+        self.arena = None
+        # candidate lanes examined by the LAST calculate() call (kernel
+        # lanes + zero-load fast-path allocations) — the number the
+        # incremental engine's skip telemetry is measured against
+        self.last_solve_lanes = 0
 
     # -- spec ingestion (reference system.go:82-175) --------------------
 
     def set_from_spec(self, spec: SystemSpec) -> OptimizerSpec:
+        """Ingest a SystemSpec, REPLACING any previously ingested state.
+
+        Re-ingestion semantics are explicit: a System that persists
+        across reconcile cycles must describe exactly the spec it was
+        last given — entities deleted from the spec disappear here too,
+        instead of silently surviving a dict merge (the old behavior:
+        `capacity.update` and re-adds on pre-populated registries).
+        Derived solve state (candidate allocations, the solution) is
+        cleared with it."""
+        self.accelerators = {}
+        self.models = {}
+        self.service_classes = {}
+        self.servers = {}
+        self.capacity = {}
+        self.allocation_by_type = {}
+        self.allocation_solution = None
         for acc in spec.accelerators:
             self.add_accelerator(acc)
         for profile in spec.profiles:
@@ -128,7 +152,8 @@ class System:
     # -- candidate analysis --------------------------------------------
 
     def calculate(self, backend: str = "batched", mesh=None,
-                  ttft_percentile: float | None = None) -> None:
+                  ttft_percentile: float | None = None,
+                  only: Optional[set] = None) -> None:
         """Compute candidate allocations for every server.
 
         backend="batched": gather all (server, slice) candidates and solve
@@ -152,30 +177,41 @@ class System:
         TTFT distribution instead of its mean — supported by ALL
         backends (ops.batched.size_batch_tail / pallas tail kernel /
         native wva_size_tail / the scalar QueueAnalyzer tail search).
+        only: restrict candidate computation to these server names,
+        leaving every other server's all_allocations untouched — the
+        incremental engine (solver/incremental.py) restores cached
+        allocations for unchanged variants and sizes only the changed
+        sub-batch through here.
         """
+        self.last_solve_lanes = 0
         for acc in self.accelerators.values():
             acc.calculate()
         if backend == "scalar":
             if mesh is not None:
                 raise ValueError("mesh sharding requires backend='batched'")
             for server in self.servers.values():
+                if only is not None and server.name not in only:
+                    continue
                 server.calculate(self, ttft_percentile=ttft_percentile)
+                self.last_solve_lanes += len(server.all_allocations)
             return
         if backend == "native":
             if mesh is not None:
                 raise ValueError("mesh sharding requires backend='batched'")
-            self._calculate_native(ttft_percentile=ttft_percentile)
+            self._calculate_native(ttft_percentile=ttft_percentile, only=only)
             return
         if backend == "pallas" and mesh is not None:
             raise ValueError("mesh sharding requires backend='batched'")
         self._calculate_batched(mesh=mesh, ttft_percentile=ttft_percentile,
-                                use_pallas=(backend == "pallas"))
+                                use_pallas=(backend == "pallas"), only=only)
 
-    def _candidate_pairs(self):
+    def _candidate_pairs(self, only: Optional[set] = None):
         """Feasible (server, acc) candidates with resolved profile/target;
         mirrors the lookup guards of allocation.go:42-75."""
         sized_pairs = []   # need a kernel solve
         for server in self.servers.values():
+            if only is not None and server.name not in only:
+                continue
             server.all_allocations = {}
             load = server.load
             if load is None or load.arrival_rate < 0 or load.avg_in_tokens < 0 \
@@ -195,6 +231,7 @@ class System:
                 if profile is None:
                     continue
                 if load.arrival_rate == 0 or load.avg_out_tokens == 0:
+                    self.last_solve_lanes += 1
                     alloc = zero_load_allocation(self, server.name, acc_name)
                     if alloc is not None:
                         self._value_and_store(server, acc_name, alloc)
@@ -202,6 +239,7 @@ class System:
                 # context-resolved coefficients (long context is a profile
                 # dimension; see spec.resolve_for_context)
                 profile = resolve_for_context(profile, load.avg_in_tokens)
+                self.last_solve_lanes += 1
                 sized_pairs.append((server, acc_name, profile, target))
         return sized_pairs
 
@@ -212,8 +250,9 @@ class System:
 
     def _calculate_batched(self, mesh=None,
                            ttft_percentile: float | None = None,
-                           use_pallas: bool = False) -> None:
-        pairs = self._candidate_pairs()
+                           use_pallas: bool = False,
+                           only: Optional[set] = None) -> None:
+        pairs = self._candidate_pairs(only=only)
         if not pairs:
             return
 
@@ -252,24 +291,36 @@ class System:
             itls.append(target.slo_itl)
             tpss.append(target.slo_tps)
 
-        q = make_queue_batch(alphas, betas, gammas, deltas, in_toks, out_toks, n_eff)
         # K bucketed for shape stability under load drift (see k_max_bucket)
         k_max = k_max_bucket(k_max_for(n_eff))
-        dtype = q.alpha.dtype
-        slo = SLOTargets(
-            ttft=jnp.asarray(ttfts, dtype),
-            itl=jnp.asarray(itls, dtype),
-            tps=jnp.asarray(tpss, dtype),
-        )
         # Bucket the candidate axis so adding/removing a variant (or a
         # candidate slice) doesn't retrace + recompile the kernel: shapes
         # only change when the fleet crosses a 16-candidate boundary, and
         # every crossed bucket stays in jit's executable cache. Padded
         # lanes are benign invalid queues (valid=False -> feasible=False).
-        from ..parallel import pad_to_multiple
-
         bucket = 16 if mesh is None else math.lcm(16, int(mesh.devices.size))
-        q, slo, _ = pad_to_multiple(q, slo, bucket)
+        if self.arena is not None and mesh is None:
+            # resident arena: scatter only this group's lanes into the
+            # persistent bucketed buffers — no full re-pack in steady
+            # state, and bit-identical arrays to the list path below
+            q, slo = self.arena.pack(
+                dict(alpha=alphas, beta=betas, gamma=gammas, delta=deltas,
+                     in_tokens=in_toks, out_tokens=out_toks,
+                     max_batch=n_eff, ttft=ttfts, itl=itls, tps=tpss),
+                quantum=bucket)
+            dtype = q.alpha.dtype
+        else:
+            q = make_queue_batch(alphas, betas, gammas, deltas, in_toks,
+                                 out_toks, n_eff)
+            dtype = q.alpha.dtype
+            slo = SLOTargets(
+                ttft=jnp.asarray(ttfts, dtype),
+                itl=jnp.asarray(itls, dtype),
+                tps=jnp.asarray(tpss, dtype),
+            )
+            from ..parallel import pad_to_multiple
+
+            q, slo, _ = pad_to_multiple(q, slo, bucket)
         if mesh is not None:
             from ..parallel import size_batch_sharded
 
@@ -349,7 +400,8 @@ class System:
             alloc.value = alloc.cost
             self._value_and_store(server, acc_name, alloc)
 
-    def _calculate_native(self, ttft_percentile: float | None = None) -> None:
+    def _calculate_native(self, ttft_percentile: float | None = None,
+                          only: Optional[set] = None) -> None:
         """All sized candidates through the C++ kernel: one FFI call per
         sizing group (per effective TTFT percentile, mirroring the
         batched path), then per-replica re-analysis per feasible
@@ -362,7 +414,7 @@ class System:
                 "native queueing kernel unavailable (no g++/.so); "
                 "use backend='batched' or 'scalar'"
             )
-        pairs = self._candidate_pairs()
+        pairs = self._candidate_pairs(only=only)
         if not pairs:
             return
         for p, group in _percentile_groups(pairs, ttft_percentile).items():

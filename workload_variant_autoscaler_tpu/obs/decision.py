@@ -71,6 +71,13 @@ class DecisionInputs:
     # "legacy" (WVA_FLEET_COLLECTION=off). "" on records predating the
     # field.
     collection_mode: str = ""
+    # how the sizing was produced (solver/incremental.py): "full" (every
+    # lane re-solved — forced-full cycles and WVA_INCREMENTAL_SOLVE=off),
+    # "incremental" (signature changed, this variant's lanes re-solved),
+    # or "cached" (signature unchanged, cached allocations reused — the
+    # kernel never saw this variant this cycle). "" on records that never
+    # reached the analyze stage (held variants) or predate the field.
+    solve_mode: str = ""
 
 
 @dataclass(frozen=True)
@@ -131,6 +138,7 @@ def explain_text(record: DecisionRecord) -> str:
         f"  degradation rung: {i.degradation}",
         *([f"  collection path: {i.collection_mode}"]
           if i.collection_mode else []),
+        *([f"  solve path: {i.solve_mode}"] if i.solve_mode else []),
         "  inputs:",
         f"    arrival rate:    {i.arrival_rate_rpm:.2f} req/min",
         f"    tokens in/out:   {i.avg_input_tokens:.1f} / "

@@ -47,9 +47,11 @@ def _chips_per_replica(system: System, server: Server, alloc: Allocation) -> int
     return model.num_instances(acc.name) * acc.chips
 
 
-def _make_entries(system: System) -> list[_Entry]:
+def _make_entries(system: System, only=None) -> list[_Entry]:
     entries = []
     for server in system.servers.values():
+        if only is not None and server.name not in only:
+            continue
         server.remove_allocation()
         if not server.all_allocations:
             continue
@@ -70,6 +72,93 @@ def solve_greedy(
     available = dict(system.capacity)  # chip generation -> chips
     entries = _make_entries(system)
 
+    if delayed_best_effort:
+        unallocated = _allocate(system, entries, available)
+        _best_effort(system, unallocated, available, policy)
+    else:
+        for group in priority_groups(entries):
+            unallocated = _allocate(system, group, available)
+            _best_effort(system, unallocated, available, policy)
+
+
+def solve_greedy_warm(
+    system: System,
+    policy: SaturationPolicy,
+    prev: dict[str, Allocation],
+    changed,
+    prev_pools: dict[str, tuple] | None = None,
+    delayed_best_effort: bool = False,
+) -> None:
+    """Greedy solve warm-started from the previous cycle's choices.
+
+    Chip capacity couples servers only through shared generation pools:
+    a server's allocation can influence another's exactly when some
+    candidate of each draws from the same chip pool. So partition the
+    fleet into pool-connected components (union-find over the chips of
+    each server's candidate allocations) and re-run the full greedy on
+    precisely the components containing a changed server; every server
+    in an untouched component keeps its previous allocation verbatim
+    (a clone — best-effort policies mutate Allocation in place).
+
+    A changed server's PREVIOUS pools (`prev_pools`) count as touched
+    too: a candidate set that left a pool frees capacity that unchanged
+    competitors in that pool would claim in a full solve.
+
+    Exactness relies on the caller's invariants (solver/incremental.py):
+    `prev` is the completed previous solve over the same candidate set,
+    every unchanged server's candidate allocations (values included) are
+    equal to last cycle's, and the capacity view is unchanged — any of
+    those failing must route to solve_greedy instead.
+    """
+    changed = set(changed)
+    prev_pools = prev_pools or {}
+    # union-find over chip pools; servers attach to their candidates' pools
+    parent: dict[str, str] = {}
+
+    def find(x: str) -> str:
+        while parent.setdefault(x, x) != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(a: str, b: str) -> None:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    server_pools: dict[str, list[str]] = {}
+    for name, server in system.servers.items():
+        chips = []
+        for alloc in server.all_allocations.values():
+            acc = system.accelerator(alloc.accelerator)
+            if acc is not None:
+                chips.append(acc.chip)
+        server_pools[name] = chips
+        for chip in chips[1:]:
+            union(chips[0], chip)
+
+    affected_roots = set()
+    for name in changed:
+        for chip in list(server_pools.get(name, ())) + \
+                list(prev_pools.get(name, ())):
+            affected_roots.add(find(chip))
+    affected = {name for name, chips in server_pools.items()
+                if name in changed
+                or any(find(c) in affected_roots for c in chips)}
+
+    for name, server in system.servers.items():
+        if name in affected:
+            continue
+        server.remove_allocation()
+        prev_alloc = prev.get(name)
+        if prev_alloc is not None:
+            server.set_allocation(prev_alloc.clone())
+
+    # the full algorithm, restricted to the affected components; their
+    # pools are untouched by unaffected servers (disjoint by
+    # construction), so starting from the full capacity view is exact
+    available = dict(system.capacity)
+    entries = _make_entries(system, only=affected)
     if delayed_best_effort:
         unallocated = _allocate(system, entries, available)
         _best_effort(system, unallocated, available, policy)

@@ -1,0 +1,371 @@
+"""Incremental steady-state solve engine: signature-gated re-solving.
+
+In steady state an autoscaler fleet barely moves, yet the legacy cycle
+re-derived every variant's candidate allocations from zero: rebuild the
+`System`, regenerate all (server, accelerator) pairs, re-pack the padded
+kernel batch, re-solve every lane, re-run the allocator. This engine
+makes analyze + optimize O(changed-variants):
+
+1. **Input signatures.** Every variant's solve inputs — quantized load
+   (relative epsilon `WVA_SOLVE_EPSILON`), SLO target, profile
+   coefficients, candidate-accelerator catalog entries, server bounds,
+   degradation rung — fold into a per-variant signature. An unchanged
+   signature reuses last cycle's cached per-candidate allocations and
+   skips those kernel lanes entirely, including the zero-load fast path.
+2. **Resident candidate arena** (ops/arena.py, attached to the System):
+   the changed sub-batch scatters into persistent bucketed buffers, so
+   steady-state cycles do no full re-pack and the jitted kernels never
+   retrace.
+3. **Warm-started greedy** (solver/greedy.py `solve_greedy_warm`): the
+   capacity-aware solve seeds from the previous cycle's choices and
+   recomputes only the chip-generation pools touched by changed
+   variants, falling back to a full solve whenever capacity, the
+   candidate set, the cycle's degradation rung, or the engine
+   configuration changes — and unconditionally every
+   `WVA_SOLVE_FULL_EVERY` cycles, so drift is provably bounded.
+
+Correctness contract (pinned by tests/test_incremental_solve.py): an
+incremental cycle publishes BIT-IDENTICAL allocations to a from-scratch
+solve over the same (quantized) inputs. That works because the
+quantizer is a pure function (same load bucket -> same solve inputs),
+the kernel is deterministic per lane (masked states make results
+independent of batch shape and padded K), and cached entries are exact
+solve outputs with values re-derived against the live current
+allocation each cycle.
+
+Load quantization is the one deliberate semantic of incremental mode:
+sizing consumes load snapped to a relative-epsilon bucket (default 2%,
+well inside rate-estimate noise), which is what makes "unchanged" a
+stable property under jitter. `WVA_INCREMENTAL_SOLVE=off` restores the
+legacy exact-load full-solve path byte-for-byte.
+
+The engine is owned by the reconcile loop and touched only between
+stages on that single thread; the fanout'd status writers never reach
+it (statically checked — wvalint WVL402 follows `self.<attr>` calls
+into same-file classes).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..models import System
+from ..models.spec import OptimizerSpec, ServerLoadSpec
+from ..ops.arena import CandidateArena
+from ..utils import get_logger, kv
+from .solver import WarmStart
+
+log = get_logger("wva.solver.incremental")
+
+DEFAULT_EPSILON = 0.02
+DEFAULT_FULL_EVERY = 32
+
+# solve_mode values carried by DecisionRecords / `controller explain`
+SOLVE_FULL = "full"              # every lane re-solved from scratch
+SOLVE_INCREMENTAL = "incremental"  # changed variant, lanes re-solved
+SOLVE_CACHED = "cached"          # unchanged signature, lanes skipped
+SOLVE_MODES = (SOLVE_FULL, SOLVE_INCREMENTAL, SOLVE_CACHED)
+
+
+def quantize(value: float, epsilon: float) -> float:
+    """Snap a positive value to a relative-epsilon log bucket. Pure:
+    equal buckets always produce the equal representative, so the
+    signature and the solve consume the same number. epsilon <= 0 (or a
+    non-positive value) passes through untouched."""
+    if epsilon <= 0 or value <= 0 or not math.isfinite(value):
+        return value
+    step = math.log1p(epsilon)
+    return math.exp(round(math.log(value) / step) * step)
+
+
+def quantize_load(load: Optional[ServerLoadSpec],
+                  epsilon: float) -> Optional[ServerLoadSpec]:
+    """Quantized view of a server load: arrival rate and token means
+    snapped to epsilon buckets (token means re-rounded to ints — the
+    spec's type). Zero/negative components pass through, so the
+    zero-load fast path and the invalid-load guards see exact values."""
+    if load is None or epsilon <= 0:
+        return load
+    return ServerLoadSpec(
+        arrival_rate=quantize(load.arrival_rate, epsilon),
+        avg_in_tokens=int(round(quantize(load.avg_in_tokens, epsilon))),
+        avg_out_tokens=int(round(quantize(load.avg_out_tokens, epsilon))),
+    )
+
+
+@dataclass
+class SolveStats:
+    """One cycle's incremental-solve telemetry."""
+
+    full: bool
+    reason: str = ""
+    lanes_solved: int = 0
+    lanes_skipped: int = 0
+    modes: dict = field(default_factory=dict)  # mode -> variant count
+
+
+class IncrementalSolveEngine:
+    """Persistent (across cycles) signature cache + arena + warm-start
+    state. One instance per Reconciler; single-threaded by design (the
+    reconcile loop is its only caller)."""
+
+    def __init__(self, epsilon: float = DEFAULT_EPSILON,
+                 full_every: int = DEFAULT_FULL_EVERY):
+        self.epsilon = epsilon
+        self.full_every = max(int(full_every), 0)
+        self.arena = CandidateArena()
+        self._cycle = 0
+        # server name -> signature of the lane inputs the cache entry
+        # was solved from, and the pristine allocation clones themselves
+        self._lane_sigs: dict[str, tuple] = {}
+        self._alloc_cache: dict[str, dict] = {}
+        # committed at finish_cycle: the last COMPLETED solve's state
+        self._prev_choice: dict = {}
+        self._prev_pools: dict[str, tuple] = {}
+        self._prev_value_sigs: dict[str, tuple] = {}
+        self._prev_solve_sig: Optional[tuple] = None
+        self._prev_complete = False
+        # scratch between calculate() and finish_cycle()
+        self._pending_value_sigs: dict[str, tuple] = {}
+        self._pending_solve_sig: Optional[tuple] = None
+        self._analyze_sig: Optional[tuple] = None
+        self._changed_for_solver: frozenset = frozenset()
+        self._warm_ok = False
+        self.solve_modes: dict[str, str] = {}
+        self.last_stats: Optional[SolveStats] = None
+
+    # -- signatures -------------------------------------------------------
+
+    @staticmethod
+    def _candidate_entries(system: System, server) -> tuple:
+        model = system.models.get(server.model_name)
+        out = []
+        for acc_name in sorted(server.candidate_accelerators(
+                system.accelerators)):
+            acc = system.accelerators[acc_name]
+            profile = model.profile(acc_name) if model is not None else None
+            out.append((acc_name, acc.spec, profile))
+        return tuple(out)
+
+    def _lane_signature(self, system: System, server,
+                        ttft_percentile: Optional[float],
+                        rung: str) -> tuple:
+        svc = system.service_classes.get(server.service_class_name)
+        target = svc.target(server.model_name) if svc is not None else None
+        load = server.load
+        pinned = (server.cur_allocation.accelerator
+                  if server.keep_accelerator and server.cur_allocation
+                  else "")
+        return (
+            server.model_name,
+            server.service_class_name,
+            svc.priority if svc is not None else None,
+            target,
+            server.min_num_replicas,
+            server.max_batch_size,
+            server.keep_accelerator,
+            pinned,
+            ((load.arrival_rate, load.avg_in_tokens, load.avg_out_tokens)
+             if load is not None else None),
+            rung,
+            ttft_percentile,
+            self._candidate_entries(system, server),
+        )
+
+    @staticmethod
+    def _value_signature(server) -> Optional[tuple]:
+        cur = server.cur_allocation
+        if cur is None:
+            return None
+        return (cur.accelerator, cur.num_replicas, cur.cost)
+
+    @staticmethod
+    def _solve_signature(system: System, optimizer_spec: OptimizerSpec,
+                         cycle_rung: str) -> tuple:
+        return (
+            optimizer_spec,
+            tuple(sorted(system.capacity.items())),
+            frozenset(system.servers),
+            cycle_rung,
+        )
+
+    # -- the analyze step -------------------------------------------------
+
+    def calculate(self, system: System, *, backend: str, mesh=None,
+                  ttft_percentile: Optional[float] = None,
+                  optimizer_spec: Optional[OptimizerSpec] = None,
+                  rungs: Optional[dict] = None,
+                  cycle_rung: str = "healthy") -> SolveStats:
+        """Signature-gated replacement for System.calculate: restores
+        cached candidate allocations for unchanged variants, sizes only
+        the changed sub-batch (through the resident arena), and
+        refreshes the cache. Also precomputes the warm-start decision
+        the optimize stage consumes via warm_start()."""
+        self._cycle += 1
+        rungs = rungs or {}
+        optimizer_spec = optimizer_spec or OptimizerSpec()
+
+        # quantized load is the solve's input (see module docstring) —
+        # applied before signatures so bucket-stable jitter reads as
+        # unchanged
+        for server in system.servers.values():
+            server.load = quantize_load(server.load, self.epsilon)
+
+        analyze_sig = (backend,
+                       int(mesh.devices.size) if mesh is not None else None,
+                       ttft_percentile)
+        solve_sig = self._solve_signature(system, optimizer_spec, cycle_rung)
+
+        full = False
+        reason = ""
+        if self._cycle == 1 or not self._lane_sigs:
+            full, reason = True, "first cycle"
+        elif self.full_every and (self._cycle - 1) % self.full_every == 0:
+            full, reason = True, \
+                f"forced (WVA_SOLVE_FULL_EVERY={self.full_every})"
+        elif self._analyze_sig != analyze_sig:
+            full, reason = True, "backend/mesh/percentile changed"
+        self._analyze_sig = analyze_sig
+
+        lane_sigs = {
+            name: self._lane_signature(system, server, ttft_percentile,
+                                       rungs.get(name, "healthy"))
+            for name, server in system.servers.items()
+        }
+        self._pending_value_sigs = {
+            name: self._value_signature(server)
+            for name, server in system.servers.items()
+        }
+
+        system.arena = self.arena if mesh is None else None
+        if full:
+            system.calculate(backend=backend, mesh=mesh,
+                             ttft_percentile=ttft_percentile)
+            self._alloc_cache = {}
+            self._lane_sigs = {}
+            for name, server in system.servers.items():
+                self._lane_sigs[name] = lane_sigs[name]
+                self._alloc_cache[name] = {
+                    acc: alloc.clone()
+                    for acc, alloc in server.all_allocations.items()}
+            self.solve_modes = dict.fromkeys(system.servers, SOLVE_FULL)
+            self._changed_for_solver = frozenset(system.servers)
+            self._warm_ok = False
+            stats = SolveStats(full=True, reason=reason,
+                               lanes_solved=system.last_solve_lanes,
+                               lanes_skipped=0,
+                               modes={SOLVE_FULL: len(system.servers)})
+        else:
+            changed = {
+                name for name in system.servers
+                if self._lane_sigs.get(name) != lane_sigs[name]
+                or name not in self._alloc_cache
+            }
+            skipped_lanes = 0
+            for name, server in system.servers.items():
+                if name in changed:
+                    continue
+                skipped_lanes += self._restore(system, server,
+                                               self._alloc_cache[name])
+            system.calculate(backend=backend, mesh=mesh,
+                             ttft_percentile=ttft_percentile,
+                             only=changed)
+            for name in changed:
+                server = system.servers[name]
+                self._lane_sigs[name] = lane_sigs[name]
+                self._alloc_cache[name] = {
+                    acc: alloc.clone()
+                    for acc, alloc in server.all_allocations.items()}
+            self.solve_modes = {
+                name: (SOLVE_INCREMENTAL if name in changed
+                       else SOLVE_CACHED)
+                for name in system.servers
+            }
+            # the solver additionally treats value-only drift (current
+            # allocation moved, so transition penalties moved) as change
+            value_changed = {
+                name for name in system.servers
+                if self._prev_value_sigs.get(name)
+                != self._pending_value_sigs[name]
+            }
+            self._changed_for_solver = frozenset(changed | value_changed)
+            self._warm_ok = (self._prev_complete
+                             and self._prev_solve_sig == solve_sig)
+            stats = SolveStats(
+                full=False,
+                reason=("capacity/candidate-set/rung changed"
+                        if not self._warm_ok and self._prev_complete
+                        else ""),
+                lanes_solved=system.last_solve_lanes,
+                lanes_skipped=skipped_lanes,
+                modes={SOLVE_INCREMENTAL: len(changed),
+                       SOLVE_CACHED: len(system.servers) - len(changed)})
+        self._pending_solve_sig = solve_sig
+        self.last_stats = stats
+        if stats.full:
+            log.debug("full solve", extra=kv(reason=reason,
+                                             lanes=stats.lanes_solved))
+        return stats
+
+    @staticmethod
+    def _restore(system: System, server, cached: dict) -> int:
+        """Rehydrate a server's candidate allocations from pristine
+        cache clones, re-deriving values against the LIVE current
+        allocation — exactly the epilogue a fresh solve would run
+        (value=cost, then the transition penalty when a current
+        allocation exists). Returns the number of lanes skipped."""
+        server.all_allocations = {}
+        for acc_name, alloc in cached.items():
+            a = alloc.clone()
+            a.value = a.cost
+            system._value_and_store(server, acc_name, a)
+        return len(cached)
+
+    # -- the optimize step ------------------------------------------------
+
+    def warm_start(self) -> Optional[WarmStart]:
+        """WarmStart for this cycle's greedy solve, or None when a full
+        solve is required (first/forced-full cycle, a failed previous
+        cycle, or a capacity / candidate-set / degradation-rung
+        change)."""
+        if not self._warm_ok:
+            return None
+        return WarmStart(prev=self._prev_choice,
+                         changed=self._changed_for_solver,
+                         prev_pools=self._prev_pools)
+
+    def finish_cycle(self, system: System) -> None:
+        """Commit a COMPLETED solve as the next cycle's warm-start seed.
+        Never called on a failed cycle (note_failure), so a half-run
+        cycle can't poison the seed."""
+        self._prev_choice = {
+            name: server.allocation.clone()
+            for name, server in system.servers.items()
+            if server.allocation is not None
+        }
+        pools: dict[str, tuple] = {}
+        for name, server in system.servers.items():
+            chips = set()
+            for alloc in server.all_allocations.values():
+                acc = system.accelerators.get(alloc.accelerator)
+                if acc is not None:
+                    chips.add(acc.chip)
+            pools[name] = tuple(sorted(chips))
+        self._prev_pools = pools
+        self._prev_value_sigs = dict(self._pending_value_sigs)
+        self._prev_solve_sig = getattr(self, "_pending_solve_sig", None)
+        self._prev_complete = True
+        # bound memory under fleet churn: drop cache entries for
+        # variants that left the fleet
+        live = set(system.servers)
+        for stale in [n for n in self._lane_sigs if n not in live]:
+            del self._lane_sigs[stale]
+            self._alloc_cache.pop(stale, None)
+
+    def note_failure(self) -> None:
+        """The optimize stage failed: the published solution no longer
+        corresponds to this cycle's inputs, so the next cycle must not
+        warm-start from it."""
+        self._prev_complete = False

@@ -23,7 +23,7 @@ class Optimizer:
         self.solver: Optional[Solver] = None
         self.solution_time_msec: float = 0.0
 
-    def optimize(self, system: System) -> None:
+    def optimize(self, system: System, warm=None) -> None:
         if self.spec is None:
             raise ValueError("missing optimizer spec")
         self.solver = Solver(self.spec)
@@ -32,8 +32,9 @@ class Optimizer:
         # outside a cycle trace), so solver wall time is attributable
         # inside the trace, not just as the stage remainder
         with obs_trace.span("solver.solve",
-                            unlimited=self.spec.unlimited) as sp:
-            self.solver.solve(system)
+                            unlimited=self.spec.unlimited,
+                            warm=warm is not None) as sp:
+            self.solver.solve(system, warm=warm)
             self.solution_time_msec = (time.perf_counter() - start) * 1000.0
             if sp is not None:
                 sp.set(servers=len(system.servers),
@@ -47,6 +48,6 @@ class Manager:
         self.system = system
         self.optimizer = optimizer
 
-    def optimize(self) -> None:
-        self.optimizer.optimize(self.system)
+    def optimize(self, warm=None) -> None:
+        self.optimizer.optimize(self.system, warm=warm)
         self.system.allocate_by_type()

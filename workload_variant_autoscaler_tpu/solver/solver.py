@@ -11,11 +11,29 @@ Reference: /root/reference pkg/solver/solver.go. Two modes:
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
 from typing import Optional
 
 from ..models import Allocation, AllocationDiff, SaturationPolicy, System, allocation_diff
 from ..models.spec import OptimizerSpec
-from .greedy import solve_greedy
+from .greedy import solve_greedy, solve_greedy_warm
+
+
+@dataclass(frozen=True)
+class WarmStart:
+    """Previous-cycle solve state for the warm-started greedy
+    (solver/incremental.py builds one only when its invariants hold:
+    completed previous solve, same candidate set, same capacity view).
+
+    prev: server name -> the Allocation chosen last cycle (pristine
+    clones; greedy clones again before mutating). changed: servers whose
+    solver-visible inputs (candidates, values, load signature) changed.
+    prev_pools: server name -> chip pools its candidates drew on last
+    cycle, so a candidate set that LEFT a pool still marks it touched."""
+
+    prev: dict[str, Allocation]
+    changed: frozenset
+    prev_pools: dict[str, tuple] = field(default_factory=dict)
 
 
 class Solver:
@@ -24,9 +42,12 @@ class Solver:
         self.current_allocation: dict[str, Allocation] = {}
         self.diff_allocation: dict[str, AllocationDiff] = {}
 
-    def solve(self, system: System) -> None:
+    def solve(self, system: System, warm: Optional[WarmStart] = None) -> None:
         """Snapshot current allocations, dispatch by mode, compute diffs
-        (reference solver.go:32-59)."""
+        (reference solver.go:32-59). `warm` seeds the greedy mode from
+        the previous cycle's solution, recomputing only the chip pools
+        touched by changed servers; the unlimited mode is separable
+        per-server host arithmetic, so it always runs in full."""
         self.current_allocation = {
             name: server.cur_allocation
             for name, server in system.servers.items()
@@ -35,6 +56,15 @@ class Solver:
 
         if self.spec.unlimited:
             self.solve_unlimited(system)
+        elif warm is not None:
+            solve_greedy_warm(
+                system,
+                SaturationPolicy.parse(self.spec.saturation_policy),
+                prev=warm.prev,
+                changed=warm.changed,
+                prev_pools=warm.prev_pools,
+                delayed_best_effort=self.spec.delayed_best_effort,
+            )
         else:
             solve_greedy(
                 system,
