@@ -31,6 +31,10 @@ bench-loop: ## North-star closed-loop benchmark: chip-hours to hold p95-ITL SLO 
 bench-loop-churn: ## Steady-state incremental-solve bench: 512 variants, 1% churn/cycle, WVA_INCREMENTAL_SOLVE on vs off (BENCH_solve artifact)
 	$(PY) bench_loop.py solve-churn
 
+.PHONY: bench-goodput
+bench-goodput: ## Fleet goodput digital twin: all six scenarios, seeded + sim-time (regenerates BENCH_goodput_r08.json byte-identically)
+	$(PY) bench_goodput.py
+
 .PHONY: bench-scenarios
 bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO headlines + mean ablations, tail stress, strict SLO)
 	$(PY) bench_loop.py whole-fleet-p95
@@ -43,7 +47,7 @@ bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO 
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo
 
-LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py __graft_entry__.py
+LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py __graft_entry__.py
 
 .PHONY: lint
 lint: ## Static analysis gate: ruff+mypy when installed, wvalint always (rule catalog: docs/developer-guide/wvalint.md)

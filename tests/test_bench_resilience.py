@@ -318,6 +318,29 @@ class TestWallTimeBound:
         # bytes input (TimeoutExpired.stdout can be bytes)
         assert bench._salvage_json(b'{"a": 1}\ngarbage') == {"a": 1}
 
+    def test_pallas_e2e_salvage_keeps_the_record(self, monkeypatch):
+        """ADVICE r5 #2: probe_pallas_e2e honours the salvage contract —
+        an "ok-salvaged:*" stage (record printed, then died in teardown)
+        keeps its measured result tagged status:"ok-salvaged", instead of
+        being demoted to an error with the dict stringified away."""
+        record = {"batched": {"p50_ms": 1.0}, "pallas": {"p50_ms": 0.8},
+                  "backends_agree": True}
+        for kind, status in (("ok", "ok"),
+                             ("ok-salvaged:crash", "ok-salvaged"),
+                             ("ok-salvaged:timeout", "ok-salvaged")):
+            monkeypatch.setattr(
+                bench, "_subproc", lambda *_a, kind=kind: (kind,
+                                                           dict(record)))
+            out = bench.probe_pallas_e2e(timeout_s=1.0)
+            assert out["status"] == status, kind
+            assert out["backends_agree"] is True
+        monkeypatch.setattr(bench, "_subproc",
+                            lambda *_a: ("error", "boom"))
+        assert bench.probe_pallas_e2e(timeout_s=1.0)["status"] == "error"
+        monkeypatch.setattr(bench, "_subproc",
+                            lambda *_a: ("timeout", None))
+        assert bench.probe_pallas_e2e(timeout_s=1.0)["status"] == "timeout"
+
     def test_compose_never_fabricates_shed_xla_series(self):
         # budget-shed auxiliary: no xla_cpu_rate key in the stage output
         # -> none in the artifact (a fabricated 0.0 would read as a

@@ -47,15 +47,19 @@ from workload_variant_autoscaler_tpu.controller.degradation import (
 from workload_variant_autoscaler_tpu.faults import (
     KUBE_CONFLICT,
     KUBE_NOT_FOUND,
+    NODE_POOL_DRAIN,
     PROM_CLOCK_SKEW,
     PROM_LABEL_DROP,
     PROM_NAN,
+    PROM_OUTAGE,
     PROM_PARTIAL,
     PROM_TIMEOUT,
+    SPOT_RECLAIM,
     WATCH_DROP,
     FaultPlan,
     FaultRule,
     FaultyPromAPI,
+    InjectedTimeout,
 )
 from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
 
@@ -699,6 +703,146 @@ class TestFaultPlanScripting:
                            '{"rules": [{"kind": "nope"}]}')  # noqa: WVL321
         with pytest.raises(ValueError):
             _fault_plan_from_env()  # bad plan = startup error, not no-op
+
+
+class TestPromOutageWindow:
+    """prom-outage-window: a hard CORRELATED outage — every query of
+    every client holding the plan fails inside the window, whatever its
+    text, and all of them recover together when the window closes."""
+
+    def test_every_query_blocked_inside_the_window(self):
+        plan = FaultPlan([FaultRule(kind=PROM_OUTAGE, after_cycle=2,
+                                    until_cycle=3)])
+        prom_a = FaultyPromAPI(FakePromAPI(), plan)
+        prom_b = FaultyPromAPI(FakePromAPI(), plan)   # second "backend"
+        plan.begin_cycle()
+        prom_a.query("anything_at_all")               # healthy: answers
+        plan.begin_cycle()
+        # window open: both clients dark, regardless of query text
+        for prom in (prom_a, prom_b):
+            for q in ("up", 'sum(rate(vllm:request_success_total[1m]))'):
+                with pytest.raises(InjectedTimeout):
+                    prom.query(q)
+        plan.begin_cycle()
+        # window closed: both recover on the same cycle
+        prom_a.query("up")
+        prom_b.query("up")
+
+    def test_reconciler_rides_the_ladder_through_the_window(self):
+        plan = FaultPlan([FaultRule(kind=PROM_OUTAGE, after_cycle=3,
+                                    until_cycle=5)], seed=31)
+        kube, prom, emitter, rec, clock = make_chaos_cluster(plan)
+        out = [cycle_summary(kube, emitter,
+                             run_cycle(rec, plan, clock, prom, rps=20.0))
+               for _ in range(6)]
+        healthy = out[1]
+        assert healthy["desired"] > 0
+        for s in out[2:4]:
+            assert s["degraded"].get(FULL) == "stale-cache"
+            assert s["desired"] == healthy["desired"]
+        assert out[-1]["degraded"] == {}
+        assert_never_scaled_to_zero(out)
+
+
+def node(name, accel="tpu-v5-lite-podslice", chips=2):
+    from workload_variant_autoscaler_tpu.controller.kube import Node
+
+    return Node(name=name,
+                labels={"cloud.google.com/gke-tpu-accelerator": accel},
+                tpu_capacity=chips)
+
+
+class TestNodePoolFaults:
+    """node-pool-drain / spot-reclaim: capacity withdrawal reads as
+    SHRINKING inventory through the normal node LIST — the apiserver
+    keeps answering, no error storm."""
+
+    def _kube(self, plan):
+        kube = InMemoryKube()
+        for i in range(4):
+            kube.put_node(node(f"v5e-spot-{i}"))
+        kube.put_node(node("v5e-od-0"))
+        kube.attach_fault_plan(plan)
+        return kube
+
+    def test_drain_reads_unschedulable_never_an_error(self):
+        from workload_variant_autoscaler_tpu.collector import (
+            collect_inventory_k8s,
+        )
+
+        plan = FaultPlan([FaultRule(kind=NODE_POOL_DRAIN,
+                                    match="v5e-spot")])
+        kube = self._kube(plan)
+        nodes = kube.list_nodes()          # no exception: LIST answers
+        assert len(nodes) == 5             # drained nodes still listed
+        drained = {n.name for n in nodes if not n.schedulable()}
+        assert drained == {f"v5e-spot-{i}" for i in range(4)}
+        # ...and the collector's inventory shrinks to the healthy pool
+        assert collect_inventory_k8s(kube) == {"v5e": 2}
+        assert plan.trips, "drain trips must be recorded"
+
+    def test_reclaim_vanishes_nodes_stably(self):
+        """A reclaimed node is GONE from the LIST and stays gone for the
+        whole window: the per-node draw is a stable seeded hash, so
+        repeated LISTs (and rerun plans with the same seed) agree."""
+        def survivors(seed):
+            plan = FaultPlan([FaultRule(kind=SPOT_RECLAIM,
+                                        match="v5e-spot",
+                                        probability=0.5)], seed=seed)
+            kube = self._kube(plan)
+            first = {n.name for n in kube.list_nodes()}
+            second = {n.name for n in kube.list_nodes()}
+            assert first == second, "reclamation must not flap per LIST"
+            return first
+
+        assert survivors(7) == survivors(7)
+        assert "v5e-od-0" in survivors(7)  # unmatched pool untouched
+        # some draw must differ across seeds for a 0.5 rule over 4 nodes
+        assert any(survivors(7) != survivors(s) for s in (8, 9, 10, 11))
+
+    def test_window_end_restores_the_pool(self):
+        plan = FaultPlan([FaultRule(kind=SPOT_RECLAIM, match="v5e-spot",
+                                    after_cycle=1, until_cycle=2)])
+        kube = self._kube(plan)
+        plan.begin_cycle()                 # cycle 1: window open
+        assert len(kube.list_nodes()) == 1
+        plan.begin_cycle()                 # cycle 2: reclaim over
+        assert len(kube.list_nodes()) == 5
+        assert all(n.schedulable() for n in kube.list_nodes())
+
+
+class TestGoodputTwinDeterminism:
+    """The trace-driven twin scenarios rerun byte-identically: same seed
+    => identical fault timeline (trip count and order) and identical
+    goodput score sheet."""
+
+    def _run(self, name, horizon_s):
+        from workload_variant_autoscaler_tpu.emulator.scenarios import (
+            SCENARIOS,
+            abbreviated,
+        )
+        from workload_variant_autoscaler_tpu.emulator.twin import (
+            run_scenario,
+        )
+
+        return run_scenario(abbreviated(SCENARIOS[name], horizon_s))
+
+    def test_pool_drain_rerun_equivalence(self):
+        first = self._run("pool-drain", 390.0)
+        second = self._run("pool-drain", 390.0)
+        assert first.fault_trips > 0, "the drain window must have tripped"
+        assert first.to_dict() == second.to_dict()
+        assert first.never_scaled_to_zero
+
+    def test_prom_outage_rerun_equivalence_and_ladder(self):
+        first = self._run("prom-outage-spike", 330.0)
+        second = self._run("prom-outage-spike", 330.0)
+        assert first.fault_trips > 0, "the outage window must have tripped"
+        assert first.to_dict() == second.to_dict()
+        # the guarded landing: blind through the window, never torn down
+        assert first.never_scaled_to_zero
+        for v in first.variants:
+            assert v.min_desired_after_publish >= 1
 
 
 class TestChaosClosedLoop:

@@ -107,3 +107,64 @@ from tests.envtest_suite import (  # noqa: E402,F401,WVL002
     TestLeaseAgainstRealAPIServer,
     TestReconcileAgainstRealAPIServer,
 )
+
+
+class TestNamespacedCreateConformance:
+    """Apiserver create semantics on the facade (ADVICE r5 #1/#3): a
+    POST into an unregistered namespace is a 404, a non-empty body
+    namespace conflicting with the path is a 400, and only an EMPTY
+    body namespace is defaulted from the URL."""
+
+    def _post(self, cluster, path, body):
+        return cluster.session().post(f"{cluster.base_url}{path}",
+                                      json=body, timeout=10)
+
+    def _va_body(self, name, namespace=""):
+        from tests.envtest_suite import va_body
+
+        body = va_body(name)
+        body["metadata"]["namespace"] = namespace
+        return body
+
+    def test_unknown_namespace_is_404_on_every_create(self, cluster):
+        from workload_variant_autoscaler_tpu.controller import crd
+
+        for path, body in (
+            ("/api/v1/namespaces/never-made/configmaps",
+             {"apiVersion": "v1", "kind": "ConfigMap",
+              "metadata": {"name": "cm"}, "data": {}}),
+            ("/apis/apps/v1/namespaces/never-made/deployments",
+             {"apiVersion": "apps/v1", "kind": "Deployment",
+              "metadata": {"name": "d"}, "spec": {"replicas": 1}}),
+            (f"/apis/{crd.GROUP}/{crd.VERSION}/namespaces/never-made/"
+             f"{crd.PLURAL}", self._va_body("va-404")),
+        ):
+            r = self._post(cluster, path, body)
+            assert r.status_code == 404, (path, r.status_code, r.text)
+
+    def test_default_namespace_is_preseeded(self, cluster):
+        r = self._post(cluster, "/api/v1/namespaces/default/configmaps",
+                       {"apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {"name": "conf-default"}, "data": {}})
+        assert r.status_code == 201, (r.status_code, r.text)
+
+    def test_mismatched_body_namespace_is_400(self, cluster):
+        from workload_variant_autoscaler_tpu.controller import crd
+
+        cluster.ensure_namespace("conf-a")
+        path = (f"/apis/{crd.GROUP}/{crd.VERSION}/namespaces/conf-a/"
+                f"{crd.PLURAL}")
+        r = self._post(cluster, path, self._va_body("va-bad",
+                                                    namespace="conf-b"))
+        assert r.status_code == 400, (r.status_code, r.text)
+        assert "does not match the namespace" in r.text
+
+    def test_empty_body_namespace_defaults_from_the_path(self, cluster):
+        from workload_variant_autoscaler_tpu.controller import crd
+
+        cluster.ensure_namespace("conf-a")
+        path = (f"/apis/{crd.GROUP}/{crd.VERSION}/namespaces/conf-a/"
+                f"{crd.PLURAL}")
+        r = self._post(cluster, path, self._va_body("va-defaulted"))
+        assert r.status_code == 201, (r.status_code, r.text)
+        assert r.json()["metadata"]["namespace"] == "conf-a"

@@ -141,7 +141,8 @@ class TestCallArity:
 
 @pytest.mark.parametrize("paths", [
     ["workload_variant_autoscaler_tpu", "tools", "tests", "bench.py",
-     "bench_loop.py", "bench_collect.py", "__graft_entry__.py"],
+     "bench_loop.py", "bench_collect.py", "bench_goodput.py",
+     "__graft_entry__.py"],
 ])
 def test_package_lints_clean(paths):
     """The gate itself: the shipped source must lint clean — every rule
@@ -900,7 +901,11 @@ class TestKnobParity:
         what test_package_lints_clean also enforces via main()."""
         files, sources, trees = [], {}, {}
         import ast as ast_mod
-        for sub in ("workload_variant_autoscaler_tpu", "tools", "tests"):
+        # same surface as the Makefile's LINT_PATHS: the repo-root bench
+        # drivers read WVA_* knobs too (WVA_BENCH_*, WVA_GOODPUT_*)
+        for sub in ("workload_variant_autoscaler_tpu", "tools", "tests",
+                    "bench.py", "bench_loop.py", "bench_collect.py",
+                    "bench_goodput.py"):
             for fp in wvalint.iter_py_files([os.path.join(REPO, sub)]):
                 files.append(fp)
                 with open(fp, encoding="utf-8") as f:
@@ -968,7 +973,39 @@ class TestFaultKindLiterals:
             {plan_py: tree}, os.path.join("faults", "plan.py"),
             "ALL_KINDS")
         assert kinds is not None and "prom-timeout" in kinds \
-            and "watch-drop" in kinds and len(kinds) == 9
+            and "watch-drop" in kinds and len(kinds) == 12
+        # the goodput-twin fault kinds are first-class vocabulary, so
+        # scenario specs naming them lint clean
+        assert {"prom-outage-window", "node-pool-drain",
+                "spot-reclaim"} <= kinds
+
+    def test_scenario_library_lints_clean_under_repo_vocab(self):
+        """The committed scenario library (emulator/scenarios, the twin,
+        bench_goodput) must pass WVL321 with the REAL ALL_KINDS — a
+        fault kind added to a scenario but not to the vocabulary fails
+        here, not at twin runtime."""
+        import ast as ast_mod
+
+        plan_py = os.path.join(REPO, "workload_variant_autoscaler_tpu",
+                               "faults", "plan.py")
+        with open(plan_py, encoding="utf-8") as f:
+            plan_tree = ast_mod.parse(f.read(), plan_py)
+        kinds = wvalint._vocab_from_trees(
+            {plan_py: plan_tree}, os.path.join("faults", "plan.py"),
+            "ALL_KINDS")
+        for rel in (
+            os.path.join("workload_variant_autoscaler_tpu", "emulator",
+                         "scenarios", "__init__.py"),
+            os.path.join("workload_variant_autoscaler_tpu", "emulator",
+                         "twin.py"),
+            "bench_goodput.py",
+        ):
+            path = os.path.join(REPO, rel)
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            codes = [fi.code for fi in wvalint.lint_source(
+                path, source, fault_kinds=kinds)]
+            assert "WVL321" not in codes, rel
 
 
 class TestStageLiterals:

@@ -12,6 +12,8 @@ from __future__ import annotations
 import json
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 
@@ -185,6 +187,57 @@ def test_incremental_solve_claims_match_artifact():
     flat = " ".join(doc.split())
     assert f"**{art['vs_baseline']}×**" in flat, \
         "observability.md's incremental-solve lane claim drifted"
+
+
+def test_goodput_claims_match_artifact():
+    """Round-8 fleet goodput twin: the committed BENCH_goodput_r08.json
+    must (a) cover the full six-scenario library, (b) clear every
+    scenario's stated goodput floor — including the correlated
+    prom-outage-during-spike scenario, whose losses must be attributed
+    to the degradation ladder, not to mis-sizing — (c) never scale to
+    zero on stale metrics in ANY scenario, and (d) be internally
+    consistent (badput fractions + goodput partition the provisioned
+    cost; the headline is the cost-weighted mean)."""
+    art = _artifact("BENCH_goodput_r08.json")
+    assert art["bench"] == "goodput"
+    scenarios = art["scenarios"]
+    assert art["scenario_count"] == len(scenarios) >= 6
+    assert set(scenarios) >= {
+        "diurnal-wave", "flash-crowd", "pool-drain", "spot-reclaim-wave",
+        "prom-outage-spike", "hetero-cost-skew"}
+    for name, s in scenarios.items():
+        assert s["goodput_fraction"] >= s["goodput_floor"] > 0.0, \
+            f"{name} no longer clears its committed goodput floor"
+        assert s["never_scaled_to_zero"] is True, \
+            f"{name} scaled to zero on stale metrics"
+        # the ledger partitions the cost: useful + badput == 1
+        assert s["goodput_fraction"] + sum(s["badput"].values()) == \
+            pytest.approx(1.0, abs=1e-3), name
+    # the correlated-outage scenario's badput is a degradation story:
+    # the ladder held the fleet (degradation-held), it did not mis-size
+    outage = scenarios["prom-outage-spike"]
+    assert outage["badput"].get("degradation-held", 0.0) > 0.0
+    assert outage["badput"].get("under-provisioned", 0.0) == 0.0
+    # capacity withdrawal reads as under-provisioned badput
+    for name in ("pool-drain", "spot-reclaim-wave"):
+        assert scenarios[name]["badput"].get(
+            "under-provisioned", 0.0) > 0.0, name
+        assert scenarios[name]["fault_trips"] > 0, name
+    # the cost skew: per dollar-second, v5e buys the most demand, the
+    # premium v5p-4 slice the least
+    het = scenarios["hetero-cost-skew"]["variants"]
+    gpd = {v["chip"]: v["goodput_demand_per_dollar_s"]
+           for v in het.values()}
+    assert gpd["v5e-1"] > gpd["v6e-1"] > gpd["v5p-4"]
+    # headline = cost-weighted mean of the scenario fractions
+    total = sum(s["cost_dollar_seconds"] for s in scenarios.values())
+    useful = sum(s["goodput_fraction"] * s["cost_dollar_seconds"]
+                 for s in scenarios.values())
+    assert art["value"] == pytest.approx(useful / total, abs=5e-4)
+    # doc parity: every scenario is catalogued in docs/robustness.md
+    doc = (REPO / "docs" / "robustness.md").read_text()
+    for name in scenarios:
+        assert name in doc, f"{name} missing from the scenario catalog"
 
 
 def test_capstone_claims_match_baseline_json():

@@ -1,0 +1,148 @@
+"""Fleet goodput digital twin: tier-1 smoke + accounting invariants.
+
+One abbreviated library scenario runs end-to-end in sim time (seconds of
+wall clock) so the twin cannot silently rot out of tier-1, plus unit
+coverage of the goodput ledger's invariants and of the DecisionRecord
+goodput-attribution surface. The full six-scenario sweep lives in
+`make bench-goodput` (BENCH_goodput_r08.json, asserted by
+tests/test_perf_claims.py); rerun-equivalence of the fault timeline is
+asserted in tests/test_chaos.py next to the other chaos scenarios.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from workload_variant_autoscaler_tpu.emulator.scenarios import (
+    CHIP_MATRIX,
+    SCENARIOS,
+    abbreviated,
+)
+from workload_variant_autoscaler_tpu.emulator.twin import run_scenario
+from workload_variant_autoscaler_tpu.obs import (
+    GOODPUT_BUCKETS,
+    GOODPUT_USEFUL,
+    DecisionInputs,
+    DecisionLog,
+    DecisionRecord,
+    explain_text,
+    record_from_dict,
+)
+
+
+@pytest.fixture(scope="module")
+def smoke_result():
+    """One abbreviated flash-crowd run shared by the smoke assertions:
+    long enough to cover warmup, the spike step, and the lag window."""
+    return run_scenario(abbreviated(SCENARIOS["flash-crowd"], 300.0))
+
+
+class TestTwinSmoke:
+    def test_runs_and_scores(self, smoke_result):
+        d = smoke_result.to_dict()
+        assert d["cycles"] >= 8
+        assert d["raised_cycles"] == 0
+        assert 0.0 < d["goodput_fraction"] <= 1.0
+        assert 0.0 < d["slo_attainment"] <= 1.0
+        assert d["cost_dollar_seconds"] > 0.0
+        assert d["never_scaled_to_zero"] is True
+
+    def test_ledger_partitions_the_cost(self, smoke_result):
+        """Every dollar-second of provisioned cost lands in exactly one
+        bucket: useful + badput fractions sum to 1."""
+        for v in smoke_result.variants:
+            total = sum(v.badput.values())
+            assert total == pytest.approx(v.cost_dollar_seconds, rel=1e-6)
+            assert set(v.badput) <= set(GOODPUT_BUCKETS)
+        d = smoke_result.to_dict()
+        assert d["goodput_fraction"] + sum(d["badput"].values()) == \
+            pytest.approx(1.0, abs=1e-4)
+
+    def test_flash_crowd_shows_lag_badput(self, smoke_result):
+        """The spike lands between reconciles and pods take startup lag:
+        the run must charge actuation-lagged or under-provisioned badput
+        (a flash crowd with zero tracking error means the meter is
+        blind)."""
+        d = smoke_result.to_dict()
+        assert sum(d["badput"].values()) > 0.0
+        assert d["goodput_fraction"] < 1.0
+
+    def test_decisions_carry_goodput_attribution(self, smoke_result):
+        """Cycle records are annotated post-interval so `controller
+        explain` answers why a cycle lost goodput."""
+        records = smoke_result.decisions.records("chat-flash")
+        annotated = [r for r in records if r.goodput_bucket]
+        assert annotated, "no DecisionRecord carries a goodput bucket"
+        assert {r.goodput_bucket for r in annotated} <= set(GOODPUT_BUCKETS)
+        # the rendering surface: explain shows the attribution
+        text = explain_text(annotated[0])
+        assert "goodput:" in text
+        assert annotated[0].goodput_bucket in text
+        # and it round-trips through the JSON form the CLI consumes
+        again = record_from_dict(annotated[0].to_dict())
+        assert again.goodput_bucket == annotated[0].goodput_bucket
+        assert again.goodput_detail == annotated[0].goodput_detail
+
+    def test_deterministic_rerun(self, smoke_result):
+        """Same scenario, same seed: byte-identical score sheet."""
+        again = run_scenario(abbreviated(SCENARIOS["flash-crowd"], 300.0))
+        assert again.to_dict() == smoke_result.to_dict()
+
+
+class TestScenarioLibrary:
+    def test_library_has_the_six_production_shapes(self):
+        assert set(SCENARIOS) == {
+            "diurnal-wave", "flash-crowd", "pool-drain",
+            "spot-reclaim-wave", "prom-outage-spike", "hetero-cost-skew",
+        }
+
+    def test_every_scenario_states_a_floor_and_a_path(self):
+        for sc in SCENARIOS.values():
+            assert sc.goodput_floor > 0.0, sc.name
+            assert sc.expected_path, sc.name
+            assert sc.variants, sc.name
+
+    def test_fleet_matrix_spans_three_generations_with_cost_skew(self):
+        gens = {lane.generation for lane in CHIP_MATRIX.values()}
+        assert gens == {"v5e", "v5p", "v6e"}
+        for lane in CHIP_MATRIX.values():
+            assert 0.0 < lane.spot_cost_per_hour < lane.cost_per_hour
+
+    def test_spot_variant_is_priced_at_the_spot_rate(self):
+        spot = next(v for v in SCENARIOS["spot-reclaim-wave"].variants
+                    if v.spot)
+        lane = CHIP_MATRIX[spot.chip]
+        assert spot.cost_per_hour == lane.spot_cost_per_hour
+
+    def test_abbreviated_only_clips(self):
+        sc = SCENARIOS["flash-crowd"]
+        assert abbreviated(sc, 120.0).duration_s == 120.0
+        assert abbreviated(sc, 10_000.0).duration_s == sc.duration_s
+        assert abbreviated(sc, 120.0).variants == sc.variants
+
+
+class TestGoodputAnnotation:
+    def _record(self, cycle=3):
+        return DecisionRecord(trace_id="t1", cycle=cycle, ts=0.0,
+                              variant="v", namespace="ns",
+                              inputs=DecisionInputs())
+
+    def test_annotate_replaces_the_matching_record(self):
+        log = DecisionLog(capacity=8)
+        log.record(self._record(cycle=3))
+        assert log.annotate_goodput("v", "ns", 3, GOODPUT_USEFUL,
+                                    detail="all useful")
+        rec = log.latest("v", "ns")
+        assert rec.goodput_bucket == GOODPUT_USEFUL
+        assert rec.goodput_detail == "all useful"
+
+    def test_annotate_misses_rotated_or_unknown_cycles(self):
+        log = DecisionLog(capacity=8)
+        log.record(self._record(cycle=3))
+        assert not log.annotate_goodput("v", "ns", 99, GOODPUT_USEFUL)
+        assert not log.annotate_goodput("other", "ns", 3, GOODPUT_USEFUL)
+
+    def test_annotate_rejects_unknown_buckets(self):
+        log = DecisionLog(capacity=8)
+        with pytest.raises(ValueError, match="unknown goodput bucket"):
+            log.annotate_goodput("v", "ns", 3, "made-up-bucket")

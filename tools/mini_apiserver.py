@@ -91,6 +91,13 @@ from workload_variant_autoscaler_tpu.controller.schema import (
 WATCH_RING = 2048   # retained events; older resourceVersions get 410
 
 
+class BadRequestError(InvalidError):
+    """A malformed REQUEST (400 BadRequest) as opposed to a
+    schema-invalid OBJECT (422 Invalid): the apiserver rejects e.g. a
+    body namespace conflicting with the path namespace with 400, and
+    clients distinguish the two codes."""
+
+
 def _status_body(code: int, reason: str, message: str) -> dict:
     """A metav1.Status the way the apiserver writes error bodies."""
     return {
@@ -430,6 +437,8 @@ def _make_handler(srv: MiniApiServer):
                 self._error(404, "NotFound", str(e))
             except ConflictError as e:
                 self._error(409, "Conflict", str(e))
+            except BadRequestError as e:
+                self._error(400, "BadRequest", str(e))
             except InvalidError as e:
                 self._error(422, "Invalid", str(e))
             except BrokenPipeError:
@@ -600,7 +609,7 @@ def _make_handler(srv: MiniApiServer):
                 raise NotFoundError(f'namespaces "{ns}" not found')
             body_ns = ((body.get("metadata") or {}).get("namespace") or "")
             if body_ns and body_ns != ns:
-                raise InvalidError(
+                raise BadRequestError(
                     f"the namespace of the provided object ({body_ns!r}) "
                     f"does not match the namespace sent on the request "
                     f"({ns!r})")

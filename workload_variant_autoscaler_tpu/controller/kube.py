@@ -230,7 +230,8 @@ class InMemoryKube:
         count-based inject_fault remains for one-shot unit faults; a
         plan expresses multi-cycle scenarios the same way for unit tests
         and the emulator loop. Pass None to detach."""
-        self._fault_plan = plan
+        with self._lock:
+            self._fault_plan = plan
 
     def _trip(self, verb: str, kind: str) -> None:
         plan = self._fault_plan
@@ -389,9 +390,33 @@ class InMemoryKube:
             self.nodes[node.name] = node
 
     def list_nodes(self) -> list[Node]:
+        """Node LIST with scheduled capacity withdrawal: an active
+        `node-pool-drain` rule makes matching nodes read unschedulable
+        (GKE maintenance cordon) and an active `spot-reclaim` rule makes
+        them vanish entirely (preemptible VM reclaimed). Either way the
+        apiserver keeps answering — a draining pool is SHRINKING
+        capacity in the inventory, never a kube error storm."""
         with self._lock:
             self._trip("list", "Node")
-            return [copy.deepcopy(n) for n in self.nodes.values()]
+            plan = self._fault_plan
+            out: list[Node] = []
+            for n in self.nodes.values():
+                n = copy.deepcopy(n)
+                if plan is not None:
+                    from ..collector.collector import (
+                        GKE_TPU_ACCELERATOR_LABEL,
+                    )
+                    from ..faults.plan import NODE_POOL_DRAIN
+
+                    rule = plan.node_fault(
+                        n.name, n.labels.get(GKE_TPU_ACCELERATOR_LABEL, ""))
+                    if rule is not None:
+                        if rule.kind == NODE_POOL_DRAIN:
+                            n.unschedulable = True
+                        else:   # spot-reclaim: the VM is gone
+                            continue
+                out.append(n)
+            return out
 
     # -- Leases (leader election) ----------------------------------------
 

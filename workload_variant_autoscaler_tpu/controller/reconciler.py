@@ -208,6 +208,10 @@ class Reconciler:
         # cycles; (re)built lazily from the WVA_SOLVE_* knobs and
         # dropped when WVA_INCREMENTAL_SOLVE turns off
         self._solve_engine_obj: Optional[IncrementalSolveEngine] = None
+        # previous cycle's limited-mode inventory, for capacity-withdrawal
+        # detection (a draining pool must read as shrinking capacity on
+        # the series and in the log, not silently re-solve smaller)
+        self._last_capacity: dict[str, int] = {}
 
     # -- fleet-scale collection knobs -------------------------------------
 
@@ -483,6 +487,7 @@ class Reconciler:
             self.emitter.emit_condition_metrics({})
             self.emitter.emit_drift_metrics({})
             self.emitter.emit_tpu_utilization_metrics({})
+            self._note_capacity({})
             return result
 
         # limited mode (realizes the reference's dead greedy path +
@@ -516,6 +521,7 @@ class Reconciler:
                     limited = False
                 else:
                     log.info("limited mode capacity", extra=kv(**capacity))
+        self._note_capacity(capacity if limited else {})
 
         policy = operator_cm.get("WVA_SATURATION_POLICY", "None")
         if SaturationPolicy.parse(policy).value != policy:
@@ -675,6 +681,24 @@ class Reconciler:
         self._emit_conditions()
         mark(STAGE_PUBLISH)
         return result
+
+    def _note_capacity(self, capacity: dict[str, int]) -> None:
+        """Capacity-withdrawal visibility (docs/robustness.md node-pool
+        faults): publish the cycle's per-generation chip inventory on
+        inferno_pool_capacity_chips and log every shrink against the
+        previous cycle — a maintenance drain or spot-reclamation wave is
+        an observable capacity event, not a silent smaller solve. Pass {}
+        outside limited mode (the gauge clears)."""
+        for generation, prev in sorted(self._last_capacity.items()):
+            cur = capacity.get(generation, 0)
+            if cur < prev:
+                log.warning(
+                    "pool capacity withdrawn",
+                    extra=kv(generation=generation, chips_before=prev,
+                             chips_now=cur,
+                             withdrawn=prev - cur))
+        self._last_capacity = dict(capacity)
+        self.emitter.emit_pool_capacity_metrics(capacity)
 
     def _record_decision(self, key: str, published: int,
                          outcome: str = "", reason: str = "") -> None:
@@ -1508,6 +1532,16 @@ class Reconciler:
         return (os.environ.get(self.PROBE_WINDOW_ENV)
                 or self._last_operator_cm.get(self.PROBE_WINDOW_ENV)
                 or "1m").strip()
+
+    def capacity_envelopes(self) -> dict[str, float]:
+        """Published SLO-feasible capacity per variant in req/s (the
+        published replica count x the sized operating point's
+        max-arrival rate), keyed by full_name. The same envelope the
+        demand-breakout probe compares live demand against — exposed for
+        the goodput twin's meter, which judges provisioning against the
+        controller's own published capacity model. Empty for variants
+        (or cycles) that published nothing."""
+        return {key: cap for key, (_q, cap) in self._probe_targets.items()}
 
     def demand_probe(self) -> bool:
         """One demand query per published variant; True (and an

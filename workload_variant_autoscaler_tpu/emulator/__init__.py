@@ -3,6 +3,11 @@
 The GPU/TPU-free test backbone: the full collect->analyze->optimize->
 actuate loop runs against this package in simulated time (tests) or in
 real time over HTTP (`python -m workload_variant_autoscaler_tpu.emulator`).
+
+`emulator.twin` + `emulator.scenarios` build the fleet goodput digital
+twin on top: production-shaped scenarios driving the real reconciler to
+a single headline efficiency score (imported explicitly — they pull the
+controller stack, which this namespace keeps out of the light path).
 """
 
 from .engine import Fleet, MetricsSink, Replica, Request, Simulation, SliceModelConfig

@@ -1,0 +1,644 @@
+"""Fleet goodput digital twin: scenario in, headline efficiency out.
+
+`run_scenario` drives the REAL Reconciler through one
+`scenarios.Scenario` end-to-end in simulated time — emulator fleets
+(one per variant, chip-generation physics from `scenarios.CHIP_MATRIX`)
+feeding SimPromAPI/MultiPromAPI, an InMemoryKube holding the CRs and
+node pools, a shared deterministic FaultPlan on BOTH dependencies, and
+emulated actuation with pod-startup lag — then scores the run with the
+fleet-efficiency metric of "ML Fleet Efficiency with ML Productivity
+Goodput" (PAPERS.md, arxiv 2502.06982):
+
+    goodput = SLO-attained demand-seconds served
+              ---------------------------------------
+              chip-cost-seconds provisioned
+
+decomposed tick by tick into badput buckets over the provisioned cost:
+
+- `useful`            capacity that served demand within SLO
+- `under-provisioned` SLO-failing ticks the controller simply mis-sized
+                      (demand moved between cycles, or capacity was
+                      withdrawn below need)
+- `over-provisioned`  surplus replicas demand cannot use
+- `degradation-held`  mis-provision while the variant rode a degraded
+                      rung (stale-cache/limited/hold — the controller
+                      was flying on old evidence)
+- `actuation-lagged`  the decision was right but pods were still
+                      starting (scale-up landed inside the startup lag)
+
+SLO attainment per tick is a capacity test (provisioned >= the replicas
+the published SLO-feasible envelope says the GROUND-TRUTH demand needs)
+cross-checked against observed TTFT of completions in the tick — a
+solver that under-sizes shows up empirically even if its own envelope
+claims health. The per-replica envelope comes from the controller's own
+published capacity (`Reconciler.capacity_envelopes`, the demand-probe
+surface), so the meter judges the controller against the demand it
+actually faced, not against a second model of the hardware.
+
+Every reconcile interval's dominant badput bucket is stamped back onto
+that cycle's DecisionRecords (`DecisionLog.annotate_goodput`), so
+`controller explain <variant>` answers "why did scenario X lose goodput
+at cycle N" from the audit trail alone.
+
+Everything runs on the sim clock from seeded inputs — a rerun of the
+same scenario is byte-identical, which tests/test_chaos.py asserts.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+
+from ..controller import (
+    ACCELERATOR_CM_NAME,
+    CONFIG_MAP_NAME,
+    CONFIG_MAP_NAMESPACE,
+    SERVICE_CLASS_CM_NAME,
+    ConfigMap,
+    Deployment,
+    InMemoryKube,
+    Reconciler,
+    crd,
+)
+from ..collector import collect_inventory_k8s
+from ..controller.degradation import DegradationState
+from ..controller.kube import Node
+from ..faults import FaultPlan
+from ..metrics import MetricsEmitter
+from ..obs.decision import (
+    GOODPUT_DEGRADED,
+    GOODPUT_LAGGED,
+    GOODPUT_OVER,
+    GOODPUT_UNDER,
+    GOODPUT_USEFUL,
+)
+from ..utils import full_name, get_logger, kv
+from .engine import Fleet, MetricsSink, Request, Simulation, SliceModelConfig
+from .loadgen import PoissonLoadGenerator, TokenDistribution, rate_at
+from .metrics import PrometheusSink
+from .scenarios import CHIP_MATRIX, GKE_POOL_LABELS, Scenario, VariantSpec
+from .simprom import MultiPromAPI, SimPromAPI
+
+log = get_logger("wva.twin")
+
+# rungs whose mis-provision is charged to `degradation-held` (the
+# controller flew on degraded EVIDENCE). `limited` deliberately stays
+# out: an optimizer that cannot fit withdrawn capacity is
+# capacity-bound, and its SLO misses read as `under-provisioned` — the
+# bucket that answers "buy more chips", not "fix the telemetry"
+DEGRADED_RUNGS = ("stale-cache", "hold")
+
+_RUNG_LABELS = {int(s): s.label for s in DegradationState}
+
+
+class _TTFTRecorder(MetricsSink):
+    """Time-ordered (first_token_ms, ttft_ms) samples, consumed one tick
+    window at a time by the meter."""
+
+    def __init__(self) -> None:
+        self.samples: list[tuple[float, float]] = []
+        self._idx = 0
+
+    def on_arrival(self, req: Request) -> None: ...
+    def on_token(self, dt_ms: float) -> None: ...
+    def on_finish(self, req: Request) -> None: ...
+    def set_queue_sizes(self, running: int, waiting: int) -> None: ...
+    def set_kv_usage(self, frac: float) -> None: ...
+
+    def on_first_token(self, req: Request) -> None:
+        self.samples.append((req.first_token_ms, req.ttft_ms))
+
+    def take_until(self, t_ms: float) -> list[float]:
+        """TTFTs of first tokens emitted before t_ms and not yet taken."""
+        out = []
+        while self._idx < len(self.samples) and \
+                self.samples[self._idx][0] < t_ms:
+            out.append(self.samples[self._idx][1])
+            self._idx += 1
+        return out
+
+
+class _FanSink(MetricsSink):
+    """Forward every sink hook to several sinks (the Prometheus sink the
+    collector scrapes + the meter's TTFT recorder)."""
+
+    def __init__(self, *sinks: MetricsSink):
+        self.sinks = sinks
+
+    def on_arrival(self, req: Request) -> None:
+        for s in self.sinks:
+            s.on_arrival(req)
+
+    def on_first_token(self, req: Request) -> None:
+        for s in self.sinks:
+            s.on_first_token(req)
+
+    def on_token(self, dt_ms: float) -> None:
+        for s in self.sinks:
+            s.on_token(dt_ms)
+
+    def on_finish(self, req: Request) -> None:
+        for s in self.sinks:
+            s.on_finish(req)
+
+    def set_queue_sizes(self, running: int, waiting: int) -> None:
+        for s in self.sinks:
+            s.set_queue_sizes(running, waiting)
+
+    def set_kv_usage(self, frac: float) -> None:
+        for s in self.sinks:
+            s.set_kv_usage(frac)
+
+
+@dataclass
+class _VariantState:
+    """Per-variant live state + goodput accumulators."""
+
+    spec: VariantSpec
+    fleet: Fleet
+    recorder: _TTFTRecorder
+    price_per_hour: float
+    desired: int = 0            # last published replica count
+    actual: int = 1             # replicas actually serving (startup lag)
+    r_star: float = 0.0         # SLO-feasible req/s per replica (envelope)
+    rung: str = "healthy"       # degradation rung governing the interval
+    published_once: bool = False
+    min_desired_after_publish: int = 10**9
+    scaled_to_zero_on_stale: bool = False
+    # accumulators, all in "dollar-seconds" of provisioned cost
+    cost_s: float = 0.0
+    buckets: dict = field(default_factory=dict)
+    demand_s: float = 0.0       # integral of ground-truth demand (req)
+    slo_demand_s: float = 0.0   # the SLO-attained part of it
+    # per-reconcile-interval bucket costs, flushed into DecisionRecord
+    # annotations at each cycle boundary
+    interval_buckets: dict = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return full_name(self.spec.name, self.spec.namespace)
+
+    def add(self, bucket: str, cost: float) -> None:
+        if cost <= 0.0:
+            return
+        self.buckets[bucket] = self.buckets.get(bucket, 0.0) + cost
+        self.interval_buckets[bucket] = \
+            self.interval_buckets.get(bucket, 0.0) + cost
+
+
+@dataclass
+class VariantResult:
+    """One variant's goodput ledger for the whole run."""
+
+    name: str
+    namespace: str
+    chip: str
+    price_per_hour: float
+    cost_dollar_seconds: float
+    demand_seconds: float
+    slo_demand_seconds: float
+    badput: dict[str, float]          # bucket -> dollar-seconds
+    min_desired_after_publish: int
+    scaled_to_zero_on_stale: bool
+
+    @property
+    def goodput_fraction(self) -> float:
+        """Useful share of the provisioned cost, in [0, 1]."""
+        if self.cost_dollar_seconds <= 0.0:
+            return 0.0
+        return self.badput.get(GOODPUT_USEFUL, 0.0) / self.cost_dollar_seconds
+
+    @property
+    def slo_attainment(self) -> float:
+        if self.demand_seconds <= 0.0:
+            return 1.0
+        return self.slo_demand_seconds / self.demand_seconds
+
+    @property
+    def goodput(self) -> float:
+        """SLO-attained demand-seconds per dollar-second provisioned."""
+        if self.cost_dollar_seconds <= 0.0:
+            return 0.0
+        return self.slo_demand_seconds / self.cost_dollar_seconds
+
+
+@dataclass
+class ScenarioResult:
+    """A full twin run: per-variant ledgers + the run's fault/decision
+    evidence. `decisions` is the reconciler's DecisionLog with goodput
+    annotations applied — feed it to `obs.explain_text` to answer why a
+    cycle lost goodput."""
+
+    scenario: str
+    duration_s: float
+    cycles: int
+    raised_cycles: int
+    fault_trips: int
+    goodput_floor: float
+    variants: list[VariantResult]
+    decisions: object = None    # obs.DecisionLog (kept out of to_dict)
+    emitter: object = None      # MetricsEmitter of the run
+
+    @property
+    def cost_dollar_seconds(self) -> float:
+        return sum(v.cost_dollar_seconds for v in self.variants)
+
+    @property
+    def goodput_fraction(self) -> float:
+        cost = self.cost_dollar_seconds
+        if cost <= 0.0:
+            return 0.0
+        return sum(v.badput.get(GOODPUT_USEFUL, 0.0)
+                   for v in self.variants) / cost
+
+    @property
+    def slo_attainment(self) -> float:
+        demand = sum(v.demand_seconds for v in self.variants)
+        if demand <= 0.0:
+            return 1.0
+        return sum(v.slo_demand_seconds for v in self.variants) / demand
+
+    @property
+    def goodput(self) -> float:
+        cost = self.cost_dollar_seconds
+        if cost <= 0.0:
+            return 0.0
+        return sum(v.slo_demand_seconds for v in self.variants) / cost
+
+    @property
+    def never_scaled_to_zero(self) -> bool:
+        return not any(v.scaled_to_zero_on_stale for v in self.variants)
+
+    def to_dict(self) -> dict:
+        def r(x: float) -> float:
+            return round(x, 6)
+
+        def badput_fractions(cost: float, buckets: dict) -> dict:
+            if cost <= 0.0:
+                return {}
+            return {b: r(c / cost) for b, c in sorted(buckets.items())
+                    if b != GOODPUT_USEFUL}
+
+        totals: dict[str, float] = {}
+        for v in self.variants:
+            for b, c in v.badput.items():
+                totals[b] = totals.get(b, 0.0) + c
+        return {
+            "scenario": self.scenario,
+            "duration_s": self.duration_s,
+            "cycles": self.cycles,
+            "raised_cycles": self.raised_cycles,
+            "fault_trips": self.fault_trips,
+            "goodput_floor": self.goodput_floor,
+            "goodput_fraction": r(self.goodput_fraction),
+            "goodput_demand_per_dollar_s": r(self.goodput),
+            "slo_attainment": r(self.slo_attainment),
+            "cost_dollar_seconds": r(self.cost_dollar_seconds),
+            "never_scaled_to_zero": self.never_scaled_to_zero,
+            "badput": badput_fractions(self.cost_dollar_seconds, totals),
+            "variants": {
+                v.name: {
+                    "chip": v.chip,
+                    "price_per_hour": r(v.price_per_hour),
+                    "goodput_fraction": r(v.goodput_fraction),
+                    # the cost-skew axis: how many SLO-attained
+                    # demand-seconds each dollar-second of this
+                    # generation bought
+                    "goodput_demand_per_dollar_s": r(v.goodput),
+                    "slo_attainment": r(v.slo_attainment),
+                    "cost_dollar_seconds": r(v.cost_dollar_seconds),
+                    "demand_seconds": r(v.demand_seconds),
+                    "badput": badput_fractions(v.cost_dollar_seconds,
+                                               v.badput),
+                    "min_desired_after_publish":
+                        v.min_desired_after_publish,
+                }
+                for v in self.variants
+            },
+        }
+
+
+def _slice_config(spec: VariantSpec) -> SliceModelConfig:
+    """Emulator physics for the variant's lane. Memory is sized to be
+    non-binding (the goodput scenarios stress capacity and evidence, not
+    KV eviction — the tail-stress suite owns that axis)."""
+    lane = CHIP_MATRIX[spec.chip]
+    return SliceModelConfig(
+        model_name=spec.model, slice_name=lane.slice_name,
+        alpha=lane.alpha, beta=lane.beta,
+        gamma=lane.gamma, delta=lane.delta,
+        max_batch_size=lane.max_batch,
+        hbm_gb=16.0 * lane.chips, model_size_gb=8.0,
+        kv_mb_per_token=0.25,
+    )
+
+
+def _seed_kube(scenario: Scenario, kube: InMemoryKube) -> None:
+    """ConfigMaps, Deployments, VAs, and node pools for the scenario —
+    the same wiring shape the closed-loop e2e tests use, generalized to
+    many variants/generations."""
+    interval = f"{scenario.reconcile_interval_s:.0f}s"
+    operator = {"GLOBAL_OPT_INTERVAL": interval, **scenario.operator}
+    if scenario.limited_mode:
+        operator.setdefault("WVA_LIMITED_MODE", "true")
+    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
+                                 operator))
+
+    # slice-shape catalog: spot-priced when any variant on the shape is
+    # spot (the scenarios never mix pricing on one shape)
+    accel: dict[str, str] = {}
+    for v in scenario.variants:
+        lane = CHIP_MATRIX[v.chip]
+        accel[v.chip] = json.dumps({
+            "chip": lane.generation,
+            "chips": str(lane.chips),
+            "cost": f"{v.cost_per_hour}",
+        })
+    kube.put_configmap(ConfigMap(ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+                                 accel))
+
+    rows = "\n".join(
+        f"  - model: {v.model}\n"
+        f"    slo-tpot: {v.slo_itl_ms:.0f}\n"
+        f"    slo-ttft: {v.slo_ttft_ms:.0f}"
+        for v in scenario.variants)
+    kube.put_configmap(ConfigMap(
+        SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"premium": f"name: Premium\npriority: 1\ndata:\n{rows}\n"}))
+
+    for v in scenario.variants:
+        lane = CHIP_MATRIX[v.chip]
+        kube.put_deployment(Deployment(name=v.name, namespace=v.namespace,
+                                       spec_replicas=1, status_replicas=1))
+        kube.put_variant_autoscaling(crd.VariantAutoscaling(
+            metadata=crd.ObjectMeta(
+                name=v.name, namespace=v.namespace,
+                labels={crd.ACCELERATOR_LABEL: v.chip}),
+            spec=crd.VariantAutoscalingSpec(
+                model_id=v.model,
+                slo_class_ref=crd.ConfigMapKeyRef(
+                    name=SERVICE_CLASS_CM_NAME, key="premium"),
+                model_profile=crd.ModelProfile(accelerators=[
+                    crd.AcceleratorProfile(
+                        acc=v.chip, acc_count=1,
+                        perf_parms=crd.PerfParms(
+                            decode_parms={"alpha": str(lane.alpha),
+                                          "beta": str(lane.beta)},
+                            prefill_parms={"gamma": str(lane.gamma),
+                                           "delta": str(lane.delta)},
+                        ),
+                        max_batch_size=lane.max_batch,
+                    ),
+                ]),
+            ),
+        ))
+
+    for pool in scenario.node_pools:
+        label = GKE_POOL_LABELS[pool.generation]
+        for i in range(pool.count):
+            kube.put_node(Node(
+                name=f"{pool.prefix}-{i}",
+                labels={"cloud.google.com/gke-tpu-accelerator": label},
+                tpu_capacity=pool.chips_per_node,
+            ))
+
+
+def run_scenario(scenario: Scenario) -> ScenarioResult:
+    """Run one scenario to completion and return its goodput ledger."""
+    plan = FaultPlan(list(scenario.faults), seed=scenario.seed)
+    kube = InMemoryKube()
+    _seed_kube(scenario, kube)
+    kube.attach_fault_plan(plan)
+
+    sinks: list[PrometheusSink] = []
+    states: list[_VariantState] = []
+    fleets: list[Fleet] = []
+    for v in scenario.variants:
+        prom_sink = PrometheusSink(v.model, v.namespace)
+        recorder = _TTFTRecorder()
+        fleet = Fleet(_slice_config(v), _FanSink(prom_sink, recorder),
+                      replicas=1)
+        sinks.append(prom_sink)
+        fleets.append(fleet)
+        states.append(_VariantState(
+            spec=v, fleet=fleet, recorder=recorder,
+            price_per_hour=v.cost_per_hour))
+
+    sim = Simulation(fleets, seed=scenario.seed)
+    backends = [SimPromAPI(sink, v.model, v.namespace, fault_plan=plan)
+                for sink, v in zip(sinks, scenario.variants)]
+    prom = MultiPromAPI(backends)
+    emitter = MetricsEmitter()
+    rec = Reconciler(kube=kube, prom=prom, emitter=emitter,
+                     now=lambda: sim.now_ms / 1000.0, sleep=lambda _s: None)
+
+    for i, (v, fleet) in enumerate(zip(scenario.variants, fleets)):
+        gen = PoissonLoadGenerator(
+            sim, schedule=list(v.schedule),
+            tokens=TokenDistribution(v.avg_in_tokens, v.avg_out_tokens,
+                                     "deterministic"),
+            seed=scenario.seed * 1000 + i, fleet=fleet)
+        gen.start()
+
+    tick_s = scenario.tick_s
+    interval_ms = scenario.reconcile_interval_s * 1000.0
+    delay_ms = scenario.actuation_delay_s * 1000.0
+    cycle = 0
+    raised = 0
+    next_reconcile = interval_ms
+
+    def pool_limit(st: _VariantState,
+                   capacity: dict[str, int] | None) -> int | None:
+        """Max replicas the variant's generation pool can host right now
+        (limited-mode scenarios only; None = unconstrained)."""
+        if capacity is None:
+            return None
+        lane = CHIP_MATRIX[st.spec.chip]
+        return capacity.get(lane.generation, 0) // max(lane.chips, 1)
+
+    def gen_capacity() -> dict[str, int] | None:
+        """Live schedulable chips per generation, through the SAME node
+        LIST the collector's inventory uses — so drain/reclaim windows
+        act on the twin's pods exactly as they act on the solver."""
+        if not scenario.limited_mode:
+            return None
+        return collect_inventory_k8s(kube)
+
+    def set_actual(st: _VariantState, n: int, now_ms: float) -> None:
+        st.actual = n
+        st.fleet.set_replicas(max(n, 0), now_ms)
+        kube.put_deployment(Deployment(
+            name=st.spec.name, namespace=st.spec.namespace,
+            spec_replicas=st.desired or st.actual,
+            status_replicas=st.actual))
+        sim.kick()
+
+    def apply_target(st: _VariantState, now_ms: float) -> None:
+        """Make the fleet match the published target (idempotent — the
+        startup-lag callback re-reads the CURRENT target at fire time).
+        In limited mode the target is additionally clamped to what the
+        generation pool can host: pods cannot schedule onto drained or
+        reclaimed nodes."""
+        target = st.desired if st.published_once else st.actual
+        limit = pool_limit(st, gen_capacity())
+        if limit is not None:
+            target = min(target, limit)
+        if target == st.actual:
+            return
+        set_actual(st, target, now_ms)
+
+    def meter_tick(now_ms: float) -> None:
+        # capacity withdrawal reaches the PODS, not just the solver: a
+        # replica whose node drained away or was reclaimed dies now (its
+        # in-flight work reroutes/queues per the engine's drain path)
+        capacity = gen_capacity()
+        if capacity is not None:
+            for st in states:
+                limit = pool_limit(st, capacity)
+                if limit is not None and st.actual > limit:
+                    log.info("capacity withdrawal killed replicas",
+                             extra=kv(variant=st.spec.name,
+                                      had=st.actual, fit=limit))
+                    set_actual(st, limit, now_ms)
+        for st in states:
+            d = rate_at(now_ms / 1000.0, st.spec.schedule) / 60.0  # req/s
+            ttfts = st.recorder.take_until(now_ms)
+            if not st.published_once or st.r_star <= 0.0:
+                continue    # warmup: nothing published to judge yet
+            n = len(st.fleet.all_replicas())    # draining still bills
+            price_s = st.price_per_hour / 3600.0
+            cost = n * price_s * tick_s
+            st.cost_s += cost
+            if d > 0.0:
+                st.demand_s += d * tick_s
+            n_req = int(math.ceil(d / st.r_star)) if d > 0.0 else 0
+            limit = pool_limit(st, capacity)
+            latency_ok = (not ttfts or
+                          sum(ttfts) / len(ttfts) <= st.spec.slo_ttft_ms)
+            if n >= n_req and latency_ok:
+                if d > 0.0:
+                    st.slo_demand_s += d * tick_s
+                st.add(GOODPUT_USEFUL, min(n, n_req) * price_s * tick_s)
+                surplus = (n - n_req) * price_s * tick_s
+                st.add(GOODPUT_DEGRADED if st.rung in DEGRADED_RUNGS
+                       else GOODPUT_OVER, surplus)
+            else:
+                # the whole provisioned cost served SLO-violating load:
+                # attribute it to WHY the controller was wrong
+                if st.rung in DEGRADED_RUNGS:
+                    bucket = GOODPUT_DEGRADED
+                elif (n < n_req <= st.desired
+                        and (limit is None or limit >= n_req)):
+                    # the published decision was right and the pool could
+                    # host it — pods were simply still starting. A pool
+                    # that CANNOT host the right count is withdrawn
+                    # capacity: under-provisioned, not lag
+                    bucket = GOODPUT_LAGGED
+                else:
+                    bucket = GOODPUT_UNDER
+                st.add(bucket, cost)
+
+    def flush_interval(ended_cycle: int) -> None:
+        """Stamp the interval's dominant badput bucket onto the cycle's
+        DecisionRecords (the audit-trail half of the goodput story)."""
+        for st in states:
+            buckets = st.interval_buckets
+            st.interval_buckets = {}
+            if not buckets or ended_cycle <= 0:
+                continue
+            total = sum(buckets.values())
+            badput = {b: c for b, c in buckets.items()
+                      if b != GOODPUT_USEFUL}
+            if badput and max(badput.values()) > 0.0:
+                bucket = max(sorted(badput), key=lambda b: badput[b])
+                share = badput[bucket] / total if total > 0 else 0.0
+            else:
+                bucket, share = GOODPUT_USEFUL, 1.0
+            rec.decisions.annotate_goodput(
+                st.spec.name, st.spec.namespace, ended_cycle, bucket,
+                detail=f"{share:.0%} of {total:.4f} $·s interval cost")
+
+    def reconcile(now_ms: float) -> None:
+        nonlocal cycle, raised
+        flush_interval(cycle)
+        plan.begin_cycle()
+        cycle += 1
+        rungs: dict[str, str] = {}
+        try:
+            result = rec.reconcile()
+            rungs = dict(result.degraded)
+        except Exception as e:  # noqa: BLE001 — run_forever's catch, inline
+            raised += 1
+            log.warning("twin reconcile cycle raised",
+                        extra=kv(scenario=scenario.name, cycle=cycle,
+                                 error=str(e)))
+            for st in states:
+                rungs[st.key] = "hold"
+        envelopes = rec.capacity_envelopes()
+        # the cycle-level rung floors every variant's rung: a cycle that
+        # went limited (optimizer could not fit) or died into hold
+        # governs the whole interval even though no per-variant entry
+        # exists in result.degraded
+        cycle_rung = int(emitter.value(
+            "inferno_cycle_degradation_state") or 0)
+        rung_ints = {label: value for value, label in _RUNG_LABELS.items()}
+        for st in states:
+            variant_rung = rung_ints.get(rungs.get(st.key, "healthy"), 0)
+            st.rung = _RUNG_LABELS[max(variant_rung, cycle_rung)]
+            va = kube.get_variant_autoscaling(st.spec.name,
+                                             st.spec.namespace)
+            desired = va.status.desired_optimized_alloc.num_replicas
+            if desired > 0:
+                st.desired = desired
+                st.published_once = True
+                st.min_desired_after_publish = min(
+                    st.min_desired_after_publish, desired)
+                cap = envelopes.get(st.key, 0.0)
+                if cap > 0.0:
+                    st.r_star = cap / desired
+                if desired < st.actual:
+                    apply_target(st, now_ms)     # scale-down: immediate
+                elif desired > st.actual:
+                    sim.schedule(delay_ms, "call",
+                                 lambda t, st=st: apply_target(st, t))
+            elif st.published_once:
+                # a published variant dropping to zero on a degraded rung
+                # is the exact failure the stale-veto guardrail forbids
+                if st.rung in DEGRADED_RUNGS:
+                    st.scaled_to_zero_on_stale = True
+                st.min_desired_after_publish = 0
+
+    def on_tick(now_ms: float) -> None:
+        nonlocal next_reconcile
+        prom.scrape(now_ms)
+        meter_tick(now_ms)
+        if now_ms >= next_reconcile:
+            next_reconcile += interval_ms
+            reconcile(now_ms)
+
+    sim.run_until(scenario.duration_s * 1000.0, on_tick=on_tick,
+                  tick_ms=tick_s * 1000.0)
+    flush_interval(cycle)
+
+    variants = [
+        VariantResult(
+            name=st.spec.name, namespace=st.spec.namespace,
+            chip=st.spec.chip, price_per_hour=st.price_per_hour,
+            cost_dollar_seconds=st.cost_s,
+            demand_seconds=st.demand_s,
+            slo_demand_seconds=st.slo_demand_s,
+            badput=dict(st.buckets),
+            min_desired_after_publish=(
+                st.min_desired_after_publish
+                if st.min_desired_after_publish < 10**9 else 0),
+            scaled_to_zero_on_stale=st.scaled_to_zero_on_stale,
+        )
+        for st in states
+    ]
+    return ScenarioResult(
+        scenario=scenario.name, duration_s=scenario.duration_s,
+        cycles=cycle, raised_cycles=raised, fault_trips=len(plan.trips),
+        goodput_floor=scenario.goodput_floor, variants=variants,
+        decisions=rec.decisions, emitter=emitter,
+    )

@@ -18,18 +18,23 @@ from .inject import (
 from .plan import (
     ALL_KINDS,
     DEP_KUBE,
+    DEP_NODE_POOL,
     DEP_PROMETHEUS,
     DEP_WATCH,
     KUBE_CONFLICT,
     KUBE_ERROR,
     KUBE_KINDS,
     KUBE_NOT_FOUND,
+    NODE_POOL_DRAIN,
+    NODE_POOL_KINDS,
     PROM_CLOCK_SKEW,
     PROM_KINDS,
     PROM_LABEL_DROP,
     PROM_NAN,
+    PROM_OUTAGE,
     PROM_PARTIAL,
     PROM_TIMEOUT,
+    SPOT_RECLAIM,
     WATCH_DROP,
     FaultPlan,
     FaultRule,
@@ -38,6 +43,7 @@ from .plan import (
 __all__ = [
     "ALL_KINDS",
     "DEP_KUBE",
+    "DEP_NODE_POOL",
     "DEP_PROMETHEUS",
     "DEP_WATCH",
     "FaultPlan",
@@ -49,12 +55,16 @@ __all__ = [
     "KUBE_ERROR",
     "KUBE_KINDS",
     "KUBE_NOT_FOUND",
+    "NODE_POOL_DRAIN",
+    "NODE_POOL_KINDS",
     "PROM_CLOCK_SKEW",
     "PROM_KINDS",
     "PROM_LABEL_DROP",
     "PROM_NAN",
+    "PROM_OUTAGE",
     "PROM_PARTIAL",
     "PROM_TIMEOUT",
+    "SPOT_RECLAIM",
     "WATCH_DROP",
     "apply_prom_fault",
     "exception_for_kube_fault",

@@ -61,7 +61,10 @@ def apply_prom_fault(plan: FaultPlan | None, promql: str,
     # downstream symptoms (no-op outside an active cycle trace)
     add_event("fault-injected", dependency=plan_mod.DEP_PROMETHEUS,
               kind=rule.kind, match=rule.match, query=promql[:120])
-    if rule.kind == plan_mod.PROM_TIMEOUT:
+    if rule.kind in (plan_mod.PROM_TIMEOUT, plan_mod.PROM_OUTAGE):
+        # prom-outage-window is a correlated hard outage: the shared
+        # window covers every query of every backend holding this plan,
+        # so the whole fleet goes blind and recovers together
         raise InjectedTimeout(
             f"injected prometheus timeout for {promql[:80]!r}")
     if rule.kind == plan_mod.PROM_PARTIAL:

@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import random
 import threading
+import zlib
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -29,6 +30,7 @@ from typing import Optional
 DEP_PROMETHEUS = "prometheus"
 DEP_KUBE = "kube"
 DEP_WATCH = "watch"
+DEP_NODE_POOL = "node-pool"
 
 # fault kinds (the fault matrix; see docs/robustness.md)
 PROM_TIMEOUT = "prom-timeout"        # query raises TimeoutError
@@ -39,19 +41,35 @@ PROM_LABEL_DROP = "prom-label-drop"  # samples matching `labels` dropped from
                                      # answers (one variant's series vanish
                                      # from a grouped fleet result while the
                                      # rest of the vector stays intact)
+PROM_OUTAGE = "prom-outage-window"   # hard correlated outage: EVERY query
+                                     # times out for the window, whatever
+                                     # its text — one shared window covers
+                                     # all backends of a MultiPromAPI, so
+                                     # start/stop are correlated across the
+                                     # fleet (a real TSDB dies whole)
 KUBE_CONFLICT = "kube-conflict"      # matching verbs raise 409 ConflictError
 KUBE_ERROR = "kube-error"            # matching verbs raise a transport error
 KUBE_NOT_FOUND = "kube-not-found"    # matching verbs raise 404 NotFoundError
 WATCH_DROP = "watch-drop"            # watch events silently swallowed
+NODE_POOL_DRAIN = "node-pool-drain"  # matching nodes read unschedulable
+                                     # (GKE pool maintenance: capacity
+                                     # withdraws, the apiserver stays up)
+SPOT_RECLAIM = "spot-reclaim"        # matching nodes vanish from LISTs
+                                     # (preemptible VM reclamation; the
+                                     # per-node draw is stable for the
+                                     # whole window — a reclaimed node
+                                     # stays gone, it does not flap)
 
 PROM_KINDS = (PROM_TIMEOUT, PROM_PARTIAL, PROM_NAN, PROM_CLOCK_SKEW,
-              PROM_LABEL_DROP)
+              PROM_LABEL_DROP, PROM_OUTAGE)
 KUBE_KINDS = (KUBE_CONFLICT, KUBE_ERROR, KUBE_NOT_FOUND)
-ALL_KINDS = PROM_KINDS + KUBE_KINDS + (WATCH_DROP,)
+NODE_POOL_KINDS = (NODE_POOL_DRAIN, SPOT_RECLAIM)
+ALL_KINDS = PROM_KINDS + KUBE_KINDS + NODE_POOL_KINDS + (WATCH_DROP,)
 
 _KIND_DEPS = {
     **{k: DEP_PROMETHEUS for k in PROM_KINDS},
     **{k: DEP_KUBE for k in KUBE_KINDS},
+    **{k: DEP_NODE_POOL for k in NODE_POOL_KINDS},
     WATCH_DROP: DEP_WATCH,
 }
 
@@ -68,8 +86,10 @@ class FaultRule:
 
     match: substring filter on the call being intercepted — the PromQL
     text for prometheus kinds, "verb:Kind" (e.g. "get:ConfigMap",
-    "update_status:VariantAutoscaling") for kube kinds; "" matches
-    every call of the dependency.
+    "update_status:VariantAutoscaling") for kube kinds,
+    "node-name:pool-label" (e.g. ":tpu-v5-lite-podslice" to take a whole
+    generation, "spot-" to take nodes by name prefix) for node-pool
+    kinds; "" matches every call of the dependency.
     probability: per-call trip chance, drawn from the rule's own seeded
     rng (1.0 = always).
     skew_s: for prom-clock-skew, how far sample timestamps are shifted
@@ -207,6 +227,34 @@ class FaultPlan:
     def watch_dropping(self) -> bool:
         """True while a watch-drop window is active (events swallowed)."""
         return self._active((WATCH_DROP,), "") is not None
+
+    def node_fault(self, node_name: str, pool: str) -> Optional[FaultRule]:
+        """First active node-pool rule (drain/reclaim) covering this node,
+        or None. Matched against "node-name:pool-label". Unlike the other
+        lookups, probability is evaluated per (rule, node) from a STABLE
+        seeded hash rather than the rule's rng stream: node LISTs repeat
+        every cycle, and a spot node reclaimed by the draw must stay
+        reclaimed for the whole window instead of flapping back per LIST.
+        Drain rules ignore probability (maintenance takes the whole
+        pool)."""
+        text = f"{node_name}:{pool}"
+        with self._lock:
+            for i, rule in enumerate(self.rules):
+                if rule.kind not in NODE_POOL_KINDS:
+                    continue
+                if not rule.in_window(self.cycle, self.now_s):
+                    continue
+                if rule.match and rule.match not in text:
+                    continue
+                if rule.kind == SPOT_RECLAIM and rule.probability < 1.0:
+                    draw = random.Random(
+                        (self.seed * 1_000_003 + i)
+                        ^ zlib.crc32(node_name.encode())).random()
+                    if draw >= rule.probability:
+                        continue
+                self.trips.append((self.cycle, rule.kind, text[:120]))
+                return rule
+        return None
 
     # -- scripting (JSON form: the emulator server's WVA_FAULT_PLAN) ------
 

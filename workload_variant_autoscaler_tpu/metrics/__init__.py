@@ -148,9 +148,15 @@ INFERNO_COLLECTION_SECONDS = "inferno_collection_seconds"
 # that PROVE steady-state analyze+optimize is O(changed-variants)
 INFERNO_SOLVE_MODE_TOTAL = "inferno_solve_mode_total"
 INFERNO_SOLVE_LANES = "inferno_solve_lanes"
+# limited-mode inventory visibility: schedulable chips per TPU generation
+# as the collector saw them this cycle — a maintenance drain or a spot
+# reclamation wave reads as this series SHRINKING, never as a kube error
+# storm (docs/robustness.md, node-pool fault kinds)
+INFERNO_POOL_CAPACITY_CHIPS = "inferno_pool_capacity_chips"
 
 LABEL_DEPENDENCY = "dependency"
 LABEL_OUTCOME = "outcome"
+LABEL_GENERATION = "generation"
 LABEL_MODE = "mode"
 LABEL_STATE = "state"
 STATE_SOLVED = "solved"
@@ -374,6 +380,15 @@ class MetricsEmitter:
             "fast path; skipped: reused from the signature cache)",
             [LABEL_STATE], registry=self.registry,
         )
+        # limited-mode chip inventory, per generation: a draining node
+        # pool or a spot-reclamation wave is visible as this gauge
+        # shrinking cycle over cycle
+        self.pool_capacity = Gauge(
+            INFERNO_POOL_CAPACITY_CHIPS,
+            "Schedulable google.com/tpu chips per generation as collected "
+            "this cycle (limited mode only; empty when capacity-unaware)",
+            [LABEL_GENERATION], registry=self.registry,
+        )
         # perf-model drift (beyond-reference: the reference never compares
         # its scraped latencies against its own queueing model)
         self.model_drift = Gauge(
@@ -426,6 +441,17 @@ class MetricsEmitter:
                 **{LABEL_STATE: STATE_SOLVED}).set(lanes_solved)
             self.solve_lanes.labels(
                 **{LABEL_STATE: STATE_SKIPPED}).set(lanes_skipped)
+
+    def emit_pool_capacity_metrics(self, capacity: dict[str, int]) -> None:
+        """Replace the per-generation inventory gauge wholesale each
+        cycle (a generation whose last node drained away must stop
+        exporting its stale chip count). Pass {} outside limited mode —
+        capacity-unaware cycles export nothing rather than a lie."""
+        with self._lock:
+            self.pool_capacity.clear()
+            for generation, chips in capacity.items():
+                self.pool_capacity.labels(
+                    **{LABEL_GENERATION: generation}).set(chips)
 
     def emit_power_metrics(
         self, per_variant: dict[tuple[str, str, str], float]

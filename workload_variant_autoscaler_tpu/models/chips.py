@@ -28,6 +28,18 @@ class ChipSpec:
     hbm_gb: float          # per chip
     power: PowerSpec       # per chip
     chips_per_host: int    # max chips on one host (single-host slice bound)
+    # spot/preemptible price as a fraction of on-demand (GCP spot TPUs
+    # run at a steep discount; the exact ratio is region/time-varying —
+    # these are fixture defaults in the same spirit as cost_per_chip).
+    # Interruptible capacity is cheap precisely because it can be
+    # reclaimed mid-serve: the goodput twin prices spot pools with this
+    # and then charges the reclamation wave's badput against the savings.
+    spot_discount: float = 0.35
+
+    @property
+    def spot_cost_per_chip(self) -> float:
+        """cents/hr for interruptible (spot/preemptible) capacity."""
+        return self.cost_per_chip * self.spot_discount
 
 
 # Default catalog. Costs are illustrative defaults (same role as the

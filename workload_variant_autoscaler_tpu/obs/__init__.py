@@ -17,6 +17,12 @@ from .decision import (
     CLAMP_REPLICA_STEP,
     CLAMP_STABILIZATION,
     CLAMP_STALE_VETO,
+    GOODPUT_BUCKETS,
+    GOODPUT_DEGRADED,
+    GOODPUT_LAGGED,
+    GOODPUT_OVER,
+    GOODPUT_UNDER,
+    GOODPUT_USEFUL,
     HELD,
     LIMITED,
     PUBLISHED,
@@ -50,6 +56,12 @@ __all__ = [
     "DecisionInputs",
     "DecisionLog",
     "DecisionRecord",
+    "GOODPUT_BUCKETS",
+    "GOODPUT_DEGRADED",
+    "GOODPUT_LAGGED",
+    "GOODPUT_OVER",
+    "GOODPUT_UNDER",
+    "GOODPUT_USEFUL",
     "HELD",
     "LIMITED",
     "PUBLISHED",

@@ -24,12 +24,23 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, dataclass, field, replace as _dc_replace
 from typing import Callable, Optional
 
 from .trace import _capacity_from_env
 
 DEFAULT_DECISION_BUFFER = 256
+
+# goodput-attribution buckets (emulator/twin.py's fleet meter): where the
+# chip-cost-seconds governed by this decision went. "" = not metered
+# (production records outside a twin run).
+GOODPUT_USEFUL = "useful"
+GOODPUT_UNDER = "under-provisioned"
+GOODPUT_OVER = "over-provisioned"
+GOODPUT_DEGRADED = "degradation-held"
+GOODPUT_LAGGED = "actuation-lagged"
+GOODPUT_BUCKETS = (GOODPUT_USEFUL, GOODPUT_UNDER, GOODPUT_OVER,
+                   GOODPUT_DEGRADED, GOODPUT_LAGGED)
 
 # outcome values
 PUBLISHED = "published"    # a fresh allocation was published this cycle
@@ -94,6 +105,13 @@ class DecisionRecord:
     published_replicas: int = 0
     outcome: str = PUBLISHED
     reason: str = ""               # for held/limited: why
+    # per-cycle goodput attribution (GOODPUT_* buckets), stamped by the
+    # fleet twin's meter AFTER the decision's interval has played out —
+    # the one post-hoc annotation on the audit trail, applied by
+    # wholesale record replacement (DecisionLog.annotate_goodput), never
+    # by mutation. "" = unmetered.
+    goodput_bucket: str = ""
+    goodput_detail: str = ""
 
     def replay(self) -> int:
         """Re-derive the published count from the record alone: start at
@@ -121,7 +139,7 @@ def record_from_dict(obj: dict) -> DecisionRecord:
     clamps = tuple(Clamp(**c) for c in (obj.get("clamps") or []))
     known = {"trace_id", "cycle", "ts", "variant", "namespace",
              "accelerator", "proposed_replicas", "published_replicas",
-             "outcome", "reason"}
+             "outcome", "reason", "goodput_bucket", "goodput_detail"}
     kwargs = {k: v for k, v in obj.items() if k in known}
     return DecisionRecord(inputs=inputs, clamps=clamps, **kwargs)
 
@@ -136,6 +154,10 @@ def explain_text(record: DecisionRecord) -> str:
         f"  outcome: {record.outcome}"
         + (f" ({record.reason})" if record.reason else ""),
         f"  degradation rung: {i.degradation}",
+        *([f"  goodput: {record.goodput_bucket}"
+           + (f" ({record.goodput_detail})" if record.goodput_detail
+              else "")]
+          if record.goodput_bucket else []),
         *([f"  collection path: {i.collection_mode}"]
           if i.collection_mode else []),
         *([f"  solve path: {i.solve_mode}"] if i.solve_mode else []),
@@ -204,6 +226,26 @@ class DecisionLog:
     def snapshot(self, variant: str = "", namespace: str = "",
                  limit: Optional[int] = None) -> list[dict]:
         return [r.to_dict() for r in self.records(variant, namespace, limit)]
+
+    def annotate_goodput(self, variant: str, namespace: str, cycle: int,
+                         bucket: str, detail: str = "") -> bool:
+        """Stamp a cycle's goodput attribution onto its record (the fleet
+        twin meters an interval AFTER the decision that governed it was
+        frozen). The record is REPLACED with an updated copy — records
+        themselves stay immutable. Returns False when the cycle's record
+        has already rotated out of the ring."""
+        if bucket not in GOODPUT_BUCKETS:
+            raise ValueError(f"unknown goodput bucket {bucket!r}; known: "
+                             f"{list(GOODPUT_BUCKETS)}")
+        with self._lock:
+            for i in range(len(self._records) - 1, -1, -1):
+                rec = self._records[i]
+                if rec.variant == variant and rec.namespace == namespace \
+                        and rec.cycle == cycle:
+                    self._records[i] = _dc_replace(
+                        rec, goodput_bucket=bucket, goodput_detail=detail)
+                    return True
+        return False
 
 
 @dataclass
