@@ -35,6 +35,14 @@ bench-loop-churn: ## Steady-state incremental-solve bench: 512 variants, 1% chur
 bench-goodput: ## Fleet goodput digital twin: all six scenarios, seeded + sim-time (regenerates BENCH_goodput_r08.json byte-identically)
 	$(PY) bench_goodput.py
 
+.PHONY: bench-profile
+bench-profile: ## Cycle wall-clock attribution: 512-variant load-shift cycle, sampler on, determinism double-run (writes BENCH_profile_r09.json)
+	$(PY) bench_profile.py
+
+.PHONY: profile-smoke
+profile-smoke: ## Abbreviated attribution-ledger run: asserts the partition-sums-to-wall invariant and zero steady-state retraces (~30s)
+	$(PY) bench_profile.py --smoke
+
 .PHONY: bench-scenarios
 bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO headlines + mean ablations, tail stress, strict SLO)
 	$(PY) bench_loop.py whole-fleet-p95
@@ -47,7 +55,7 @@ bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO 
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo
 
-LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py __graft_entry__.py
+LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_profile.py __graft_entry__.py
 
 .PHONY: lint
 lint: ## Static analysis gate: ruff+mypy when installed, wvalint always (rule catalog: docs/developer-guide/wvalint.md)

@@ -134,7 +134,11 @@ def seed_prom(store: FakePromAPI, rps: float = 30.0) -> None:
             store.set_result(q, v, labels=labels)
 
 
-def build_cluster() -> tuple[CountingKube, LatencyPromAPI, Reconciler]:
+def build_cluster(n_variants: int = N_VARIANTS,
+                  ) -> tuple[CountingKube, LatencyPromAPI, Reconciler]:
+    """The bench fleet: n_variants VAs sharing N_MODELS models, one
+    fixed-latency Prometheus, one in-memory kube. bench_profile.py
+    reuses this at 512 (the artifact cycle) and small (smoke)."""
     kube = CountingKube()
     kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
                                  {"GLOBAL_OPT_INTERVAL": "60s",
@@ -152,7 +156,7 @@ def build_cluster() -> tuple[CountingKube, LatencyPromAPI, Reconciler]:
         SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
         {"premium": f"name: Premium\npriority: 1\ndata:\n{slos}\n"},
     ))
-    for i in range(N_VARIANTS):
+    for i in range(n_variants):
         name = f"chat-{i}"
         kube.put_deployment(Deployment(name=name, namespace=NS,
                                        spec_replicas=1, status_replicas=1))

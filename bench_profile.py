@@ -1,0 +1,147 @@
+"""bench_profile: where did the 727ms go — cycle wall-clock attribution.
+
+BENCH_solve_r07 established that analyze+optimize is ~20ms of a ~727ms
+512-variant cycle; nothing in the repo could decompose the rest. This
+bench drives the SAME 512-variant fleet shape as bench_collect (fixed
+2ms-per-query Prometheus latency model, in-memory kube) through a
+warm-up cycle and one profiled WHOLE-FLEET load-shift cycle (every
+signature changes, every lane re-solves through the resident arena),
+with the residual sampler on (WVA_PROFILE_SAMPLE_HZ), and commits the
+cycle's full attribution ledger as BENCH_profile_r09.json:
+
+- `buckets` partitions the cycle wall EXACTLY (Σ exclusive +
+  unattributed == wall — the invariant every run re-asserts here and
+  tests/test_perf_claims.py asserts on the committed artifact);
+- `value` is the attributed fraction (named buckets / wall), claimed
+  >= 0.9;
+- `python_ms` is the headline residual — stage-exclusive + unattributed
+  Python orchestration, the fusion target of ROADMAP item 3 — itemized
+  by caller via the stdlib sampler;
+- `jax` is the profiled cycle's self-audit delta: ZERO retraces in
+  steady state (the warm-up cycle pays the compiles), constant
+  host<->device transfer counts;
+- `determinism` records a full double-run: the partition invariant
+  holds in both runs and the bucket keyset + aggregated span-tree shape
+  are identical (timings vary with the host; structure must not).
+
+`--smoke` (the `make profile-smoke` target) runs an abbreviated fleet
+and only asserts the invariants — no artifact is written.
+
+The batched XLA backend is forced (WVA_NATIVE_KERNEL=false) so the
+profiled cycle exercises the jit/pack entry points the audit hooks
+instrument; bench_collect keeps the backend-default collection numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("LOG_LEVEL", "error")
+# exercise the audited jit entry points (CPU hosts default to the C++
+# kernel, which never touches JAX) and keep collection deterministic
+os.environ.setdefault("WVA_NATIVE_KERNEL", "false")
+os.environ.setdefault("WVA_PROFILE_SAMPLE_HZ", "97")
+
+from bench_collect import N_VARIANTS, build_cluster, seed_prom  # noqa: E402
+
+SMOKE_VARIANTS = 32
+OUT = "BENCH_profile_r09.json"
+
+
+def profiled_cycle(n_variants: int) -> dict:
+    """One warm-up cycle (compiles, first publish), then one profiled
+    WHOLE-FLEET load-shift cycle — every variant's demand moved, so
+    every signature changes and every lane re-solves through the
+    resident arena. The worst case for the jit audit, and it must still
+    show ZERO retraces (the arena's pinned shapes are the invariant).
+    Returns the profiled cycle's ProfileRecord dict."""
+    kube, prom, rec = build_cluster(n_variants)
+    rec.reconcile()                     # warm-up: compile + first publish
+    seed_prom(prom.store, rps=36.0)     # fleet-wide demand step
+    result = rec.reconcile()            # the attributed cycle
+    assert len(result.processed) == n_variants, result.skipped
+    record = rec.profiler.records()[0]
+    return record.to_dict()
+
+
+def assert_invariants(rec: dict) -> None:
+    """The acceptance invariants every run must satisfy."""
+    wall = rec["wall_ms"]
+    total = sum(rec["buckets"].values())
+    assert wall > 0.0, "profiled cycle recorded no wall time"
+    assert abs(total - wall) <= max(1e-6 * wall, 1e-3), \
+        f"partition broken: buckets sum {total} != wall {wall}"
+    assert rec["attributed_fraction"] >= 0.9, \
+        f"only {rec['attributed_fraction']:.3f} of the wall attributed"
+    assert any(k.startswith("stage:") for k in rec["buckets"])
+    assert "kube" in rec["buckets"] and "prometheus" in rec["buckets"]
+    assert not rec["jax"]["retraces"], \
+        f"steady-state cycle retraced: {rec['jax']['retraces']}"
+    assert rec["jax"]["transfers"].get("h2d", 0) > 0, \
+        "load-shift cycle dispatched no kernels (audit hooks dead?)"
+    assert rec["residual_by_caller"], \
+        "sampler produced no residual itemization (cycle too fast?)"
+
+
+def tree_shape(node: dict):
+    return (node["name"], node["count"],
+            tuple(tree_shape(c) for c in node.get("children", [])))
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv[1:]
+    n = SMOKE_VARIANTS if smoke else N_VARIANTS
+    first = profiled_cycle(n)
+    assert_invariants(first)
+    if smoke:
+        print(json.dumps({
+            "bench": "profile-smoke", "variants": n,
+            "wall_ms": first["wall_ms"],
+            "attributed_fraction": first["attributed_fraction"],
+            "python_ms": first["python_ms"],
+        }), flush=True)
+        return
+
+    second = profiled_cycle(n)          # determinism double-run
+    assert_invariants(second)
+    determinism = {
+        "partition_holds_both_runs": True,   # assert_invariants raised if not
+        "bucket_keys_match":
+            sorted(first["buckets"]) == sorted(second["buckets"]),
+        "tree_shape_matches":
+            tree_shape(first["tree"]) == tree_shape(second["tree"]),
+    }
+    assert all(determinism.values()), determinism
+
+    top_residual = dict(sorted(first["residual_by_caller"].items(),
+                               key=lambda kv: -kv[1])[:10])
+    out = {
+        "metric": "cycle_wall_attributed_fraction",
+        "bench": "profile",
+        "variants": n,
+        "value": first["attributed_fraction"],
+        "unit": "fraction of cycle wall in named buckets",
+        "wall_ms": first["wall_ms"],
+        "python_ms": first["python_ms"],
+        "unattributed_ms": first["unattributed_ms"],
+        "buckets": first["buckets"],
+        "top_residual_by_caller_ms": top_residual,
+        "jax": first["jax"],
+        "determinism": determinism,
+        "second_run": {
+            "wall_ms": second["wall_ms"],
+            "attributed_fraction": second["attributed_fraction"],
+            "python_ms": second["python_ms"],
+        },
+    }
+    with open(OUT, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -142,13 +142,13 @@ class TestCallArity:
 @pytest.mark.parametrize("paths", [
     ["workload_variant_autoscaler_tpu", "tools", "tests", "bench.py",
      "bench_loop.py", "bench_collect.py", "bench_goodput.py",
-     "__graft_entry__.py"],
+     "bench_profile.py", "__graft_entry__.py"],
 ])
 def test_package_lints_clean(paths):
     """The gate itself: the shipped source must lint clean — every rule
     family including concurrency safety (WVL401-403), knob parity
-    (WVL311/312), literal validity (WVL321/322), and the stale-noqa
-    audit (WVL005)."""
+    (WVL311/312), literal validity (WVL321/322), stage coverage
+    (WVL304), and the stale-noqa audit (WVL005)."""
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "wvalint.py"), *paths],
         capture_output=True, text=True, cwd=REPO, timeout=300)
@@ -905,7 +905,7 @@ class TestKnobParity:
         # drivers read WVA_* knobs too (WVA_BENCH_*, WVA_GOODPUT_*)
         for sub in ("workload_variant_autoscaler_tpu", "tools", "tests",
                     "bench.py", "bench_loop.py", "bench_collect.py",
-                    "bench_goodput.py"):
+                    "bench_goodput.py", "bench_profile.py"):
             for fp in wvalint.iter_py_files([os.path.join(REPO, sub)]):
                 files.append(fp)
                 with open(fp, encoding="utf-8") as f:
@@ -1043,6 +1043,70 @@ class TestStageLiterals:
             {metrics_py: tree}, os.path.join("metrics", "__init__.py"),
             "RECONCILE_STAGES")
         assert stages == STAGES
+
+
+class TestStageCoverage:
+    """WVL304 — the reverse of WVL322: every RECONCILE_STAGES constant
+    needs a live mark()/span site, or its series can only read zero."""
+
+    STAGE_CONSTS = {"STAGE_CONFIG": "config", "STAGE_PREPARE": "prepare",
+                    "STAGE_ANALYZE": "analyze"}
+
+    def _sites(self, src: str):
+        import ast as ast_mod
+
+        return wvalint._stage_use_sites(ast_mod.parse(src),
+                                        self.STAGE_CONSTS)
+
+    def test_mark_literal_and_const_both_count(self):
+        assert self._sites("mark('config')\n") == {"config"}
+        assert self._sites("mark(STAGE_PREPARE)\n") == {"prepare"}
+        assert self._sites("mark(metrics.STAGE_ANALYZE)\n") == {"analyze"}
+
+    def test_span_name_literal_counts(self):
+        assert self._sites("t.begin('stage:publish')\n") == {"publish"}
+
+    def test_stage_kwarg_read_does_not_count(self):
+        # reading a stage's series back is not producing it
+        assert self._sites("emitter.value(s, stage='config')\n") == set()
+
+    def test_uncovered_stage_fires(self):
+        findings = wvalint.check_stage_coverage(
+            {"config": 10, "prepare": 11}, used={"config"})
+        assert [(f.code, f.line) for f in findings] == [("WVL304", 11)]
+        assert "prepare" in findings[0].message
+
+    def test_full_coverage_silent(self):
+        assert wvalint.check_stage_coverage(
+            {"config": 10}, used={"config", "extra"}) == []
+
+    def test_repo_stages_all_covered(self):
+        """The real repo surface: every stage in metrics.RECONCILE_STAGES
+        has a live mark() site in the reconciler (the repo-wide zero-
+        findings gate test_package_lints_clean asserts this too; this
+        pins the driver wiring specifically)."""
+        files = [os.path.join(REPO, "workload_variant_autoscaler_tpu",
+                              "metrics", "__init__.py"),
+                 os.path.join(REPO, "workload_variant_autoscaler_tpu",
+                              "controller", "reconciler.py")]
+        import ast as ast_mod
+
+        trees = {}
+        for fp in files:
+            with open(fp, encoding="utf-8") as f:
+                trees[fp] = ast_mod.parse(f.read(), fp)
+        assert wvalint._stage_coverage_findings(files, trees) == []
+
+    def test_gated_on_reconciler_in_scan(self):
+        """A metrics-module-only scan must not report phantom uncovered
+        stages (the WVL311 partial-scan rule, same shape)."""
+        fp = os.path.join(REPO, "workload_variant_autoscaler_tpu",
+                          "metrics", "__init__.py")
+        import ast as ast_mod
+
+        with open(fp, encoding="utf-8") as f:
+            trees = {fp: ast_mod.parse(f.read(), fp)}
+        assert wvalint._stage_coverage_findings([fp], trees) == []
 
 
 class TestStaleNoqa:

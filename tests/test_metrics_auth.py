@@ -210,6 +210,104 @@ class TestServeEndToEnd:
         assert status == 401
 
 
+class TestDebugRoutesAuthGated:
+    """The flight recorder's read surface (/debug/traces,
+    /debug/decisions, /debug/profile) mounts INSIDE the auth gate:
+    serve() wraps ONE app — debug middleware first, then the gate in
+    front — so every debug route 401s/403s exactly like /metrics, and a
+    new route can never ship outside the gate by construction."""
+
+    DEBUG_ROUTES = ("/debug/traces", "/debug/decisions", "/debug/profile")
+
+    @pytest.fixture()
+    def served(self):
+        from workload_variant_autoscaler_tpu.obs import (
+            DecisionLog,
+            Profiler,
+            Tracer,
+            debug_middleware,
+        )
+
+        emitter = MetricsEmitter()
+        tracer = Tracer(capacity=4)
+        with tracer.span("reconcile", cycle=1):
+            pass
+        profiler = Profiler(capacity=4)
+        profiler.observe(tracer.traces()[0], cycle=1, ts=0.0)
+        gate = KubeAuthGate(granted_kube())
+        server, thread, _rel = emitter.serve(
+            0, addr="127.0.0.1", auth_gate=gate,
+            debug_middleware=debug_middleware(tracer, DecisionLog(4),
+                                              profiler))
+        yield f"http://127.0.0.1:{server.server_address[1]}"
+        server.shutdown()
+
+    def _get(self, url, token=None):
+        req = urllib.request.Request(url)
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status, dict(resp.headers), resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), e.read()
+
+    def test_all_debug_routes_401_without_token(self, served):
+        for route in self.DEBUG_ROUTES:
+            status, headers, body = self._get(served + route)
+            assert status == 401, route
+            # the ONE middleware path: the same bearer challenge (and no
+            # flight-recorder payload) on every route
+            assert headers.get("WWW-Authenticate") == "Bearer", route
+            assert b"traces" not in body and b"profiles" not in body, route
+
+    def test_all_debug_routes_401_with_forged_token(self, served):
+        for route in self.DEBUG_ROUTES:
+            status, _h, _b = self._get(served + route, token="forged")
+            assert status == 401, route
+
+    def test_debug_routes_serve_with_valid_token(self, served):
+        import json as json_mod
+
+        status, _h, body = self._get(served + "/debug/traces", token=TOKEN)
+        assert status == 200
+        assert json_mod.loads(body)["traces"][0]["root"] == "reconcile"
+        status, _h, body = self._get(served + "/debug/profile",
+                                     token=TOKEN)
+        assert status == 200
+        assert json_mod.loads(body)["profiles"][0]["cycle"] == 1
+        status, _h, body = self._get(served + "/debug/decisions",
+                                     token=TOKEN)
+        assert status == 200
+        assert json_mod.loads(body)["decisions"] == []
+
+    def test_rbacless_token_403_on_debug_routes(self, served=None):
+        from workload_variant_autoscaler_tpu.obs import (
+            DecisionLog,
+            Profiler,
+            Tracer,
+            debug_middleware,
+        )
+        from workload_variant_autoscaler_tpu.metrics.authz import wrap_wsgi
+
+        kube = InMemoryKube()
+        kube.grant_token(TOKEN, USER)   # authenticates, no RBAC grant
+        inner = debug_middleware(Tracer(capacity=2), DecisionLog(2),
+                                 Profiler(capacity=2))(
+            lambda env, sr: (sr("200 OK", []), [b""])[1])
+        gated = wrap_wsgi(inner, KubeAuthGate(kube))
+        for route in self.DEBUG_ROUTES:
+            captured = {}
+
+            def start_response(status, hdrs):
+                captured["status"] = status
+
+            b"".join(gated({"PATH_INFO": route, "QUERY_STRING": "",
+                            "HTTP_AUTHORIZATION": f"Bearer {TOKEN}"},
+                           start_response))
+            assert captured["status"].startswith("403"), route
+
+
 class TestCacheBound:
     def test_token_spray_bounded_memory(self):
         """An unauthenticated client spraying unique bearer tokens must

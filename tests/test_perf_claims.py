@@ -240,6 +240,54 @@ def test_goodput_claims_match_artifact():
         assert name in doc, f"{name} missing from the scenario catalog"
 
 
+def test_profile_claims_match_artifact():
+    """Round-9 cycle attribution: the committed BENCH_profile_r09.json
+    must (a) attribute >= 90% of a 512-variant cycle's wall to named
+    buckets, (b) satisfy the exact-partition invariant (sum of exclusive
+    buckets + unattributed == cycle wall) on the committed numbers, (c)
+    show the zero-retrace steady state with the residual itemized by
+    caller, (d) carry a passing determinism double-run, and (e) match
+    the numbers quoted in docs/observability.md."""
+    art = _artifact("BENCH_profile_r09.json")
+    assert art["bench"] == "profile"
+    assert art["variants"] == 512
+    assert art["value"] >= 0.9, \
+        "artifact no longer justifies the >=90% attribution claim"
+    # the exact-partition invariant, on the committed artifact itself
+    assert sum(art["buckets"].values()) == pytest.approx(
+        art["wall_ms"], abs=1e-6)
+    assert art["buckets"]["unattributed"] == art["unattributed_ms"]
+    assert art["value"] == pytest.approx(
+        1.0 - art["unattributed_ms"] / art["wall_ms"], abs=1e-3)
+    # the headline residual: stage-exclusive + unattributed Python
+    stage_ms = sum(v for k, v in art["buckets"].items()
+                   if k.startswith("stage:"))
+    assert art["python_ms"] == pytest.approx(
+        stage_ms + art["unattributed_ms"], abs=1e-6)
+    # a whole-fleet load-shift cycle dispatched kernels yet never
+    # retraced — the arena's zero-retrace invariant, monitored
+    assert art["jax"]["retraces"] == {}
+    assert art["jax"]["transfers"].get("h2d", 0) > 0
+    assert art["jax"]["transfers"].get("d2h", 0) > 0
+    # the residual is itemized by caller (the stdlib sampling fallback)
+    assert art["top_residual_by_caller_ms"], "residual not itemized"
+    assert all(":" in caller for caller in art["top_residual_by_caller_ms"])
+    # determinism double-run: invariant held in both runs, and the
+    # bucket keyset + aggregated span-tree shape were identical
+    det = art["determinism"]
+    assert det["partition_holds_both_runs"] is True
+    assert det["bucket_keys_match"] is True
+    assert det["tree_shape_matches"] is True
+    assert art["second_run"]["attributed_fraction"] >= 0.9
+    # doc parity: observability.md quotes this artifact
+    doc = (REPO / "docs" / "observability.md").read_text()
+    flat = " ".join(doc.split())
+    assert f"**{art['value'] * 100:.2f} %**" in flat, \
+        "observability.md's attribution claim drifted from the artifact"
+    assert f"{art['wall_ms']:.1f} ms" in flat
+    assert f"{art['python_ms']:.1f} ms" in flat
+
+
 def test_capstone_claims_match_baseline_json():
     """Round-5 whole-fleet capstone: every quoted tail and the headline
     must equal the committed BASELINE.json entry, and the entry itself

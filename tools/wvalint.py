@@ -41,6 +41,11 @@ Rules (suppress per-line with `# noqa` or `# noqa: WVLxxx`):
   WVL302  metrics doc parity: an `INFERNO_*` series constant whose
           series name does not appear in docs/metrics-health-monitoring.md
           (an exported series operators can't look up)
+  WVL304  stage coverage parity: a constant in metrics.RECONCILE_STAGES
+          with no live `mark(...)` / `"stage:<name>"` span site anywhere
+          in the scan — the stage's gauge/histogram/ledger series can
+          only ever read zero (the reverse direction of WVL322, the
+          same two-way shape as WVL311/312)
   WVL311  config-knob doc parity: a `WVA_*` knob read from os.environ in
           package/tools code with no row in docs/user-guide/configuration.md
           (a knob operators can't discover)
@@ -1720,6 +1725,92 @@ def _check_stage_literals(path: str, tree: ast.Module,
     return findings
 
 
+# -- stage coverage parity (WVL304) ------------------------------------------
+
+# the reconciler module anchors the rule: without it in the scan there
+# are no real mark() sites, and every stage would read uncovered
+RECONCILER_MODULE_SUFFIX = os.path.join("controller", "reconciler.py")
+
+
+def _stage_use_sites(tree: ast.Module, stage_consts: dict) -> set:
+    """Stage values this module LIVELY marks or spans: `mark("x")`,
+    `mark(STAGE_X)` / `mark(metrics.STAGE_X)` resolved through the
+    metrics module's constants, and `"stage:x"` span-name literals.
+    `stage=` keyword reads deliberately do not count — reading a
+    stage's series back is not producing it."""
+    used: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _call_tail(node) == "mark" \
+                and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                used.add(arg.value)
+            elif isinstance(arg, ast.Name) and arg.id in stage_consts:
+                used.add(stage_consts[arg.id])
+            elif isinstance(arg, ast.Attribute) and \
+                    arg.attr in stage_consts:
+                used.add(stage_consts[arg.attr])
+        elif isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                node.value.startswith("stage:"):
+            used.add(node.value[len("stage:"):])
+    return used
+
+
+def check_stage_coverage(stages_with_lines: dict, used: set,
+                         path: str = "metrics/__init__.py",
+                         ) -> list[Finding]:
+    """WVL304 — every member of metrics.RECONCILE_STAGES must have a
+    live mark()/span site somewhere in the scan; a constant nothing
+    marks is a stage whose series can only ever read zero."""
+    findings: list[Finding] = []
+    for stage, line in sorted(stages_with_lines.items()):
+        if stage not in used:
+            findings.append(Finding(
+                path, line, "WVL304",
+                f"reconcile stage {stage!r} has no live mark()/span "
+                "site in the scan — its stage series can only read "
+                "zero"))
+    return findings
+
+
+def _stage_coverage_findings(files: list[str],
+                             trees: dict[str, ast.Module]) -> list[Finding]:
+    """Run WVL304 only when the scan plausibly covers the whole mark
+    surface: both the metrics module (the vocabulary) and the
+    reconciler (the marker) must be in scope — partial runs must not
+    report phantom uncovered stages."""
+    metrics_fp = next((fp for fp in files if os.path.abspath(fp).endswith(
+        METRICS_MODULE_SUFFIX) and fp in trees), None)
+    if metrics_fp is None or not any(
+            os.path.abspath(fp).endswith(RECONCILER_MODULE_SUFFIX)
+            for fp in files):
+        return []
+    consts = _module_consts(trees[metrics_fp])
+    stages = consts.get("RECONCILE_STAGES")
+    if not isinstance(stages, tuple):
+        return []
+    stage_consts = {name: val for name, val in consts.items()
+                    if name.startswith("STAGE_") and isinstance(val, str)}
+    lines: dict = {}
+    for node in trees[metrics_fp].body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if name in stage_consts:
+                lines[stage_consts[name]] = node.lineno
+            elif name == "RECONCILE_STAGES":
+                for s in stages:
+                    lines.setdefault(s, node.lineno)
+    used: set = set()
+    for fp, tree in trees.items():
+        if os.path.abspath(fp).endswith(METRICS_MODULE_SUFFIX):
+            continue   # the vocabulary module itself is not a use site
+        used |= _stage_use_sites(tree, stage_consts)
+    return check_stage_coverage(
+        {s: lines.get(s, 1) for s in stages}, used, metrics_fp)
+
+
 # -- driver ----------------------------------------------------------------
 
 
@@ -1835,6 +1926,7 @@ def main(argv=None) -> int:
                                 fault_kinds, stages)
     findings += _metrics_doc_findings(files, sources)
     findings += _knob_parity_findings(files, sources, trees)
+    findings += _stage_coverage_findings(files, trees)
     for f in sorted(findings, key=lambda f: (f.path, f.line)):
         print(f.format())
     if findings:

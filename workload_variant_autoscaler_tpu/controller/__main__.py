@@ -12,13 +12,24 @@ Usage:
         [--config-namespace NS] [--allow-http-prom]
 
     python -m workload_variant_autoscaler_tpu.controller explain <variant> \
-        [--namespace NS] [--url http://HOST:METRICS_PORT] [--json]
+        [--namespace NS] [--url http://HOST:METRICS_PORT] [--json] [--trace]
+
+    python -m workload_variant_autoscaler_tpu.controller profile \
+        [--cycle N] [--url http://HOST:METRICS_PORT] [--json]
 
 The `explain` subcommand renders a variant's latest DecisionRecord —
 the solve inputs, every clamp applied, and the published replica count,
 reproducible from the record alone — fetched from a running
 controller's /debug/decisions endpoint (or a saved JSON dump via
---file; see docs/observability.md).
+--file; see docs/observability.md). `--trace` additionally renders the
+decision's cycle span tree with exclusive/inclusive wall columns from
+the attribution ledger (/debug/profile).
+
+The `profile` subcommand renders a cycle's full wall-clock attribution
+(docs/observability.md "Profiling"): the exact-partition bucket ledger,
+a text flamegraph with exclusive/inclusive columns, the JAX self-audit
+delta, and the sampled residual itemization when WVA_PROFILE_SAMPLE_HZ
+was on.
 """
 
 from __future__ import annotations
@@ -32,12 +43,82 @@ import threading
 
 from ..collector import HTTPPromAPI, PrometheusConfig, validate_prometheus_api
 from ..metrics import MetricsEmitter
-from ..obs import debug_middleware, explain_text, record_from_dict
+from ..obs import (
+    debug_middleware,
+    explain_text,
+    record_from_dict,
+    render_profile,
+    render_tree,
+)
 from ..utils import get_logger, kv
 from ..utils.platform import pin_platform_from_env
 from .kube import RestKube, in_memory_kube_from_manifests
 from .reconciler import CONFIG_MAP_NAMESPACE, Reconciler
 from .runtime import HealthServer, LeaderElector
+
+
+def _fetch_profiles(url: str, file: str | None,
+                    cycle: int | None = None) -> list[dict]:
+    """The /debug/profile payload (or a saved dump): a list of
+    ProfileRecord dicts, newest first."""
+    if file:
+        with open(file, encoding="utf-8") as f:
+            payload = json.load(f)
+    else:
+        from urllib.parse import urlencode
+        from urllib.request import urlopen
+
+        params = {"limit": 64}
+        if cycle is not None:
+            params["cycle"] = cycle
+        query = urlencode(params)
+        full = f"{url.rstrip('/')}/debug/profile?{query}"
+        with urlopen(full, timeout=10.0) as resp:  # noqa: S310 — operator-supplied URL
+            payload = json.load(resp)
+    profiles = payload.get("profiles", payload) \
+        if isinstance(payload, dict) else payload
+    return [p for p in profiles if isinstance(p, dict)]
+
+
+def profile_main(argv) -> int:
+    """The attribution read path: where did cycle N's wall time go.
+    Exits 0 with the rendered ledger, 1 when no record exists."""
+    parser = argparse.ArgumentParser(
+        prog="python -m workload_variant_autoscaler_tpu.controller profile",
+        description="Render a reconcile cycle's wall-clock attribution "
+                    "ledger from its ProfileRecord")
+    parser.add_argument("--cycle", type=int, default=None,
+                        help="cycle number (default: the latest profiled "
+                             "cycle)")
+    parser.add_argument("--url",
+                        default=os.environ.get("WVA_DEBUG_URL",
+                                               "http://127.0.0.1:8080"),
+                        help="base URL of the controller's metrics/debug "
+                             "server (default http://127.0.0.1:8080)")
+    parser.add_argument("--file", default=None, metavar="PATH",
+                        help="read a saved /debug/profile JSON payload "
+                             "instead of querying a live controller")
+    parser.add_argument("--json", action="store_true",
+                        help="print the raw record JSON instead of the "
+                             "rendered ledger")
+    args = parser.parse_args(argv)
+
+    profiles = _fetch_profiles(args.url, args.file, cycle=args.cycle)
+    if args.cycle is not None:
+        profiles = [p for p in profiles if p.get("cycle") == args.cycle]
+    if not profiles:
+        print("no ProfileRecord"
+              + (f" for cycle {args.cycle}" if args.cycle is not None
+                 else "")
+              + " (rotated out of WVA_PROFILE_BUFFER, or no cycle has "
+                "run yet)", file=sys.stderr)
+        return 1
+    record = profiles[0]
+    if args.json:
+        print(json.dumps(record, indent=2, default=str))
+    else:
+        print(render_profile(record))
+    return 0
 
 
 def explain_main(argv) -> int:
@@ -61,6 +142,13 @@ def explain_main(argv) -> int:
     parser.add_argument("--json", action="store_true",
                         help="print the raw record JSON instead of the "
                              "rendered explanation")
+    parser.add_argument("--trace", action="store_true",
+                        help="also render the decision's cycle span tree "
+                             "with exclusive/inclusive wall columns (from "
+                             "/debug/profile, or --profile-file)")
+    parser.add_argument("--profile-file", default=None, metavar="PATH",
+                        help="with --trace: read a saved /debug/profile "
+                             "payload instead of querying the controller")
     args = parser.parse_args(argv)
 
     if args.file:
@@ -95,6 +183,26 @@ def explain_main(argv) -> int:
         replayed = record.replay()
         print(f"  replay check: clamp chain reproduces {replayed} "
               f"({'OK' if replayed == record.published_replicas else 'MISMATCH'})")
+    if args.trace:
+        # the decision's cycle, through the attribution ledger: the same
+        # renderer `controller profile` uses, scoped to the span tree
+        try:
+            profiles = _fetch_profiles(args.url, args.profile_file,
+                                       cycle=record.cycle)
+        except OSError as e:
+            print(f"  trace unavailable: {e}", file=sys.stderr)
+            return 0
+        match = [p for p in profiles if p.get("cycle") == record.cycle]
+        if not match:
+            print(f"  trace unavailable: cycle {record.cycle} rotated "
+                  "out of WVA_PROFILE_BUFFER", file=sys.stderr)
+            return 0
+        prof = match[0]
+        print(f"\ncycle {record.cycle} span tree "
+              f"(wall {prof.get('wall_ms', 0.0):.3f} ms, attributed "
+              f"{prof.get('attributed_fraction', 0.0) * 100.0:.1f}%):")
+        print(render_tree(prof.get("tree", {}),
+                          wall_ms=prof.get("wall_ms")))
     return 0
 
 
@@ -102,6 +210,8 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "explain":
         return explain_main(argv[1:])
+    if argv and argv[0] == "profile":
+        return profile_main(argv[1:])
     parser = argparse.ArgumentParser(description="TPU-native workload variant autoscaler")
     parser.add_argument("--metrics-port", type=int, default=8080,
                         help="port for the emitted /metrics endpoint")
@@ -262,10 +372,11 @@ def main(argv=None) -> int:
             client_cafile=args.metrics_client_ca or None,
             auth_gate=auth_gate,
             # the flight recorder's read surface (/debug/traces,
-            # /debug/decisions — docs/observability.md), inside the
-            # auth gate when one is configured
+            # /debug/decisions, /debug/profile — docs/observability.md),
+            # inside the auth gate when one is configured
             debug_middleware=debug_middleware(reconciler.tracer,
-                                              reconciler.decisions),
+                                              reconciler.decisions,
+                                              reconciler.profiler),
         )
     except ValueError as e:
         log.error("invalid metrics TLS configuration", extra=kv(error=str(e)))

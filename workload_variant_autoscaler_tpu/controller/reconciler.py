@@ -55,6 +55,8 @@ from ..obs import (
     DecisionBuilder,
     DecisionInputs,
     DecisionLog,
+    Profiler,
+    ResidualSampler,
     Tracer,
 )
 from ..obs import trace as obs_trace
@@ -123,6 +125,7 @@ class Reconciler:
         monotonic=time.monotonic,
         tracer: Optional[Tracer] = None,
         decisions: Optional[DecisionLog] = None,
+        profiler: Optional[Profiler] = None,
     ):
         self.kube = kube
         self.prom = prom
@@ -136,9 +139,18 @@ class Reconciler:
         # DecisionRecord per variant per cycle — served by /debug/traces
         # and /debug/decisions on the metrics server and by the
         # `controller explain` CLI. Ring capacities from WVA_TRACE_BUFFER
-        # / WVA_TRACE_DECISIONS.
+        # / WVA_TRACE_DECISIONS. The tracer derives span DURATIONS from
+        # the injected clock too, so sim-time runs (emulator/twin.py)
+        # trace sim durations, deterministically.
         self.tracer = tracer or Tracer(now=now)
         self.decisions = decisions or DecisionLog(now=now)
+        # wall-clock attribution ledger (obs/profile.py): each cycle's
+        # trace folds into a ProfileRecord partitioning the cycle wall
+        # into exclusive buckets + the unattributed residual, served by
+        # /debug/profile and `controller profile`; the per-cycle JAX
+        # audit delta (retraces/compiles/transfers) rides along onto the
+        # inferno_jit_* series. Ring capacity from WVA_PROFILE_BUFFER.
+        self.profiler = profiler or Profiler()
         self._trace_log = os.environ.get(
             "WVA_TRACE_LOG", "").lower() in ("1", "true")
         self._cycle_index = 0
@@ -385,6 +397,16 @@ class Reconciler:
         t0 = time.perf_counter()
         self._cycle_index += 1
         self._cycle_builders = {}
+        # WVA_PROFILE_SAMPLE_HZ: the residual itemizer — a stdlib stack
+        # sampler on THIS thread that breaks the ledger's unattributed /
+        # stage-exclusive Python time down by caller. Wall-clock based,
+        # off by default (0); `make bench-profile` turns it on.
+        sampler = None
+        sample_hz = parse_float_or(
+            os.environ.get("WVA_PROFILE_SAMPLE_HZ")
+            or self._last_operator_cm.get("WVA_PROFILE_SAMPLE_HZ"), 0.0)
+        if sample_hz > 0:
+            sampler = ResidualSampler(sample_hz).start()
         root = self.tracer.begin("reconcile", cycle=self._cycle_index)
         # the open slot for the stage currently running; mark() names it
         # after the stage it just completed and opens the next slot
@@ -441,6 +463,20 @@ class Reconciler:
                                   spans=len(root.trace.spans),
                                   degradation=cycle_state.label,
                                   status=root.status))
+            # fold the finished trace into the attribution ledger and
+            # drain the cycle's JAX-audit delta onto the inferno_jit_*
+            # series. Observability only: a ledger bug must not fail
+            # (or re-fail) the cycle.
+            try:
+                residual = sampler.stop() if sampler is not None else None
+                record = self.profiler.observe(
+                    root.trace, cycle=self._cycle_index, ts=self.now(),
+                    residual=residual)
+                if record is not None:
+                    self.emitter.emit_jax_audit(record.jax)
+            except Exception as e:  # noqa: BLE001
+                log.warning("cycle profile ledger failed",
+                            extra=kv(error=str(e)))
             self.emitter.emit_cycle_timing(stages)
             self.emitter.emit_degradation_metrics(
                 self._degradation.gauge_samples(),

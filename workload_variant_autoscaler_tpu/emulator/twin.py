@@ -239,6 +239,11 @@ class ScenarioResult:
     variants: list[VariantResult]
     decisions: object = None    # obs.DecisionLog (kept out of to_dict)
     emitter: object = None      # MetricsEmitter of the run
+    # obs.Tracer of the run (kept out of to_dict): span durations are
+    # SIM durations — the tracer derives them from the reconciler's
+    # injected clock — so a scenario rerun traces byte-identically
+    # (asserted by tests/test_twin.py)
+    tracer: object = None
 
     @property
     def cost_dollar_seconds(self) -> float:
@@ -640,5 +645,5 @@ def run_scenario(scenario: Scenario) -> ScenarioResult:
         scenario=scenario.name, duration_s=scenario.duration_s,
         cycles=cycle, raised_cycles=raised, fault_trips=len(plan.trips),
         goodput_floor=scenario.goodput_floor, variants=variants,
-        decisions=rec.decisions, emitter=emitter,
+        decisions=rec.decisions, emitter=emitter, tracer=rec.tracer,
     )

@@ -153,12 +153,21 @@ INFERNO_SOLVE_LANES = "inferno_solve_lanes"
 # reclamation wave reads as this series SHRINKING, never as a kube error
 # storm (docs/robustness.md, node-pool fault kinds)
 INFERNO_POOL_CAPACITY_CHIPS = "inferno_pool_capacity_chips"
+# JAX self-audit (obs/profile.py JAX_AUDIT, drained once per cycle): jit
+# retraces per kernel entry point, the compile seconds each retrace
+# paid, and host<->device transfers per direction — the series that make
+# the arena's zero-retrace steady state (solver/incremental.py) a
+# monitored invariant. A steady-state fleet shows these FLAT.
+INFERNO_JIT_RETRACES_TOTAL = "inferno_jit_retraces_total"
+INFERNO_JIT_COMPILE_SECONDS = "inferno_jit_compile_seconds"
+INFERNO_HOST_DEVICE_TRANSFERS_TOTAL = "inferno_host_device_transfers_total"
 
 LABEL_DEPENDENCY = "dependency"
 LABEL_OUTCOME = "outcome"
 LABEL_GENERATION = "generation"
 LABEL_MODE = "mode"
 LABEL_STATE = "state"
+LABEL_FN = "fn"
 STATE_SOLVED = "solved"
 STATE_SKIPPED = "skipped"
 
@@ -389,6 +398,32 @@ class MetricsEmitter:
             "this cycle (limited mode only; empty when capacity-unaware)",
             [LABEL_GENERATION], registry=self.registry,
         )
+        # JAX self-audit (obs/profile.py): retraces/compiles per jit
+        # entry point + host<->device transfers, drained per cycle. The
+        # retrace counter flat across steady-state cycles IS the
+        # zero-retrace invariant on the wire.
+        self.jit_retraces = Counter(
+            INFERNO_JIT_RETRACES_TOTAL.removesuffix("_total"),
+            "JAX retraces (recompilations) per jit entry point — a "
+            "steady-state fleet holds this flat; growth means shapes "
+            "are churning past the arena/bucketing",
+            [LABEL_FN], registry=self.registry,
+        )
+        self.jit_compile_seconds = Histogram(
+            INFERNO_JIT_COMPILE_SECONDS,
+            "Wall time paid per JAX retrace (trace + compile + first "
+            "execute) per jit entry point",
+            [LABEL_FN], buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                                 10.0, 30.0, 60.0),
+            registry=self.registry,
+        )
+        self.host_device_transfers = Counter(
+            INFERNO_HOST_DEVICE_TRANSFERS_TOTAL.removesuffix("_total"),
+            "Host<->device array transfers at the pack/readback choke "
+            "points (h2d: arrays staged onto device per kernel "
+            "dispatch; d2h: result arrays pulled back)",
+            [LABEL_DIRECTION], registry=self.registry,
+        )
         # perf-model drift (beyond-reference: the reference never compares
         # its scraped latencies against its own queueing model)
         self.model_drift = Gauge(
@@ -441,6 +476,22 @@ class MetricsEmitter:
                 **{LABEL_STATE: STATE_SOLVED}).set(lanes_solved)
             self.solve_lanes.labels(
                 **{LABEL_STATE: STATE_SKIPPED}).set(lanes_skipped)
+
+    def emit_jax_audit(self, delta: dict) -> None:
+        """One cycle's JAX self-audit delta (obs.JaxAudit.delta shape):
+        per-fn retrace counts, the compile events behind them, and
+        per-direction host<->device transfer counts."""
+        with self._lock:
+            for fn, count in (delta.get("retraces") or {}).items():
+                if count > 0:
+                    self.jit_retraces.labels(**{LABEL_FN: fn}).inc(count)
+            for fn, seconds in (delta.get("compiles") or []):
+                self.jit_compile_seconds.labels(
+                    **{LABEL_FN: fn}).observe(seconds)
+            for direction, count in (delta.get("transfers") or {}).items():
+                if count > 0:
+                    self.host_device_transfers.labels(
+                        **{LABEL_DIRECTION: direction}).inc(count)
 
     def emit_pool_capacity_metrics(self, capacity: dict[str, int]) -> None:
         """Replace the per-generation inventory gauge wholesale each
@@ -634,9 +685,10 @@ class MetricsEmitter:
         WithAuthenticationAndAuthorization filter, how in-cluster
         Prometheus service accounts actually authenticate — and composes
         with either transport. debug_middleware (obs.debug_middleware's
-        app->app wrapper) mounts the /debug/traces + /debug/decisions
-        flight-recorder routes next to /metrics, INSIDE the auth gate —
-        decision records are not more public than the series. Returns
+        app->app wrapper) mounts the /debug/traces + /debug/decisions +
+        /debug/profile flight-recorder routes next to /metrics, INSIDE
+        the auth gate — decision records are not more public than the
+        series. Returns
         (server, thread, reloader); reloader is None for plain HTTP."""
         if bool(certfile) != bool(keyfile):
             raise ValueError("metrics TLS requires both certfile and keyfile")
@@ -654,8 +706,8 @@ class MetricsEmitter:
 
         app = make_wsgi_app(self.registry)
         if debug_middleware is not None:
-            # the param is the obs.debug_middleware(tracer, decisions)
-            # RESULT: an app->app wrapper
+            # the param is the obs.debug_middleware(tracer, decisions,
+            # profiler) RESULT: an app->app wrapper
             app = debug_middleware(app)  # noqa: WVL201
         if auth_gate is not None:
             if not certfile:

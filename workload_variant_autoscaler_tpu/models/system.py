@@ -352,6 +352,12 @@ class System:
             sized = size_batch(q, slo, k_max)
         feasible = np.asarray(sized.feasible)
         rate_star = np.asarray(sized.throughput) * 1000.0  # req/sec per replica
+        from ..obs.profile import JAX_AUDIT
+
+        # sizing-result readback: 2 device arrays pulled to host (the
+        # d2h half of the transfer audit; the per-replica re-analysis
+        # pulls 5 more below)
+        JAX_AUDIT.note_transfer("d2h", 2)
 
         # replica counts + per-replica rates on host (tiny arrays; sized to
         # the padded batch so the re-analysis call reuses the same shape)
@@ -380,6 +386,7 @@ class System:
         rho_a = np.asarray(per_rep["rho"])
         rate_ok = np.asarray(per_rep["valid_rate"])
         max_batch_a = np.asarray(q.max_batch)
+        JAX_AUDIT.note_transfer("d2h", 5)
 
         for i, (server, acc_name, profile, target) in enumerate(pairs):
             if not feasible[i] or num_replicas[i] <= 0 or not rate_ok[i]:

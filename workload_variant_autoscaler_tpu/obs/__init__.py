@@ -9,8 +9,12 @@ at module load without cycles):
 - `decision`: immutable per-variant DecisionRecords — solve inputs,
   proposed count, every clamp applied, published count — replayable to
   the published number from the record alone.
-- `debug`: the /debug/traces + /debug/decisions WSGI routes mounted on
-  the metrics server.
+- `profile`: the per-cycle wall-clock attribution ledger (exact
+  partition of the cycle wall into exclusive buckets + an unattributed
+  residual), the JAX self-audit (retraces / compiles / host<->device
+  transfers), and the text flamegraph renderers.
+- `debug`: the /debug/traces + /debug/decisions + /debug/profile WSGI
+  routes mounted on the metrics server.
 """
 
 from .decision import (
@@ -35,6 +39,17 @@ from .decision import (
     record_from_dict,
 )
 from .debug import debug_middleware
+from .profile import (
+    JAX_AUDIT,
+    UNATTRIBUTED,
+    JaxAudit,
+    ProfileRecord,
+    Profiler,
+    ResidualSampler,
+    build_record,
+    render_profile,
+    render_tree,
+)
 from .trace import (
     Span,
     Trace,
@@ -63,18 +78,27 @@ __all__ = [
     "GOODPUT_UNDER",
     "GOODPUT_USEFUL",
     "HELD",
+    "JAX_AUDIT",
+    "JaxAudit",
     "LIMITED",
     "PUBLISHED",
+    "ProfileRecord",
+    "Profiler",
+    "ResidualSampler",
     "Span",
     "Trace",
     "Tracer",
+    "UNATTRIBUTED",
     "add_event",
+    "build_record",
     "current_span",
     "current_span_id",
     "current_trace_id",
     "debug_middleware",
     "explain_text",
     "record_from_dict",
+    "render_profile",
+    "render_tree",
     "set_attribute",
     "span",
 ]

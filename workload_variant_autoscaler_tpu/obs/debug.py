@@ -2,8 +2,8 @@
 
 WSGI middleware mounted on the metrics server (metrics/__init__.py
 `serve(debug_middleware=...)`), INSIDE the kube-auth gate when one is
-configured — trace and decision payloads describe the fleet and must not
-be more public than /metrics itself.
+configured — trace, decision, and profile payloads describe the fleet
+and must not be more public than /metrics itself.
 
 Routes:
 
@@ -12,6 +12,9 @@ Routes:
 - `GET /debug/decisions[?variant=V&namespace=NS&limit=N]` — the last N
   DecisionRecords (newest first), optionally filtered; what the
   `explain` CLI consumes.
+- `GET /debug/profile[?cycle=N&limit=N]` — the last N per-cycle
+  wall-clock attribution ledgers (obs/profile.py), newest first, or
+  exactly cycle N; what the `controller profile` CLI consumes.
 
 Stdlib-only, no intra-repo imports (see obs/trace.py's import rule).
 """
@@ -23,6 +26,7 @@ from typing import Optional
 from urllib.parse import parse_qs
 
 from .decision import DecisionLog
+from .profile import Profiler
 from .trace import Tracer
 
 
@@ -36,7 +40,8 @@ def _int_param(params: dict, key: str, default: Optional[int]) -> Optional[int]:
 
 
 def debug_middleware(tracer: Optional[Tracer],
-                     decisions: Optional[DecisionLog]):
+                     decisions: Optional[DecisionLog],
+                     profiler: Optional[Profiler] = None):
     """app -> app wrapper adding the /debug/* routes in front of
     whatever the inner app (the Prometheus exposition) serves."""
 
@@ -55,6 +60,12 @@ def debug_middleware(tracer: Optional[Tracer],
                     variant=params.get("variant", [""])[0],
                     namespace=params.get("namespace", [""])[0],
                     limit=limit or 64,
+                )}
+            elif path.rstrip("/") == "/debug/profile" \
+                    and profiler is not None:
+                body = {"profiles": profiler.snapshot(
+                    limit=limit or 8,
+                    cycle=_int_param(params, "cycle", None),
                 )}
             else:
                 payload = json.dumps({"error": "not found"}).encode()

@@ -110,7 +110,10 @@ class Span:
         self.events: list[tuple[float, str, dict]] = []
         self.status = "ok"
         self.error = ""
-        self._start_perf = time.perf_counter()
+        # duration clock reading at span start, in the tracer's perf
+        # timebase (seconds) — the attribution ledger (obs/profile.py)
+        # places spans on a common timeline with it
+        self.start_perf = tracer.perf()
         self._token: Optional[contextvars.Token] = None
         self._ended = False
 
@@ -119,7 +122,7 @@ class Span:
         return self
 
     def event(self, name: str, **attrs: Any) -> None:
-        offset_ms = (time.perf_counter() - self._start_perf) * 1000.0
+        offset_ms = (self.tracer.perf() - self.start_perf) * 1000.0
         self.events.append((round(offset_ms, 3), name, attrs))
 
     def finish(self, error: Optional[BaseException] = None) -> None:
@@ -128,7 +131,7 @@ class Span:
         if self._ended:
             return
         self._ended = True
-        self.duration_ms = (time.perf_counter() - self._start_perf) * 1000.0
+        self.duration_ms = (self.tracer.perf() - self.start_perf) * 1000.0
         if error is not None:
             self.status = "error"
             self.error = f"{type(error).__name__}: {error}"
@@ -227,13 +230,24 @@ class Tracer:
     `now` is injectable (sim-time tests); span/trace IDs are drawn from a
     counter so scripted chaos runs trace identically across reruns. The
     ring is guarded by a lock: the debug endpoint thread snapshots while
-    the reconcile thread appends."""
+    the reconcile thread appends.
+
+    `perf` is the DURATION clock. By default a tracer on wall time uses
+    `time.perf_counter` (monotonic, high resolution), but a tracer whose
+    `now` was injected derives durations from that same clock — a
+    twin/sim-time run (emulator/twin.py) records sim durations, not the
+    host's wall time, so rerunning the same scenario produces
+    byte-identical span durations."""
 
     def __init__(self, capacity: Optional[int] = None,
-                 now: Callable[[], float] = time.time):
+                 now: Callable[[], float] = time.time,
+                 perf: Optional[Callable[[], float]] = None):
         self.capacity = capacity or _capacity_from_env(
             "WVA_TRACE_BUFFER", DEFAULT_TRACE_BUFFER)
         self.now = now
+        if perf is None:
+            perf = time.perf_counter if now is time.time else now
+        self.perf = perf
         self._traces: deque[Trace] = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
         self._seq = 0
@@ -251,8 +265,12 @@ class Tracer:
     def begin(self, name: str, **attrs: Any) -> Span:
         """Open and ACTIVATE a span; the caller must finish() (or
         cancel()) it. A span opened with no active parent starts a new
-        trace in the ring."""
+        trace in the ring. A parent belonging to a DIFFERENT tracer is
+        ignored (a leaked never-finished span from another tracer must
+        not graft this tracer's spans onto a foreign trace)."""
         parent = _current_span.get()
+        if parent is not None and parent.tracer is not self:
+            parent = None
         if parent is None:
             trace = Trace(self._next_id("t"))
             with self._lock:

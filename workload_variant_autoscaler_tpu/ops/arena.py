@@ -100,6 +100,11 @@ class CandidateArena:
                 buf[:c] = rows[name]
             buf[c:] = fill
         self.packs += 1
+        # 12 resident host buffers staged onto device per pack (the
+        # transfer audit's h2d counter; obs/profile.py JAX_AUDIT)
+        from ..obs.profile import JAX_AUDIT
+
+        JAX_AUDIT.note_transfer("h2d", len(_COLUMNS))
         fdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         f = lambda n: jnp.asarray(slab[n], dtype=fdt)       # noqa: E731
         i = lambda n: jnp.asarray(slab[n], dtype=jnp.int32)  # noqa: E731

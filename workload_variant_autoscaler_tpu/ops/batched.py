@@ -25,6 +25,7 @@ on TPU.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import NamedTuple
 
@@ -32,8 +33,33 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.profile import JAX_AUDIT
 from .queueing import EPSILON, MAX_QUEUE_TO_BATCH_RATIO, STABILITY_SAFETY_FRACTION
 from .search import MAX_ITERATIONS, TOLERANCE
+
+
+class _AuditedJit:
+    """Thin audited facade over a jitted entry point: the impl body
+    calls `JAX_AUDIT.note_trace(name)` (Python side effects run only
+    while JAX traces, so cached-executable calls cost nothing), and this
+    wrapper times any call that traced as that retrace's compile cost
+    (`inferno_jit_compile_seconds{fn}`). Attribute access forwards to
+    the jit object so `_cache_size()`/`lower()` keep working."""
+
+    def __init__(self, name: str, jitted):
+        self._name = name
+        self._jitted = jitted
+
+    def __call__(self, *args, **kwargs):
+        before = JAX_AUDIT.traces(self._name)
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        if JAX_AUDIT.traces(self._name) > before:
+            JAX_AUDIT.note_compile(self._name, time.perf_counter() - t0)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._jitted, item)
 
 
 class QueueBatch(NamedTuple):
@@ -116,6 +142,9 @@ def make_queue_batch(
         valid = jnp.ones(alpha.shape[0], dtype=bool)
     else:
         valid = jnp.asarray(valid, dtype=bool)
+    # 9 host arrays staged onto device per pack (the h2d half of the
+    # transfer audit; ops/arena.py counts its resident-slab packs too)
+    JAX_AUDIT.note_transfer("h2d", 9)
     return QueueBatch(
         alpha=f(alpha), beta=f(beta), gamma=f(gamma), delta=f(delta),
         in_tokens=f(in_tokens), out_tokens=f(out_tokens),
@@ -524,7 +553,8 @@ def _sizing_result(
 
 
 @partial(jax.jit, static_argnames=("k_max",))
-def size_batch(q: QueueBatch, targets: SLOTargets, k_max: int) -> SizingResult:
+def _size_batch_impl(q: QueueBatch, targets: SLOTargets,
+                     k_max: int) -> SizingResult:
     """SLO-size all queues at once (reference queueanalyzer.go:185-255).
 
     Returns per-queue max stable rates for each enabled target, the binding
@@ -532,13 +562,17 @@ def size_batch(q: QueueBatch, targets: SLOTargets, k_max: int) -> SizingResult:
     bisections run fused: each trip evaluates one solve of shape
     [2B, K_max+1] (TTFT lanes stacked on ITL lanes).
     """
+    JAX_AUDIT.note_trace("size_batch")   # trace-time only: one per compile
     prob, eval_y = _sizing_problem(q, targets, k_max)
     x_star = _bisect(prob, eval_y, q.alpha.dtype)
     return _sizing_result(q, targets, prob, x_star, k_max)
 
 
+size_batch = _AuditedJit("size_batch", _size_batch_impl)
+
+
 @partial(jax.jit, static_argnames=("k_max", "ttft_percentile"))
-def size_batch_tail(
+def _size_batch_tail_impl(
     q: QueueBatch, targets: SLOTargets, k_max: int,
     ttft_percentile: float = 0.95,
 ) -> SizingResult:
@@ -554,18 +588,23 @@ def size_batch_tail(
     Mean-based sizing holds AVERAGE TTFT while p95 rides far above it at
     high utilisation; this is the principled alternative to blanket
     demand headroom for tail SLOs (WVA_TTFT_PERCENTILE)."""
+    JAX_AUDIT.note_trace("size_batch_tail")
     prob, eval_y = _tail_problem(q, targets, k_max, ttft_percentile)
     x_star = _bisect(prob, eval_y, q.alpha.dtype)
     return _sizing_result(q, targets, prob, x_star, k_max)
 
 
+size_batch_tail = _AuditedJit("size_batch_tail", _size_batch_tail_impl)
+
+
 @partial(jax.jit, static_argnames=("k_max",))
-def analyze_batch(q: QueueBatch, rates_per_sec: jax.Array, k_max: int):
+def _analyze_batch_impl(q: QueueBatch, rates_per_sec: jax.Array, k_max: int):
     """Metrics at given request rates (req/sec) for all queues — the batched
     analogue of QueueAnalyzer.analyze (reference queueanalyzer.go:134-174).
 
     Returns a dict of [B] arrays; `valid_rate` flags rates inside (0, max].
     """
+    JAX_AUDIT.note_trace("analyze_batch")
     dtype = q.alpha.dtype
     clm = _cum_log_mu(_transition_rates(q, k_max))
     _, lam_max = _rate_range(q)
@@ -584,6 +623,9 @@ def analyze_batch(q: QueueBatch, rates_per_sec: jax.Array, k_max: int):
         "rho": rho,
         "valid_rate": (lam > 0) & (lam <= lam_max),
     }
+
+
+analyze_batch = _AuditedJit("analyze_batch", _analyze_batch_impl)
 
 
 def k_max_for(max_batch) -> int:
