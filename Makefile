@@ -43,6 +43,14 @@ bench-profile: ## Cycle wall-clock attribution: 512-variant load-shift cycle, sa
 profile-smoke: ## Abbreviated attribution-ledger run: asserts the partition-sums-to-wall invariant and zero steady-state retraces (~30s)
 	$(PY) bench_profile.py --smoke
 
+.PHONY: bench-fuse
+bench-fuse: ## Fused decision program vs staged pipeline: 512-variant load-shift stage:analyze, steady-state transfer audit, 4096-variant analyze+optimize wall (writes BENCH_fuse_r10.json)
+	$(PY) bench_fuse.py
+
+.PHONY: fuse-smoke
+fuse-smoke: ## Abbreviated fused-path run (64 variants, ~3s): zero retraces over 10 steady-state cycles, exactly one bulk d2h per sizing group
+	$(PY) bench_fuse.py --smoke
+
 .PHONY: bench-scenarios
 bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO headlines + mean ablations, tail stress, strict SLO)
 	$(PY) bench_loop.py whole-fleet-p95
@@ -55,7 +63,7 @@ bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO 
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo
 
-LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_profile.py __graft_entry__.py
+LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_profile.py bench_fuse.py __graft_entry__.py
 
 .PHONY: lint
 lint: ## Static analysis gate: ruff+mypy when installed, wvalint always (rule catalog: docs/developer-guide/wvalint.md)

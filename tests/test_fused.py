@@ -1,0 +1,443 @@
+"""The fused decision program (ops/fused.py + System._size_group_fused).
+
+The load-bearing property: WVA_FUSED_SOLVE=on publishes EXACTLY the
+decisions the staged size_batch + host-loop + analyze_batch pipeline
+(`off`) publishes — same accelerator, same replica count, same batch,
+bit-identical cost and value — because both run the same float ops
+(the sizing and re-analysis share `ops.batched`'s bodies and the
+replica arithmetic mirrors the host loop operand-for-operand). The
+advisory latency telemetry (itl/ttft/rho on the allocation) is equal to
+within FLOAT-COMPILATION ulps only: the two pipelines are different XLA
+programs, and XLA may form FMAs differently per program, which the
+`w = t - s` wait-time cancellation then amplifies — observed ≤ 1e-12
+relative; asserted ≤ 1e-9. The randomized-churn suite drives the
+210-cycle harness shape from tests/test_incremental_solve.py with the
+fused path (and its persistent incremental engine — cached restores,
+`only=` sub-batches) on one side and staged from-scratch solves on the
+other, across percentile groups, zero-load lanes, and min-replica
+clamps.
+
+Also pinned here: the fused path's transfer discipline (exactly ONE
+bulk d2h readback per sizing group), the arena's epilogue slabs
+(bit-identical staging to the list path), and the off-switch restoring
+the staged pipeline's 2-dispatch / 7-readback shape.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+import helpers
+from test_incremental_solve import (
+    PROFILES,
+    SLICES,
+    run_cycle,
+)
+
+from workload_variant_autoscaler_tpu.models.spec import (
+    ModelTarget,
+    OptimizerSpec,
+    ServiceClassSpec,
+    SystemSpec,
+)
+from workload_variant_autoscaler_tpu.models.system import (
+    System,
+    fused_solve_enabled,
+)
+from workload_variant_autoscaler_tpu.obs.profile import JAX_AUDIT, JaxAudit
+from workload_variant_autoscaler_tpu.ops.arena import CandidateArena
+from workload_variant_autoscaler_tpu.solver import IncrementalSolveEngine
+
+# Premium buys a p95 TTFT guarantee on m-a while everything else sizes
+# on the mean: every churn cycle exercises BOTH the tail and the mean
+# sizing groups through the fused program.
+SERVICE_CLASSES = [
+    ServiceClassSpec(name="Premium", priority=1, model_targets=(
+        ModelTarget(model="m-a", slo_itl=24.0, slo_ttft=500.0,
+                    slo_ttft_percentile=0.95),
+        ModelTarget(model="m-b", slo_itl=80.0, slo_ttft=2000.0),
+    )),
+    ServiceClassSpec(name="Freemium", priority=10, model_targets=(
+        ModelTarget(model="m-a", slo_itl=150.0, slo_ttft=1500.0),
+        ModelTarget(model="m-b", slo_itl=200.0, slo_ttft=4000.0),
+    )),
+]
+
+
+def make_spec(servers, capacity, unlimited=True, policy="None"):
+    return SystemSpec(
+        accelerators=list(SLICES), profiles=list(PROFILES),
+        service_classes=list(SERVICE_CLASSES), servers=list(servers),
+        capacity=dict(capacity),
+        optimizer=OptimizerSpec(unlimited=unlimited,
+                                saturation_policy=policy),
+    )
+
+
+@pytest.fixture()
+def xla_backend(monkeypatch):
+    # the fused program is an XLA-path feature; CPU hosts default to the
+    # C++ kernel, which has no staged/fused split
+    monkeypatch.setenv("WVA_NATIVE_KERNEL", "false")
+
+
+def assert_allocation_equal(a, b, where):
+    """Decisions exact, telemetry to float-compilation ulps (module
+    docstring): a and b are Allocation-or-AllocationData-shaped."""
+    get = lambda o, f: getattr(o, f)  # noqa: E731
+    for field in ("accelerator", "num_replicas", "cost"):
+        assert get(a, field) == get(b, field), (where, field, a, b)
+    for field in ("batch_size", "max_batch", "value",
+                  "max_arrv_rate_per_replica"):
+        if hasattr(a, field):
+            assert get(a, field) == get(b, field), (where, field, a, b)
+    for field in ("itl", "ttft", "rho", "itl_average", "ttft_average"):
+        if hasattr(a, field):
+            assert get(a, field) == pytest.approx(
+                get(b, field), rel=1e-9, abs=1e-9), (where, field, a, b)
+
+
+def assert_solutions_equivalent(a, b, cycle):
+    assert set(a.allocations) == set(b.allocations), \
+        f"cycle {cycle}: allocated variant sets differ"
+    for name in b.allocations:
+        assert_allocation_equal(a.allocations[name], b.allocations[name],
+                                f"cycle {cycle}, {name}")
+        assert a.allocations[name].load == b.allocations[name].load
+
+
+class FusedChurnDriver:
+    """Seeded churn over a fleet that hits every fused-path variant:
+    percentile AND mean sizing groups, zero-load transitions, min-replica
+    floors above the sized count, and fleet grow/shrink (which drives the
+    persistent engine's `only=` sub-batches)."""
+
+    def __init__(self, seed: int):
+        self.rng = random.Random(seed)
+        self.names = [f"v{i}:ns" for i in range(10)]
+        self.live = set(self.names[:7])
+        self.loads = {n: 280.0 + 55.0 * i
+                      for i, n in enumerate(self.names)}
+        self.min_replicas = {n: 1 for n in self.names}
+        self.capacity = {"v5e": 400, "v5p": 120}
+
+    def servers(self):
+        out = []
+        for n in sorted(self.live):
+            i = int(n[1:].split(":")[0])
+            out.append(helpers.server_spec(
+                name=n,
+                model="m-b" if i % 3 == 0 else "m-a",
+                service_class="Premium" if i % 2 else "Freemium",
+                accelerator="v5e-1",
+                arrival_rpm=self.loads[n],
+                in_tokens=128, out_tokens=128,
+                num_replicas=1,
+                min_replicas=self.min_replicas[n]))
+        return out
+
+    def churn(self):
+        rng = self.rng
+        for n in rng.sample(sorted(self.live), 2):
+            f = rng.choice([1.0, 1.4, 0.6, 0.0])
+            self.loads[n] = self.loads[n] * f if f else 0.0
+            if self.loads[n] == 0.0 and rng.random() < 0.5:
+                self.loads[n] = 180.0 + rng.randrange(9) * 41.0
+        if rng.random() < 0.2:
+            # a min-replica floor the sized count is usually below:
+            # exercises the clamp inside the fused program
+            n = rng.choice(sorted(self.live))
+            self.min_replicas[n] = rng.choice([1, 1, 3, 7])
+        if rng.random() < 0.15:
+            pick = rng.choice(self.names)
+            if pick in self.live and len(self.live) > 4:
+                self.live.discard(pick)
+            else:
+                self.live.add(pick)
+
+
+def test_randomized_churn_fused_equals_staged(xla_backend, monkeypatch):
+    """210 cycles of seeded churn: the PERSISTENT fused incremental
+    engine (exercising cached restores and `only=` sub-batch sizing)
+    must publish exactly the allocations a staged from-scratch solve
+    computes on the same inputs, every cycle."""
+    driver = FusedChurnDriver(seed=0x5EED)
+    fused_engine = IncrementalSolveEngine(epsilon=0.05, full_every=9)
+    for cycle in range(210):
+        driver.churn()
+        servers = driver.servers()
+        monkeypatch.setenv("WVA_FUSED_SOLVE", "on")
+        sol_fused, stats = run_cycle(
+            make_spec(servers, driver.capacity), fused_engine)
+        monkeypatch.setenv("WVA_FUSED_SOLVE", "off")
+        staged = IncrementalSolveEngine(epsilon=0.05, full_every=1)
+        sol_staged, _ = run_cycle(
+            make_spec(servers, driver.capacity), staged)
+        assert_solutions_equivalent(sol_fused, sol_staged, cycle)
+
+
+def test_fused_equals_staged_direct_calculate(xla_backend, monkeypatch):
+    """System.calculate without any engine: every allocation field is
+    bit-identical between the two pipelines, for the mean, percentile,
+    zero-load, and min-replica-clamped lanes alike."""
+    servers = [
+        helpers.server_spec(name="mean:ns", model="llama-8b",
+                            service_class="Freemium", arrival_rpm=1800.0),
+        helpers.server_spec(name="tail:ns", model="llama-8b",
+                            service_class="Premium", arrival_rpm=900.0),
+        helpers.server_spec(name="idle:ns", model="llama-8b",
+                            arrival_rpm=0.0),
+        helpers.server_spec(name="floor:ns", model="llama-8b",
+                            arrival_rpm=60.0, min_replicas=9),
+    ]
+
+    def calc(mode):
+        monkeypatch.setenv("WVA_FUSED_SOLVE", mode)
+        system, _ = helpers.make_system(servers=servers)
+        system.calculate(backend="batched", ttft_percentile=0.9)
+        return system
+
+    sys_off = calc("off")
+    sys_on = calc("on")
+    for name, server in sys_off.servers.items():
+        twin = sys_on.servers[name]
+        assert set(server.all_allocations) == set(twin.all_allocations), name
+        for acc, alloc in server.all_allocations.items():
+            assert_allocation_equal(alloc, twin.all_allocations[acc],
+                                    (name, acc))
+    # the min-replica clamp engaged (the floor exceeds the sized count)
+    floor = sys_on.servers["floor:ns"].all_allocations
+    assert all(a.num_replicas == 9 for a in floor.values())
+
+
+def test_fused_pallas_interpret_equals_staged_pallas(xla_backend,
+                                                     monkeypatch):
+    """The fused program composes with the Pallas backend (interpret
+    mode on CPU): fused+pallas == staged+pallas exactly."""
+    servers = [helpers.server_spec(name="chat:ns", arrival_rpm=1500.0),
+               helpers.server_spec(name="bulk:ns", arrival_rpm=300.0,
+                                   service_class="Freemium")]
+
+    def calc(mode):
+        monkeypatch.setenv("WVA_FUSED_SOLVE", mode)
+        system, _ = helpers.make_system(servers=servers)
+        system.calculate(backend="pallas")
+        return system
+
+    sys_off = calc("off")
+    sys_on = calc("on")
+    for name, server in sys_off.servers.items():
+        for acc, alloc in server.all_allocations.items():
+            assert_allocation_equal(alloc,
+                                    sys_on.servers[name].all_allocations[acc],
+                                    (name, acc))
+
+
+class TestTransferDiscipline:
+    def _audit_calc(self, monkeypatch, mode, servers=None):
+        monkeypatch.setenv("WVA_NATIVE_KERNEL", "false")
+        monkeypatch.setenv("WVA_FUSED_SOLVE", mode)
+        system, _ = helpers.make_system(servers=servers or [
+            helpers.server_spec(name="chat:ns", arrival_rpm=1200.0)])
+        system.calculate(backend="batched")   # compile outside the window
+        system, _ = helpers.make_system(servers=servers or [
+            helpers.server_spec(name="chat:ns", arrival_rpm=1200.0)])
+        before = JAX_AUDIT.snapshot()
+        system.calculate(backend="batched")
+        return JaxAudit.delta(before, JAX_AUDIT.snapshot())
+
+    def test_fused_group_is_one_bulk_readback(self, monkeypatch):
+        delta = self._audit_calc(monkeypatch, "on")
+        # one sizing group -> exactly ONE d2h (the packed result)
+        assert delta["transfers"]["d2h"] == 1
+        # list-path staging: 9 queue arrays + 3 epilogue arrays
+        assert delta["transfers"]["h2d"] == 12
+        assert delta["retraces"] == {}
+
+    def test_staged_group_keeps_the_seven_readbacks(self, monkeypatch):
+        delta = self._audit_calc(monkeypatch, "off")
+        # the staged shape: 2 sizing readbacks + 5 re-analysis readbacks,
+        # now DERIVED from the arrays note_readback actually pulled
+        assert delta["transfers"]["d2h"] == 7
+        assert delta["transfers"]["h2d"] == 9
+        assert delta["retraces"] == {}
+
+    def test_two_percentile_groups_two_readbacks(self, monkeypatch):
+        # Premium's m-a target carries slo_ttft_percentile=0.95 (module
+        # SERVICE_CLASSES); Freemium sizes on the mean -> two groups
+        servers = [
+            helpers.server_spec(name="tail:ns", model="m-a",
+                                service_class="Premium", arrival_rpm=900.0),
+            helpers.server_spec(name="mean:ns", model="m-a",
+                                service_class="Freemium", arrival_rpm=900.0),
+        ]
+        monkeypatch.setenv("WVA_NATIVE_KERNEL", "false")
+        monkeypatch.setenv("WVA_FUSED_SOLVE", "on")
+
+        def calc():
+            system = System()
+            system.set_from_spec(make_spec(servers, {}))
+            system.calculate(backend="batched")
+            return system
+
+        calc()                       # compile outside the audit window
+        before = JAX_AUDIT.snapshot()
+        calc()
+        delta = JaxAudit.delta(before, JAX_AUDIT.snapshot())
+        # one fused dispatch and one bulk readback PER GROUP
+        assert delta["transfers"]["d2h"] == 2
+
+
+class TestArenaEpilogueSlabs:
+    ROWS = dict(
+        alpha=[6.973, 3.2, 9.0], beta=[0.027, 0.012, 0.06],
+        gamma=[5.2, 2.4, 7.0], delta=[0.1, 0.04, 0.15],
+        in_tokens=[128.0, 128.0, 256.0], out_tokens=[128.0, 128.0, 200.0],
+        max_batch=[16, 23, 20],
+        ttft=[500.0, 500.0, 2000.0], itl=[24.0, 24.0, 80.0],
+        tps=[0.0, 0.0, 0.0],
+        demand=[25.0, 8.125, 0.4], min_replicas=[1, 3, 0],
+        cost_rate=[20.0, 80.0, 120.0],
+    )
+
+    def test_epilogue_pack_matches_list_path_bitwise(self):
+        """The arena's epilogue slabs stage bit-identical arrays to
+        ops.fused.make_epilogue_batch on the same rows."""
+        from workload_variant_autoscaler_tpu.ops.fused import (
+            make_epilogue_batch,
+        )
+
+        arena = CandidateArena()
+        q, _slo, epi = arena.pack(dict(self.ROWS))
+        ref = make_epilogue_batch(self.ROWS["demand"],
+                                  self.ROWS["min_replicas"],
+                                  self.ROWS["cost_rate"],
+                                  q.alpha.dtype, pad_to=q.batch_size)
+        for name in epi._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(epi, name)),
+                np.asarray(getattr(ref, name)), err_msg=name)
+            assert getattr(epi, name).dtype == getattr(ref, name).dtype
+
+    def test_epilogue_slabs_resident_and_stale_lanes_reset(self):
+        arena = CandidateArena()
+        arena.pack(dict(self.ROWS))
+        assert arena.slab_allocs == 1
+        small = {k: v[:1] for k, v in self.ROWS.items()}
+        _q, _slo, epi = arena.pack(small)
+        assert arena.slab_allocs == 1    # same bucket -> no realloc
+        host = np.asarray(epi.demand)
+        assert host[0] == 25.0 and not host[1:].any()
+        assert not np.asarray(epi.min_replicas)[1:].any()
+
+    def test_pack_without_epilogue_untouched(self):
+        """A staged-path pack neither stages nor returns epilogue
+        columns — the pre-fusion arena contract, byte for byte."""
+        rows = {k: v for k, v in self.ROWS.items()
+                if k not in ("demand", "min_replicas", "cost_rate")}
+        before = JAX_AUDIT.snapshot()
+        _q, _slo, epi = CandidateArena().pack(rows)
+        delta = JaxAudit.delta(before, JAX_AUDIT.snapshot())
+        assert epi is None
+        assert delta["transfers"]["h2d"] == 12
+
+
+class TestFusedKnob:
+    def test_default_on(self, monkeypatch):
+        monkeypatch.delenv("WVA_FUSED_SOLVE", raising=False)
+        assert fused_solve_enabled()
+
+    @pytest.mark.parametrize("raw", ["off", "false", "0", "disabled", "OFF"])
+    def test_off_values(self, monkeypatch, raw):
+        monkeypatch.setenv("WVA_FUSED_SOLVE", raw)
+        assert not fused_solve_enabled()
+
+    def test_knob_flip_forces_full_solve(self, xla_backend, monkeypatch):
+        """Flipping WVA_FUSED_SOLVE mid-run invalidates the incremental
+        engine's analyze signature: the next cycle re-solves every lane
+        instead of mixing cached entries across pipelines."""
+        servers = [helpers.server_spec(name="v:ns", model="m-a",
+                                       arrival_rpm=600.0)]
+        engine = IncrementalSolveEngine(epsilon=0.05, full_every=0)
+        monkeypatch.setenv("WVA_FUSED_SOLVE", "on")
+        run_cycle(make_spec(servers, {}), engine)
+        _sol, steady = run_cycle(make_spec(servers, {}), engine)
+        assert steady.lanes_solved == 0       # cached in steady state
+        monkeypatch.setenv("WVA_FUSED_SOLVE", "off")
+        _sol, flipped = run_cycle(make_spec(servers, {}), engine)
+        assert flipped.full
+        assert flipped.reason == "backend/mesh/percentile changed"
+
+
+def test_fuse_smoke_bench_passes():
+    """`make fuse-smoke` in-suite: the abbreviated fused-path run
+    (bench_fuse.py --smoke, 64 variants) asserts zero retraces over 10
+    steady-state load-shift cycles and exactly ONE bulk d2h per sizing
+    group per cycle, and must stay green in tier-1. Run as a
+    subprocess: the bench pins its own env (XLA backend, fused on)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench_fuse.py"), "--smoke"],
+        capture_output=True, text=True, cwd=repo, timeout=240)
+    assert r.returncode == 0, f"fuse smoke failed:\n{r.stdout}\n{r.stderr}"
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["bench"] == "fuse-smoke"
+    assert line["steady_state"]["retraces_total"] == 0
+    assert line["steady_state"]["d2h_per_cycle"] == [1]
+
+
+def test_mesh_fused_matches_unmeshed():
+    """decide_batch_sharded over the suite's 8-virtual-device CPU mesh
+    computes the same packed results as the unsharded fused program
+    (sharding is a placement knob, never a result knob). Inputs are
+    rebuilt per call: the fused program DONATES its buffers."""
+    import jax.numpy as jnp
+
+    from workload_variant_autoscaler_tpu.ops.batched import (
+        SLOTargets,
+        k_max_bucket,
+        k_max_for,
+        make_queue_batch,
+    )
+    from workload_variant_autoscaler_tpu.ops.fused import (
+        decide_batch,
+        make_epilogue_batch,
+    )
+    from workload_variant_autoscaler_tpu.parallel import (
+        candidate_mesh,
+        decide_batch_sharded,
+    )
+
+    b = 21   # deliberately NOT a multiple of the mesh size
+    k_max = k_max_bucket(k_max_for([64]))
+
+    def build():
+        rng = np.random.default_rng(3)
+        q = make_queue_batch(
+            rng.uniform(2.0, 20.0, b), rng.uniform(0.005, 0.15, b),
+            rng.uniform(1.0, 15.0, b), rng.uniform(0.02, 0.3, b),
+            np.full(b, 128.0), np.full(b, 128.0),
+            rng.choice([16, 48, 64], b))
+        d = q.alpha.dtype
+        slo = SLOTargets(ttft=jnp.full(b, 500.0, d),
+                         itl=jnp.full(b, 24.0, d), tps=jnp.zeros(b, d))
+        epi = make_epilogue_batch(
+            rng.uniform(1.0, 40.0, b), np.ones(b, np.int64),
+            np.full(b, 20.0), d)
+        return q, slo, epi
+
+    q, slo, epi = build()
+    base = np.asarray(decide_batch(q, slo, epi, k_max))
+    q, slo, epi = build()
+    sharded = np.asarray(
+        decide_batch_sharded(q, slo, epi, k_max, candidate_mesh()))
+    assert sharded.shape == base.shape == (7, b)
+    np.testing.assert_allclose(sharded, base, rtol=1e-6, atol=1e-9)

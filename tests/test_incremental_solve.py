@@ -156,7 +156,8 @@ class TestArenaParity:
         q_ref, slo_ref, _ = pad_to_multiple(q_ref, slo_ref, 16)
 
         arena = CandidateArena()
-        q, slo = arena.pack(dict(r))
+        q, slo, epi = arena.pack(dict(r))
+        assert epi is None   # no epilogue columns -> staged-shape pack
         for name in q._fields:
             np.testing.assert_array_equal(
                 np.asarray(getattr(q, name)),
@@ -173,7 +174,7 @@ class TestArenaParity:
         assert arena.slab_allocs == 1
         # a smaller pack reuses the slab and resets the stale lanes
         small = {k: v[:1] for k, v in self.ROWS.items()}
-        q, _slo = arena.pack(small)
+        q, _slo, _epi = arena.pack(small)
         assert arena.slab_allocs == 1  # same bucket shape -> no realloc
         valid = np.asarray(q.valid)
         assert valid[0] and not valid[1:].any()

@@ -142,7 +142,7 @@ class TestCallArity:
 @pytest.mark.parametrize("paths", [
     ["workload_variant_autoscaler_tpu", "tools", "tests", "bench.py",
      "bench_loop.py", "bench_collect.py", "bench_goodput.py",
-     "bench_profile.py", "__graft_entry__.py"],
+     "bench_profile.py", "bench_fuse.py", "__graft_entry__.py"],
 ])
 def test_package_lints_clean(paths):
     """The gate itself: the shipped source must lint clean — every rule
@@ -1107,6 +1107,97 @@ class TestStageCoverage:
         with open(fp, encoding="utf-8") as f:
             trees = {fp: ast_mod.parse(f.read(), fp)}
         assert wvalint._stage_coverage_findings([fp], trees) == []
+
+
+class TestUnauditedReadback:
+    """WVL305 — np.asarray / .block_until_ready in jax-importing
+    models/+ops/ modules must sit inside a function that routes its
+    transfers through the JAX self-audit (PR-7's choke-point
+    discipline, now enforced)."""
+
+    OPS = os.path.join("workload_variant_autoscaler_tpu", "ops", "zz.py")
+    MODELS = os.path.join("workload_variant_autoscaler_tpu", "models",
+                          "zz.py")
+    CTRL = os.path.join("workload_variant_autoscaler_tpu", "controller",
+                        "zz.py")
+
+    def lint_at(self, path, source):
+        return [f.code for f in wvalint.lint_source(path, source)]
+
+    def test_unaudited_asarray_fires(self):
+        src = ("import jax\nimport numpy as np\n"
+               "def pull(arr):\n"
+               "    return np.asarray(jax.device_put(arr))\n")
+        assert self.lint_at(self.OPS, src) == ["WVL305"]
+        assert self.lint_at(self.MODELS, src) == ["WVL305"]
+
+    def test_unaudited_block_until_ready_fires(self):
+        src = ("import jax\n"
+               "def sync(arr):\n"
+               "    return jax.block_until_ready(arr)\n")
+        assert self.lint_at(self.OPS, src) == ["WVL305"]
+        src_method = ("import jax\n"
+                      "def sync(arr):\n"
+                      "    jax.device_put(arr)\n"
+                      "    return arr.block_until_ready()\n")
+        assert self.lint_at(self.OPS, src_method) == ["WVL305"]
+
+    def test_note_readback_in_function_silences(self):
+        src = ("import jax\nimport numpy as np\n"
+               "from workload_variant_autoscaler_tpu.obs.profile "
+               "import JAX_AUDIT\n"
+               "def pull(arr):\n"
+               "    (out,) = JAX_AUDIT.note_readback(jax.device_put(arr))\n"
+               "    return np.asarray(out)\n")
+        assert self.lint_at(self.OPS, src) == []
+
+    def test_note_transfer_in_function_silences(self):
+        src = ("import jax\nimport numpy as np\n"
+               "from workload_variant_autoscaler_tpu.obs.profile "
+               "import JAX_AUDIT\n"
+               "def stage(rows):\n"
+               "    JAX_AUDIT.note_transfer('h2d', 9)\n"
+               "    return jax.device_put(np.asarray(rows))\n")
+        assert self.lint_at(self.OPS, src) == []
+
+    def test_numpy_only_module_exempt(self):
+        # the scalar reference kernels hold no device arrays
+        src = ("import numpy as np\n"
+               "def host_math(x):\n"
+               "    return np.asarray(x)\n")
+        assert self.lint_at(self.OPS, src) == []
+
+    def test_outside_models_ops_exempt(self):
+        src = ("import jax\nimport numpy as np\n"
+               "def pull(arr):\n"
+               "    return np.asarray(jax.device_put(arr))\n")
+        assert self.lint_at(self.CTRL, src) == []
+
+    def test_module_scope_readback_fires(self):
+        src = ("import jax\nimport numpy as np\n"
+               "X = np.asarray(jax.numpy.ones(3))\n")
+        assert self.lint_at(self.OPS, src) == ["WVL305"]
+
+    def test_noqa_suppresses_and_is_not_stale(self):
+        src = ("import jax\nimport numpy as np\n"
+               "def shape_of(rows):\n"
+               "    jax.device_put(rows)\n"
+               "    # host-list derivation, not a device readback\n"
+               "    return np.asarray(rows).shape  # noqa" ": WVL305\n")
+        assert self.lint_at(self.OPS, src) == []
+
+    def test_real_decision_path_is_clean(self):
+        """The shipped models/ + ops/ surface passes the rule (the
+        repo-wide gate covers this too; this pins the decision-path
+        files specifically)."""
+        for rel in (("models", "system.py"), ("ops", "batched.py"),
+                    ("ops", "fused.py"), ("ops", "arena.py")):
+            fp = os.path.join(REPO, "workload_variant_autoscaler_tpu", *rel)
+            with open(fp, encoding="utf-8") as f:
+                src = f.read()
+            codes = [x.code for x in wvalint.lint_source(fp, src)
+                     if x.code == "WVL305"]
+            assert codes == [], (rel, codes)
 
 
 class TestStaleNoqa:

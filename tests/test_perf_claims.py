@@ -288,6 +288,58 @@ def test_profile_claims_match_artifact():
     assert f"{art['python_ms']:.1f} ms" in flat
 
 
+def test_fuse_claims_match_artifact():
+    """Round-10 fused decision program: the committed BENCH_fuse_r10.json
+    must (a) justify the headline — the 512-variant whole-fleet
+    load-shift cycle's `stage:analyze` exclusive wall >= 5x faster than
+    the committed BENCH_profile_r09 baseline it cites (the r09 number is
+    cross-checked against the r09 artifact itself, so the baseline can't
+    drift) — with (b) zero retraces and <= 2 d2h transfers per cycle in
+    steady state (exactly ONE bulk readback per sizing group), (c) a
+    4096-variant fused analyze+optimize wall < 100 ms on CPU (ROADMAP
+    item 3's target) with the lane-dedup disclosure (unique lanes + the
+    no-sharing worst case) committed alongside, and (d) doc parity with
+    docs/observability.md."""
+    art = _artifact("BENCH_fuse_r10.json")
+    assert art["bench"] == "fuse"
+    assert art["variants"] == 512
+    r09 = _artifact("BENCH_profile_r09.json")
+    assert art["r09_staged_analyze_ms"] == \
+        r09["buckets"]["stage:analyze"], \
+        "the cited r09 staged baseline drifted from BENCH_profile_r09"
+    assert art["vs_r09"] >= 5.0, \
+        "artifact no longer justifies the >=5x stage:analyze claim"
+    assert art["vs_r09"] == pytest.approx(
+        art["r09_staged_analyze_ms"] / art["value"], abs=0.01)
+    # transfer discipline: the fused cycle's ONE bulk readback vs the
+    # staged cycle's 2+5 split, zero retraces on both
+    assert art["fused"]["transfers"]["d2h"] <= 2
+    assert art["fused"]["retraces"] == {}
+    assert art["staged"]["transfers"]["d2h"] == 7
+    assert art["staged"]["transfers"]["h2d"] == 12
+    # steady state: every load-shift cycle re-dispatches the donated
+    # program without recompiling, one bulk readback per cycle
+    steady = art["steady_state"]
+    assert steady["retraces_total"] == 0
+    assert steady["d2h_per_cycle"] == [1]
+    # the 4096-variant target, with the dedup disclosure
+    fleet = art["fleet_4096"]
+    assert fleet["variants"] == 4096
+    assert fleet["analyze_optimize_ms_p50"] < 100.0, \
+        "artifact no longer justifies the <100ms 4096-variant claim"
+    assert fleet["unique_lanes"] <= fleet["variants"]
+    worst = art["fleet_4096_distinct_loads"]
+    assert worst["unique_lanes"] == worst["variants"] == 4096
+    # doc parity: observability.md quotes this artifact
+    doc = (REPO / "docs" / "observability.md").read_text()
+    flat = " ".join(doc.split())
+    assert f"**{art['vs_r09']}×**" in flat, \
+        "observability.md's fused-analyze claim drifted from the artifact"
+    assert f"{art['value']:.1f} ms" in flat
+    assert f"{fleet['analyze_optimize_ms_p50']:.1f} ms" in flat
+    assert f"{worst['analyze_optimize_ms_p50']:.1f} ms" in flat
+
+
 def test_capstone_claims_match_baseline_json():
     """Round-5 whole-fleet capstone: every quoted tail and the headline
     must equal the committed BASELINE.json entry, and the entry itself

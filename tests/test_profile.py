@@ -451,6 +451,35 @@ class TestZeroRetraceChurn:
         assert len({json.dumps(t, sort_keys=True)
                     for t in per_cycle}) == 1
 
+    def test_fused_load_shift_cycle_is_one_bulk_readback(self, xla_backend):
+        """The fused decision path (WVA_FUSED_SOLVE, default on): a
+        load-shift cycle re-solves its sizing group with exactly ONE
+        bulk d2h readback (the packed decision result) and one resident
+        arena pack of 15 h2d stages (12 queue/SLO + 3 epilogue slabs) —
+        the per-cycle ProfileRecord audit is the proof surface."""
+        _kube, prom, _emitter, rec = one_variant_cluster()
+        rec.reconcile()                              # compile + publish
+        set_load(prom, "llama-8b", 55.0, 128.0, 128.0)
+        rec.reconcile()                              # the audited shift
+        d = rec.profiler.records()[0].jax
+        assert d["retraces"] == {}
+        assert d["transfers"]["d2h"] == 1, d["transfers"]
+        assert d["transfers"]["h2d"] == 15, d["transfers"]
+
+    def test_staged_readback_counts_derive_from_arrays_pulled(
+            self, xla_backend, monkeypatch):
+        """WVA_FUSED_SOLVE=off restores the staged 2+5 readback shape —
+        now counted by note_readback from the arrays actually pulled,
+        never a hard-coded literal."""
+        monkeypatch.setenv("WVA_FUSED_SOLVE", "off")
+        _kube, prom, _emitter, rec = one_variant_cluster()
+        rec.reconcile()
+        set_load(prom, "llama-8b", 55.0, 128.0, 128.0)
+        rec.reconcile()
+        d = rec.profiler.records()[0].jax
+        assert d["transfers"]["d2h"] == 7, d["transfers"]
+        assert d["transfers"]["h2d"] == 12, d["transfers"]
+
     def test_jit_audit_series_registered(self):
         _kube, _prom, emitter, rec = one_variant_cluster()
         rec.reconcile()

@@ -46,6 +46,14 @@ Rules (suppress per-line with `# noqa` or `# noqa: WVLxxx`):
           in the scan — the stage's gauge/histogram/ledger series can
           only ever read zero (the reverse direction of WVL322, the
           same two-way shape as WVL311/312)
+  WVL305  unaudited device readback: an `np.asarray(...)` or
+          `.block_until_ready()` call in a jax-importing module under
+          workload_variant_autoscaler_tpu/{models,ops}/ whose enclosing
+          function never routes a transfer through the JAX self-audit
+          (`JAX_AUDIT.note_transfer` / `note_readback`) — a host<->device
+          hop the inferno_host_device_transfers_total series silently
+          misses (numpy-only reference modules are exempt: they cannot
+          hold device arrays)
   WVL311  config-knob doc parity: a `WVA_*` knob read from os.environ in
           package/tools code with no row in docs/user-guide/configuration.md
           (a knob operators can't discover)
@@ -1725,6 +1733,96 @@ def _check_stage_literals(path: str, tree: ast.Module,
     return findings
 
 
+# -- unaudited device readback (WVL305) --------------------------------------
+
+# the modules whose functions may hold jax arrays on the decision path:
+# every host<->device hop there must flow through the audit choke points
+_READBACK_DIRS = (
+    os.path.join("workload_variant_autoscaler_tpu", "models"),
+    os.path.join("workload_variant_autoscaler_tpu", "ops"),
+)
+_AUDIT_CALLS = ("note_transfer", "note_readback")
+
+
+def _imports_jax(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax" or mod.startswith("jax."):
+                return True
+    return False
+
+
+def _readback_sites(subtree) -> list:
+    """Calls that pull a device array to host: np.asarray(...) (the
+    conversion numpy performs via __array__, a d2h copy for a jax array)
+    and any .block_until_ready() (incl. jax.block_until_ready(x))."""
+    sites = []
+    for node in ast.walk(subtree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "asarray" and \
+                    (_dotted(fn.value) or "") in ("np", "numpy"):
+                sites.append(node)
+            elif fn.attr == "block_until_ready":
+                sites.append(node)
+    return sites
+
+
+def _check_unaudited_readbacks(path: str, tree: ast.Module) -> list[Finding]:
+    """WVL305 — see the module docstring. The discipline PR 7 set up by
+    convention (readbacks only at counted choke points) made
+    inferno_host_device_transfers_total trustworthy; this rule enforces
+    it: any new readback either flows through the audit or carries an
+    explicit, justified noqa."""
+    apath = os.path.abspath(path)
+    if not any(d in apath for d in _READBACK_DIRS):
+        return []
+    if not _imports_jax(tree):
+        return []   # numpy-only reference kernels can't hold jax arrays
+
+    findings: list[Finding] = []
+
+    def flag(site: ast.Call) -> None:
+        what = site.func.attr if isinstance(site.func, ast.Attribute) \
+            else "asarray"
+        findings.append(Finding(
+            path, site.lineno, "WVL305",
+            f"unaudited device readback: {what}() outside any function "
+            "that calls JAX_AUDIT.note_transfer/note_readback — the "
+            "transfer audit cannot see this host<->device hop"))
+
+    funcs = []   # outermost function scopes (module or class level)
+
+    def collect(body) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.append(node)
+            elif isinstance(node, ast.ClassDef):
+                collect(node.body)
+
+    collect(tree.body)
+    in_func: set[int] = set()
+    for fn in funcs:
+        audited = any(
+            isinstance(n, ast.Call) and _call_tail(n) in _AUDIT_CALLS
+            for n in ast.walk(fn))
+        for site in _readback_sites(fn):
+            in_func.add(id(site))
+            if not audited:
+                flag(site)
+    for site in _readback_sites(tree):
+        if id(site) not in in_func:   # module-scope readback
+            flag(site)
+    return findings
+
+
 # -- stage coverage parity (WVL304) ------------------------------------------
 
 # the reconciler module anchors the rule: without it in the scan there
@@ -1816,7 +1914,7 @@ def _stage_coverage_findings(files: list[str],
 
 _STRUCTURAL_CODES = frozenset({
     "WVL001", "WVL002", "WVL003", "WVL101", "WVL102", "WVL103", "WVL104",
-    "WVL105", "WVL106", "WVL401", "WVL402", "WVL403",
+    "WVL105", "WVL106", "WVL305", "WVL401", "WVL402", "WVL403",
 })
 
 
@@ -1842,6 +1940,7 @@ def lint_source(path: str, source: str,
             findings += _check_class_concurrency(path, node)
     findings += _check_module_lock_discipline(path, tree)
     findings += _check_thread_shared_state(path, tree)
+    findings += _check_unaudited_readbacks(path, tree)
     active = set(_STRUCTURAL_CODES)
     if sigs:
         findings += _check_calls(path, tree, sigs)

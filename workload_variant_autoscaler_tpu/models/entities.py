@@ -151,7 +151,8 @@ class Server:
     (reference pkg/core/server.go)."""
 
     def __init__(self, spec: ServerSpec):
-        self.spec = spec
+        self._spec = spec
+        self._desired_stale = False
         self.name = spec.name
         self.service_class_name = spec.service_class or DEFAULT_SERVICE_CLASS_NAME
         self.model_name = spec.model
@@ -163,6 +164,31 @@ class Server:
         self.cur_allocation: Optional[Allocation] = Allocation.from_data(spec.current_alloc)
         self.all_allocations: dict[str, Allocation] = {}
         self.allocation: Optional[Allocation] = None
+
+    @property
+    def spec(self) -> ServerSpec:
+        """The server spec with `desired_alloc` synced to the chosen
+        allocation. The sync is LAZY: ServerSpec is a frozen dataclass,
+        so each sync is a full reconstruction, and the greedy solver
+        re-assigns allocations many times per solve — paying the
+        rebuild once per spec READ instead of once per assignment takes
+        the rebuild off the optimize hot loop entirely for the
+        (majority of) cycles that never read the spec afterwards."""
+        if self._desired_stale:
+            self._desired_stale = False
+            if self.allocation is not None:
+                self._spec = dc_replace(
+                    self._spec,
+                    desired_alloc=self.allocation.to_data(self.load))
+            else:
+                self._spec = dc_replace(self._spec,
+                                        desired_alloc=AllocationData())
+        return self._spec
+
+    @spec.setter
+    def spec(self, value: ServerSpec) -> None:
+        self._spec = value
+        self._desired_stale = False
 
     def priority(self, system: "System") -> int:
         svc = system.service_class(self.service_class_name)
@@ -209,12 +235,9 @@ class Server:
         )
 
     def update_desired_alloc(self) -> None:
-        if self.allocation is not None:
-            self.spec = dc_replace(
-                self.spec, desired_alloc=self.allocation.to_data(self.load)
-            )
-        else:
-            self.spec = dc_replace(self.spec, desired_alloc=AllocationData())
+        """Mark `spec.desired_alloc` out of sync with the chosen
+        allocation; the spec property rebuilds it on next read."""
+        self._desired_stale = True
 
     def apply_desired_alloc(self) -> None:
         """Promote desired -> current (reference server.go:155-161)."""

@@ -11,6 +11,7 @@ candidate) with ONE batched JAX kernel call across every
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -34,6 +35,19 @@ from .spec import (
     SystemSpec,
     resolve_for_context,
 )
+
+
+def fused_solve_enabled() -> bool:
+    """WVA_FUSED_SOLVE (default on): run each sizing group as ONE fused,
+    donated-buffer compiled program (ops/fused.py decide_batch —
+    size -> replica-count -> re-analyze -> value, one bulk readback)
+    instead of the staged size_batch + host loop + analyze_batch
+    pipeline. `off` restores the staged path; both publish identical
+    DECISIONS — accelerator, replicas, batch, bit-identical cost/value —
+    with the advisory latency telemetry equal to float-compilation ulps
+    (tests/test_fused.py pins the contract)."""
+    return os.environ.get("WVA_FUSED_SOLVE", "").strip().lower() not in (
+        "off", "false", "0", "disabled")
 
 
 @dataclass
@@ -79,6 +93,10 @@ class System:
         # lanes + zero-load fast-path allocations) — the number the
         # incremental engine's skip telemetry is measured against
         self.last_solve_lanes = 0
+        # distinct lanes the fused path actually dispatched after
+        # identical-lane dedup (_dedup_rows); equals the sized-lane
+        # count on the staged path (bench/telemetry surface)
+        self.last_unique_lanes = 0
 
     # -- spec ingestion (reference system.go:82-175) --------------------
 
@@ -184,6 +202,7 @@ class System:
         sub-batch through here.
         """
         self.last_solve_lanes = 0
+        self.last_unique_lanes = 0
         for acc in self.accelerators.values():
             acc.calculate()
         if backend == "scalar":
@@ -264,81 +283,228 @@ class System:
     def _size_group(self, pairs, mesh=None,
                     ttft_percentile: float | None = None,
                     use_pallas: bool = False) -> None:
-        import jax.numpy as jnp
+        if fused_solve_enabled():
+            self._size_group_fused(pairs, mesh=mesh,
+                                   ttft_percentile=ttft_percentile,
+                                   use_pallas=use_pallas)
+        else:
+            self._size_group_staged(pairs, mesh=mesh,
+                                    ttft_percentile=ttft_percentile,
+                                    use_pallas=use_pallas)
 
-        from ..ops.batched import (
-            SLOTargets,
-            analyze_batch,
-            k_max_bucket,
-            k_max_for,
-            make_queue_batch,
-            size_batch,
-            size_batch_tail,
-        )
-
-        n_eff, alphas, betas, gammas, deltas, in_toks, out_toks = [], [], [], [], [], [], []
-        ttfts, itls, tpss = [], [], []
+    def _group_rows(self, pairs, epilogue: bool):
+        """Host rows for one sizing group. With `epilogue`, the inputs
+        the staged host loop used to read per candidate — aggregate
+        demand, the min-replica floor, the per-replica cost rate — ride
+        along as batch columns for the fused program."""
+        rows: dict[str, list] = {
+            "alpha": [], "beta": [], "gamma": [], "delta": [],
+            "in_tokens": [], "out_tokens": [], "max_batch": [],
+            "ttft": [], "itl": [], "tps": [],
+        }
+        if epilogue:
+            rows.update(demand=[], min_replicas=[], cost_rate=[])
         for server, acc_name, profile, target in pairs:
             out_tok = server.load.avg_out_tokens
-            n_eff.append(effective_batch_size(profile, server.max_batch_size, out_tok))
-            alphas.append(profile.alpha)
-            betas.append(profile.beta)
-            gammas.append(profile.gamma)
-            deltas.append(profile.delta)
-            in_toks.append(server.load.avg_in_tokens)
-            out_toks.append(out_tok)
-            ttfts.append(target.slo_ttft)
-            itls.append(target.slo_itl)
-            tpss.append(target.slo_tps)
+            rows["alpha"].append(profile.alpha)
+            rows["beta"].append(profile.beta)
+            rows["gamma"].append(profile.gamma)
+            rows["delta"].append(profile.delta)
+            rows["in_tokens"].append(server.load.avg_in_tokens)
+            rows["out_tokens"].append(out_tok)
+            rows["max_batch"].append(effective_batch_size(
+                profile, server.max_batch_size, out_tok))
+            rows["ttft"].append(target.slo_ttft)
+            rows["itl"].append(target.slo_itl)
+            rows["tps"].append(target.slo_tps)
+            if epilogue:
+                rows["demand"].append(replica_demand(
+                    server.load.arrival_rate, target.slo_tps, out_tok))
+                rows["min_replicas"].append(server.min_num_replicas)
+                rows["cost_rate"].append(
+                    self.accelerators[acc_name].cost
+                    * self.models[server.model_name].num_instances(acc_name))
+        return rows
 
-        # K bucketed for shape stability under load drift (see k_max_bucket)
-        k_max = k_max_bucket(k_max_for(n_eff))
+    def _pack_group(self, rows, bucket: int, mesh):
+        """Device-ready (q, slo, epi|None) for one group: the resident
+        arena's scatter path when attached (bit-identical arrays to the
+        list path), else make_queue_batch + pad_to_multiple."""
+        import jax.numpy as jnp
+
+        from ..ops.batched import SLOTargets, make_queue_batch
+
+        if self.arena is not None and mesh is None:
+            return self.arena.pack(rows, quantum=bucket)
+        q = make_queue_batch(rows["alpha"], rows["beta"], rows["gamma"],
+                             rows["delta"], rows["in_tokens"],
+                             rows["out_tokens"], rows["max_batch"])
+        dtype = q.alpha.dtype
+        slo = SLOTargets(
+            ttft=jnp.asarray(rows["ttft"], dtype),
+            itl=jnp.asarray(rows["itl"], dtype),
+            tps=jnp.asarray(rows["tps"], dtype),
+        )
+        from ..parallel import pad_to_multiple
+
+        q, slo, _ = pad_to_multiple(q, slo, bucket)
+        epi = None
+        if "demand" in rows:
+            from ..ops.fused import make_epilogue_batch
+
+            epi = make_epilogue_batch(rows["demand"], rows["min_replicas"],
+                                      rows["cost_rate"], dtype,
+                                      pad_to=q.batch_size)
+        return q, slo, epi
+
+    @staticmethod
+    def _group_bucket(mesh) -> int:
         # Bucket the candidate axis so adding/removing a variant (or a
         # candidate slice) doesn't retrace + recompile the kernel: shapes
         # only change when the fleet crosses a 16-candidate boundary, and
         # every crossed bucket stays in jit's executable cache. Padded
         # lanes are benign invalid queues (valid=False -> feasible=False).
-        bucket = 16 if mesh is None else math.lcm(16, int(mesh.devices.size))
-        if self.arena is not None and mesh is None:
-            # resident arena: scatter only this group's lanes into the
-            # persistent bucketed buffers — no full re-pack in steady
-            # state, and bit-identical arrays to the list path below
-            q, slo = self.arena.pack(
-                dict(alpha=alphas, beta=betas, gamma=gammas, delta=deltas,
-                     in_tokens=in_toks, out_tokens=out_toks,
-                     max_batch=n_eff, ttft=ttfts, itl=itls, tps=tpss),
-                quantum=bucket)
-            dtype = q.alpha.dtype
-        else:
-            q = make_queue_batch(alphas, betas, gammas, deltas, in_toks,
-                                 out_toks, n_eff)
-            dtype = q.alpha.dtype
-            slo = SLOTargets(
-                ttft=jnp.asarray(ttfts, dtype),
-                itl=jnp.asarray(itls, dtype),
-                tps=jnp.asarray(tpss, dtype),
-            )
-            from ..parallel import pad_to_multiple
+        return 16 if mesh is None else math.lcm(16, int(mesh.devices.size))
 
-            q, slo, _ = pad_to_multiple(q, slo, bucket)
+    @staticmethod
+    def _pallas_interpret() -> bool:
+        import jax
+
+        # off-TPU there is no Mosaic: interpret mode keeps the exact
+        # semantics (tests/test_pallas.py pins parity) at CPU speed.
+        # Device platform, not default_backend(): remote-TPU plugins
+        # (axon) report their own backend name but TPU devices.
+        return jax.devices()[0].platform != "tpu"
+
+    # the columns that fully determine a lane's kernel result (occupancy
+    # derives from max_batch; the group's percentile is shared)
+    _LANE_KEY_COLUMNS = ("alpha", "beta", "gamma", "delta", "in_tokens",
+                         "out_tokens", "max_batch", "ttft", "itl", "tps",
+                         "demand", "min_replicas", "cost_rate")
+
+    @staticmethod
+    def _dedup_rows(rows: dict) -> tuple[dict, list]:
+        """Collapse identical candidate lanes to one representative.
+
+        Fleet reality makes this a large win: variants share models (and
+        so profiles) tens-to-one, SLO classes are few, and under the
+        incremental engine loads arrive quantized to WVA_SOLVE_EPSILON
+        buckets — so whole cohorts of (variant, slice) candidates are
+        the SAME queue problem. Solving each distinct problem once is
+        EXACT, not approximate: a lane's kernel result is bitwise
+        independent of the batch around it (pinned by
+        tests/test_incremental_solve.py's cross-shape bit test), so the
+        representative's result IS every member's result. Returns the
+        deduped rows and each original lane's index into them."""
+        cols = [rows[c] for c in System._LANE_KEY_COLUMNS]
+        index: dict[tuple, int] = {}
+        lane_of: list[int] = []
+        keep: list[int] = []
+        for i, key in enumerate(zip(*cols)):
+            at = index.get(key)
+            if at is None:
+                at = index[key] = len(keep)
+                keep.append(i)
+            lane_of.append(at)
+        if len(keep) == len(lane_of):        # nothing shared
+            return rows, lane_of
+        deduped = {name: [col[i] for i in keep]
+                   for name, col in rows.items()}
+        return deduped, lane_of
+
+    def _size_group_fused(self, pairs, mesh=None,
+                          ttft_percentile: float | None = None,
+                          use_pallas: bool = False) -> None:
+        """One fused, donated-buffer compiled program per sizing group
+        (ops/fused.py decide_batch): size -> replica-count ->
+        re-analyze -> value entirely on device, ONE bulk readback of the
+        packed result, allocations materialized lazily for the feasible
+        lanes only. Identical candidate lanes are solved once
+        (_dedup_rows)."""
+        from ..obs.profile import JAX_AUDIT
+        from ..ops import fused
+        from ..ops.batched import k_max_bucket, k_max_for
+
+        all_rows = self._group_rows(pairs, epilogue=True)
+        n_eff = all_rows["max_batch"]
+        rows, lane_of = self._dedup_rows(all_rows)
+        self.last_unique_lanes += len(rows["alpha"])
+        # K bucketed for shape stability under load drift (see k_max_bucket)
+        k_max = k_max_bucket(k_max_for(rows["max_batch"]))
+        q, slo, epi = self._pack_group(rows, self._group_bucket(mesh), mesh)
+        if mesh is not None:
+            from ..parallel import decide_batch_sharded
+
+            packed = decide_batch_sharded(q, slo, epi, k_max, mesh,
+                                          ttft_percentile=ttft_percentile)
+        else:
+            packed = fused.decide_batch(
+                q, slo, epi, k_max, ttft_percentile=ttft_percentile,
+                use_pallas=use_pallas,
+                interpret=use_pallas and self._pallas_interpret())
+        # exactly ONE bulk d2h: the packed [N_ROWS, B] result; one
+        # C-level tolist() then plain-float indexing (a numpy scalar
+        # extraction per field per lane is measurably slower at fleet
+        # scale, and tolist's float conversion is the same
+        # nearest-double value)
+        (host,) = JAX_AUDIT.note_readback(packed)
+        rows_h = host.tolist()
+        feasible = rows_h[fused.ROW_FEASIBLE]
+        replicas = rows_h[fused.ROW_REPLICAS]
+        costs = rows_h[fused.ROW_COST]
+        itls = rows_h[fused.ROW_ITL]
+        ttfts = rows_h[fused.ROW_TTFT]
+        rhos = rows_h[fused.ROW_RHO]
+        rate_stars = rows_h[fused.ROW_RATE_STAR]
+        for i, (server, acc_name, _profile, _target) in enumerate(pairs):
+            lane = lane_of[i]
+            if feasible[lane] <= 0.0:
+                continue
+            alloc = Allocation(
+                accelerator=acc_name,
+                num_replicas=int(replicas[lane]),
+                batch_size=int(n_eff[i]),
+                cost=costs[lane],
+                itl=itls[lane],
+                ttft=ttfts[lane],
+                rho=rhos[lane],
+                max_arrv_rate_per_replica=rate_stars[lane] / 1000.0,
+            )
+            alloc.value = alloc.cost
+            self._value_and_store(server, acc_name, alloc)
+
+    def _size_group_staged(self, pairs, mesh=None,
+                           ttft_percentile: float | None = None,
+                           use_pallas: bool = False) -> None:
+        """The staged pipeline (WVA_FUSED_SOLVE=off): separate sizing
+        and re-analysis dispatches with the replica arithmetic as a host
+        loop between them. Kept byte-for-byte as the reference shape the
+        fused program is pinned against."""
+        import jax.numpy as jnp
+
+        from ..obs.profile import JAX_AUDIT
+        from ..ops.batched import analyze_batch, k_max_bucket, k_max_for, \
+            size_batch, size_batch_tail
+
+        rows = self._group_rows(pairs, epilogue=False)
+        n_eff = rows["max_batch"]
+        self.last_unique_lanes += len(n_eff)     # no dedup on this path
+        # K bucketed for shape stability under load drift (see k_max_bucket)
+        k_max = k_max_bucket(k_max_for(n_eff))
+        q, slo, _epi = self._pack_group(rows, self._group_bucket(mesh), mesh)
+        dtype = q.alpha.dtype
         if mesh is not None:
             from ..parallel import size_batch_sharded
 
             sized = size_batch_sharded(q, slo, k_max, mesh,
                                        ttft_percentile=ttft_percentile)
         elif use_pallas:
-            import jax
-
             from ..ops.pallas_kernel import (
                 size_batch_pallas,
                 size_batch_tail_pallas,
             )
 
-            # off-TPU there is no Mosaic: interpret mode keeps the exact
-            # semantics (tests/test_pallas.py pins parity) at CPU speed.
-            # Device platform, not default_backend(): remote-TPU plugins
-            # (axon) report their own backend name but TPU devices.
-            interp = jax.devices()[0].platform != "tpu"
+            interp = self._pallas_interpret()
             if ttft_percentile is not None:
                 sized = size_batch_tail_pallas(
                     q, slo, k_max, ttft_percentile=ttft_percentile,
@@ -350,14 +516,12 @@ class System:
                                     ttft_percentile=ttft_percentile)
         else:
             sized = size_batch(q, slo, k_max)
-        feasible = np.asarray(sized.feasible)
-        rate_star = np.asarray(sized.throughput) * 1000.0  # req/sec per replica
-        from ..obs.profile import JAX_AUDIT
-
         # sizing-result readback: 2 device arrays pulled to host (the
-        # d2h half of the transfer audit; the per-replica re-analysis
-        # pulls 5 more below)
-        JAX_AUDIT.note_transfer("d2h", 2)
+        # per-replica re-analysis pulls 5 more below); the count is
+        # derived from the arrays actually pulled, never a literal
+        feasible, rate_star = JAX_AUDIT.note_readback(
+            sized.feasible, sized.throughput)
+        rate_star = rate_star * 1000.0  # req/sec per replica
 
         # replica counts + per-replica rates on host (tiny arrays; sized to
         # the padded batch so the re-analysis call reuses the same shape)
@@ -381,12 +545,9 @@ class System:
                 q, jnp.asarray(per_replica_rate, dtype), k_max, mesh)
         else:
             per_rep = analyze_batch(q, jnp.asarray(per_replica_rate, dtype), k_max)
-        itl_a = np.asarray(per_rep["avg_token_time"])
-        ttft_a = np.asarray(per_rep["ttft"])
-        rho_a = np.asarray(per_rep["rho"])
-        rate_ok = np.asarray(per_rep["valid_rate"])
-        max_batch_a = np.asarray(q.max_batch)
-        JAX_AUDIT.note_transfer("d2h", 5)
+        itl_a, ttft_a, rho_a, rate_ok, max_batch_a = JAX_AUDIT.note_readback(
+            per_rep["avg_token_time"], per_rep["ttft"], per_rep["rho"],
+            per_rep["valid_rate"], q.max_batch)
 
         for i, (server, acc_name, profile, target) in enumerate(pairs):
             if not feasible[i] or num_replicas[i] <= 0 or not rate_ok[i]:

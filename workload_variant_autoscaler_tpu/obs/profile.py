@@ -354,6 +354,18 @@ class JaxAudit:
             self._transfers[direction] = \
                 self._transfers.get(direction, 0) + n
 
+    def note_readback(self, *arrays) -> tuple:
+        """Pull device arrays to host, counting EXACTLY what was pulled:
+        the d2h counter increments by the number of arrays converted, so
+        the audit can never drift from the actual readbacks the way a
+        hard-coded `note_transfer("d2h", N)` literal silently did.
+        Returns the host (numpy) arrays in argument order."""
+        import numpy  # deferred: obs/ stays stdlib-only at import time
+
+        out = tuple(numpy.asarray(a) for a in arrays)
+        self.note_transfer("d2h", len(out))
+        return out
+
     def snapshot(self) -> dict:
         with self._lock:
             return {

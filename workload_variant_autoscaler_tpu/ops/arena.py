@@ -49,6 +49,16 @@ _COLUMNS = {
     "tps": (np.float64, 0.0),
 }
 
+# epilogue columns (ops/fused.py EpilogueBatch) — written and staged only
+# when the caller packs them (the fused decision path); the staged
+# pipeline's packs carry exactly the 12 queue/SLO columns as before.
+# Zero fills are benign: a zero-demand lane sizes to zero replicas.
+_EPI_COLUMNS = {
+    "demand": (np.float64, 0.0),
+    "min_replicas": (np.int64, 0),
+    "cost_rate": (np.float64, 0.0),
+}
+
 LANE_BUCKET = 16  # the candidate-axis quantum System._calculate_batched uses
 
 
@@ -70,18 +80,23 @@ class CandidateArena:
         slab = self._slabs.get(b)
         if slab is None:
             slab = {name: np.full(b, fill, dtype=dt)
-                    for name, (dt, fill) in _COLUMNS.items()}
+                    for name, (dt, fill) in (*_COLUMNS.items(),
+                                             *_EPI_COLUMNS.items())}
             self._slabs[b] = slab
             self.slab_allocs += 1
         return slab
 
     def pack(self, rows: dict[str, list], quantum: int = LANE_BUCKET,
-             ) -> tuple[QueueBatch, SLOTargets]:
+             ):
         """Scatter `rows` (column -> list of C values) into the resident
         slab for the bucketed shape and return device-ready
-        (QueueBatch, SLOTargets) of length lane_bucket(C). Rows past C
-        are reset to the benign-invalid fills every pack, so a stale
-        previous cycle's lane can never leak into the masked padding."""
+        (QueueBatch, SLOTargets, EpilogueBatch | None) of length
+        lane_bucket(C). Rows past C are reset to the benign-invalid
+        fills every pack, so a stale previous cycle's lane can never
+        leak into the masked padding. The epilogue slabs (demand /
+        min_replicas / cost_rate — the fused decision program's inputs)
+        are written and staged only when `rows` carries them: the staged
+        pipeline's packs are byte-identical to the pre-fusion arena."""
         import jax
         import jax.numpy as jnp
 
@@ -90,9 +105,13 @@ class CandidateArena:
             rows = dict(rows)
             rows["occupancy"] = [int(m) * (1 + MAX_QUEUE_TO_BATCH_RATIO)
                                  for m in rows["max_batch"]]
+        with_epi = "demand" in rows
         b = lane_bucket(c, quantum)
         slab = self._slab(b)
-        for name, (_dt, fill) in _COLUMNS.items():
+        columns = dict(_COLUMNS)
+        if with_epi:
+            columns.update(_EPI_COLUMNS)
+        for name, (_dt, fill) in columns.items():
             buf = slab[name]
             if name == "valid":
                 buf[:c] = True
@@ -100,11 +119,12 @@ class CandidateArena:
                 buf[:c] = rows[name]
             buf[c:] = fill
         self.packs += 1
-        # 12 resident host buffers staged onto device per pack (the
-        # transfer audit's h2d counter; obs/profile.py JAX_AUDIT)
+        # 12 (15 with the fused epilogue) resident host buffers staged
+        # onto device per pack (the transfer audit's h2d counter;
+        # obs/profile.py JAX_AUDIT)
         from ..obs.profile import JAX_AUDIT
 
-        JAX_AUDIT.note_transfer("h2d", len(_COLUMNS))
+        JAX_AUDIT.note_transfer("h2d", len(columns))
         fdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         f = lambda n: jnp.asarray(slab[n], dtype=fdt)       # noqa: E731
         i = lambda n: jnp.asarray(slab[n], dtype=jnp.int32)  # noqa: E731
@@ -115,4 +135,11 @@ class CandidateArena:
             occupancy=i("occupancy"), valid=jnp.asarray(slab["valid"]),
         )
         slo = SLOTargets(ttft=f("ttft"), itl=f("itl"), tps=f("tps"))
-        return q, slo
+        if not with_epi:
+            return q, slo, None
+        from .fused import EpilogueBatch
+
+        epi = EpilogueBatch(demand=f("demand"),
+                            min_replicas=i("min_replicas"),
+                            cost_rate=f("cost_rate"))
+        return q, slo, epi

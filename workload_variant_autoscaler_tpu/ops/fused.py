@@ -1,0 +1,183 @@
+"""Fused decision program: size -> replica-count -> re-analyze -> value,
+one compiled XLA program per sizing group.
+
+The staged pipeline (`System._size_group_staged`) dispatches TWO jitted
+programs with a Python loop between them: `size_batch` solves the SLO
+bisections, two device arrays come back to host, a per-candidate loop
+computes replica counts (`replica_demand` / ceil / min-replica clamp),
+and `analyze_batch` re-analyzes each feasible candidate at its
+per-replica rate — 2 dispatches, 7 d2h readbacks, and O(candidates)
+host work per group, which BENCH_profile_r09 pinned as the dominant
+term of the cycle wall (659.8 ms of Python inside `_size_group` at 512
+variants).
+
+`decide_batch` runs the WHOLE decision on device: the epilogue inputs
+that used to live only on host — aggregate demand, the min-replica
+floor, the per-replica cost rate — ride the batch as `EpilogueBatch`
+lanes (scattered through the resident arena like every other column),
+the replica arithmetic is a handful of [B] ops between the sizing and
+the re-analysis, and exactly ONE packed [ROWS, B] result array crosses
+back to host (`JAX_AUDIT.note_readback` counts it). Input buffers are
+DONATED: in steady state the arena re-stages into buffers XLA reuses
+for the program's workspace instead of allocating fresh ones each
+cycle.
+
+Exactness contract (pinned by tests/test_fused.py): the fused program
+publishes EXACTLY the staged path's decisions — accelerator, replica
+count, batch bound, bit-identical cost/value — because every stage is
+the same float ops with the same operands: the sizing and re-analysis
+share `ops.batched`'s `_sizing_problem`/`_analyze_core` bodies, and the
+replica arithmetic mirrors the host loop operand-for-operand (demand is
+computed ON HOST from spec values and staged, so the device sees the
+same f64-rounded value the host loop consumed). The advisory latency
+telemetry (itl/ttft/rho) is equal only to within float-COMPILATION
+ulps: the two pipelines are distinct XLA programs and XLA may form FMAs
+differently per program, which the wait-time cancellation (w = t - s)
+then amplifies — observed ≤1e-12 relative, asserted ≤1e-9.
+
+`WVA_FUSED_SOLVE=off` (models/system.py) restores the staged pipeline.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs.profile import JAX_AUDIT
+from .batched import (
+    QueueBatch,
+    SLOTargets,
+    _AuditedJit,
+    _analyze_core,
+    _bisect,
+    _sizing_problem,
+    _sizing_result,
+    _tail_problem,
+)
+
+# rows of the packed result array, in readback order
+ROW_FEASIBLE = 0      # 1.0 where an allocation materializes
+ROW_REPLICAS = 1      # replica count (exact small integer)
+ROW_COST = 2          # cost_rate * replicas
+ROW_ITL = 3           # per-replica avg token time at the final rate
+ROW_TTFT = 4          # per-replica wait + prefill at the final rate
+ROW_RHO = 5           # per-replica utilisation at the final rate
+ROW_RATE_STAR = 6     # max stable rate per replica, req/sec
+N_ROWS = 7
+
+
+class EpilogueBatch(NamedTuple):
+    """Per-candidate epilogue inputs (all [B]) — the values the staged
+    host loop read from Server/Accelerator/Model objects, now resident
+    on device next to the queue parameters."""
+
+    demand: jax.Array        # aggregate req/sec to provision for
+    min_replicas: jax.Array  # int32 floor from the server spec
+    cost_rate: jax.Array     # $ per replica (acc.cost * num_instances)
+
+
+def make_epilogue_batch(demand, min_replicas, cost_rate, dtype,
+                        pad_to: int | None = None) -> EpilogueBatch:
+    """Stage host epilogue rows onto device, padded with benign zeros
+    (a zero-demand padded lane sizes to zero replicas behind the valid
+    mask). 3 h2d transfers, audited here — the arena's resident-slab
+    pack audits its own."""
+    demand = np.atleast_1d(np.asarray(demand, dtype=np.float64))
+    b = demand.shape[0]
+    pad = 0 if pad_to is None else pad_to - b
+    f = lambda x, dt: jnp.asarray(  # noqa: E731
+        np.pad(np.atleast_1d(np.asarray(x)), (0, pad)), dtype=dt)
+    JAX_AUDIT.note_transfer("h2d", 3)
+    return EpilogueBatch(
+        demand=f(demand, dtype),
+        min_replicas=f(min_replicas, jnp.int32),
+        cost_rate=f(cost_rate, dtype),
+    )
+
+
+def _epilogue(q: QueueBatch, sized, epi: EpilogueBatch, k_max: int):
+    """Replica count + per-replica re-analysis + cost, mirroring the
+    staged host loop float-for-float (system.py _size_group_staged):
+    ceil(demand / rate*) clamped to the min-replica floor, the
+    re-analysis at demand/replicas, feasibility = sized-feasible AND
+    replicas > 0 AND the re-analysis rate is valid."""
+    dtype = q.alpha.dtype
+    rate_star = sized.throughput * 1000.0            # req/sec per replica
+    demand = epi.demand.astype(dtype)
+    sizable = sized.feasible & (rate_star > 0)
+    n = jnp.ceil(demand / jnp.where(rate_star > 0, rate_star, 1.0))
+    n = jnp.maximum(n, epi.min_replicas.astype(dtype))
+    n = jnp.where(sizable & (demand > 0), n, 0.0)
+    per_replica = jnp.where(n > 0, demand / jnp.where(n > 0, n, 1.0), 0.0)
+    per = _analyze_core(q, per_replica, k_max)
+    ok = sizable & (n > 0) & per["valid_rate"]
+    cost = epi.cost_rate.astype(dtype) * n
+    return jnp.stack([
+        ok.astype(dtype),
+        n,
+        cost,
+        per["avg_token_time"],
+        per["ttft"],
+        per["rho"],
+        rate_star,
+    ])
+
+
+@partial(jax.jit, static_argnames=("k_max", "ttft_percentile",
+                                  "use_pallas", "interpret"),
+         donate_argnums=(0, 1, 2))
+def _decide_batch_impl(
+    q: QueueBatch, targets: SLOTargets, epi: EpilogueBatch, k_max: int,
+    ttft_percentile: float | None = None, use_pallas: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """The fused program: returns the packed [N_ROWS, B] result.
+
+    The sizing stage is selected statically: the fori_loop bisection
+    (mean or percentile-tail), or the Pallas kernels when the pallas
+    backend is active — their jitted wrappers inline here, so the whole
+    decision is still one executable."""
+    JAX_AUDIT.note_trace("decide_batch")
+    if use_pallas:
+        from .pallas_kernel import size_batch_pallas, size_batch_tail_pallas
+
+        if ttft_percentile is not None:
+            sized = size_batch_tail_pallas(
+                q, targets, k_max, ttft_percentile=ttft_percentile,
+                interpret=interpret)
+        else:
+            sized = size_batch_pallas(q, targets, k_max, interpret=interpret)
+    else:
+        if ttft_percentile is not None:
+            prob, eval_y = _tail_problem(q, targets, k_max, ttft_percentile)
+        else:
+            prob, eval_y = _sizing_problem(q, targets, k_max)
+        x_star = _bisect(prob, eval_y, q.alpha.dtype)
+        sized = _sizing_result(q, targets, prob, x_star, k_max)
+    return _epilogue(q, sized, epi, k_max)
+
+
+class _QuietDonationJit(_AuditedJit):
+    """decide_batch's audited facade, with XLA's 'donated buffers were
+    not usable' lowering warning scoped out: the packed [N_ROWS, B]
+    result matches no input shape, so the runtime cannot ALIAS the
+    donated slabs onto it — donation still invalidates and frees the
+    inputs eagerly (the allocator-level reuse the donation is for), and
+    the warning would otherwise fire on every compile. Filtered only
+    around this call so genuine donation problems elsewhere stay
+    visible."""
+
+    def __call__(self, *args, **kwargs):
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return super().__call__(*args, **kwargs)
+
+
+decide_batch = _QuietDonationJit("decide_batch", _decide_batch_impl)

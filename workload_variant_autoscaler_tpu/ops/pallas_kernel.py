@@ -44,10 +44,12 @@ from .batched import (
     QueueBatch,
     SizingResult,
     SLOTargets,
+    _cum_log_mu,
     _full_batch_mu,
     _sizing_problem,
     _sizing_result,
     _tail_problem,
+    _transition_rates,
     _within_tol,
     bisection_trips,
 )
@@ -263,7 +265,6 @@ def _half_problem(prob, sl: slice):
     whose result the select would discard."""
     return prob._replace(
         q2=jax.tree.map(lambda a: a[sl], prob.q2),
-        clm2=prob.clm2[sl],
         is_ttft=prob.is_ttft[sl],
         y_targets=prob.y_targets[sl],
         increasing=prob.increasing[sl],
@@ -272,7 +273,16 @@ def _half_problem(prob, sl: slice):
     )
 
 
-def _run_bisect_kernel(prob, k_max, interpret, tile_b, tail_pct,
+def _full_clm(q: QueueBatch, k_max: int) -> jax.Array:
+    """Full-grid prefix log service rates for the in-kernel eval. The XLA
+    path's SizingProblem only carries the factored basis (batched.py
+    SolveBasis — head grid + geometric closed-form tail); this kernel's
+    VMEM-resident eval walks every state, so it rebuilds the [B, K] grid
+    itself."""
+    return _cum_log_mu(_transition_rates(q, k_max))
+
+
+def _run_bisect_kernel(prob, clm2, k_max, interpret, tile_b, tail_pct,
                        slo2=None, mun2=None):
     """Shared pallas_call plumbing for the mean and tail kernels."""
     from jax.experimental import pallas as pl
@@ -288,7 +298,7 @@ def _run_bisect_kernel(prob, k_max, interpret, tile_b, tail_pct,
 
     q2 = prob.q2
     clm_padded = _pad_rows(
-        jnp.pad(prob.clm2, ((0, 0), (0, k_pad - k_max)), constant_values=0.0),
+        jnp.pad(clm2, ((0, 0), (0, k_pad - k_max)), constant_values=0.0),
         rows,
     )
 
@@ -332,7 +342,9 @@ def size_batch_pallas(
     same `_sizing_problem`/`_sizing_result` helpers the fori_loop backend
     uses; only the trip loop runs in the kernel."""
     prob, _eval_y = _sizing_problem(q, targets, k_max)
-    x_star2 = _run_bisect_kernel(prob, k_max, interpret, tile_b, None)
+    clm = _full_clm(q, k_max)
+    clm2 = jnp.concatenate([clm, clm], axis=0)
+    x_star2 = _run_bisect_kernel(prob, clm2, k_max, interpret, tile_b, None)
     return _sizing_result(q, targets, prob, x_star2, k_max)
 
 
@@ -354,13 +366,14 @@ def size_batch_tail_pallas(
     result would be discarded."""
     b = q.batch_size
     prob, _eval_y = _tail_problem(q, targets, k_max, ttft_percentile)
+    clm = _full_clm(q, k_max)
     x_ttft = _run_bisect_kernel(
-        _half_problem(prob, slice(0, b)), k_max, interpret, tile_b,
+        _half_problem(prob, slice(0, b)), clm, k_max, interpret, tile_b,
         float(ttft_percentile),
         slo2=targets.ttft.astype(q.alpha.dtype), mun2=_full_batch_mu(q),
     )
     x_itl = _run_bisect_kernel(
-        _half_problem(prob, slice(b, 2 * b)), k_max, interpret, tile_b,
+        _half_problem(prob, slice(b, 2 * b)), clm, k_max, interpret, tile_b,
         None,
     )
     x_star2 = jnp.concatenate([x_ttft, x_itl])

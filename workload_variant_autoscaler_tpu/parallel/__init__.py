@@ -13,6 +13,7 @@ rides ICI only for result gathering).
 from .mesh import (
     analyze_batch_sharded,
     candidate_mesh,
+    decide_batch_sharded,
     pad_to_multiple,
     shard_batch,
     size_batch_sharded,
@@ -21,6 +22,7 @@ from .mesh import (
 __all__ = [
     "analyze_batch_sharded",
     "candidate_mesh",
+    "decide_batch_sharded",
     "pad_to_multiple",
     "shard_batch",
     "size_batch_sharded",

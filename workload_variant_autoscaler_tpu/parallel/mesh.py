@@ -137,3 +137,44 @@ def _sharded_analyze_fn(k_max: int, mesh: Mesh):
         partial(analyze_batch, k_max=k_max),
         out_shardings=NamedSharding(mesh, P(AXIS)),
     )
+
+
+def decide_batch_sharded(q: QueueBatch, targets: SLOTargets, epi,
+                         k_max: int, mesh: Mesh,
+                         ttft_percentile: Optional[float] = None):
+    """The fused decision program (ops.fused.decide_batch) with the
+    candidate axis sharded over `mesh`: sizing, replica counting, and
+    the per-replica re-analysis all stay on the devices that hold each
+    shard — the packed [N_ROWS, B] result is the only gather. Padded
+    epilogue lanes are benign zeros (zero demand -> zero replicas behind
+    the valid mask)."""
+    from ..ops.fused import EpilogueBatch
+
+    n = mesh.devices.size
+    b = q.batch_size
+    q, targets, orig_b = pad_to_multiple(q, targets, n)
+    pad = q.batch_size - b
+    if pad:
+        epi = EpilogueBatch(
+            demand=_pad_1d(epi.demand, 0.0, pad),
+            min_replicas=_pad_1d(epi.min_replicas, 0, pad),
+            cost_rate=_pad_1d(epi.cost_rate, 0.0, pad),
+        )
+    q = shard_batch(q, mesh)
+    targets = shard_batch(targets, mesh)
+    epi = shard_batch(epi, mesh)
+    packed = _sharded_decide_fn(k_max, mesh, ttft_percentile)(
+        q, targets, epi)
+    return packed[:, :orig_b]
+
+
+@lru_cache(maxsize=32)
+def _sharded_decide_fn(k_max: int, mesh: Mesh,
+                       ttft_percentile: Optional[float] = None):
+    """Jitted sharded fused program, cached per (k_max, mesh,
+    percentile). The packed result's candidate axis is dim 1, so its
+    output sharding splits that axis and replicates the row axis."""
+    from ..ops.fused import decide_batch
+
+    fn = partial(decide_batch, k_max=k_max, ttft_percentile=ttft_percentile)
+    return jax.jit(fn, out_shardings=NamedSharding(mesh, P(None, AXIS)))

@@ -52,7 +52,9 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..models import System
+from ..models.allocation import replica_demand
 from ..models.spec import OptimizerSpec, ServerLoadSpec
+from ..models.system import fused_solve_enabled
 from ..ops.arena import CandidateArena
 from ..utils import get_logger, kv
 from .solver import WarmStart
@@ -146,7 +148,14 @@ class IncrementalSolveEngine:
                 system.accelerators)):
             acc = system.accelerators[acc_name]
             profile = model.profile(acc_name) if model is not None else None
-            out.append((acc_name, acc.spec, profile))
+            # the per-candidate COST RATE is an epilogue input of the
+            # fused decision program (ops/fused.py EpilogueBatch): named
+            # explicitly so a cost or slices-per-replica change can
+            # never ride a cached lane (acc.spec/profile already imply
+            # it — this pins the contract, it does not widen it)
+            cost_rate = (acc.spec.cost * model.num_instances(acc_name)
+                         if model is not None else 0.0)
+            out.append((acc_name, acc.spec, profile, cost_rate))
         return tuple(out)
 
     def _lane_signature(self, system: System, server,
@@ -158,6 +167,15 @@ class IncrementalSolveEngine:
         pinned = (server.cur_allocation.accelerator
                   if server.keep_accelerator and server.cur_allocation
                   else "")
+        # the aggregate demand the fused program provisions for is a
+        # pure function of (quantized load, slo_tps) — both below — but
+        # it is an EPILOGUE INPUT of the device program now, so the
+        # signature names it explicitly: the cache key provably covers
+        # every value the fused kernel consumes
+        demand = (replica_demand(load.arrival_rate,
+                                 target.slo_tps if target else 0.0,
+                                 load.avg_out_tokens)
+                  if load is not None and target is not None else None)
         return (
             server.model_name,
             server.service_class_name,
@@ -169,6 +187,7 @@ class IncrementalSolveEngine:
             pinned,
             ((load.arrival_rate, load.avg_in_tokens, load.avg_out_tokens)
              if load is not None else None),
+            demand,
             rung,
             ttft_percentile,
             self._candidate_entries(system, server),
@@ -213,9 +232,15 @@ class IncrementalSolveEngine:
         for server in system.servers.values():
             server.load = quantize_load(server.load, self.epsilon)
 
+        # the fused-solve knob rides the analyze signature: flipping
+        # WVA_FUSED_SOLVE mid-run forces a full re-solve, so a cache
+        # can never mix allocations from the two pipelines (they are
+        # bit-identical by contract, but the invariant should not
+        # depend on it)
         analyze_sig = (backend,
                        int(mesh.devices.size) if mesh is not None else None,
-                       ttft_percentile)
+                       ttft_percentile,
+                       fused_solve_enabled())
         solve_sig = self._solve_signature(system, optimizer_spec, cycle_rung)
 
         full = False
