@@ -51,6 +51,14 @@ bench-fuse: ## Fused decision program vs staged pipeline: 512-variant load-shift
 fuse-smoke: ## Abbreviated fused-path run (64 variants, ~3s): zero retraces over 10 steady-state cycles, exactly one bulk d2h per sizing group
 	$(PY) bench_fuse.py --smoke
 
+.PHONY: bench-stream
+bench-stream: ## Streaming reconcile lag: 512 variants, remote-write ingest, p50/p99 load-change->published vs the polled baseline (writes BENCH_stream_r11.json)
+	$(PY) bench_stream.py
+
+.PHONY: stream-smoke
+stream-smoke: ## Abbreviated streaming-lag run (64 variants, ~5s): every pushed event consumed, published, and lag-metered
+	$(PY) bench_stream.py --smoke
+
 .PHONY: bench-scenarios
 bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO headlines + mean ablations, tail stress, strict SLO)
 	$(PY) bench_loop.py whole-fleet-p95
@@ -63,7 +71,7 @@ bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO 
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo
 
-LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_profile.py bench_fuse.py __graft_entry__.py
+LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_profile.py bench_fuse.py bench_stream.py __graft_entry__.py
 
 .PHONY: lint
 lint: ## Static analysis gate: ruff+mypy when installed, wvalint always (rule catalog: docs/developer-guide/wvalint.md)

@@ -142,7 +142,8 @@ class TestCallArity:
 @pytest.mark.parametrize("paths", [
     ["workload_variant_autoscaler_tpu", "tools", "tests", "bench.py",
      "bench_loop.py", "bench_collect.py", "bench_goodput.py",
-     "bench_profile.py", "bench_fuse.py", "__graft_entry__.py"],
+     "bench_profile.py", "bench_fuse.py", "bench_stream.py",
+     "__graft_entry__.py"],
 ])
 def test_package_lints_clean(paths):
     """The gate itself: the shipped source must lint clean — every rule
@@ -843,6 +844,92 @@ class TestSelfDeadlock:
             "            pass\n"
             "        self.inc()\n")
         assert "WVL403" not in lint(src)
+
+
+def lint_stream(source: str):
+    """Lint under a stream/ module path (activates WVL404)."""
+    return [f.code for f in wvalint.lint_source(
+        os.path.join("workload_variant_autoscaler_tpu", "stream", "x.py"),
+        source)]
+
+
+class TestStreamLockGuard:
+    """WVL404 — in stream/ modules, a lock-owning class must mutate ALL
+    its self attributes under the lock (stricter than WVL401: no
+    guarded-elsewhere inventory — the ingest threads and the solve
+    consumer both reach stream-core objects)."""
+
+    SHARED = (
+        "import threading\n"
+        "class Store:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._pending = {}\n"
+        "        self.count = 0\n"
+    )
+
+    def test_unlocked_mutation_fires_even_if_never_guarded_elsewhere(self):
+        # `count` is never touched under the lock anywhere — WVL401
+        # stays silent by design; WVL404 fires anyway
+        src = self.SHARED + (
+            "    def bump(self):\n"
+            "        self.count += 1\n")
+        out = lint_stream(src)
+        assert "WVL404" in out
+        assert "WVL401" not in lint(src.replace("stream", "x"))
+
+    def test_locked_mutation_passes(self):
+        src = self.SHARED + (
+            "    def offer(self, key):\n"
+            "        with self._lock:\n"
+            "            self._pending[key] = 1\n"
+            "            self.count += 1\n")
+        assert "WVL404" not in lint_stream(src)
+
+    def test_ctor_and_locked_suffix_exempt(self):
+        src = self.SHARED + (
+            "    def _drain_locked(self):\n"
+            "        out, self._pending = self._pending, {}\n"
+            "        return out\n")
+        assert "WVL404" not in lint_stream(src)
+
+    def test_lock_free_class_out_of_scope(self):
+        # single-thread state (the StreamState contract) declares no
+        # lock and is exempt
+        src = ("class StreamState:\n"
+               "    def __init__(self):\n"
+               "        self.cycle_index = 0\n"
+               "    def advance(self):\n"
+               "        self.cycle_index += 1\n")
+        assert lint_stream(src) == []
+
+    def test_rule_scoped_to_stream_modules(self):
+        src = self.SHARED + (
+            "    def bump(self):\n"
+            "        self.count += 1\n")
+        assert "WVL404" not in lint(src)
+
+    def test_noqa_suppresses_and_stale_noqa_audited(self):
+        src = self.SHARED + (
+            "    def bump(self):\n"
+            "        self.count += 1  # noqa" ": WVL404\n")
+        assert "WVL404" not in lint_stream(src)
+        stale = self.SHARED + (
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self.count += 1  # noqa" ": WVL404\n")
+        assert "WVL005" in lint_stream(stale)
+
+    def test_shipped_stream_package_is_covered(self):
+        """The real stream/ package is inside the rule's scope (its
+        lock-owning classes pass because they ARE disciplined — this
+        pins that the scope matcher sees them)."""
+        pkg = os.path.join(REPO, "workload_variant_autoscaler_tpu",
+                           "stream")
+        assert wvalint._is_stream_module(os.path.join(pkg, "core.py"))
+        assert not wvalint._is_stream_module(
+            os.path.join(REPO, "workload_variant_autoscaler_tpu",
+                         "controller", "reconciler.py"))
 
 
 # -- config-knob parity (WVL311/312) -----------------------------------------

@@ -86,6 +86,16 @@ Rules (suppress per-line with `# noqa` or `# noqa: WVLxxx`):
   WVL403  self-deadlock: acquiring a class's non-reentrant lock (a
           nested `with self._lock:` or a call to a method that takes it)
           while already holding that same lock
+  WVL404  unguarded stream-core state: in `stream/` modules, a class
+          that owns a lock attribute (i.e. declares itself
+          thread-shared: the ingest WSGI threads, the scrape poller,
+          and the solve consumer all reach stream-core objects) mutates
+          ANY `self.` attribute outside the lock. Stricter than WVL401:
+          no "guarded elsewhere" inventory — declaring a lock puts
+          every mutation under it. Constructors and `*_locked` methods
+          are exempt; lock-free classes (single-thread state like
+          StreamState, which by contract only the consumer touches) are
+          out of scope by not owning a lock.
 
   WVL005  stale suppression: a `# noqa: WVLxxx` comment naming a rule
           that does not fire on that line (audited only for rule
@@ -1270,6 +1280,48 @@ def _store_is_locked(fn, target) -> bool:
     return bool(walk(fn, False))
 
 
+# -- stream-core lock guard (WVL404) -----------------------------------------
+
+
+def _is_stream_module(path: str) -> bool:
+    """True for modules inside a `stream/` package directory (the
+    long-lived streaming core, whose objects are reachable from both
+    the ingest threads and the solve consumer)."""
+    norm = os.path.normpath(path).replace(os.sep, "/")
+    return "/stream/" in norm or norm.startswith("stream/")
+
+
+def _check_stream_lock_guard(path: str, tree: ast.Module) -> list[Finding]:
+    """WVL404: in stream/ modules, a lock-owning class must mutate ALL
+    its non-lock self attributes under the lock, in every non-ctor
+    method. The WVL401 family only fires on attributes *guarded
+    elsewhere*; long-lived stream-core state has no single-threaded
+    grace period, so owning a lock means every mutation takes it."""
+    if not _is_stream_module(path):
+        return []
+    findings: list[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _class_lock_attrs(cls)
+        if not locks:
+            continue
+        for m in cls.body:
+            if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if m.name in _CTOR_METHODS or m.name.endswith("_locked"):
+                continue
+            for lineno, attr, is_self, locked in _self_mutations(
+                    m, lock_attrs=set(locks)):
+                if is_self and not locked and attr not in locks:
+                    findings.append(Finding(
+                        path, lineno, "WVL404",
+                        f"stream-core state {cls.name}.{attr} mutated "
+                        f"outside the lock in {m.name}() (reachable from "
+                        "ingest threads and the solve consumer)"))
+    return findings
+
+
 # -- thread-reachable shared-state mutation (WVL402) -------------------------
 
 
@@ -1914,7 +1966,7 @@ def _stage_coverage_findings(files: list[str],
 
 _STRUCTURAL_CODES = frozenset({
     "WVL001", "WVL002", "WVL003", "WVL101", "WVL102", "WVL103", "WVL104",
-    "WVL105", "WVL106", "WVL305", "WVL401", "WVL402", "WVL403",
+    "WVL105", "WVL106", "WVL305", "WVL401", "WVL402", "WVL403", "WVL404",
 })
 
 
@@ -1940,6 +1992,7 @@ def lint_source(path: str, source: str,
             findings += _check_class_concurrency(path, node)
     findings += _check_module_lock_discipline(path, tree)
     findings += _check_thread_shared_state(path, tree)
+    findings += _check_stream_lock_guard(path, tree)
     findings += _check_unaudited_readbacks(path, tree)
     active = set(_STRUCTURAL_CODES)
     if sigs:

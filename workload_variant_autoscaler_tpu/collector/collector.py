@@ -556,6 +556,8 @@ MODE_FLEET = "fleet"                    # demuxed from the grouped result
 MODE_REPAIR = "per-variant-repair"      # labels missing from the grouped
                                         # result: single-variant queries
 MODE_LEGACY = "legacy"                  # WVA_FLEET_COLLECTION=off path
+MODE_STREAM = "stream"                  # pushed/streamed ingest (stream/):
+                                        # zero Prometheus round-trips
 
 
 def fleet_group_by(family: MetricFamily | None = None) -> str:

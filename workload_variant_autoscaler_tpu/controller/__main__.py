@@ -365,6 +365,16 @@ def main(argv=None) -> int:
         kube=kube, prom=prom, emitter=emitter,
         config_namespace=args.config_namespace,
     )
+    stream_middleware = None
+    if reconciler._stream_enabled():
+        # the streaming core's push door (POST /api/v1/write, Prometheus
+        # remote-write) mounts beside /debug on the metrics server —
+        # attach the core now so pushes that land before leadership
+        # starts the consumer are not dropped
+        from ..stream import remote_write_middleware
+
+        stream_middleware = remote_write_middleware(
+            reconciler.ensure_stream_core())
     try:
         emitter.serve(
             args.metrics_port, addr=args.metrics_addr,
@@ -377,6 +387,7 @@ def main(argv=None) -> int:
             debug_middleware=debug_middleware(reconciler.tracer,
                                               reconciler.decisions,
                                               reconciler.profiler),
+            stream_middleware=stream_middleware,
         )
     except ValueError as e:
         log.error("invalid metrics TLS configuration", extra=kv(error=str(e)))

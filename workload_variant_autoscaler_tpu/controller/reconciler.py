@@ -25,6 +25,7 @@ from ..collector import (
     MODE_FLEET,
     MODE_LEGACY,
     MODE_REPAIR,
+    MODE_STREAM,
     CountingPromAPI,
     FleetLoadCollector,
     IncompleteMetricsError,
@@ -66,7 +67,12 @@ from ..solver import (
     Manager,
     Optimizer,
 )
-from ..solver.incremental import DEFAULT_EPSILON, DEFAULT_FULL_EVERY
+from ..solver.incremental import (
+    DEFAULT_EPSILON,
+    DEFAULT_FULL_EVERY,
+    quantize_load,
+)
+from ..stream.state import FleetSnapshot, StreamState
 from ..utils import (
     CIRCUIT_OPEN,
     STANDARD_BACKOFF,
@@ -153,9 +159,19 @@ class Reconciler:
         self.profiler = profiler or Profiler()
         self._trace_log = os.environ.get(
             "WVA_TRACE_LOG", "").lower() in ("1", "true")
-        self._cycle_index = 0
-        # per-cycle decision scratchpads, key -> DecisionBuilder
-        self._cycle_builders: dict[str, DecisionBuilder] = {}
+        # ALL engine state that outlives a stage call — the cycle
+        # counter, decision scratchpads, stabilization history, probe
+        # targets, the fleet snapshot, the merged export series — lives
+        # in one explicit StreamState (stream/state.py). The streaming
+        # core (stream/core.py) shares this object so the polled loop
+        # and the event-driven consumer are two drivers of one engine;
+        # the `_`-prefixed accessors below keep the historical attribute
+        # names as properties.
+        self.state = StreamState()
+        # the streaming core, attached lazily by ensure_stream_core()
+        # (run_forever with WVA_STREAM on, tests, the bench); None means
+        # kick() keeps its legacy wake-event-only semantics
+        self.stream_core = None
         # per-dependency circuit breakers (utils/backoff.py): a dependency
         # that has failed `threshold` consecutive times fails FAST instead
         # of charging every cycle a full backoff ladder per call — badput
@@ -184,46 +200,127 @@ class Reconciler:
         # deterministic jitter source for every retry ladder (the chaos
         # suite's no-wall-clock-randomness rule)
         self._rng = random.Random(0x57A)
-        # per-cycle state, rebuilt at each reconcile() entry
-        self._deadline = Deadline.unlimited()
-        self._degradation = DegradationTracker()
-        # recommendation history per VA for scale-down stabilization
-        # (in-memory like HPA's window; a controller restart just delays
-        # one scale-down, the fail-safe direction)
-        self._recommendations: dict[str, list[tuple[float, int]]] = {}
-        # consecutive out-of-tolerance drift readings per VA (hysteresis:
-        # one noisy 1m-rate sample must not flip PerfModelAccurate)
-        self._drift_strikes: dict[str, int] = {}
         # set by kick() to wake run_forever early (watch-event trigger)
         self._wake = threading.Event()
-        # ns -> (consecutive empty TPU-gauge probes, cycles skipped since)
-        self._tpu_util_misses: dict[str, tuple[int, int]] = {}
-        # demand-breakout probe state: key -> (demand PromQL, capacity
-        # the published count sustains in req/s); rebuilt every publish
-        self._probe_targets: dict[str, tuple[str, float]] = {}
-        self._last_operator_cm: dict[str, str] = {}
-        # namespaces already warned about model-label-free aggregation
-        # (warn on change, not every cycle)
-        self._shared_ns_warned: tuple[str, ...] = ()
         # the probe daemon thread's private Prometheus client (lazy; a
         # shared requests.Session is not thread-safe under concurrency).
         # The lock covers the lazy init: demand_probe() can be called
         # from the daemon thread and directly by tests/kick paths
         self._probe_prom = None
         self._probe_prom_lock = threading.Lock()
-        # fleet-mode per-cycle condition source: full_name -> the VA
-        # object this cycle read/wrote, so _emit_conditions needs no
-        # extra LIST; None = legacy mode (post-publish LIST)
-        self._cycle_condition_vas: Optional[dict] = None
         # incremental solve engine (solver/incremental.py): persists the
         # signature cache / resident arena / warm-start seed across
         # cycles; (re)built lazily from the WVA_SOLVE_* knobs and
         # dropped when WVA_INCREMENTAL_SOLVE turns off
         self._solve_engine_obj: Optional[IncrementalSolveEngine] = None
-        # previous cycle's limited-mode inventory, for capacity-withdrawal
-        # detection (a draining pool must read as shrinking capacity on
-        # the series and in the log, not silently re-solve smaller)
-        self._last_capacity: dict[str, int] = {}
+
+    # -- StreamState accessors --------------------------------------------
+    # The historical private-attribute names, kept as properties over
+    # the shared StreamState so the whole existing body of call sites
+    # (and tests) reads/writes the same state the streaming core owns.
+
+    @property
+    def _cycle_index(self) -> int:
+        return self.state.cycle_index
+
+    @_cycle_index.setter
+    def _cycle_index(self, value: int) -> None:
+        self.state.cycle_index = value
+
+    @property
+    def _cycle_builders(self) -> dict:
+        return self.state.cycle_builders
+
+    @_cycle_builders.setter
+    def _cycle_builders(self, value: dict) -> None:
+        self.state.cycle_builders = value
+
+    @property
+    def _deadline(self):
+        if self.state.deadline is None:
+            self.state.deadline = Deadline.unlimited()
+        return self.state.deadline
+
+    @_deadline.setter
+    def _deadline(self, value) -> None:
+        self.state.deadline = value
+
+    @property
+    def _degradation(self):
+        if self.state.degradation is None:
+            self.state.degradation = DegradationTracker()
+        return self.state.degradation
+
+    @_degradation.setter
+    def _degradation(self, value) -> None:
+        self.state.degradation = value
+
+    @property
+    def _recommendations(self) -> dict:
+        # scale-down stabilization history per VA (in-memory like HPA's
+        # window; a restart just delays one scale-down — the fail-safe
+        # direction)
+        return self.state.recommendations
+
+    @_recommendations.setter
+    def _recommendations(self, value: dict) -> None:
+        self.state.recommendations = value
+
+    @property
+    def _drift_strikes(self) -> dict:
+        return self.state.drift_strikes
+
+    @_drift_strikes.setter
+    def _drift_strikes(self, value: dict) -> None:
+        self.state.drift_strikes = value
+
+    @property
+    def _tpu_util_misses(self) -> dict:
+        return self.state.tpu_util_misses
+
+    @_tpu_util_misses.setter
+    def _tpu_util_misses(self, value: dict) -> None:
+        self.state.tpu_util_misses = value
+
+    @property
+    def _probe_targets(self) -> dict:
+        return self.state.probe_targets
+
+    @_probe_targets.setter
+    def _probe_targets(self, value: dict) -> None:
+        self.state.probe_targets = value
+
+    @property
+    def _last_operator_cm(self) -> dict:
+        return self.state.last_operator_cm
+
+    @_last_operator_cm.setter
+    def _last_operator_cm(self, value: dict) -> None:
+        self.state.last_operator_cm = value
+
+    @property
+    def _shared_ns_warned(self) -> tuple:
+        return self.state.shared_ns_warned
+
+    @_shared_ns_warned.setter
+    def _shared_ns_warned(self, value: tuple) -> None:
+        self.state.shared_ns_warned = value
+
+    @property
+    def _cycle_condition_vas(self) -> Optional[dict]:
+        return self.state.cycle_condition_vas
+
+    @_cycle_condition_vas.setter
+    def _cycle_condition_vas(self, value: Optional[dict]) -> None:
+        self.state.cycle_condition_vas = value
+
+    @property
+    def _last_capacity(self) -> dict:
+        return self.state.last_capacity
+
+    @_last_capacity.setter
+    def _last_capacity(self, value: dict) -> None:
+        self.state.last_capacity = value
 
     # -- fleet-scale collection knobs -------------------------------------
 
@@ -243,6 +340,29 @@ class Reconciler:
         per-variant calls (status writes, owner-ref patches, TPU-util
         probes). 1 = fully sequential (strict-determinism hatch)."""
         return fanout_workers(self._last_operator_cm)
+
+    # -- streaming reconcile (stream/) ------------------------------------
+
+    def _stream_enabled(self, operator_cm=None) -> bool:
+        """WVA_STREAM: the event-driven streaming core behind
+        run_forever (default on). `off` restores the polled cadence
+        loop byte-for-byte — env first, then the operator ConfigMap
+        (standard knob precedence)."""
+        raw = (os.environ.get("WVA_STREAM")
+               or (operator_cm if operator_cm is not None
+                   else self._last_operator_cm).get("WVA_STREAM")
+               or "")
+        return raw.strip().lower() not in ("off", "false", "0", "disabled")
+
+    def ensure_stream_core(self):
+        """Attach (once) and return the streaming core. Lazy import:
+        controller/ must stay importable without stream/ and vice
+        versa."""
+        if self.stream_core is None:
+            from ..stream import StreamCore
+
+            self.stream_core = StreamCore(self)
+        return self.stream_core
 
     # -- incremental solve knobs ------------------------------------------
 
@@ -378,7 +498,7 @@ class Reconciler:
 
     # -- the cycle (reference controller.go:86-202) ----------------------
 
-    def reconcile(self) -> ReconcileResult:
+    def reconcile(self, *, scope=None, stream_loads=None) -> ReconcileResult:
         """One cycle, with per-stage wall-clock timing published as
         inferno_reconcile_stage_duration_msec{stage=...} — whichever
         dependency stalls (apiserver config reads, Prometheus scrapes, the
@@ -392,9 +512,28 @@ class Reconciler:
         The whole cycle is ONE trace (obs/): a root `reconcile` span,
         one child span per stage, and under those the dependency-call,
         solver, and fault-injection spans/events — every log line inside
-        carries the cycle's trace_id."""
+        carries the cycle's trace_id.
+
+        `scope`/`stream_loads` (keyword-only; the streaming core's
+        entry, stream/core.py) turn the cycle into a SCOPED micro-cycle:
+        only the named full_name keys are prepared/solved/published, fed
+        from the pushed loads instead of Prometheus, against the last
+        full pass's FleetSnapshot — zero ConfigMap reads, zero fleet
+        LISTs. Wholesale-replaced series are merged, cross-cycle
+        bookkeeping (pruning, capacity notes, TPU probes) is left to
+        full passes. A scoped call before any full pass has taken a
+        snapshot silently runs full. Default (None) is the legacy
+        full-fleet cycle, byte-for-byte."""
         stages: dict[str, float] = {}
         t0 = time.perf_counter()
+        self.state.scope = (frozenset(scope)
+                            if scope and self.state.snapshot is not None
+                            else None)
+        self.state.stream_loads = (dict(stream_loads)
+                                   if stream_loads
+                                   and self.state.scope is not None
+                                   else None)
+        self.state.cycle_loads = {}
         self._cycle_index += 1
         self._cycle_builders = {}
         # WVA_PROFILE_SAMPLE_HZ: the residual itemizer — a stdlib stack
@@ -407,7 +546,13 @@ class Reconciler:
             or self._last_operator_cm.get("WVA_PROFILE_SAMPLE_HZ"), 0.0)
         if sample_hz > 0:
             sampler = ResidualSampler(sample_hz).start()
-        root = self.tracer.begin("reconcile", cycle=self._cycle_index)
+        if self.state.scope is not None:
+            # a per-event mini-trace: same span shape as a full cycle,
+            # tagged with how many variants the event window covered
+            root = self.tracer.begin("reconcile", cycle=self._cycle_index,
+                                     stream_scope=len(self.state.scope))
+        else:
+            root = self.tracer.begin("reconcile", cycle=self._cycle_index)
         # the open slot for the stage currently running; mark() names it
         # after the stage it just completed and opens the next slot
         stage_span = [self.tracer.begin("stage")]
@@ -478,58 +623,115 @@ class Reconciler:
                 log.warning("cycle profile ledger failed",
                             extra=kv(error=str(e)))
             self.emitter.emit_cycle_timing(stages)
-            self.emitter.emit_degradation_metrics(
-                self._degradation.gauge_samples(),
-                int(cycle_state))
+            samples = self._degradation.gauge_samples()
+            if self.state.scope is None:
+                self.state.rungs = dict(samples)
+                self.emitter.emit_degradation_metrics(
+                    dict(self.state.rungs), int(cycle_state))
+            else:
+                removed = self.state.merge_by_variant(
+                    self.state.rungs, samples, set(samples))
+                self.emitter.update_degradation_metrics(
+                    samples, removed, int(cycle_state))
             self.emitter.emit_circuit_metrics(
                 {name: b.state_code() for name, b in self.breakers.items()})
+            self.state.scope = None
+            self.state.stream_loads = None
 
     def _reconcile_timed(self, mark) -> ReconcileResult:
-        operator_cm = self.read_operator_config()
-        self._last_operator_cm = operator_cm  # demand-probe knob source
-        interval = self.read_optimization_interval(operator_cm)
-        result = ReconcileResult(requeue_after=interval)
+        scope = self.state.scope
+        snap = self.state.snapshot if scope is not None else None
+        if snap is not None:
+            # scoped micro-cycle (stream/core.py): config + fleet view
+            # come from the last full pass's snapshot — zero ConfigMap
+            # reads, zero fleet-wide LISTs on the event path
+            operator_cm = dict(snap.operator_cm)
+            self._last_operator_cm = operator_cm
+            interval = snap.interval_s
+            result = ReconcileResult(requeue_after=interval)
+            accelerator_cm = snap.accelerator_cm
+            service_class_cm = snap.service_class_cm
+            vas = list(snap.vas.values())
+        else:
+            scope = None
+            operator_cm = self.read_operator_config()
+            self._last_operator_cm = operator_cm  # demand-probe knob source
+            interval = self.read_optimization_interval(operator_cm)
+            result = ReconcileResult(requeue_after=interval)
 
-        accelerator_cm = self.read_accelerator_config()
-        service_class_cm = self.read_service_class_config()
+            accelerator_cm = self.read_accelerator_config()
+            service_class_cm = self.read_service_class_config()
 
-        vas = self._kube_call(self.kube.list_variant_autoscalings,
-                              what="list:VariantAutoscaling")
+            vas = self._kube_call(self.kube.list_variant_autoscalings,
+                                  what="list:VariantAutoscaling")
+            # refresh the streaming snapshot: every full pass re-anchors
+            # what later scoped micro-cycles solve against (`vas` below
+            # are this cycle's working objects; _apply overlays the
+            # fresh post-write copies)
+            self.state.snapshot = FleetSnapshot(
+                operator_cm=dict(operator_cm),
+                accelerator_cm=accelerator_cm,
+                service_class_cm=service_class_cm,
+                interval_s=interval,
+                vas={full_name(va.name, va.namespace): va
+                     for va in vas if va.is_active()},
+                taken_at=self.now(),
+            )
         mark(STAGE_CONFIG)
         active = [va for va in vas if va.is_active()]
+        if scope is not None:
+            active = [va for va in active
+                      if full_name(va.name, va.namespace) in scope]
         # fleet mode: the cycle's LIST copies are the condition-metrics
         # source of truth (updated with the fresh post-write objects in
         # _apply), so the post-publish re-LIST is not paid; legacy keeps
-        # the LIST (None)
+        # the LIST (None). Scoped cycles always use the in-hand objects
+        # (a per-event LIST would defeat the point).
         self._cycle_condition_vas = (
             {full_name(va.name, va.namespace): va for va in active}
-            if self._fleet_collection_enabled(operator_cm) else None)
-        for va in vas:
-            if not va.is_active():
-                result.skipped[full_name(va.name, va.namespace)] = "deleted"
-        # drop stabilization history for VAs that no longer exist (bounds
-        # memory; a recreated namesake starts with a clean window)
-        active_keys = {full_name(va.name, va.namespace) for va in active}
-        for stale in [k for k in self._recommendations if k not in active_keys]:
-            del self._recommendations[stale]
-        for stale in [k for k in self._drift_strikes if k not in active_keys]:
-            del self._drift_strikes[stale]
-        self.load_cache.prune(active_keys)
+            if (self._fleet_collection_enabled(operator_cm)
+                or scope is not None) else None)
+        if scope is None:
+            for va in vas:
+                if not va.is_active():
+                    result.skipped[full_name(va.name, va.namespace)] = \
+                        "deleted"
+            # drop stabilization history for VAs that no longer exist
+            # (bounds memory; a recreated namesake starts with a clean
+            # window). Scoped cycles see only their slice of the fleet
+            # and must not prune the rest.
+            active_keys = {full_name(va.name, va.namespace)
+                           for va in active}
+            for stale in [k for k in self._recommendations
+                          if k not in active_keys]:
+                del self._recommendations[stale]
+            for stale in [k for k in self._drift_strikes
+                          if k not in active_keys]:
+                del self._drift_strikes[stale]
+            self.load_cache.prune(active_keys)
+        if scope is not None and not active:
+            # every scoped variant left the fleet between the snapshot
+            # and the event: nothing to do, nothing to clear
+            return result
         if not active:
             log.info("no active VariantAutoscalings, skipping optimization")
             # no fleet: every per-variant/per-namespace series must read
             # empty, not hold its last value forever
-            self.emitter.emit_power_metrics({})
-            self.emitter.emit_condition_metrics({})
-            self.emitter.emit_drift_metrics({})
+            self._publish_power({})
+            self._publish_conditions({})
+            self._publish_drift({})
             self.emitter.emit_tpu_utilization_metrics({})
             self._note_capacity({})
             return result
 
         # limited mode (realizes the reference's dead greedy path +
         # CollectInventoryK8S stub, collector.go:37-42): allocate against
-        # the cluster's actual per-generation chip inventory
-        limited = operator_cm.get("WVA_LIMITED_MODE", "").lower() == "true"
+        # the cluster's actual per-generation chip inventory. Scoped
+        # micro-cycles never run limited: shared capacity couples
+        # variants, so the streaming core escalates those fleets to full
+        # passes (stream/core.py) — this is the belt to that suspender.
+        limited = (operator_cm.get("WVA_LIMITED_MODE", "").lower() == "true"
+                   and scope is None)
         capacity: dict[str, int] = {}
         if limited:
             try:
@@ -557,7 +759,8 @@ class Reconciler:
                     limited = False
                 else:
                     log.info("limited mode capacity", extra=kv(**capacity))
-        self._note_capacity(capacity if limited else {})
+        if scope is None:
+            self._note_capacity(capacity if limited else {})
 
         policy = operator_cm.get("WVA_SATURATION_POLICY", "None")
         if SaturationPolicy.parse(policy).value != policy:
@@ -585,8 +788,9 @@ class Reconciler:
                                  operator_cm=operator_cm)
         mark(STAGE_PREPARE)
         if not prepared:
-            self.emitter.emit_power_metrics({})
-            self._probe_targets = {}   # nothing published -> nothing to probe
+            self._publish_power({})
+            # nothing published -> nothing to probe
+            self._set_probe_targets({})
             # skip-path conditions (MetricsAvailable=False etc.) were
             # written to the CRs above and must reach the series too
             self._emit_conditions()
@@ -602,7 +806,26 @@ class Reconciler:
         engine_backend = translate.engine_backend()
         ttft_percentile = translate.ttft_percentile(operator_cm)
         engine_mesh = translate.engine_mesh(engine_backend)
-        solve_engine = self._solve_engine(operator_cm)
+        # scoped micro-cycles bypass the incremental engine (its caches
+        # describe the FULL fleet; a scoped pass must not advance or
+        # prune them) and solve the event's sub-batch directly, through
+        # a resident arena of their own so the fused program never
+        # retraces on the event path. Loads are snapped to the SAME
+        # WVA_SOLVE_EPSILON buckets the engine sizes on, so a streamed
+        # decision is bit-equal to what the next full incremental pass
+        # would publish for the same load.
+        solve_engine = (self._solve_engine(operator_cm)
+                        if scope is None else None)
+        if scope is not None:
+            epsilon = parse_float_or(
+                self._solve_knob("WVA_SOLVE_EPSILON", operator_cm),
+                DEFAULT_EPSILON)
+            if epsilon < 0:
+                epsilon = DEFAULT_EPSILON
+            for server in system.servers.values():
+                server.load = quantize_load(server.load, epsilon)
+            if engine_mesh is None:
+                system.arena = self.state.stream_arena
         if solve_engine is not None:
             stats = solve_engine.calculate(
                 system, backend=engine_backend, mesh=engine_mesh,
@@ -718,6 +941,54 @@ class Reconciler:
         mark(STAGE_PUBLISH)
         return result
 
+    def _scope_variants(self) -> set:
+        """The current scope as (variant_name, namespace) pairs (empty
+        when running full-fleet)."""
+        scope = self.state.scope or ()
+        out = set()
+        for key in scope:
+            name, _, ns = key.partition(":")
+            out.add((name, ns))
+        return out
+
+    def _publish_power(self, power: dict) -> None:
+        """Power series with merge semantics: a full cycle replaces the
+        whole gauge (deleted variants' label sets clear); a scoped
+        micro-cycle updates only its variants' samples in place — the
+        rest of the fleet keeps exporting its last full-pass values, and
+        the micro-cycle never pays a fleet-sized gauge rebuild."""
+        if self.state.scope is None:
+            self.state.power = dict(power)
+            self.emitter.emit_power_metrics(dict(self.state.power))
+            return
+        removed = self.state.merge_by_variant(self.state.power, power,
+                                              self._scope_variants())
+        self.emitter.update_power_metrics(
+            power, removed, sum(self.state.power.values()))
+
+    def _publish_drift(self, samples: dict) -> None:
+        """Same merge semantics as the power series."""
+        if self.state.scope is None:
+            self.state.drift = dict(samples)
+            self.emitter.emit_drift_metrics(dict(self.state.drift))
+            return
+        removed = self.state.merge_by_variant(self.state.drift, samples,
+                                              self._scope_variants())
+        self.emitter.update_drift_metrics(samples, removed)
+
+    def _set_probe_targets(self, targets: dict) -> None:
+        """Demand-probe envelope table with the same merge semantics:
+        full cycles rebuild it wholesale, scoped cycles replace only
+        their variants' rows."""
+        if self.state.scope is None:
+            self._probe_targets = dict(targets)
+            return
+        variants = self._scope_variants()
+        for key in [k for k in self._probe_targets
+                    if tuple(k.partition(":")[::2]) in variants]:
+            del self._probe_targets[key]
+        self._probe_targets.update(targets)
+
     def _note_capacity(self, capacity: dict[str, int]) -> None:
         """Capacity-withdrawal visibility (docs/robustness.md node-pool
         faults): publish the cycle's per-generation chip inventory on
@@ -772,10 +1043,23 @@ class Reconciler:
                     continue
                 for cond in va.status.conditions:
                     samples[(va.name, va.namespace, cond.type)] = cond.status
-            self.emitter.emit_condition_metrics(samples)
+            self._publish_conditions(samples)
         except Exception as e:  # noqa: BLE001
             log.warning("condition metrics emission failed",
                         extra=kv(error=str(e)))
+
+    def _publish_conditions(self, samples: dict) -> None:
+        """Condition series with the power-gauge merge semantics: full
+        cycles replace wholesale, scoped cycles update only their
+        variants' condition sets in place."""
+        if self.state.scope is None:
+            self.state.conditions = dict(samples)
+            self.emitter.emit_condition_metrics(
+                dict(self.state.conditions))
+            return
+        removed = self.state.merge_by_variant(
+            self.state.conditions, samples, self._scope_variants())
+        self.emitter.update_condition_metrics(samples, removed)
 
     # -- scale-down stabilization (beyond-reference; HPA-style) -----------
 
@@ -977,15 +1261,21 @@ class Reconciler:
         probe_window = (self.probe_window()
                         if self._probe_knob(self.PROBE_ENV, 0.0) > 0
                         else None)
-        self._warn_shared_namespace_aggregation(active, family)
+        scoped = self.state.scope is not None
+        if not scoped:
+            # the warning keys on fleet-wide namespace sharing; a scoped
+            # slice would flap the warned-set state
+            self._warn_shared_namespace_aggregation(active, family)
 
         fleet_mode = self._fleet_collection_enabled(operator_cm)
         # one-LIST kube snapshot: the whole fleet's Deployments in one
         # call, indexed by (namespace, name), instead of a GET per
         # variant. A failed LIST falls back to per-variant GETs — the
-        # pre-existing ladder, not a whole-fleet skip.
+        # pre-existing ladder, not a whole-fleet skip. Scoped
+        # micro-cycles GET just their few Deployments instead of paying
+        # a fleet-wide LIST per event.
         deploy_index: Optional[dict[tuple[str, str], Deployment]] = None
-        if fleet_mode and active:
+        if fleet_mode and active and not scoped:
             try:
                 deploys = self._kube_call(
                     lambda: self.kube.list_deployments(),
@@ -1121,46 +1411,63 @@ class Reconciler:
             # evidence failure falls through to the last-known-good cache
             # (STALE_CACHE rung) and only a cache miss/expiry HOLDs the
             # variant — the documented degradation ladder
-            # (docs/robustness.md)
+            # (docs/robustness.md). A load pushed by the streaming
+            # ingest (stream/core.py) IS live evidence — fresher than
+            # any scrape — and replaces the whole Prometheus round-trip
+            # for this variant (mode "stream" on the DecisionRecord).
             load = None
             fallback = None  # (skip_reason, condition_reason, message)
-            validation = validate_metrics_availability(
-                variant_prom, model, deploy.namespace, now=self.now(),
-                family=family,
-            )
-            if validation.available:
+            streamed = (self.state.stream_loads or {}).get(key)
+            if streamed is not None:
+                collection_mode = MODE_STREAM
+                load = streamed
                 crd.set_condition(
                     va, crd.TYPE_METRICS_AVAILABLE, "True",
-                    validation.reason, validation.message, now=self.now(),
+                    crd.REASON_METRICS_FOUND,
+                    "load folded from streamed ingest (remote-write/"
+                    "streamed scrape)", now=self.now(),
                 )
-                try:
-                    load = collect_load(variant_prom, model,
-                                        deploy.namespace,
-                                        fallback=self._last_known_load(va),
-                                        family=family,
-                                        probe_window=probe_window)
-                except IncompleteMetricsError as e:
-                    # loaded variant with unusable modeling series:
-                    # scaling it on zero-filled data would tear it down
-                    # to min replicas (the reference zero-fills here)
-                    log.warning("metrics incomplete",
-                                extra=kv(variant=name, missing=e.missing))
-                    fallback = (crd.REASON_METRICS_INCOMPLETE,
-                                crd.REASON_METRICS_INCOMPLETE, str(e))
-                except Exception as e:  # noqa: BLE001
-                    log.error("failed to collect metrics",
-                              extra=kv(variant=name, error=str(e)))
-                    fallback = ("metric collection failed",
-                                crd.REASON_PROMETHEUS_ERROR,
-                                f"Failed to collect metrics: {e}")
             else:
-                log.warning(
-                    "metrics unavailable",
-                    extra=kv(variant=name, reason=validation.reason,
-                             troubleshooting=validation.message),
+                validation = validate_metrics_availability(
+                    variant_prom, model, deploy.namespace, now=self.now(),
+                    family=family,
                 )
-                fallback = (validation.reason, validation.reason,
-                            validation.message)
+                if validation.available:
+                    crd.set_condition(
+                        va, crd.TYPE_METRICS_AVAILABLE, "True",
+                        validation.reason, validation.message,
+                        now=self.now(),
+                    )
+                    try:
+                        load = collect_load(variant_prom, model,
+                                            deploy.namespace,
+                                            fallback=self._last_known_load(va),
+                                            family=family,
+                                            probe_window=probe_window)
+                    except IncompleteMetricsError as e:
+                        # loaded variant with unusable modeling series:
+                        # scaling it on zero-filled data would tear it
+                        # down to min replicas (the reference zero-fills
+                        # here)
+                        log.warning("metrics incomplete",
+                                    extra=kv(variant=name,
+                                             missing=e.missing))
+                        fallback = (crd.REASON_METRICS_INCOMPLETE,
+                                    crd.REASON_METRICS_INCOMPLETE, str(e))
+                    except Exception as e:  # noqa: BLE001
+                        log.error("failed to collect metrics",
+                                  extra=kv(variant=name, error=str(e)))
+                        fallback = ("metric collection failed",
+                                    crd.REASON_PROMETHEUS_ERROR,
+                                    f"Failed to collect metrics: {e}")
+                else:
+                    log.warning(
+                        "metrics unavailable",
+                        extra=kv(variant=name, reason=validation.reason,
+                                 troubleshooting=validation.message),
+                    )
+                    fallback = (validation.reason, validation.reason,
+                                validation.message)
 
             stale_load = False
             if fallback is not None:
@@ -1211,6 +1518,10 @@ class Reconciler:
                 self.load_cache.put(key, load, self.now())
                 self._degradation.record(va.name, va.namespace,
                                          DegradationState.HEALTHY)
+
+            # what this cycle actually sizes on, for the streaming
+            # core's consumed-signature bookkeeping (stream/core.py)
+            self.state.cycle_loads[(model, deploy.namespace)] = load
 
             # open this cycle's decision scratchpad: the solve inputs are
             # now known; the publish loop adds proposal + clamps and
@@ -1265,10 +1576,14 @@ class Reconciler:
             queries_by_mode = {MODE_LEGACY: legacy_prom.count}
         self.emitter.emit_collection_metrics(
             queries_by_mode, time.perf_counter() - collect_t0)
-        self.emitter.emit_drift_metrics(drift_samples)
-        self._collect_tpu_utilization(
-            {deploy.namespace for _va, deploy in prepared},
-            operator_cm=operator_cm)
+        self._publish_drift(drift_samples)
+        if not scoped:
+            # the per-namespace TPU gauges are observability-only and
+            # wholesale-replaced; the backstop cadence keeps them fresh
+            # without charging every micro-cycle two queries/namespace
+            self._collect_tpu_utilization(
+                {deploy.namespace for _va, deploy in prepared},
+                operator_cm=operator_cm)
         return prepared
 
     # after this many consecutive empty probes a namespace's TPU-gauge
@@ -1516,13 +1831,20 @@ class Reconciler:
             [lambda va=va, deploy=deploy: publish_one(va, deploy)
              for va, deploy in publishing],
             workers=self._fanout_workers(), label="apply")
-        if self._cycle_condition_vas is not None:
-            for fresh, _err in outcomes:
-                if fresh is not None:
-                    self._cycle_condition_vas[
-                        full_name(fresh.name, fresh.namespace)] = fresh
-        self.emitter.emit_power_metrics(power)
-        self._probe_targets = probe_targets
+        snap = self.state.snapshot
+        for fresh, _err in outcomes:
+            if fresh is None:
+                continue
+            key = full_name(fresh.name, fresh.namespace)
+            if self._cycle_condition_vas is not None:
+                self._cycle_condition_vas[key] = fresh
+            # keep the streaming snapshot's working copies at the
+            # just-published state, so the next scoped micro-cycle
+            # stabilizes/steps against what is actually on the CR
+            if snap is not None and key in snap.vas:
+                snap.vas[key] = fresh
+        self._publish_power(power)
+        self._set_probe_targets(probe_targets)
 
     def _update_status(self, va: crd.VariantAutoscaling) -> None:
         from .kube import ConflictError
@@ -1650,9 +1972,14 @@ class Reconciler:
     def kick(self) -> None:
         """Request an immediate reconcile cycle. Thread-safe; multiple
         kicks before the next cycle coalesce into one (workqueue
-        semantics). Watch events land here; shutdown paths may also call
-        it after setting `stop` to wake the loop promptly."""
+        semantics; with the streaming core attached, N kicks inside one
+        WVA_STREAM_DEBOUNCE_MS window coalesce into exactly ONE pass).
+        Watch events land here; shutdown paths may also call it after
+        setting `stop` to wake the loop promptly."""
         self._wake.set()
+        core = self.stream_core
+        if core is not None:
+            core.note_kick()
 
     def on_watch_event(self, ev) -> None:
         """Watch-event filter -> kick. Mirrors the reference's event
@@ -1699,6 +2026,13 @@ class Reconciler:
                     watch: bool = True) -> None:
         """RequeueAfter-driven cadence, woken early by watch events.
 
+        With WVA_STREAM on (the default) this hands the loop to the
+        streaming core (stream/core.py): the cadence becomes the
+        backstop full pass, watch kicks become debounced full passes,
+        and pushed/streamed load changes drive scoped micro-cycles in
+        between — the polled loop is one consumer of the same engine.
+        WVA_STREAM=off runs the legacy polled loop below, byte-for-byte.
+
         The reference paces itself by requeue but registers watches so a
         VariantAutoscaling Create or an operator-ConfigMap change
         reconciles immediately (controller.go:456-487); same here: the
@@ -1706,6 +2040,13 @@ class Reconciler:
         a cycle is not lost — the wait returns at once and the next
         cycle runs (at-least-once after the last event)."""
         stop = stop or threading.Event()
+        if self._stream_enabled():
+            core = self.ensure_stream_core()
+            if watch:
+                self.start_watches(stop)
+            self._start_demand_probe(stop)
+            core.run(stop)
+            return
         if watch:
             self.start_watches(stop)
         self._start_demand_probe(stop)

@@ -61,6 +61,30 @@ def _chips_from_name(name: str) -> int:
     return int(m.group(1)) if m else 1
 
 
+# content-keyed memo for the service-class YAML: the ConfigMap rarely
+# changes, yet every cycle re-reads it — and the streaming core's scoped
+# micro-cycles (stream/core.py) run many cycles per interval, where a
+# 64-row parse (~35 ms) would dominate the tens-of-ms reaction budget.
+# Consumers below only READ the parsed doc (they build spec objects and
+# drop the dict), so sharing the cached object is safe. Bounded: the
+# admin CM has a handful of keys; 128 distinct raw strings is churn
+# headroom, not a workload.
+_YAML_MEMO: dict[str, object] = {}
+_YAML_MEMO_MAX = 128
+
+
+def _safe_load_cached(raw: str):
+    """yaml.safe_load memoized by content. The returned object is shared
+    across calls — callers must treat it as read-only."""
+    if raw in _YAML_MEMO:
+        return _YAML_MEMO[raw]
+    doc = yaml.safe_load(raw)
+    if len(_YAML_MEMO) >= _YAML_MEMO_MAX:
+        _YAML_MEMO.clear()
+    _YAML_MEMO[raw] = doc
+    return doc
+
+
 def parse_accelerator_configmap(data: dict[str, str]) -> dict[str, dict[str, str]]:
     """accelerator-unit-costs ConfigMap: each entry is a JSON object
     (reference variantautoscaling_controller.go:499-514). Accepts both the
@@ -111,7 +135,7 @@ def create_system_data(
     service_classes = []
     for key, raw in service_class_cm.items():
         try:
-            doc = yaml.safe_load(raw)
+            doc = _safe_load_cached(raw)
         except yaml.YAMLError as e:
             log.warning("skipping unparseable service class", extra=kv(key=key, error=str(e)))
             continue
@@ -154,7 +178,7 @@ def service_class_key_names(service_class_cm: dict[str, str]) -> dict[str, str]:
     out: dict[str, str] = {}
     for key, raw in service_class_cm.items():
         try:
-            doc = yaml.safe_load(raw)
+            doc = _safe_load_cached(raw)
         except yaml.YAMLError:
             continue
         if isinstance(doc, dict):

@@ -150,6 +150,12 @@ class Scenario:
     # committed floor on the run's useful-cost fraction; asserted by
     # test_perf_claims against BENCH_goodput_r08.json
     goodput_floor: float = 0.0
+    # drive the run through the streaming core (stream/core.py): every
+    # tick pushes the scraped loads through the ingest door and calls
+    # process_once(), so signature flips trigger scoped micro-cycles in
+    # sim time while the reconcile_interval_s cadence becomes the
+    # backstop. False = the polled per-tick loop (the library default)
+    streaming: bool = False
 
 
 def abbreviated(scenario: Scenario, duration_s: float) -> Scenario:
@@ -333,12 +339,41 @@ SCENARIOS: dict[str, Scenario] = {
     )
 }
 
+# Streaming-core twin scenarios, registered SEPARATELY from the goodput
+# library: bench_goodput's committed artifact covers exactly SCENARIOS,
+# while these exercise the event-driven reconcile path
+# (tests/test_stream.py runs flash-crowd-streaming against its polled
+# twin and asserts reaction latency + goodput are no worse).
+STREAMING_SCENARIOS: dict[str, Scenario] = {
+    s.name: s
+    for s in (
+        replace(
+            SCENARIOS["flash-crowd"],
+            name="flash-crowd-streaming",
+            description=(
+                "The flash-crowd 8x step served by the STREAMING core: "
+                "every tick pushes the scraped load through the ingest "
+                "door, the signature quantizer detects the step, and a "
+                "scoped micro-cycle re-sizes within one tick instead of "
+                "waiting out the reconcile interval"),
+            expected_path="healthy throughout; the scale-up race is won "
+                          "by ingest latency + pod startup, not by the "
+                          "polling cadence",
+            streaming=True,
+            # zero debounce: in sim time an event fires on the tick it
+            # arrives, making the run deterministic tick-for-tick
+            operator={**_STEP, "WVA_STREAM_DEBOUNCE_MS": "0"},
+        ),
+    )
+}
+
 __all__ = [
     "CHIP_MATRIX",
     "ChipLane",
     "GKE_POOL_LABELS",
     "NodePool",
     "SCENARIOS",
+    "STREAMING_SCENARIOS",
     "Scenario",
     "VariantSpec",
     "abbreviated",

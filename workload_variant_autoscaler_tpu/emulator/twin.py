@@ -339,16 +339,20 @@ def _slice_config(spec: VariantSpec) -> SliceModelConfig:
     )
 
 
-def _seed_kube(scenario: Scenario, kube: InMemoryKube) -> None:
-    """ConfigMaps, Deployments, VAs, and node pools for the scenario —
-    the same wiring shape the closed-loop e2e tests use, generalized to
-    many variants/generations."""
+def _operator_cm(scenario: Scenario) -> dict[str, str]:
     interval = f"{scenario.reconcile_interval_s:.0f}s"
     operator = {"GLOBAL_OPT_INTERVAL": interval, **scenario.operator}
     if scenario.limited_mode:
         operator.setdefault("WVA_LIMITED_MODE", "true")
+    return operator
+
+
+def _seed_kube(scenario: Scenario, kube: InMemoryKube) -> None:
+    """ConfigMaps, Deployments, VAs, and node pools for the scenario —
+    the same wiring shape the closed-loop e2e tests use, generalized to
+    many variants/generations."""
     kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
-                                 operator))
+                                 _operator_cm(scenario)))
 
     # slice-shape catalog: spot-priced when any variant on the shape is
     # spot (the scenarios never mix pricing on one shape)
@@ -564,11 +568,17 @@ def run_scenario(scenario: Scenario) -> ScenarioResult:
                 st.spec.name, st.spec.namespace, ended_cycle, bucket,
                 detail=f"{share:.0%} of {total:.4f} $·s interval cost")
 
-    def reconcile(now_ms: float) -> None:
-        nonlocal cycle, raised
+    def begin_cycle() -> None:
+        """Per-cycle bookkeeping shared by the polled loop and the
+        streaming core (which runs it via its on_cycle_start hook)."""
+        nonlocal cycle
         flush_interval(cycle)
         plan.begin_cycle()
         cycle += 1
+
+    def reconcile(now_ms: float) -> None:
+        nonlocal raised
+        begin_cycle()
         rungs: dict[str, str] = {}
         try:
             result = rec.reconcile()
@@ -580,6 +590,9 @@ def run_scenario(scenario: Scenario) -> ScenarioResult:
                                  error=str(e)))
             for st in states:
                 rungs[st.key] = "hold"
+        after_cycle(now_ms, rungs)
+
+    def after_cycle(now_ms: float, rungs: dict[str, str]) -> None:
         envelopes = rec.capacity_envelopes()
         # the cycle-level rung floors every variant's rung: a cycle that
         # went limited (optimizer could not fit) or died into hold
@@ -614,10 +627,41 @@ def run_scenario(scenario: Scenario) -> ScenarioResult:
                     st.scaled_to_zero_on_stale = True
                 st.min_desired_after_publish = 0
 
+    # streaming mode (stream/core.py): the core owns the loop — each
+    # tick pushes the scraped loads through the ingest door and calls
+    # process_once(); the reconcile interval becomes the backstop the
+    # core schedules itself. Clock and debounce run on SIM time, so a
+    # rerun is tick-for-tick deterministic like the polled path.
+    core = None
+    if scenario.streaming:
+        from ..collector import collect_load
+        from ..stream import StreamCore
+
+        # the core reads its debounce knob from the last-seen operator
+        # CM; seed it so the scenario's value applies before the first
+        # full pass has populated it
+        rec.state.last_operator_cm = _operator_cm(scenario)
+        core = StreamCore(rec, clock=lambda: sim.now_ms / 1000.0)
+        rec.stream_core = core
+        core.on_cycle_start(begin_cycle)
+
+        def push_loads(now_ms: float) -> None:
+            for v in scenario.variants:
+                try:
+                    load = collect_load(prom, v.model, v.namespace)
+                except Exception:  # noqa: BLE001 — ingest is best-effort
+                    continue       # the backstop pass still covers it
+                core.observe_load(v.model, v.namespace, load)
+
     def on_tick(now_ms: float) -> None:
         nonlocal next_reconcile
         prom.scrape(now_ms)
         meter_tick(now_ms)
+        if core is not None:
+            push_loads(now_ms)
+            for result in core.process_once():
+                after_cycle(now_ms, dict(result.degraded))
+            return
         if now_ms >= next_reconcile:
             next_reconcile += interval_ms
             reconcile(now_ms)

@@ -161,6 +161,13 @@ INFERNO_POOL_CAPACITY_CHIPS = "inferno_pool_capacity_chips"
 INFERNO_JIT_RETRACES_TOTAL = "inferno_jit_retraces_total"
 INFERNO_JIT_COMPILE_SECONDS = "inferno_jit_compile_seconds"
 INFERNO_HOST_DEVICE_TRANSFERS_TOTAL = "inferno_host_device_transfers_total"
+# streaming reconcile core (stream/): how metric deltas reach the
+# engine (pushed remote-write, the streamed-scrape fallback, watch
+# kicks, cadence backstop passes) and the wall time from a load change
+# being OBSERVED to the re-sized allocation being PUBLISHED — the
+# reaction-latency distribution the event-driven core exists to shrink
+INFERNO_STREAM_EVENTS_TOTAL = "inferno_stream_events_total"
+INFERNO_STREAM_LAG_SECONDS = "inferno_stream_lag_seconds"
 
 LABEL_DEPENDENCY = "dependency"
 LABEL_OUTCOME = "outcome"
@@ -170,6 +177,16 @@ LABEL_STATE = "state"
 LABEL_FN = "fn"
 STATE_SOLVED = "solved"
 STATE_SKIPPED = "skipped"
+
+LABEL_SOURCE = "source"
+# the single source of truth for stream ingest-event sources (the
+# `source` label values of inferno_stream_events_total)
+SOURCE_REMOTE_WRITE = "remote-write"
+SOURCE_SCRAPE = "scrape"
+SOURCE_WATCH = "watch"
+SOURCE_BACKSTOP = "backstop"
+STREAM_SOURCES = (SOURCE_REMOTE_WRITE, SOURCE_SCRAPE, SOURCE_WATCH,
+                  SOURCE_BACKSTOP)
 
 LABEL_CONDITION_TYPE = "type"
 
@@ -424,6 +441,27 @@ class MetricsEmitter:
             "dispatch; d2h: result arrays pulled back)",
             [LABEL_DIRECTION], registry=self.registry,
         )
+        # streaming reconcile core (stream/core.py): ingest events per
+        # source, and the observed->published reaction-latency
+        # distribution. Buckets reach down to 10 ms (the event-driven
+        # target is tens of ms) and up to the polled interval (the
+        # backstop's worst case).
+        self.stream_events = Counter(
+            INFERNO_STREAM_EVENTS_TOTAL.removesuffix("_total"),
+            "Metric deltas and wake events ingested by the streaming "
+            "reconcile core (remote-write: pushed WriteRequest groups; "
+            "scrape: streamed-scrape poller sweeps; watch: kube "
+            "watch/probe kicks; backstop: cadence full passes)",
+            [LABEL_SOURCE], registry=self.registry,
+        )
+        self.stream_lag = Histogram(
+            INFERNO_STREAM_LAG_SECONDS,
+            "Wall time from a load change being observed by the "
+            "streaming core to the re-sized allocation being published",
+            buckets=(0.01, 0.025, 0.05, 0.075, 0.1, 0.25, 0.5, 1.0,
+                     2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+            registry=self.registry,
+        )
         # perf-model drift (beyond-reference: the reference never compares
         # its scraped latencies against its own queueing model)
         self.model_drift = Gauge(
@@ -492,6 +530,82 @@ class MetricsEmitter:
                 if count > 0:
                     self.host_device_transfers.labels(
                         **{LABEL_DIRECTION: direction}).inc(count)
+
+    # -- incremental (scoped-cycle) updates of the wholesale gauges -----
+    # The streaming core's scoped micro-cycles touch a handful of
+    # variants; a wholesale clear()+rebuild of a 512-variant gauge costs
+    # more than the solve itself (prometheus child churn). These update
+    # exactly the changed samples and remove exactly the retired label
+    # sets — the merged VIEW equals what a wholesale emit of the merged
+    # dict would produce (pinned by tests/test_stream.py).
+
+    @staticmethod
+    def _remove_samples(gauge, removed) -> None:
+        for labels in removed:
+            try:
+                gauge.remove(*labels)
+            except KeyError:
+                pass  # never exported (e.g. a variant added and retired
+                #       between scrapes)
+
+    def update_power_metrics(self, fresh: dict, removed: list,
+                             fleet_total: float) -> None:
+        """Scoped-cycle power update: `fresh` keys are
+        (variant_name, namespace, accelerator_type); `removed` are label
+        tuples retired by the merge; `fleet_total` is the merged sum."""
+        with self._lock:
+            self._remove_samples(self.variant_power, removed)
+            for (variant_name, namespace, acc_type), watts in fresh.items():
+                self.variant_power.labels(**{
+                    LABEL_VARIANT_NAME: variant_name,
+                    LABEL_NAMESPACE: namespace,
+                    LABEL_ACCELERATOR_TYPE: acc_type,
+                }).set(watts)
+            self.fleet_power.set(fleet_total)
+
+    def update_condition_metrics(self, fresh: dict, removed: list) -> None:
+        encoded = {"True": 1.0, "False": 0.0}
+        with self._lock:
+            self._remove_samples(self.condition_status, removed)
+            for (variant_name, namespace, cond_type), status in \
+                    fresh.items():
+                self.condition_status.labels(**{
+                    LABEL_VARIANT_NAME: variant_name,
+                    LABEL_NAMESPACE: namespace,
+                    LABEL_CONDITION_TYPE: cond_type,
+                }).set(encoded.get(status, -1.0))
+
+    def update_drift_metrics(self, fresh: dict, removed: list) -> None:
+        with self._lock:
+            self._remove_samples(self.model_drift, removed)
+            for (variant_name, namespace, metric), ratio in fresh.items():
+                self.model_drift.labels(**{
+                    LABEL_VARIANT_NAME: variant_name,
+                    LABEL_NAMESPACE: namespace,
+                    LABEL_METRIC: metric,
+                }).set(ratio)
+
+    def update_degradation_metrics(self, fresh: dict, removed: list,
+                                   cycle_state: int) -> None:
+        with self._lock:
+            self._remove_samples(self.degradation_state, removed)
+            for (variant_name, namespace), state in fresh.items():
+                self.degradation_state.labels(**{
+                    LABEL_VARIANT_NAME: variant_name,
+                    LABEL_NAMESPACE: namespace,
+                }).set(state)
+            self.cycle_degradation_state.set(cycle_state)
+
+    def emit_stream_event(self, source: str) -> None:
+        """One streaming-core ingest/wake event (stream/core.py).
+        Thread-safe by construction (prometheus counters lock
+        internally) — this is called from ingest WSGI threads, the
+        scrape poller, and watch listeners."""
+        self.stream_events.labels(**{LABEL_SOURCE: source}).inc()
+
+    def emit_stream_lag(self, seconds: float) -> None:
+        """One consumed load change's observed->published wall time."""
+        self.stream_lag.observe(seconds)
 
     def emit_pool_capacity_metrics(self, capacity: dict[str, int]) -> None:
         """Replace the per-generation inventory gauge wholesale each
@@ -674,7 +788,8 @@ class MetricsEmitter:
               certfile: Optional[str] = None, keyfile: Optional[str] = None,
               client_cafile: Optional[str] = None,
               cert_poll_seconds: float = 10.0,
-              auth_gate=None, debug_middleware=None):
+              auth_gate=None, debug_middleware=None,
+              stream_middleware=None):
         """Expose /metrics for Prometheus to scrape — plain HTTP, or HTTPS
         when a cert/key pair is supplied, with optional required client-CA
         verification (reference cmd/main.go:122-199: TLS-capable metrics
@@ -688,7 +803,11 @@ class MetricsEmitter:
         app->app wrapper) mounts the /debug/traces + /debug/decisions +
         /debug/profile flight-recorder routes next to /metrics, INSIDE
         the auth gate — decision records are not more public than the
-        series. Returns
+        series. stream_middleware (stream.remote_write_middleware's
+        app->app wrapper) mounts the Prometheus remote-write ingest
+        route (POST /api/v1/write) the same way, also inside the auth
+        gate — pushed metrics are writes and must not be less protected
+        than reads. Returns
         (server, thread, reloader); reloader is None for plain HTTP."""
         if bool(certfile) != bool(keyfile):
             raise ValueError("metrics TLS requires both certfile and keyfile")
@@ -709,6 +828,9 @@ class MetricsEmitter:
             # the param is the obs.debug_middleware(tracer, decisions,
             # profiler) RESULT: an app->app wrapper
             app = debug_middleware(app)  # noqa: WVL201
+        if stream_middleware is not None:
+            # same shape: stream.remote_write_middleware(core)'s result
+            app = stream_middleware(app)
         if auth_gate is not None:
             if not certfile:
                 # bearer tokens are live apiserver credentials; over
@@ -729,7 +851,8 @@ class MetricsEmitter:
                 pass  # scrapes every 10s would spam stderr
 
         if not certfile:
-            if auth_gate is None and debug_middleware is None:
+            if auth_gate is None and debug_middleware is None \
+                    and stream_middleware is None:
                 server, thread = start_http_server(port, addr=addr,
                                                    registry=self.registry)
             else:

@@ -1,0 +1,51 @@
+"""Streaming reconcile core: continuous ingest, event-driven solves.
+
+The subsystem that turns the tick-scoped reconcile loop into a
+long-lived engine (ROADMAP item 2): metric deltas stream in (Prometheus
+remote-write or the streamed-scrape fallback), the `WVA_SOLVE_EPSILON`
+signature quantizer detects real change, and a debounced work queue
+drives scoped micro-cycles through the fused solve the moment a load
+signature flips — full-fleet passes demoted to the cadence backstop.
+`WVA_STREAM=off` restores the polled loop byte-for-byte.
+
+See docs/observability.md ("Streaming reconcile") for the operational
+story and docs/user-guide/configuration.md for the knobs.
+"""
+
+from .core import FALLBACK_INTERVAL_S, StreamCore
+from .ingest import (
+    REMOTE_WRITE_PATH,
+    STREAM_SERIES,
+    ScrapePoller,
+    ingest_write_request,
+    remote_write_middleware,
+)
+from .queue import DebouncedQueue, Drained, Pending
+from .remotewrite import (
+    WireError,
+    encode_write_request,
+    parse_write_request,
+    snappy_compress,
+    snappy_decompress,
+)
+from .state import FleetSnapshot, StreamState
+
+__all__ = [
+    "DebouncedQueue",
+    "Drained",
+    "FALLBACK_INTERVAL_S",
+    "FleetSnapshot",
+    "Pending",
+    "REMOTE_WRITE_PATH",
+    "STREAM_SERIES",
+    "ScrapePoller",
+    "StreamCore",
+    "StreamState",
+    "WireError",
+    "encode_write_request",
+    "ingest_write_request",
+    "parse_write_request",
+    "remote_write_middleware",
+    "snappy_compress",
+    "snappy_decompress",
+]

@@ -1,0 +1,405 @@
+"""The streaming reconcile core: event-driven decisions, not per-tick.
+
+The polled loop looks at the fleet once per `GLOBAL_OPT_INTERVAL`; the
+fused solve made the looking cost ~15 ms, so end-to-end reaction time
+is dominated by WAITING (ROADMAP item 2). This core replaces waiting
+with ingest:
+
+1. **Continuous ingest.** Metric deltas arrive pushed (the Prometheus
+   remote-write endpoint in stream/ingest.py) or via the streamed-scrape
+   fallback poller, and fold into a per-(model, namespace) store — and
+   into the reconciler's LoadCache, so the degradation ladder rides the
+   same last-known-good evidence either way.
+2. **Signature change detection.** The `WVA_SOLVE_EPSILON` quantizer
+   (solver/incremental.py) is repurposed as the change detector: a load
+   whose quantized signature equals the last solved one is noise and is
+   dropped at the door; a flipped signature enqueues exactly the
+   affected variants onto the debounced work queue (stream/queue.py).
+3. **Scoped micro-cycles.** The consumer drains the queue and drives
+   `Reconciler.reconcile(scope=..., stream_loads=...)`: a cycle over
+   just the flipped variants, fed from the stream store (zero
+   Prometheus round-trips), solved through a resident arena
+   (`StreamState.stream_arena`) so the fused program never retraces,
+   published with merge semantics on the wholesale-replaced series.
+   Full-fleet passes are demoted to the `GLOBAL_OPT_INTERVAL` backstop
+   (plus watch kicks and escalations) — the polled `run_forever` loop
+   is now just one consumer of this engine, and `WVA_STREAM=off`
+   restores it byte-for-byte.
+
+Scoped solving is sound when per-variant decisions are separable —
+always true in unlimited mode (each variant independently picks its
+best allocation). In limited mode capacity couples variants, so every
+event batch ESCALATES to a full pass (still debounced, still
+event-driven — only the scope widens).
+
+Observability: every ingested delta counts on
+`inferno_stream_events_total{source}`; every consumed change observes
+load-change-seen -> allocation-published wall time on
+`inferno_stream_lag_seconds`. Each micro-cycle is its own flight-
+recorder trace (a `reconcile` root span carrying `stream_scope`), so
+`/debug/traces` shows per-event mini-traces between backstop cycles.
+
+Thread contract: `observe_load`/`ingest_fields`/`note_kick` may be
+called from any thread (ingest WSGI workers, the scrape poller, watch
+listeners); everything they touch is behind `self._lock` or the
+queue's own lock (wvalint WVL404 enforces this package-wide).
+`process_once`/`run` belong to the single consumer thread, which is
+the only thread that ever calls into the Reconciler.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..collector import CollectedLoad
+from ..metrics import (
+    SOURCE_BACKSTOP,
+    SOURCE_REMOTE_WRITE,
+    SOURCE_SCRAPE,
+    SOURCE_WATCH,
+)
+from ..solver.incremental import DEFAULT_EPSILON, quantize
+from ..utils import get_logger, kv, parse_float_or
+from .queue import DebouncedQueue
+from .state import StreamState
+
+log = get_logger("wva.stream")
+
+# trailing-edge coalescing window: long enough that one kubectl apply /
+# one remote-write request's burst rides a single wake, short enough
+# that it stays a small fraction of the <100 ms reaction target
+DEFAULT_DEBOUNCE_MS = 25.0
+# fallback cadence when a backstop cycle raised before publishing an
+# interval (mirrors controller.reconciler.DEFAULT_INTERVAL_SECONDS)
+FALLBACK_INTERVAL_S = 60.0
+
+_LOAD_FIELDS = ("arrival_rate_rpm", "avg_input_tokens",
+                "avg_output_tokens", "avg_ttft_ms", "avg_itl_ms")
+# a load is solvable once the sizing inputs exist; latency series are
+# advisory (status/drift display) and default to the last seen value
+_REQUIRED_FIELDS = ("arrival_rate_rpm", "avg_input_tokens",
+                    "avg_output_tokens")
+
+
+@dataclass
+class _Accum:
+    """Per-(model, namespace) ingest accumulator: the latest value of
+    each load field, plus the signature the solver last consumed."""
+
+    fields: dict = field(default_factory=dict)
+    updated_at: float = 0.0
+    consumed_sig: Optional[tuple] = None
+
+    def load(self) -> Optional[CollectedLoad]:
+        if any(f not in self.fields for f in _REQUIRED_FIELDS):
+            return None
+        return CollectedLoad(
+            arrival_rate_rpm=self.fields["arrival_rate_rpm"],
+            avg_input_tokens=self.fields["avg_input_tokens"],
+            avg_output_tokens=self.fields["avg_output_tokens"],
+            avg_ttft_ms=self.fields.get("avg_ttft_ms", 0.0),
+            avg_itl_ms=self.fields.get("avg_itl_ms", 0.0),
+        )
+
+
+@dataclass(frozen=True)
+class _Plan:
+    """One claimed unit of consumer work."""
+
+    kind: str                      # "full" | "scoped" | "drop"
+    source: str = SOURCE_BACKSTOP
+    events: dict = field(default_factory=dict)   # (model, ns) -> Pending
+    scope: frozenset = frozenset()
+    loads: dict = field(default_factory=dict)    # full_name -> load
+
+
+class StreamCore:
+    """Long-lived consumer driving the Reconciler from pushed events.
+    One per Reconciler; owns the reconciler's `StreamState`."""
+
+    def __init__(self, reconciler, debounce_s: Optional[float] = None,
+                 clock=None):
+        self.rec = reconciler
+        self.emitter = reconciler.emitter
+        self.state: StreamState = reconciler.state
+        # scheduling clock (debounce windows, the backstop deadline, lag
+        # measurement): the reconciler's MONOTONIC clock, not its wall
+        # clock — a sim-time `now` (twin, chaos tests) must not freeze
+        # the production consumer loop. Sim-time drivers inject their
+        # own clock here and call process_once() synchronously.
+        self.clock = clock or reconciler.monotonic
+        if debounce_s is None:
+            debounce_s = self._knob("WVA_STREAM_DEBOUNCE_MS",
+                                    DEFAULT_DEBOUNCE_MS) / 1000.0
+        self.queue = DebouncedQueue(debounce_s=debounce_s,
+                                    clock=self.clock)
+        self._lock = threading.Lock()
+        self._store: dict[tuple, _Accum] = {}
+        self._next_full_deadline: Optional[float] = None
+        self._scrape_targets: tuple = ()
+        # pre-cycle hook (the goodput twin advances its FaultPlan here)
+        self._on_cycle_start = None
+
+    # -- knobs ------------------------------------------------------------
+
+    def _knob(self, key: str, default: float) -> float:
+        raw = (os.environ.get(key)
+               or self.rec.state.last_operator_cm.get(key))
+        return parse_float_or(raw, default)
+
+    def _epsilon(self) -> float:
+        eps = self._knob("WVA_SOLVE_EPSILON", DEFAULT_EPSILON)
+        return eps if eps >= 0 else DEFAULT_EPSILON
+
+    def _limited_mode(self) -> bool:
+        snap = self.state.snapshot
+        cm = snap.operator_cm if snap is not None else {}
+        return cm.get("WVA_LIMITED_MODE", "").lower() == "true"
+
+    # -- ingest (any thread) ----------------------------------------------
+
+    def _signature(self, load: CollectedLoad) -> tuple:
+        """The change detector: the solve inputs snapped to the same
+        relative-epsilon buckets the incremental engine sizes on, so
+        'unchanged' here means 'the solver would see the same inputs'."""
+        eps = self._epsilon()
+        return (quantize(load.arrival_rate_rpm, eps),
+                round(quantize(load.avg_input_tokens, eps)),
+                round(quantize(load.avg_output_tokens, eps)))
+
+    def observe_load(self, model: str, namespace: str,
+                     load: CollectedLoad, source: str = SOURCE_SCRAPE,
+                     t: Optional[float] = None) -> bool:
+        """Fold one complete load observation into the store; enqueue
+        the (model, namespace) group when its signature flipped.
+        Returns True when a change was enqueued."""
+        return self.ingest_fields(
+            model, namespace,
+            {f: getattr(load, f) for f in _LOAD_FIELDS},
+            source=source, t=t)
+
+    def ingest_fields(self, model: str, namespace: str, fields: dict,
+                      source: str = SOURCE_REMOTE_WRITE,
+                      t: Optional[float] = None) -> bool:
+        """Partial-update ingest (remote-write requests may carry any
+        subset of the load series). Counts one event per call; a
+        signature flip arms the debounced queue."""
+        now = self.clock() if t is None else t
+        self.emitter.emit_stream_event(source)
+        key = (model, namespace)
+        with self._lock:
+            acc = self._store.get(key)
+            if acc is None:
+                acc = _Accum()
+                self._store[key] = acc
+            acc.fields.update({k: float(v) for k, v in fields.items()
+                               if k in _LOAD_FIELDS})
+            acc.updated_at = now
+            load = acc.load()
+            if load is None:
+                return False
+            changed = self._signature(load) != acc.consumed_sig
+        if changed:
+            self.queue.offer(key, source, t=now)
+        return changed
+
+    def note_kick(self, source: str = SOURCE_WATCH) -> None:
+        """A watch event / probe kick: a debounced full-fleet pass."""
+        self.emitter.emit_stream_event(source)
+        self.queue.request_full(source)
+
+    # -- the consumer (single thread) -------------------------------------
+
+    def on_cycle_start(self, hook) -> None:
+        with self._lock:
+            self._on_cycle_start = hook
+
+    def _scope_for(self, events: dict) -> tuple[frozenset, dict]:
+        """Map drained (model, namespace) events to the variants they
+        size, with the store's current loads; marks the drained
+        signatures consumed."""
+        snap = self.state.snapshot
+        mapping: dict[tuple, list[str]] = {}
+        if snap is not None:
+            for key, va in snap.vas.items():
+                mapping.setdefault(
+                    (va.spec.model_id, va.namespace), []).append(key)
+        scope: set[str] = set()
+        loads: dict[str, CollectedLoad] = {}
+        with self._lock:
+            for group in events:
+                acc = self._store.get(group)
+                load = acc.load() if acc is not None else None
+                if load is not None:
+                    acc.consumed_sig = self._signature(load)
+                for vkey in mapping.get(group, ()):
+                    scope.add(vkey)
+                    if load is not None:
+                        loads[vkey] = load
+        return frozenset(scope), loads
+
+    def _mark_consumed(self, events: dict) -> None:
+        """A full pass re-collects everything: every drained group's
+        current signature is now the solved one."""
+        with self._lock:
+            for group in events:
+                acc = self._store.get(group)
+                load = acc.load() if acc is not None else None
+                if load is not None:
+                    acc.consumed_sig = self._signature(load)
+
+    def _absorb_cycle_loads(self, t_start: float) -> None:
+        """Fold the loads a full pass actually sized on into the ingest
+        store as consumed signatures — a scrape sweep (or push) that
+        matches what was just solved must read as 'unchanged'. Entries a
+        push updated DURING the pass are left alone: the push is newer
+        truth and its event is still pending."""
+        loads = dict(self.state.cycle_loads)
+        with self._lock:
+            for group, load in loads.items():
+                acc = self._store.get(group)
+                if acc is None:
+                    acc = _Accum()
+                    self._store[group] = acc
+                elif acc.updated_at > t_start:
+                    continue
+                acc.fields.update(
+                    {f: getattr(load, f) for f in _LOAD_FIELDS})
+                acc.updated_at = t_start
+                solvable = acc.load()
+                if solvable is not None:
+                    acc.consumed_sig = self._signature(solvable)
+            # bound the store under push abuse / model churn: groups the
+            # fleet no longer sizes (absent from every full pass) age
+            # out after two backstop intervals without a fresh push
+            horizon = t_start - 2.0 * FALLBACK_INTERVAL_S
+            for group in [g for g, acc in self._store.items()
+                          if g not in loads and acc.updated_at < horizon]:
+                del self._store[group]
+
+    def _claim(self) -> Optional[_Plan]:
+        now = self.clock()
+        with self._lock:
+            deadline = self._next_full_deadline
+        if self.state.snapshot is None or deadline is None \
+                or now >= deadline:
+            drained = self.queue.drain(now, force=True)
+            source = (drained.full.source if drained.full is not None
+                      else SOURCE_BACKSTOP)
+            return _Plan(kind="full", source=source,
+                         events=drained.events)
+        drained = self.queue.drain(now)
+        if not drained:
+            return None
+        if drained.full is not None or self._limited_mode():
+            source = (drained.full.source if drained.full is not None
+                      else SOURCE_BACKSTOP)
+            return _Plan(kind="full", source=source,
+                         events=drained.events)
+        scope, loads = self._scope_for(drained.events)
+        if not scope:
+            # events for models outside the fleet: nothing to solve
+            return _Plan(kind="drop", events=drained.events)
+        return _Plan(kind="scoped", events=drained.events, scope=scope,
+                     loads=loads)
+
+    def _execute(self, plan: _Plan):
+        if plan.kind == "drop":
+            return None
+        with self._lock:
+            hook = self._on_cycle_start
+        if hook is not None:
+            hook()
+        result = None
+        delay = FALLBACK_INTERVAL_S
+        t_start = self.clock()
+        try:
+            if plan.kind == "full":
+                if plan.source == SOURCE_BACKSTOP:
+                    self.emitter.emit_stream_event(SOURCE_BACKSTOP)
+                result = self.rec.reconcile()
+                delay = result.requeue_after
+            else:
+                result = self.rec.reconcile(scope=plan.scope,
+                                            stream_loads=plan.loads)
+        except Exception as e:  # noqa: BLE001 — run_forever's catch, here
+            log.error("stream cycle failed",
+                      extra=kv(kind=plan.kind, error=str(e)))
+        if plan.kind == "full":
+            now = self.clock()
+            with self._lock:
+                self._next_full_deadline = now + max(delay, 0.0)
+                snap = self.state.snapshot
+                self._scrape_targets = tuple(sorted(
+                    {(va.spec.model_id, va.namespace)
+                     for va in snap.vas.values()})) if snap else ()
+            if result is not None:
+                self._absorb_cycle_loads(t_start)
+            self._mark_consumed(plan.events)
+        if result is not None and plan.events:
+            self._observe_lag(plan, result)
+        return result
+
+    def _observe_lag(self, plan: _Plan, result) -> None:
+        """load-change observed -> allocation published, per drained
+        group whose variants the cycle actually processed."""
+        now = self.clock()
+        snap = self.state.snapshot
+        published = set(result.processed)
+        for group, pending in plan.events.items():
+            model, ns = group
+            keys = ([k for k, va in snap.vas.items()
+                     if va.spec.model_id == model and va.namespace == ns]
+                    if snap is not None else [])
+            if plan.kind == "full" or any(k in published for k in keys):
+                self.emitter.emit_stream_lag(
+                    max(now - pending.t_observed, 0.0))
+
+    def process_once(self) -> list:
+        """Drain-and-execute until nothing is actionable. Synchronous —
+        the sim-time twin and the unit tests drive this directly; the
+        production thread loops it in run(). Returns the cycles' results."""
+        results = []
+        while True:
+            plan = self._claim()
+            if plan is None:
+                return results
+            result = self._execute(plan)
+            if result is not None:
+                results.append(result)
+            if plan.kind == "drop":
+                return results
+
+    def run(self, stop: threading.Event) -> None:
+        """The production consumer loop: process, then sleep until the
+        earliest of (debounce window closing, backstop deadline), woken
+        immediately by the first offer after idle."""
+        from .ingest import ScrapePoller
+
+        ScrapePoller(self, stop).start()
+        while not stop.is_set():
+            try:
+                self.process_once()
+            except Exception as e:  # noqa: BLE001 — consumer must not die
+                log.error("stream consumer iteration failed",
+                          extra=kv(error=str(e)))
+            now = self.clock()
+            with self._lock:
+                deadline = self._next_full_deadline
+            deadlines = [d for d in (deadline, self.queue.next_deadline())
+                         if d is not None]
+            timeout = (min(deadlines) - now) if deadlines else 0.5
+            if self.queue.wait(min(max(timeout, 0.01), 0.5)):
+                # an offer landed: sleep out the remainder of its window
+                # (the wake flag stays set until the queue drains, so
+                # pace on `stop` to avoid a busy loop)
+                nd = self.queue.next_deadline()
+                if nd is not None:
+                    stop.wait(min(max(nd - self.clock(), 0.0), 0.5))
+
+    def scrape_targets(self) -> tuple:
+        with self._lock:
+            return self._scrape_targets

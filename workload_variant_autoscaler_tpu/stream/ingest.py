@@ -1,0 +1,195 @@
+"""Ingest layer: the remote-write endpoint + the streamed-scrape poller.
+
+Two ways metric deltas reach the streaming core without waiting for a
+reconcile tick:
+
+- **Prometheus remote-write** (`POST /api/v1/write`, mounted beside
+  the `/debug/*` routes on the metrics server, INSIDE the auth gate):
+  a snappy-compressed protobuf WriteRequest, decoded by the stdlib
+  codec in stream/remotewrite.py. The endpoint expects the load
+  signals as RECORDING RULES — Prometheus evaluates the same PromQL
+  the scrape path uses and forwards just those series here, labelled
+  `model_name`/`namespace`:
+
+      wva:stream:arrival_rpm        req/min arrival rate
+      wva:stream:avg_input_tokens   mean prompt tokens
+      wva:stream:avg_output_tokens  mean generation tokens
+      wva:stream:avg_ttft_ms        mean TTFT (advisory)
+      wva:stream:avg_itl_ms         mean ITL (advisory)
+
+  One request may carry any subset for any number of groups; per
+  (model, namespace) group the newest-timestamp sample of each series
+  wins and the group counts as ONE ingest event.
+- **Streamed scrape** (`ScrapePoller`): the fallback for clusters
+  without remote-write plumbing — a daemon thread polling the SAME
+  per-variant PromQL the reconcile scrape uses, every
+  `WVA_STREAM_SCRAPE_MS` (0, the default, disables it; the cadence
+  backstop still covers everything). Runs on its own Prometheus client
+  clone (sessions are not thread-safe) and feeds the same
+  `observe_load` door, so the change detector treats both paths
+  identically.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+from ..collector import active_family, collect_load
+from ..metrics import SOURCE_REMOTE_WRITE
+from ..utils import get_logger, kv
+from .remotewrite import WireError, parse_write_request, snappy_decompress
+
+log = get_logger("wva.stream.ingest")
+
+REMOTE_WRITE_PATH = "/api/v1/write"
+
+# remote-write series name -> CollectedLoad field (the recording-rule
+# contract; docs/observability.md "Streaming reconcile")
+STREAM_SERIES = {
+    "wva:stream:arrival_rpm": "arrival_rate_rpm",
+    "wva:stream:avg_input_tokens": "avg_input_tokens",
+    "wva:stream:avg_output_tokens": "avg_output_tokens",
+    "wva:stream:avg_ttft_ms": "avg_ttft_ms",
+    "wva:stream:avg_itl_ms": "avg_itl_ms",
+}
+
+
+def ingest_write_request(core, body: bytes,
+                         encoding: str = "snappy") -> int:
+    """Decode one remote-write request body and fold it into the core.
+    Returns the number of (model, namespace) groups ingested. Raises
+    WireError on malformed payloads."""
+    if encoding in ("snappy", ""):
+        try:
+            raw = snappy_decompress(body)
+        except WireError:
+            if encoding == "snappy":
+                raise
+            raw = body                     # uncompressed fallback
+    elif encoding == "identity":
+        raw = body
+    else:
+        raise WireError(f"unsupported content encoding {encoding!r}")
+
+    # (model, ns) -> field -> (timestamp, value); newest timestamp wins
+    groups: dict[tuple, dict] = {}
+    for series in parse_write_request(raw):
+        name = series.labels.get("__name__", "")
+        fld = STREAM_SERIES.get(name)
+        if fld is None or not series.samples:
+            continue
+        model = series.labels.get("model_name", "")
+        ns = series.labels.get("namespace", "")
+        if not model or not ns:
+            continue
+        value, ts = max(series.samples, key=lambda s: s[1])
+        best = groups.setdefault((model, ns), {})
+        if fld not in best or ts >= best[fld][0]:
+            best[fld] = (ts, value)
+    for (model, ns), fields in groups.items():
+        core.ingest_fields(model, ns,
+                           {f: v for f, (_ts, v) in fields.items()},
+                           source=SOURCE_REMOTE_WRITE)
+    return len(groups)
+
+
+def remote_write_middleware(core):
+    """app -> app wrapper mounting POST /api/v1/write in front of the
+    metrics exposition (same composition shape as obs.debug_middleware;
+    the caller places it inside the auth gate)."""
+
+    def wrap(inner_app):
+        def app(environ, start_response):
+            if environ.get("PATH_INFO", "") != REMOTE_WRITE_PATH:
+                return inner_app(environ, start_response)
+            if environ.get("REQUEST_METHOD", "") != "POST":
+                return _reply(start_response, "405 Method Not Allowed",
+                              {"error": "POST only"})
+            try:
+                length = int(environ.get("CONTENT_LENGTH") or 0)
+            except ValueError:
+                length = 0
+            body = environ["wsgi.input"].read(length) if length else b""
+            encoding = (environ.get("HTTP_CONTENT_ENCODING")
+                        or "snappy").strip().lower()
+            try:
+                groups = ingest_write_request(core, body,
+                                              encoding=encoding)
+            except WireError as e:
+                status = ("415 Unsupported Media Type"
+                          if "content encoding" in str(e)
+                          else "400 Bad Request")
+                return _reply(start_response, status, {"error": str(e)})
+            start_response("204 No Content",
+                           [("X-Ingested-Groups", str(groups))])
+            return [b""]
+
+        return app
+
+    return wrap
+
+
+def _reply(start_response, status: str, body: dict):
+    payload = json.dumps(body).encode()
+    start_response(status, [
+        ("Content-Type", "application/json"),
+        ("Content-Length", str(len(payload))),
+    ])
+    return [payload]
+
+
+class ScrapePoller:
+    """Daemon thread: the streamed-scrape fallback. All mutable state is
+    fixed at construction; the loop only reads (the knob is re-read
+    every iteration so a ConfigMap edit can enable/disable it live)."""
+
+    def __init__(self, core, stop: threading.Event, prom=None):
+        self.core = core
+        self.stop = stop
+        rec = core.rec
+        clone = getattr(rec.prom, "clone", None)
+        self.prom = prom if prom is not None else (
+            clone() if callable(clone) else rec.prom)
+
+    def _period_s(self) -> float:
+        return self.core._knob("WVA_STREAM_SCRAPE_MS", 0.0) / 1000.0
+
+    def poll_once(self) -> int:
+        """One sweep over the fleet's (model, namespace) groups through
+        the regular collect_load PromQL; returns groups ingested.
+        Best-effort: a failing group is skipped (the cadence backstop
+        still covers it)."""
+        cm = self.core.rec.state.last_operator_cm
+        family = active_family(cm.get("WVA_METRIC_FAMILY"), cm=cm)
+        ingested = 0
+        for model, ns in self.core.scrape_targets():
+            try:
+                load = collect_load(self.prom, model, ns, family=family)
+            except Exception:  # noqa: BLE001 — poller is best-effort
+                continue
+            self.core.observe_load(model, ns, load)
+            ingested += 1
+        return ingested
+
+    def start(self) -> Optional[threading.Thread]:
+        def loop() -> None:
+            while not self.stop.is_set():
+                period = self._period_s()
+                if period <= 0:
+                    self.stop.wait(5.0)
+                    continue
+                self.stop.wait(period)
+                if self.stop.is_set():
+                    return
+                try:
+                    self.poll_once()
+                except Exception as e:  # noqa: BLE001
+                    log.warning("stream scrape poll failed",
+                                extra=kv(error=str(e)))
+
+        t = threading.Thread(target=loop, name="wva-stream-scrape",
+                             daemon=True)
+        t.start()
+        return t

@@ -1,0 +1,131 @@
+"""Debounced work queue: N events inside one window -> ONE wake.
+
+The change detector (stream/core.py) and the watch-event path both
+produce bursts: a remote-write request carries many series, a `kubectl
+apply -f dir/` fires one kube event per object, a flash crowd flips
+many variants' signatures within milliseconds. The legacy loop's
+handling was a fixed 0.1s nap after the first wake — good enough for
+one kick, a thundering herd for a burst spread wider than 0.1s (every
+event past the nap bought its own full reconcile).
+
+This queue coalesces on a trailing-edge debounce window
+(`WVA_STREAM_DEBOUNCE_MS`): the FIRST offer since the last drain arms
+the window; everything arriving before it closes rides the same wake.
+The window is armed-once, not sliding, so a sustained event storm
+cannot starve the consumer — latency is bounded by exactly one window.
+
+Thread contract: `offer`/`request_full` are called from ingest/watch
+threads; `ready`/`drain` from the single consumer. Every access to the
+shared maps is lock-guarded (wvalint WVL404 enforces this for the whole
+stream package). The clock is injectable so sim-time twin runs and the
+storm unit tests are deterministic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+DEFAULT_DEBOUNCE_S = 0.025   # mirrors core.DEFAULT_DEBOUNCE_MS
+
+
+@dataclass(frozen=True)
+class Pending:
+    """One coalesced change event: when the first flip was observed (the
+    lag clock starts here) and which ingest path observed it."""
+
+    t_observed: float
+    source: str
+
+
+@dataclass(frozen=True)
+class Drained:
+    """One consumer wake: the coalesced per-key events, plus the pending
+    full-pass request (a watch kick / escalation), if any."""
+
+    events: dict
+    full: Optional[Pending] = None
+
+    def __bool__(self) -> bool:
+        return bool(self.events) or self.full is not None
+
+
+class DebouncedQueue:
+    def __init__(self, debounce_s: float = DEFAULT_DEBOUNCE_S,
+                 clock=time.time):
+        self.debounce_s = max(float(debounce_s), 0.0)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._events: dict = {}          # key -> Pending (earliest wins)
+        self._full: Optional[Pending] = None
+        self._armed_at: Optional[float] = None
+
+    def offer(self, key, source: str, t: Optional[float] = None) -> None:
+        """Enqueue a change event for `key`. Re-offers of a pending key
+        keep the EARLIEST observation time (the lag histogram measures
+        from the first moment the change was visible)."""
+        with self._lock:
+            now = self.clock() if t is None else t
+            if self._armed_at is None:
+                self._armed_at = now
+            self._events.setdefault(key, Pending(t_observed=now,
+                                                 source=source))
+        self._wake.set()
+
+    def request_full(self, source: str, t: Optional[float] = None) -> None:
+        """Enqueue a full-fleet pass (watch events, escalations). Bursts
+        coalesce exactly like per-key events."""
+        with self._lock:
+            now = self.clock() if t is None else t
+            if self._armed_at is None:
+                self._armed_at = now
+            if self._full is None:
+                self._full = Pending(t_observed=now, source=source)
+        self._wake.set()
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._events) + (1 if self._full is not None else 0)
+
+    def ready(self, now: Optional[float] = None) -> bool:
+        """True once the debounce window armed by the first un-drained
+        offer has closed."""
+        with self._lock:
+            return self._ready_locked(self.clock() if now is None else now)
+
+    def _ready_locked(self, now: float) -> bool:
+        if self._armed_at is None:
+            return False
+        return now - self._armed_at >= self.debounce_s
+
+    def next_deadline(self) -> Optional[float]:
+        """Clock reading at which the armed window closes (None when
+        nothing is pending) — what the consumer sleeps until."""
+        with self._lock:
+            if self._armed_at is None:
+                return None
+            return self._armed_at + self.debounce_s
+
+    def drain(self, now: Optional[float] = None,
+              force: bool = False) -> Drained:
+        """Take everything if the window has closed; empty otherwise.
+        `force` takes whatever is pending regardless of the window (a
+        backstop full pass serves queued events now — holding them for
+        the window would just re-solve the same signatures twice).
+        Draining re-arms on the next offer."""
+        with self._lock:
+            now = self.clock() if now is None else now
+            if not force and not self._ready_locked(now):
+                return Drained(events={})
+            events, self._events = self._events, {}
+            full, self._full = self._full, None
+            self._armed_at = None
+            self._wake.clear()
+            return Drained(events=events, full=full)
+
+    def wait(self, timeout: float) -> bool:
+        """Block the consumer until an offer lands (or timeout)."""
+        return self._wake.wait(timeout)

@@ -1,0 +1,256 @@
+"""Prometheus remote-write wire codec, standard library only.
+
+The ingest endpoint (stream/ingest.py) speaks the real remote-write 1.0
+wire format: a snappy-compressed protobuf `WriteRequest`. Neither
+`python-snappy` nor `protobuf` is a dependency of this controller, and
+the subset of both formats the endpoint needs is small and frozen, so
+this module implements exactly that subset by hand:
+
+- **protobuf**: `WriteRequest{ repeated TimeSeries timeseries = 1 }`,
+  `TimeSeries{ repeated Label labels = 1; repeated Sample samples = 2 }`,
+  `Label{ string name = 1; string value = 2 }`,
+  `Sample{ double value = 1; int64 timestamp = 2 }`. Unknown fields
+  (metadata, exemplars, histograms) are skipped by wire type, so real
+  Prometheus senders parse cleanly.
+- **snappy**: the raw block format (uvarint preamble + literal/copy
+  tags). Decompression is complete; compression emits literal-only
+  blocks — valid snappy by the format spec, just uncompressed — which
+  keeps the encoder trivial for tests and the bench while real senders'
+  compressed bodies decode through the same path.
+
+Everything is pure functions over bytes; no threads, no state.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+
+class WireError(ValueError):
+    """Malformed snappy or protobuf payload (maps to HTTP 400)."""
+
+
+# -- varints ----------------------------------------------------------------
+
+
+def _read_uvarint(buf: bytes, i: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if i >= len(buf):
+            raise WireError("truncated varint")
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+        if shift > 63:
+            raise WireError("varint overflow")
+
+
+def _uvarint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+# -- snappy block format ----------------------------------------------------
+
+
+def snappy_decompress(data: bytes) -> bytes:
+    expected, i = _read_uvarint(data, 0)
+    out = bytearray()
+    n = len(data)
+    while i < n:
+        tag = data[i]
+        i += 1
+        kind = tag & 0x03
+        if kind == 0:                        # literal
+            length = tag >> 2
+            if length >= 60:                 # 60..63: length in 1..4 bytes
+                extra = length - 59
+                if i + extra > n:
+                    raise WireError("truncated literal length")
+                length = int.from_bytes(data[i:i + extra], "little")
+                i += extra
+            length += 1
+            if i + length > n:
+                raise WireError("truncated literal")
+            out += data[i:i + length]
+            i += length
+            continue
+        if kind == 1:                        # copy, 1-byte offset
+            if i >= n:
+                raise WireError("truncated copy-1")
+            length = ((tag >> 2) & 0x07) + 4
+            offset = ((tag >> 5) << 8) | data[i]
+            i += 1
+        elif kind == 2:                      # copy, 2-byte offset
+            if i + 2 > n:
+                raise WireError("truncated copy-2")
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[i:i + 2], "little")
+            i += 2
+        else:                                # copy, 4-byte offset
+            if i + 4 > n:
+                raise WireError("truncated copy-4")
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[i:i + 4], "little")
+            i += 4
+        if offset == 0 or offset > len(out):
+            raise WireError("copy offset out of range")
+        # overlapping copies are legal and byte-by-byte (RLE shape)
+        start = len(out) - offset
+        for k in range(length):
+            out.append(out[start + k])
+    if len(out) != expected:
+        raise WireError(
+            f"snappy length mismatch: got {len(out)}, header {expected}")
+    return bytes(out)
+
+
+def snappy_compress(data: bytes) -> bytes:
+    """Literal-only snappy: a valid stream any decoder accepts."""
+    out = bytearray(_uvarint(len(data)))
+    for i in range(0, len(data), 65536):
+        chunk = data[i:i + 65536]
+        length = len(chunk) - 1
+        if length < 60:
+            out.append(length << 2)
+        else:
+            extra = (length.bit_length() + 7) // 8
+            out.append((59 + extra) << 2)
+            out += length.to_bytes(extra, "little")
+        out += chunk
+    return bytes(out)
+
+
+# -- the WriteRequest subset ------------------------------------------------
+
+
+@dataclass
+class TimeSeries:
+    labels: dict = field(default_factory=dict)
+    samples: list = field(default_factory=list)   # [(value, timestamp_ms)]
+
+
+def _skip_field(buf: bytes, i: int, wire_type: int) -> int:
+    if wire_type == 0:
+        _, i = _read_uvarint(buf, i)
+        return i
+    if wire_type == 1:
+        return i + 8
+    if wire_type == 2:
+        length, i = _read_uvarint(buf, i)
+        return i + length
+    if wire_type == 5:
+        return i + 4
+    raise WireError(f"unsupported wire type {wire_type}")
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, payload) over one message. For
+    wire type 2 the payload is the delimited bytes; for 0 the varint
+    value; for 1 the raw 8 bytes."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _read_uvarint(buf, i)
+        number, wire_type = tag >> 3, tag & 0x07
+        if wire_type == 2:
+            length, i = _read_uvarint(buf, i)
+            if i + length > n:
+                raise WireError("truncated length-delimited field")
+            yield number, wire_type, buf[i:i + length]
+            i += length
+        elif wire_type == 0:
+            value, i = _read_uvarint(buf, i)
+            yield number, wire_type, value
+        elif wire_type == 1:
+            if i + 8 > n:
+                raise WireError("truncated fixed64 field")
+            yield number, wire_type, buf[i:i + 8]
+            i += 8
+        else:
+            i = _skip_field(buf, i, wire_type)
+
+
+def _parse_label(buf: bytes) -> tuple[str, str]:
+    name = value = ""
+    for number, wire_type, payload in _fields(buf):
+        if number == 1 and wire_type == 2:
+            name = payload.decode("utf-8", "replace")
+        elif number == 2 and wire_type == 2:
+            value = payload.decode("utf-8", "replace")
+    return name, value
+
+
+def _parse_sample(buf: bytes) -> tuple[float, int]:
+    value, ts = 0.0, 0
+    for number, wire_type, payload in _fields(buf):
+        if number == 1 and wire_type == 1:
+            value = struct.unpack("<d", payload)[0]
+        elif number == 2 and wire_type == 0:
+            ts = payload - (1 << 64) if payload >= (1 << 63) else payload
+    return value, ts
+
+
+def _parse_timeseries(buf: bytes) -> TimeSeries:
+    ts = TimeSeries()
+    for number, wire_type, payload in _fields(buf):
+        if number == 1 and wire_type == 2:
+            name, value = _parse_label(payload)
+            ts.labels[name] = value
+        elif number == 2 and wire_type == 2:
+            ts.samples.append(_parse_sample(payload))
+    return ts
+
+
+def parse_write_request(buf: bytes) -> list[TimeSeries]:
+    out = []
+    for number, wire_type, payload in _fields(buf):
+        if number == 1 and wire_type == 2:
+            out.append(_parse_timeseries(payload))
+    return out
+
+
+# -- encoder (the test/bench sender half) -----------------------------------
+
+
+def _delimited(field_number: int, payload: bytes) -> bytes:
+    return _uvarint((field_number << 3) | 2) + _uvarint(len(payload)) \
+        + payload
+
+
+def _encode_label(name: str, value: str) -> bytes:
+    return (_delimited(1, name.encode()) + _delimited(2, value.encode()))
+
+
+def _encode_sample(value: float, timestamp_ms: int) -> bytes:
+    ts = timestamp_ms & ((1 << 64) - 1) if timestamp_ms < 0 \
+        else timestamp_ms
+    return (_uvarint((1 << 3) | 1) + struct.pack("<d", value)
+            + _uvarint((2 << 3) | 0) + _uvarint(ts))
+
+
+def encode_write_request(series: list) -> bytes:
+    """`series` is [(labels_dict, [(value, timestamp_ms), ...]), ...];
+    returns the protobuf body (compress with snappy_compress before
+    POSTing, per the remote-write spec)."""
+    body = bytearray()
+    for labels, samples in series:
+        ts = bytearray()
+        for name in sorted(labels):
+            ts += _delimited(1, _encode_label(name, labels[name]))
+        for value, timestamp_ms in samples:
+            ts += _delimited(2, _encode_sample(value, timestamp_ms))
+        body += _delimited(1, bytes(ts))
+    return bytes(body)
