@@ -59,6 +59,14 @@ bench-stream: ## Streaming reconcile lag: 512 variants, remote-write ingest, p50
 stream-smoke: ## Abbreviated streaming-lag run (64 variants, ~5s): every pushed event consumed, published, and lag-metered
 	$(PY) bench_stream.py --smoke
 
+.PHONY: bench-streamchaos
+bench-streamchaos: ## Streaming under fire: 100x flood shedding + admitted-event lag + restart-under-load goodput (writes BENCH_streamchaos_r12.json)
+	$(PY) bench_streamchaos.py
+
+.PHONY: chaos-stream-smoke
+chaos-stream-smoke: ## Abbreviated flood + restart pair (<10s): caps hold, sheds metered, warm restore, lag inside budget
+	$(PY) bench_streamchaos.py --smoke
+
 .PHONY: bench-scenarios
 bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO headlines + mean ablations, tail stress, strict SLO)
 	$(PY) bench_loop.py whole-fleet-p95
@@ -71,7 +79,7 @@ bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO 
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo
 
-LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_profile.py bench_fuse.py bench_stream.py __graft_entry__.py
+LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_profile.py bench_fuse.py bench_stream.py bench_streamchaos.py __graft_entry__.py
 
 .PHONY: lint
 lint: ## Static analysis gate: ruff+mypy when installed, wvalint always (rule catalog: docs/developer-guide/wvalint.md)

@@ -932,6 +932,131 @@ class TestStreamLockGuard:
                          "controller", "reconciler.py"))
 
 
+class TestBoundedContainers:
+    """WVL405 — in stream/ modules, a class-owned container grown in a
+    loop must carry a literal len() bound in the same function. The
+    ingest door is fed by unauthenticated senders: growth per event
+    without a bound at the mutation site is a memory-exhaustion DoS."""
+
+    def test_loop_append_without_bound_fires(self):
+        src = ("class Store:\n"
+               "    def __init__(self):\n"
+               "        self._rows = []\n"
+               "    def absorb(self, events):\n"
+               "        for e in events:\n"
+               "            self._rows.append(e)\n")
+        assert "WVL405" in lint_stream(src)
+
+    def test_literal_len_bound_passes(self):
+        src = ("class Store:\n"
+               "    def __init__(self):\n"
+               "        self._rows = []\n"
+               "    def absorb(self, events):\n"
+               "        for e in events:\n"
+               "            if len(self._rows) >= 4096:\n"
+               "                break\n"
+               "            self._rows.append(e)\n")
+        assert "WVL405" not in lint_stream(src)
+
+    def test_module_constant_bound_passes(self):
+        src = ("CAP = 1024\n"
+               "HARD_CAP = CAP * 64\n"
+               "class Store:\n"
+               "    def __init__(self):\n"
+               "        self._rows = []\n"
+               "    def absorb(self, events):\n"
+               "        for e in events:\n"
+               "            if len(self._rows) >= HARD_CAP:\n"
+               "                break\n"
+               "            self._rows.append(e)\n")
+        assert "WVL405" not in lint_stream(src)
+
+    def test_bound_on_other_container_does_not_cover(self):
+        # the len() check must name the SAME attribute that grows
+        src = ("class Store:\n"
+               "    def __init__(self):\n"
+               "        self._rows = []\n"
+               "        self._keys = set()\n"
+               "    def absorb(self, events):\n"
+               "        for e in events:\n"
+               "            if len(self._keys) >= 4096:\n"
+               "                break\n"
+               "            self._rows.append(e)\n")
+        assert "WVL405" in lint_stream(src)
+
+    def test_while_loop_subscript_growth_fires(self):
+        src = ("class Store:\n"
+               "    def __init__(self):\n"
+               "        self._by_key = {}\n"
+               "    def drain(self, queue):\n"
+               "        while queue:\n"
+               "            k, v = queue.pop()\n"
+               "            self._by_key[k] = v\n")
+        assert "WVL405" in lint_stream(src)
+
+    def test_ctor_loop_not_exempt(self):
+        # unlike WVL404, constructors stay in scope: a ctor loop over
+        # caller input is still attacker-reachable
+        src = ("class Store:\n"
+               "    def __init__(self, seed_events):\n"
+               "        self._rows = []\n"
+               "        for e in seed_events:\n"
+               "            self._rows.append(e)\n")
+        assert "WVL405" in lint_stream(src)
+
+    def test_local_container_out_of_scope(self):
+        # only self-owned state counts — a local list dies with the call
+        src = ("class Store:\n"
+               "    def absorb(self, events):\n"
+               "        rows = []\n"
+               "        for e in events:\n"
+               "            rows.append(e)\n"
+               "        return rows\n")
+        assert "WVL405" not in lint_stream(src)
+
+    def test_rule_scoped_to_stream_modules(self):
+        src = ("class Store:\n"
+               "    def __init__(self):\n"
+               "        self._rows = []\n"
+               "    def absorb(self, events):\n"
+               "        for e in events:\n"
+               "            self._rows.append(e)\n")
+        assert "WVL405" not in lint(src)
+
+    def test_noqa_suppresses_and_stale_noqa_audited(self):
+        src = ("class Store:\n"
+               "    def __init__(self):\n"
+               "        self._rows = []\n"
+               "    def absorb(self, events):\n"
+               "        for e in events:\n"
+               "            self._rows.append(e)  # noqa"
+               ": WVL405 — bounded upstream\n")
+        assert "WVL405" not in lint_stream(src)
+        stale = ("class Store:\n"
+                 "    def __init__(self):\n"
+                 "        self._rows = []\n"
+                 "    def absorb(self, events):\n"
+                 "        for e in events:\n"
+                 "            if len(self._rows) >= 64:\n"
+                 "                break\n"
+                 "            self._rows.append(e)  # noqa"
+                 ": WVL405\n")
+        assert "WVL005" in lint_stream(stale)
+
+    def test_shipped_stream_package_is_clean(self):
+        """Every container the real ingest path grows is bounded."""
+        pkg = os.path.join(REPO, "workload_variant_autoscaler_tpu",
+                           "stream")
+        for name in sorted(os.listdir(pkg)):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(pkg, name)
+            with open(path, encoding="utf-8") as fh:
+                codes = [f.code for f in
+                         wvalint.lint_source(path, fh.read())]
+            assert "WVL405" not in codes, name
+
+
 # -- config-knob parity (WVL311/312) -----------------------------------------
 
 
@@ -1060,11 +1185,14 @@ class TestFaultKindLiterals:
             {plan_py: tree}, os.path.join("faults", "plan.py"),
             "ALL_KINDS")
         assert kinds is not None and "prom-timeout" in kinds \
-            and "watch-drop" in kinds and len(kinds) == 12
+            and "watch-drop" in kinds and len(kinds) == 16
         # the goodput-twin fault kinds are first-class vocabulary, so
         # scenario specs naming them lint clean
         assert {"prom-outage-window", "node-pool-drain",
                 "spot-reclaim"} <= kinds
+        # the streaming chaos kinds rode in the same way
+        assert {"stream-flood", "stream-corrupt-payload",
+                "stream-clock-skew", "controller-restart"} <= kinds
 
     def test_scenario_library_lints_clean_under_repo_vocab(self):
         """The committed scenario library (emulator/scenarios, the twin,

@@ -387,6 +387,62 @@ def test_stream_claims_match_artifact():
     assert f"{base['cycle_wall_ms']} ms" in flat
 
 
+def test_streamchaos_claims_match_artifact():
+    """Round-12 streaming-under-fire: the committed
+    BENCH_streamchaos_r12.json must (a) bound memory under the seeded
+    100× flood (store/queue peaks inside their caps), (b) balance the
+    shed ledger — every push attempt either admitted or metered per
+    reason, with the backstop convergence proving nothing was silently
+    lost, (c) keep p99 admitted-event lag inside the 250 ms budget on
+    the real wire, (d) clear the restart-under-load goodput floor with
+    a warm restore and zero scale-to-zero flaps, and (e) match the
+    numbers quoted in docs/robustness.md."""
+    art = _artifact("BENCH_streamchaos_r12.json")
+    assert art["bench"] == "streamchaos"
+    flood, wire, restart = art["flood"], art["wire"], art["restart"]
+    # (a) bounded memory under flood, in both the sim and wire phases
+    assert flood["multiplier"] == 100
+    assert 0 < flood["store_peak"] <= flood["store_cap"]
+    assert 0 < flood["queue_peak"] <= flood["queue_cap"]
+    assert 0 < wire["store_peak"] <= wire["store_cap"]
+    assert 0 < wire["queue_peak"] <= wire["queue_cap"]
+    # (b) the overload ledger balances: queue-full sheds lose only the
+    # scoped wake (the store kept the data), so attempts = admitted +
+    # store-full refusals; and the shed evidence still converged
+    assert flood["accounting_ok"] is True
+    assert flood["events_admitted"] + flood["shed"]["store-full"] \
+        == flood["push_attempts"]
+    assert flood["events_shed"] == round(sum(flood["shed"].values()))
+    assert flood["shed"]["store-full"] > 0
+    assert flood["shed"]["queue-full"] > 0
+    assert flood["backstop_passes"] > 0
+    assert flood["backstop_converged"] is True
+    assert flood["goodput_fraction"] >= flood["goodput_floor"]
+    # (c) admitted events stay inside the lag budget on the real wire
+    assert art["value"] == wire["p99_ms"] < art["lag_budget_ms"] == 250.0
+    assert 0.0 < wire["p50_ms"] <= wire["p99_ms"] <= wire["max_ms"]
+    assert wire["partial_429"] > 0      # the door visibly shed
+    assert wire["decision_check"]["resized_from_push"] is True
+    # (d) restart-under-load: warm restore, floor held, no zero flap
+    assert restart["fault_trips"] == 1
+    assert restart["checkpoint_restores"] == 1.0
+    assert restart["checkpoint_saves"] >= 1.0
+    assert restart["goodput_fraction"] >= restart["goodput_floor"]
+    assert restart["scale_to_zero_flaps"] == 0
+    # (e) doc parity: robustness.md quotes this artifact
+    doc = (REPO / "docs" / "robustness.md").read_text()
+    flat = " ".join(doc.split())
+    assert f"**{flood['store_peak']}/{flood['store_cap']}**" in flat, \
+        "robustness.md's store high-water claim drifted from the artifact"
+    assert f"**{flood['queue_peak']}/{flood['queue_cap']}**" in flat, \
+        "robustness.md's queue high-water claim drifted from the artifact"
+    assert f"**{flood['events_shed']:,}** events shed" in flat, \
+        "robustness.md's shed count drifted from the artifact"
+    assert f"p99 lag **{wire['p99_ms']:.1f} ms**" in flat, \
+        "robustness.md's admitted-lag claim drifted from the artifact"
+    assert f"{art['lag_budget_ms']:.0f} ms budget" in flat
+
+
 def test_capstone_claims_match_baseline_json():
     """Round-5 whole-fleet capstone: every quoted tail and the headline
     must equal the committed BASELINE.json entry, and the entry itself

@@ -49,6 +49,8 @@ from workload_variant_autoscaler_tpu.metrics import (  # noqa: E402
 )
 from workload_variant_autoscaler_tpu.stream import (  # noqa: E402
     DebouncedQueue,
+    ShedError,
+    StreamCore,
     WireError,
     encode_write_request,
     ingest_write_request,
@@ -106,6 +108,55 @@ class TestRemoteWriteCodec:
         extra = bytes([(3 << 3) | 0, 42])
         parsed = parse_write_request(body + extra)
         assert len(parsed) == 1 and parsed[0].samples == [(1.0, 1)]
+
+
+# -- seeded fuzz corpus: adversarial bytes never crash the codec ------------
+
+
+class TestFuzzCorpus:
+    """tests/fixtures/stream_fuzz_corpus.json is a committed,
+    structure-aware corpus (seeded byte flips at both layers,
+    truncations, lying length fields, varint overflows, snappy bomb
+    claims, a valid label bomb, raw garbage). Contract: every sample
+    either round-trips through the codec or raises a typed WireError —
+    no other exception may escape toward a WSGI worker."""
+
+    @staticmethod
+    def corpus():
+        import json
+
+        path = os.path.join(os.path.dirname(__file__), "fixtures",
+                            "stream_fuzz_corpus.json")
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        assert doc["seed"] == 0xC0FFEE and len(doc["samples"]) >= 40
+        return [(s["name"], bytes.fromhex(s["hex"]))
+                for s in doc["samples"]]
+
+    def test_every_sample_roundtrips_or_raises_wire_error(self):
+        outcomes = {"ok": 0, "wire-error": 0}
+        for name, data in self.corpus():
+            try:
+                series = parse_write_request(snappy_decompress(data))
+            except WireError:
+                outcomes["wire-error"] += 1
+                assert not name.startswith("valid"), \
+                    f"{name}: a valid sample must round-trip"
+            else:
+                outcomes["ok"] += 1
+                assert isinstance(series, list)
+        # both halves of the contract are actually exercised
+        assert outcomes["ok"] >= 3 and outcomes["wire-error"] >= 10
+
+    def test_every_sample_survives_the_wsgi_door(self):
+        """The full HTTP path: whatever the corpus throws at the door,
+        the worker answers an HTTP status (2xx/4xx) and stays up."""
+        _kube, _rec, core = stream_cluster(8, 4)
+        app = remote_write_middleware(core)(lambda _e, _s: [b""])
+        for name, data in self.corpus():
+            status, _ = _post(app, data)
+            assert status[:3] in ("204", "400", "413", "429"), \
+                f"{name}: unexpected status {status}"
 
 
 # -- the debounced queue ----------------------------------------------------
@@ -296,6 +347,131 @@ class TestScopedCycles:
         assert rec.emitter.value("inferno_stream_lag_seconds_count") >= 1.0
 
 
+# -- overload protection: valve, adaptive debounce, limited-mode storm ------
+
+
+def sim_core(rec, debounce_s=0.0):
+    """A StreamCore on a hand-cranked clock (deterministic windows,
+    lag ages, breaker cooldowns). Returns (clock dict, core)."""
+    t = {"now": 0.0}
+    core = StreamCore(rec, debounce_s=debounce_s,
+                      clock=lambda: t["now"])
+    rec.stream_core = core
+    return t, core
+
+
+class TestEscalationValve:
+    def test_lag_budget_blown_coalesces_into_one_full_pass(self,
+                                                           monkeypatch):
+        monkeypatch.setenv("WVA_STREAM_LAG_BUDGET_MS", "5000")
+        _kube, rec = build_stream_cluster(8, 4)
+        t, core = sim_core(rec, debounce_s=30.0)   # window never closes
+        core.process_once()                        # baseline full pass
+        core.observe_load("llama-8b-m1", NS, mk_load(9600.0), t=1.0)
+        t["now"] = 1.0
+        assert core.process_once() == []           # window open, no valve
+        t["now"] = 6.5                             # oldest age > budget
+        results = core.process_once()
+        assert len(results) == 1 and len(results[0].processed) == 8
+        # the valve pass is marked stream-degraded
+        assert rec.emitter.value("inferno_cycle_degradation_state") == 1.0
+
+    def test_saturated_queue_bypasses_the_window(self, monkeypatch):
+        monkeypatch.setenv("WVA_STREAM_MAX_QUEUE", "1")
+        _kube, rec = build_stream_cluster(8, 4)
+        t, core = sim_core(rec, debounce_s=30.0)
+        core.process_once()
+        core.observe_load("llama-8b-m1", NS, mk_load(9600.0), t=0.0)
+        t["now"] = 0.1                             # window still open
+        results = core.process_once()              # depth == cap: valve
+        assert len(results) == 1 and len(results[0].processed) == 8
+
+
+class TestAdaptiveDebounce:
+    def knobs(self, monkeypatch):
+        monkeypatch.setenv("WVA_STREAM_STORM_EVENTS", "4")
+        monkeypatch.setenv("WVA_STREAM_MAX_DEBOUNCE_MS", "100")
+
+    def test_storm_widens_and_quiet_narrows_with_hysteresis(self,
+                                                            monkeypatch):
+        self.knobs(monkeypatch)
+        _kube, rec = build_stream_cluster(2, 2)
+        _t, core = sim_core(rec, debounce_s=0.025)
+        core._adapt_debounce(4)                    # storm: double
+        assert core._debounce_s == pytest.approx(0.05)
+        assert core.queue.debounce_s == pytest.approx(0.05)
+        core._adapt_debounce(4)
+        assert core._debounce_s == pytest.approx(0.1)
+        core._adapt_debounce(400)                  # ceiling holds
+        assert core._debounce_s == pytest.approx(0.1)
+        core._adapt_debounce(3)                    # hysteresis band:
+        assert core._debounce_s == pytest.approx(0.1)   # no flap
+        core._adapt_debounce(2)                    # <= storm/2: halve
+        assert core._debounce_s == pytest.approx(0.05)
+        core._adapt_debounce(1)
+        assert core._debounce_s == pytest.approx(0.025)
+        core._adapt_debounce(1)                    # floor: the base
+        assert core._debounce_s == pytest.approx(0.025)
+
+    def test_widening_is_flood_pressure_and_gauge(self, monkeypatch):
+        self.knobs(monkeypatch)
+        _kube, rec = build_stream_cluster(2, 2)
+        _t, core = sim_core(rec, debounce_s=0.025)
+        core._adapt_debounce(4)
+        with core._lock:
+            assert core._pressure == "flood"
+        assert rec.emitter.value("inferno_stream_debounce_ms") == \
+            pytest.approx(50.0)
+
+
+class TestLimitedModeStorm:
+    """Satellite: concurrent limited-mode escalations coalesce into ONE
+    pending backstop pass instead of N fleet-wide solves."""
+
+    def test_storm_coalesces_to_one_pending_backstop(self, monkeypatch):
+        from workload_variant_autoscaler_tpu.controller import (
+            CONFIG_MAP_NAME,
+            CONFIG_MAP_NAMESPACE,
+        )
+
+        monkeypatch.setenv("WVA_STREAM_LAG_BUDGET_MS", "5000")
+        kube, rec = build_stream_cluster(8, 4)
+        t, core = sim_core(rec, debounce_s=0.0)
+        core.process_once()                        # baseline
+        # flip limited mode on in the operator CM so every snapshot
+        # refresh (each full pass re-reads it) keeps it on
+        cm = kube.get_configmap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE)
+        cm.data["WVA_LIMITED_MODE"] = "true"
+        kube.put_configmap(cm)
+        rec.state.snapshot.operator_cm["WVA_LIMITED_MODE"] = "true"
+        backstops = rec.emitter.value("inferno_stream_events_total",
+                                      source=SOURCE_BACKSTOP) or 0.0
+        # first escalation after quiet runs immediately (fleet-wide:
+        # limited-mode capacity couples every variant)
+        t["now"] = 1.0
+        core.observe_load("llama-8b-m0", NS, mk_load(9600.0), t=1.0)
+        results = core.process_once()
+        assert len(results) == 1 and len(results[0].processed) == 8
+        # a storm of follow-up escalations inside the lag budget defers
+        # onto one pending pass — zero solves now
+        for rpm, model in ((7200.0, "llama-8b-m1"),
+                           (4800.0, "llama-8b-m2"),
+                           (2400.0, "llama-8b-m3")):
+            core.observe_load(model, NS, mk_load(rpm), t=1.0)
+            assert core.process_once() == []
+        # ...which lands once the budget horizon passes: exactly ONE
+        # more full pass serves the whole storm
+        t["now"] = 6.5
+        results = core.process_once()
+        assert len(results) == 1 and len(results[0].processed) == 8
+        assert core.process_once() == []           # nothing left behind
+        # exactly TWO escalated passes served 1 + 3 escalations: the
+        # immediate one, and the single coalesced backstop
+        new_backstops = rec.emitter.value("inferno_stream_events_total",
+                                          source=SOURCE_BACKSTOP)
+        assert new_backstops - backstops == 2.0
+
+
 # -- the remote-write route -------------------------------------------------
 
 
@@ -335,7 +511,7 @@ class TestRemoteWriteRoute:
             [({"__name__": "wva:stream:arrival_rpm",
                "model_name": "llama-8b-m0", "namespace": NS},
               [(9600.0, 1)])])
-        assert ingest_write_request(core, raw, encoding="") == 1
+        assert ingest_write_request(core, raw, encoding="") == (1, 0)
 
     def test_route_sits_inside_the_auth_gate(self):
         """Same composition proof as the /debug routes: serve() wraps
@@ -365,6 +541,187 @@ class TestRemoteWriteRoute:
             server.shutdown()
 
 
+# -- overload shedding at the door ------------------------------------------
+
+
+def _post_headers(app, body, **kw):
+    """_post, but also captures the response headers as a dict."""
+    status: list = []
+    headers: dict = {}
+    environ = {"PATH_INFO": kw.get("path", "/api/v1/write"),
+               "REQUEST_METHOD": "POST",
+               "CONTENT_LENGTH": str(len(body)),
+               "HTTP_CONTENT_ENCODING": kw.get("encoding", "snappy"),
+               "wsgi.input": io.BytesIO(body)}
+
+    def start(st, hdrs):
+        status.append(st)
+        headers.update(dict(hdrs))
+
+    payload = b"".join(app(environ, start))
+    return status[0], headers, payload
+
+
+class TestOverloadShedding:
+    def test_oversized_body_answers_413_and_is_metered(self, monkeypatch):
+        monkeypatch.setenv("WVA_STREAM_MAX_BODY_BYTES", "2048")
+        _kube, rec, core = stream_cluster(8, 4)
+        assert core.max_body_bytes() == 2048
+        status, _ = _post(remote_write_middleware(core)(
+            lambda _e, _s: [b""]), b"\x00" * 4096)
+        assert status.startswith("413")
+        assert rec.emitter.value("inferno_stream_shed_total",
+                                 reason="body-too-large") == 1.0
+        # nothing was read into the store or the queue
+        assert core.queue.pending() == 0
+
+    def test_store_cap_sheds_metered_and_requests_backstop(self,
+                                                           monkeypatch):
+        _kube, rec, core = stream_cluster(8, 4)   # 4 groups resident
+        monkeypatch.setenv("WVA_STREAM_MAX_GROUPS", "4")
+        with pytest.raises(ShedError) as err:
+            core.ingest_push("phantom-model", NS,
+                             {"arrival_rate_rpm": 100.0})
+        assert err.value.reason == "store-full"
+        assert rec.emitter.value("inferno_stream_shed_total",
+                                 reason="store-full") == 1.0
+        # the loss is folded into a coalesced full-pass request, and
+        # the serving cycle lands on the stream-degraded rung
+        results = drain_now(core)
+        assert len(results) == 1 and len(results[0].processed) == 8
+        assert rec.emitter.value("inferno_cycle_degradation_state") == 1.0
+        # resident groups keep flowing: no phantom leaked into the store
+        with core._lock:
+            assert ("phantom-model", NS) not in core._store
+
+    def test_queue_cap_keeps_data_loses_only_the_wake(self, monkeypatch):
+        monkeypatch.setenv("WVA_STREAM_MAX_QUEUE", "1")
+        _kube, rec = build_stream_cluster(8, 4)
+        t, core = sim_core(rec, debounce_s=0.0)
+        core.process_once()
+        t["now"] = 1.0
+        assert core.ingest_push("llama-8b-m0", NS,
+                                {"arrival_rate_rpm": 9600.0,
+                                 "avg_input_tokens": 128.0,
+                                 "avg_output_tokens": 128.0}, t=1.0)
+        # second flipped group: the queue is at depth cap — the store
+        # still holds the observation, only the scoped wake is shed
+        # (folded into a coalesced full-pass request, not raised: the
+        # data DID land)
+        core.ingest_push("llama-8b-m1", NS,
+                         {"arrival_rate_rpm": 7200.0,
+                          "avg_input_tokens": 128.0,
+                          "avg_output_tokens": 128.0}, t=1.0)
+        assert rec.emitter.value("inferno_stream_shed_total",
+                                 reason="queue-full") == 1.0
+        with core._lock:
+            assert core._store[("llama-8b-m1", NS)] \
+                .fields["arrival_rate_rpm"] == 7200.0
+        # the coalesced full pass serves BOTH groups' new loads
+        results = core.process_once()
+        assert len(results) == 1 and len(results[0].processed) == 8
+
+    def test_partial_shed_answers_429_with_accounting(self):
+        _kube, _rec, core = stream_cluster(8, 4)
+        app = remote_write_middleware(core)(lambda _e, _s: [b""])
+        series = [
+            ({"__name__": "wva:stream:arrival_rpm",
+              "model_name": "llama-8b-m0", "namespace": NS},
+             [(9600.0, 1000)]),
+            ({"__name__": "wva:stream:arrival_rpm",
+              "model_name": "llama-8b-m1", "namespace": NS},
+             [(float("nan"), 1000)]),                # poisoned group
+        ]
+        body = snappy_compress(encode_write_request(series))
+        status, headers, _ = _post_headers(app, body)
+        assert status.startswith("429")
+        assert headers["X-Ingested-Groups"] == "1"
+        assert headers["X-Shed-Groups"] == "1"
+
+
+# -- poisoned-input quarantine ----------------------------------------------
+
+
+class TestQuarantine:
+    def push(self, core, fields, ts_ms=0.0, model="llama-8b-m0"):
+        with pytest.raises(ShedError) as err:
+            core.ingest_push(model, NS, fields, ts_ms=ts_ms)
+        return err.value.reason
+
+    def test_nan_inf_and_unparseable_are_quarantined(self):
+        _kube, rec, core = stream_cluster(8, 4)
+        for bad in (float("nan"), float("inf"), float("-inf"), "bogus",
+                    None):
+            assert self.push(core, {"arrival_rate_rpm": bad}) \
+                == "quarantine-nan"
+        assert rec.emitter.value("inferno_stream_shed_total",
+                                 reason="quarantine-nan") == 5.0
+
+    def test_negative_load_is_quarantined(self):
+        _kube, rec, core = stream_cluster(8, 4)
+        assert self.push(core, {"arrival_rate_rpm": -1.0}) \
+            == "quarantine-negative"
+
+    def test_far_future_and_out_of_order_timestamps(self):
+        _kube, rec, core = stream_cluster(8, 4)
+        now_ms = rec.now() * 1000.0
+        assert self.push(core, {"arrival_rate_rpm": 50.0},
+                         ts_ms=now_ms + 3_600_000.0) \
+            == "quarantine-timestamp"
+        # admit one honestly-stamped sample, then replay an older one
+        assert core.ingest_push("llama-8b-m0", NS,
+                                {"arrival_rate_rpm": 50.0},
+                                ts_ms=now_ms) in (True, False)
+        assert self.push(core, {"arrival_rate_rpm": 60.0},
+                         ts_ms=now_ms - 60_000.0) \
+            == "quarantine-timestamp"
+
+    def test_label_bomb_is_quarantined_at_the_door(self):
+        _kube, rec, core = stream_cluster(8, 4)
+        labels = {"__name__": "wva:stream:arrival_rpm",
+                  "model_name": "llama-8b-m0", "namespace": NS}
+        for i in range(70):
+            labels[f"bomb_{i}"] = "x"
+        body = snappy_compress(encode_write_request(
+            [(labels, [(9600.0, 1000)])]))
+        assert ingest_write_request(core, body) == (0, 1)
+        assert rec.emitter.value("inferno_stream_shed_total",
+                                 reason="quarantine-labels") == 1.0
+
+    def test_persistent_poison_trips_the_source_breaker(self,
+                                                        monkeypatch):
+        monkeypatch.setenv("WVA_STREAM_QUARANTINE_THRESHOLD", "3")
+        _kube, rec = build_stream_cluster(8, 4)
+        t, core = sim_core(rec)
+        core.process_once()
+        for _ in range(3):
+            with pytest.raises(ShedError):
+                core.ingest_push("llama-8b-m0", NS,
+                                 {"arrival_rate_rpm": float("nan")})
+        assert core.source_quarantined(SOURCE_REMOTE_WRITE)
+        # the door answers 429 outright while the breaker is open...
+        app = remote_write_middleware(core)(lambda _e, _s: [b""])
+        status, headers, _ = _post_headers(
+            app, write_request_body("llama-8b-m0", 9600.0, 1))
+        assert status.startswith("429")
+        assert headers.get("Retry-After") == "60"
+        assert rec.emitter.value("inferno_stream_shed_total",
+                                 reason="source-quarantined") == 1.0
+        # ...and the ScrapePoller fallback kicks in at its own cadence
+        from workload_variant_autoscaler_tpu.stream import ScrapePoller
+        from workload_variant_autoscaler_tpu.stream.ingest import (
+            QUARANTINE_POLL_S,
+        )
+        poller = ScrapePoller(core, threading.Event(), prom=rec.prom)
+        assert poller._period_s() == QUARANTINE_POLL_S
+        # the cooldown elapses on the core's clock: half-open admits a
+        # clean probe and the door re-opens
+        t["now"] = 61.0
+        assert not core.source_quarantined(SOURCE_REMOTE_WRITE)
+        core.ingest_push("llama-8b-m0", NS, {"arrival_rate_rpm": 42.0})
+        assert poller._period_s() == 0.0           # fallback stands down
+
+
 # -- streamed-scrape fallback ----------------------------------------------
 
 
@@ -384,6 +741,67 @@ class TestScrapePoller:
         poller.poll_once()
         results = drain_now(core)
         assert results and len(results[0].processed) == 8  # all 4 groups
+
+    def test_failing_group_is_metered_and_skipped(self):
+        from workload_variant_autoscaler_tpu.stream import ScrapePoller
+
+        _kube, rec, core = stream_cluster(8, 4)
+
+        class BrokenProm:
+            def query(self, *_a, **_k):
+                raise TimeoutError("prom down")
+
+        poller = ScrapePoller(core, threading.Event(), prom=BrokenProm())
+        assert poller.poll_once() == 0
+        assert rec.emitter.value("inferno_stream_shed_total",
+                                 reason="scrape-error") == 4.0
+
+    def test_loop_survives_exceptions_and_joins_on_stop(self,
+                                                       monkeypatch):
+        """Satellite: a poll failure must never silently kill the
+        thread, and stop must be honored promptly — even mid-backoff."""
+        from workload_variant_autoscaler_tpu.stream import ScrapePoller
+
+        monkeypatch.setenv("WVA_STREAM_SCRAPE_MS", "10")
+        _kube, rec, core = stream_cluster(8, 4)
+        stop = threading.Event()
+        poller = ScrapePoller(core, stop, prom=rec.prom)
+        attempts = []
+
+        def explode():
+            attempts.append(1)
+            raise RuntimeError("boom")
+
+        poller.poll_once = explode
+        thread = poller.start()
+        deadline = time.monotonic() + 30.0
+        # the 6th attempt can only come from a SECOND with_backoff call
+        # (STANDARD_BACKOFF is 5 steps): the loop outlived one whole
+        # exhausted ladder raising into its catch
+        while len(attempts) < 6 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(attempts) >= 6, "poller thread died on an exception"
+        assert thread.is_alive()
+        assert rec.emitter.value("inferno_stream_shed_total",
+                                 reason="scrape-error") >= 1.0
+        stop.set()
+        thread.join(timeout=5.0)
+        assert not thread.is_alive()
+
+    def test_core_run_joins_the_poller_on_stop(self, monkeypatch):
+        monkeypatch.setenv("WVA_STREAM_SCRAPE_MS", "50")
+        _kube, rec, core = stream_cluster(8, 4)
+        stop = threading.Event()
+        t = threading.Thread(target=core.run, args=(stop,), daemon=True)
+        t.start()
+        time.sleep(0.2)
+        with core._lock:
+            poller_thread = core._poller_thread
+        assert poller_thread is not None and poller_thread.is_alive()
+        stop.set()
+        core.queue.request_full(SOURCE_WATCH)      # wake the consumer
+        t.join(timeout=5.0)
+        assert not t.is_alive() and not poller_thread.is_alive()
 
 
 # -- the kick() storm: debounce vs the legacy thundering herd ---------------
@@ -632,6 +1050,130 @@ class TestStreamState:
             == rec.decisions.latest("chat-0", NS).published_replicas
 
 
+# -- crash-safe warm restart ------------------------------------------------
+
+
+def restart_reconciler(kube, prom):
+    """A 'restarted controller': a brand-new Reconciler + emitter over
+    the same cluster, as after a process crash."""
+    from workload_variant_autoscaler_tpu.controller import Reconciler
+    from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
+
+    return Reconciler(kube=kube, prom=prom, emitter=MetricsEmitter(),
+                      sleep=lambda _s: None)
+
+
+class TestCheckpointFile:
+    """stream/checkpoint.py: atomic, versioned, CRC-guarded persistence."""
+
+    def test_round_trip(self, tmp_path):
+        from workload_variant_autoscaler_tpu.stream import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        path = str(tmp_path / "s.ckpt")
+        payload = {"taken_at": 12.5, "store": [["m", "ns", {}, 0.0,
+                                               0.0, None]]}
+        save_checkpoint(path, payload)
+        assert load_checkpoint(path) == payload
+
+    def test_corrupt_and_torn_files_raise_typed_error(self, tmp_path):
+        from workload_variant_autoscaler_tpu.stream import (
+            CheckpointError,
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        path = str(tmp_path / "s.ckpt")
+        save_checkpoint(path, {"taken_at": 1.0})
+        blob = bytearray(open(path, "rb").read())
+        blob[-3] ^= 0xFF                            # bit-rot in the body
+        open(path, "wb").write(bytes(blob))
+        with pytest.raises(CheckpointError):
+            load_checkpoint(path)
+        open(path, "wb").write(bytes(blob[: len(blob) // 2]))  # torn
+        with pytest.raises(CheckpointError):
+            load_checkpoint(path)
+        open(path, "wb").write(b"not a checkpoint at all\n")
+        with pytest.raises(CheckpointError):
+            load_checkpoint(path)
+
+
+class TestWarmRestart:
+    def checkpointed_cluster(self, monkeypatch, tmp_path):
+        path = str(tmp_path / "stream.ckpt")
+        monkeypatch.setenv("WVA_STREAM_CHECKPOINT", path)
+        kube, rec, core = stream_cluster(8, 4)
+        assert rec.emitter.value("inferno_stream_checkpoint_total",
+                                 event="save") >= 1.0
+        return path, kube, rec, core
+
+    def test_warm_restore_resumes_scoped_without_a_cold_pass(
+            self, monkeypatch, tmp_path):
+        _path, kube, rec, core = self.checkpointed_cluster(
+            monkeypatch, tmp_path)
+        core.observe_load("llama-8b-m1", NS, mk_load(9600.0))
+        drain_now(core)                           # consumed + checkpointed
+        want = {f"chat-{i}": kube.get_variant_autoscaling(
+            f"chat-{i}", NS).status.desired_optimized_alloc.num_replicas
+            for i in range(8)}
+        # crash + restart: new controller, same cluster
+        rec2 = restart_reconciler(kube, rec.prom)
+        core2 = rec2.ensure_stream_core()
+        assert rec2.emitter.value("inferno_stream_checkpoint_total",
+                                  event="restore") == 1.0
+        # the fleet snapshot and the consumed signatures survived: no
+        # cold full pass, no spurious re-solve of unchanged state
+        assert rec2.state.snapshot is not None
+        assert len(rec2.state.snapshot.vas) == 8
+        assert rec2.state.cycle_index == rec.state.cycle_index
+        assert drain_now(core2) == []
+        # the first post-restart event rides the SCOPED path — the
+        # proof the restore was warm (a cold core must full-pass first)
+        core2.observe_load("llama-8b-m2", NS, mk_load(8400.0))
+        results = drain_now(core2)
+        assert len(results) == 1
+        assert sorted(results[0].processed) == sorted(
+            f"chat-{i}:{NS}" for i in range(8) if i % 4 == 2)
+        # untouched variants keep their pre-crash allocations
+        for i in range(8):
+            if i % 4 != 2:
+                assert kube.get_variant_autoscaling(
+                    f"chat-{i}", NS).status.desired_optimized_alloc \
+                    .num_replicas == want[f"chat-{i}"]
+
+    def test_corrupt_checkpoint_discarded_cold_start(self, monkeypatch,
+                                                     tmp_path):
+        path, kube, rec, _core = self.checkpointed_cluster(
+            monkeypatch, tmp_path)
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0x55
+        open(path, "wb").write(bytes(blob))
+        rec2 = restart_reconciler(kube, rec.prom)
+        rec2.ensure_stream_core()
+        assert rec2.emitter.value("inferno_stream_checkpoint_total",
+                                  event="discard-corrupt") == 1.0
+        assert rec2.state.snapshot is None        # cold: full pass next
+
+    def test_stale_checkpoint_discarded(self, monkeypatch, tmp_path):
+        from workload_variant_autoscaler_tpu.stream import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        path, kube, rec, _core = self.checkpointed_cluster(
+            monkeypatch, tmp_path)
+        payload = load_checkpoint(path)
+        payload["taken_at"] = rec.now() - 3600.0
+        save_checkpoint(path, payload)
+        rec2 = restart_reconciler(kube, rec.prom)
+        rec2.ensure_stream_core()
+        assert rec2.emitter.value("inferno_stream_checkpoint_total",
+                                  event="discard-stale") == 1.0
+        assert rec2.state.snapshot is None
+
+
 # -- twin: flash-crowd-streaming vs the polled baseline ---------------------
 
 
@@ -671,6 +1213,45 @@ class TestStreamingTwin:
             STREAMING_SCENARIOS["flash-crowd-streaming"], horizon))
         assert rerun.to_dict() == streamed.to_dict()
 
+    def test_restart_under_flash_crowd_equivalence(self):
+        """The warm-restart pin: kill and rebuild the controller
+        mid-flash-crowd and, after at most one backstop pass, the
+        published decisions equal the never-restarted run's."""
+        from dataclasses import replace
+
+        from workload_variant_autoscaler_tpu.emulator.scenarios import (
+            STREAMING_SCENARIOS,
+            abbreviated,
+        )
+        from workload_variant_autoscaler_tpu.emulator.twin import (
+            run_scenario,
+        )
+
+        horizon = 330.0                   # restart at 240s, inside it
+        sc = STREAMING_SCENARIOS["restart-under-load"]
+        restarted = run_scenario(abbreviated(sc, horizon))
+        baseline = run_scenario(abbreviated(
+            replace(sc, name="restart-under-load-baseline", faults=()),
+            horizon))
+        assert restarted.fault_trips == 1 and baseline.fault_trips == 0
+        # the restart visibly happened: a warm restore AND a post-
+        # restart save both metered on the (rebuilt) emitter
+        em = restarted.emitter
+        assert em.value("inferno_stream_checkpoint_total",
+                        event="restore") == 1.0
+        assert em.value("inferno_stream_checkpoint_total",
+                        event="save") >= 1.0
+        # decision equivalence at the horizon, variant by variant
+        for v in baseline.variants:
+            a = baseline.decisions.latest(v.name, v.namespace)
+            b = restarted.decisions.latest(v.name, v.namespace)
+            assert a is not None and b is not None, v.name
+            assert a.published_replicas == b.published_replicas, v.name
+        # and the restart cost no goodput floor nor any zero-flap
+        assert restarted.goodput_fraction >= restarted.goodput_floor
+        for v in restarted.variants:
+            assert not v.scaled_to_zero_on_stale, v.name
+
 
 # -- bench smoke (tier-1) ---------------------------------------------------
 
@@ -686,6 +1267,27 @@ def test_stream_smoke_bench_passes():
     # generous CI bound; the committed artifact pins the real numbers
     assert out["p99_ms"] < 5_000.0
     assert out["polled_baseline"]["lag_p50_ms"] > out["p99_ms"]
+
+
+def test_stream_chaos_smoke_bench_passes():
+    """Abbreviated bench_streamchaos run (`make chaos-stream-smoke`,
+    ~10s): the flood twin keeps the store/queue inside their caps with
+    every refusal metered, the wire phase keeps admitted-event p99 lag
+    inside the 250 ms budget while the door sheds, and the restart twin
+    warm-restores and clears its goodput floor with zero zero-flaps.
+    bench_streamchaos.check() asserts all of that; re-assert the
+    load-bearing numbers here so a silently-weakened check() fails."""
+    from bench_streamchaos import run as chaos_run
+
+    out = chaos_run(smoke=True)
+    flood, wire, restart = out["flood"], out["wire"], out["restart"]
+    assert flood["store_peak"] <= flood["store_cap"]
+    assert flood["queue_peak"] <= flood["queue_cap"]
+    assert flood["accounting_ok"] is True
+    assert flood["shed"]["store-full"] > 0
+    assert wire["p99_ms"] < out["lag_budget_ms"]
+    assert restart["checkpoint_restores"] == 1.0
+    assert restart["scale_to_zero_flaps"] == 0
 
 
 def test_post_write_helper_round_trips():

@@ -96,6 +96,16 @@ Rules (suppress per-line with `# noqa` or `# noqa: WVLxxx`):
           are exempt; lock-free classes (single-thread state like
           StreamState, which by contract only the consumer touches) are
           out of scope by not owning a lock.
+  WVL405  unbounded stream container: in `stream/` modules, a
+          class-owned container (`self.` list/dict/set/deque) grown
+          inside a For/While loop (.append/.add/.appendleft/
+          .setdefault/subscript assignment) without a visible bound in
+          the same function — a `len(self.<attr>)` comparison against
+          an int literal or module-level constant. Streaming state is
+          process-lifetime and remote-write-fed; growth without a
+          literal ceiling is the memory-exhaustion bug the overload
+          defenses exist to prevent. A WVL405 noqa comment marks a
+          deliberate exception.
 
   WVL005  stale suppression: a `# noqa: WVLxxx` comment naming a rule
           that does not fire on that line (audited only for rule
@@ -1322,6 +1332,119 @@ def _check_stream_lock_guard(path: str, tree: ast.Module) -> list[Finding]:
     return findings
 
 
+# -- bounded stream containers (WVL405) --------------------------------------
+
+# container growth calls a loop can repeat without limit
+_GROWTH_METHODS = frozenset({"append", "appendleft", "add", "setdefault"})
+
+
+def _check_bounded_containers(path: str, tree: ast.Module) -> list[Finding]:
+    """WVL405: in stream/ modules, a class-owned container (`self.`
+    list/dict/set/deque) grown inside a For/While loop must carry a
+    VISIBLE bound in the same function — a `len(self.<attr>)`
+    comparison whose other side resolves to an int literal or a
+    module-level constant. Streaming state lives for the process
+    lifetime and is fed by untrusted remote-write input; a loop that
+    appends/keys into it without a literal ceiling is the memory-
+    exhaustion bug the overload defenses exist to prevent. Suppress a
+    deliberate exception with a WVL405 noqa at the mutation site."""
+    if not _is_stream_module(path):
+        return []
+    consts = _module_consts(tree)
+
+    def len_self_attr(node) -> str | None:
+        """`len(self.<attr>)` -> attr name, else None."""
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "len" and len(node.args) == 1:
+            a = node.args[0]
+            if isinstance(a, ast.Attribute) and \
+                    isinstance(a.value, ast.Name) and a.value.id == "self":
+                return a.attr
+        return None
+
+    def has_literal_bound(node) -> bool:
+        """An int literal or int-valued module constant anywhere in the
+        subtree (covers `min(self._cap(), HARD_MAX)` shapes)."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Constant) and \
+                    isinstance(sub.value, (int, float)) and \
+                    not isinstance(sub.value, bool):
+                return True
+            if isinstance(sub, ast.Name) and \
+                    isinstance(consts.get(sub.id), (int, float)):
+                return True
+        return False
+
+    def bounded_attrs(fn) -> set[str]:
+        """Attrs compared as `len(self.<attr>) <op> <literal bound>`
+        anywhere in the function (either comparison side)."""
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare):
+                continue
+            sides = [node.left] + list(node.comparators)
+            for i, side in enumerate(sides):
+                attr = len_self_attr(side)
+                if attr is None:
+                    continue
+                others = sides[:i] + sides[i + 1:]
+                if any(has_literal_bound(o) for o in others):
+                    out.add(attr)
+        return out
+
+    def growth_site(node):
+        """(attr, how) when the node grows a self container, else None."""
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _GROWTH_METHODS:
+            tgt = node.func.value
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self":
+                return tgt.attr, f".{node.func.attr}()"
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Attribute) and \
+                        isinstance(t.value.value, ast.Name) and \
+                        t.value.value.id == "self":
+                    return t.value.attr, "[...] ="
+        return None
+
+    findings: list[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for m in cls.body:
+            if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            bounded: set[str] | None = None
+            seen: set[int] = set()
+            for loop in ast.walk(m):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for node in ast.walk(loop):
+                    if node is loop or id(node) in seen:
+                        continue
+                    site = growth_site(node)
+                    if site is None:
+                        continue
+                    seen.add(id(node))
+                    attr, how = site
+                    if bounded is None:
+                        bounded = bounded_attrs(m)
+                    if attr in bounded:
+                        continue
+                    findings.append(Finding(
+                        path, node.lineno, "WVL405",
+                        f"unbounded stream container {cls.name}.{attr} "
+                        f"grown via {how} in a loop in {m.name}() with "
+                        f"no len(self.{attr}) literal bound in the same "
+                        "function"))
+    return findings
+
+
 # -- thread-reachable shared-state mutation (WVL402) -------------------------
 
 
@@ -1666,10 +1789,29 @@ def _module_consts(tree: ast.Module) -> dict:
         if isinstance(node, ast.Tuple):
             vals = [ev(e) for e in node.elts]
             return None if any(v is None for v in vals) else tuple(vals)
-        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        if isinstance(node, ast.BinOp):
             left, right = ev(node.left), ev(node.right)
-            if isinstance(left, tuple) and isinstance(right, tuple):
+            if isinstance(node.op, ast.Add) and \
+                    isinstance(left, tuple) and isinstance(right, tuple):
                 return left + right
+            # numeric constants derived from other constants
+            # (HARD_CAP = CAP * 64, MAX_BYTES = 1 << 26) feed the
+            # WVL405 literal-bound check
+            if isinstance(left, (int, float)) and \
+                    isinstance(right, (int, float)) and \
+                    not isinstance(left, bool) and \
+                    not isinstance(right, bool):
+                try:
+                    if isinstance(node.op, ast.Add):
+                        return left + right
+                    if isinstance(node.op, ast.Sub):
+                        return left - right
+                    if isinstance(node.op, ast.Mult):
+                        return left * right
+                    if isinstance(node.op, ast.LShift):
+                        return left << right
+                except TypeError:
+                    return None
         return None
 
     for node in tree.body:
@@ -1967,6 +2109,7 @@ def _stage_coverage_findings(files: list[str],
 _STRUCTURAL_CODES = frozenset({
     "WVL001", "WVL002", "WVL003", "WVL101", "WVL102", "WVL103", "WVL104",
     "WVL105", "WVL106", "WVL305", "WVL401", "WVL402", "WVL403", "WVL404",
+    "WVL405",
 })
 
 
@@ -1993,6 +2136,7 @@ def lint_source(path: str, source: str,
     findings += _check_module_lock_discipline(path, tree)
     findings += _check_thread_shared_state(path, tree)
     findings += _check_stream_lock_guard(path, tree)
+    findings += _check_bounded_containers(path, tree)
     findings += _check_unaudited_readbacks(path, tree)
     active = set(_STRUCTURAL_CODES)
     if sigs:

@@ -7,6 +7,11 @@ failing in an undefined way, and climbs back up the moment evidence
 returns. Rungs, per variant:
 
 - HEALTHY     fresh metrics, normal sizing.
+- STREAM_DEGRADED the streaming ingest path is under pressure (queue
+  saturation, lag budget blown, shedding, or a quarantined source):
+  decisions still ride fresh evidence — the escalation valve coalesces
+  the backlog into one backstop full pass — but event-grained reaction
+  latency is not being honored, so the cycle is marked.
 - STALE_CACHE sized on the last-known-good load (collector/cache.py
   tiers) under a live dependency failure; actuation guarded (no
   scale-to-zero, bounded step), drift not judged.
@@ -34,9 +39,10 @@ from ..collector import TIER_FRESH, TIER_STALE
 
 class DegradationState(IntEnum):
     HEALTHY = 0
-    STALE_CACHE = 1
-    LIMITED = 2
-    HOLD = 3
+    STREAM_DEGRADED = 1
+    STALE_CACHE = 2
+    LIMITED = 3
+    HOLD = 4
 
     @property
     def label(self) -> str:
@@ -45,6 +51,7 @@ class DegradationState(IntEnum):
 
 _LABELS = {
     DegradationState.HEALTHY: "healthy",
+    DegradationState.STREAM_DEGRADED: "stream-degraded",
     DegradationState.STALE_CACHE: "stale-cache",
     DegradationState.LIMITED: "limited",
     DegradationState.HOLD: "hold",

@@ -574,6 +574,12 @@ class Reconciler:
         self._deadline = Deadline(self._cycle_budget_s(),
                                   clock=self.monotonic)
         self._degradation = DegradationTracker()
+        if self.state.stream_pressure:
+            # the streaming core is serving this cycle under pressure
+            # (overload shed, blown lag budget, coalesced escalation):
+            # the cycle rides fresh evidence but the event-grained
+            # latency contract is suspended, so mark the ladder
+            self._degradation.record_cycle(DegradationState.STREAM_DEGRADED)
         err: Optional[BaseException] = None
         try:
             return self._reconcile_timed(mark)

@@ -25,9 +25,11 @@ from __future__ import annotations
 from dataclasses import dataclass, field, replace
 
 from ...faults.plan import (
+    CONTROLLER_RESTART,
     NODE_POOL_DRAIN,
     PROM_OUTAGE,
     SPOT_RECLAIM,
+    STREAM_FLOOD,
     FaultRule,
 )
 from ...models.chips import CHIP_CATALOG
@@ -363,6 +365,55 @@ STREAMING_SCENARIOS: dict[str, Scenario] = {
             # zero debounce: in sim time an event fires on the tick it
             # arrives, making the run deterministic tick-for-tick
             operator={**_STEP, "WVA_STREAM_DEBOUNCE_MS": "0"},
+        ),
+        replace(
+            SCENARIOS["flash-crowd"],
+            name="flash-crowd-flood",
+            description=(
+                "The flash-crowd step arrives as a remote-write FLOOD: "
+                "from t=180s every group's push is replayed 100x per "
+                "tick with jitter plus phantom relabeling-storm groups. "
+                "The store/queue caps must bound memory, the shed "
+                "counter must account every refusal, and the coalesced "
+                "backstop pass must still converge the decisions the "
+                "admitted evidence implies"),
+            expected_path="healthy -> stream-degraded while the flood "
+                          "sheds (decisions still track the admitted "
+                          "evidence) -> healthy once the storm passes",
+            seed=107,
+            streaming=True,
+            faults=(
+                FaultRule(kind=STREAM_FLOOD,
+                          labels={"multiplier": 100},
+                          after_s=180.0, until_s=300.0),
+            ),
+            operator={**_STEP, "WVA_STREAM_DEBOUNCE_MS": "0",
+                      # small caps so the seeded flood actually hits the
+                      # shedding wall inside the run's horizon
+                      "WVA_STREAM_MAX_GROUPS": "64",
+                      "WVA_STREAM_MAX_QUEUE": "32"},
+            goodput_floor=0.45,
+        ),
+        replace(
+            SCENARIOS["flash-crowd"],
+            name="restart-under-load",
+            description=(
+                "The controller process dies at t=240s — mid flash "
+                "crowd, right after the 8x step — and restarts warm "
+                "from its stream checkpoint: the rebuilt core resumes "
+                "event-grained decisions without a cold re-learn and "
+                "without ever publishing a scale-to-zero flap"),
+            expected_path="healthy -> restart (warm checkpoint restore, "
+                          "one backstop pass) -> healthy; goodput loss "
+                          "is bounded actuation lag, never a zero flap",
+            seed=108,
+            streaming=True,
+            faults=(
+                FaultRule(kind=CONTROLLER_RESTART,
+                          after_s=240.0, until_s=250.0),
+            ),
+            operator={**_STEP, "WVA_STREAM_DEBOUNCE_MS": "0"},
+            goodput_floor=0.45,
         ),
     )
 }

@@ -46,8 +46,13 @@ same scenario is byte-identical, which tests/test_chaos.py asserts.
 
 from __future__ import annotations
 
+import io
 import json
 import math
+import os
+import random
+import shutil
+import tempfile
 from dataclasses import dataclass, field
 
 from ..controller import (
@@ -64,7 +69,14 @@ from ..controller import (
 from ..collector import collect_inventory_k8s
 from ..controller.degradation import DegradationState
 from ..controller.kube import Node
-from ..faults import FaultPlan
+from ..faults import (
+    CONTROLLER_RESTART,
+    STREAM_KINDS,
+    FaultPlan,
+    corrupt_stream_body,
+    skew_stream_timestamp,
+    stream_flood_multiplier,
+)
 from ..metrics import MetricsEmitter
 from ..obs.decision import (
     GOODPUT_DEGRADED,
@@ -339,20 +351,23 @@ def _slice_config(spec: VariantSpec) -> SliceModelConfig:
     )
 
 
-def _operator_cm(scenario: Scenario) -> dict[str, str]:
+def _operator_cm(scenario: Scenario,
+                 extra: dict[str, str] | None = None) -> dict[str, str]:
     interval = f"{scenario.reconcile_interval_s:.0f}s"
-    operator = {"GLOBAL_OPT_INTERVAL": interval, **scenario.operator}
+    operator = {"GLOBAL_OPT_INTERVAL": interval, **scenario.operator,
+                **(extra or {})}
     if scenario.limited_mode:
         operator.setdefault("WVA_LIMITED_MODE", "true")
     return operator
 
 
-def _seed_kube(scenario: Scenario, kube: InMemoryKube) -> None:
+def _seed_kube(scenario: Scenario, kube: InMemoryKube,
+               operator_extra: dict[str, str] | None = None) -> None:
     """ConfigMaps, Deployments, VAs, and node pools for the scenario —
     the same wiring shape the closed-loop e2e tests use, generalized to
     many variants/generations."""
     kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
-                                 _operator_cm(scenario)))
+                                 _operator_cm(scenario, operator_extra)))
 
     # slice-shape catalog: spot-priced when any variant on the shape is
     # spot (the scenarios never mix pricing on one shape)
@@ -416,8 +431,31 @@ def _seed_kube(scenario: Scenario, kube: InMemoryKube) -> None:
 def run_scenario(scenario: Scenario) -> ScenarioResult:
     """Run one scenario to completion and return its goodput ledger."""
     plan = FaultPlan(list(scenario.faults), seed=scenario.seed)
+    restart_rules = [r for r in plan.rules
+                     if r.kind == CONTROLLER_RESTART]
+    operator_extra: dict[str, str] = {}
+    ckpt_dir = None
+    if restart_rules and scenario.streaming and \
+            "WVA_STREAM_CHECKPOINT" not in scenario.operator:
+        # restart scenarios get a warm-restart checkpoint by default;
+        # the path never enters the result, so reruns stay
+        # byte-identical
+        ckpt_dir = tempfile.mkdtemp(prefix="wva-twin-ckpt-")
+        operator_extra["WVA_STREAM_CHECKPOINT"] = \
+            os.path.join(ckpt_dir, "stream.ckpt")
+    try:
+        return _run_scenario(scenario, plan, restart_rules,
+                             operator_extra)
+    finally:
+        if ckpt_dir is not None:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def _run_scenario(scenario: Scenario, plan: FaultPlan,
+                  restart_rules: list, operator_extra: dict[str, str],
+                  ) -> ScenarioResult:
     kube = InMemoryKube()
-    _seed_kube(scenario, kube)
+    _seed_kube(scenario, kube, operator_extra)
     kube.attach_fault_plan(plan)
 
     sinks: list[PrometheusSink] = []
@@ -635,15 +673,53 @@ def run_scenario(scenario: Scenario) -> ScenarioResult:
     core = None
     if scenario.streaming:
         from ..collector import collect_load
-        from ..stream import StreamCore
+        from ..stream import (
+            REMOTE_WRITE_PATH,
+            STREAM_SERIES,
+            ShedError,
+            StreamCore,
+            encode_write_request,
+            remote_write_middleware,
+            snappy_compress,
+        )
+        from ..stream.core import _LOAD_FIELDS
 
-        # the core reads its debounce knob from the last-seen operator
-        # CM; seed it so the scenario's value applies before the first
-        # full pass has populated it
-        rec.state.last_operator_cm = _operator_cm(scenario)
-        core = StreamCore(rec, clock=lambda: sim.now_ms / 1000.0)
-        rec.stream_core = core
-        core.on_cycle_start(begin_cycle)
+        def build_core() -> StreamCore:
+            # the core reads its knobs (debounce, caps, checkpoint path)
+            # from the last-seen operator CM; seed it so the scenario's
+            # values apply before the first full pass has populated it —
+            # and so a restarted core finds its checkpoint knob
+            rec.state.last_operator_cm = _operator_cm(scenario,
+                                                      operator_extra)
+            c = StreamCore(rec, clock=lambda: sim.now_ms / 1000.0)
+            rec.stream_core = c
+            c.on_cycle_start(begin_cycle)
+            return c
+
+        core = build_core()
+        # stream faults perturb with a twin-owned rng, so the plan's
+        # per-rule streams stay aligned with non-streaming scenarios
+        has_stream_faults = any(r.kind in STREAM_KINDS
+                                for r in plan.rules)
+        flood_rng = random.Random(scenario.seed * 7919 + 17)
+
+        def push_group(model: str, ns: str, fields: dict,
+                       ts_ms: float = 0.0) -> None:
+            try:
+                core.ingest_push(model, ns, fields, ts_ms=ts_ms)
+            except ShedError:
+                pass   # metered at the door; the backstop re-covers it
+
+        def post_door(body: bytes) -> None:
+            """POST raw bytes through the REAL remote-write door, so the
+            corrupt-payload defense under test is the production WSGI
+            path (400 + decode-error metering), not a twin re-creation."""
+            app = remote_write_middleware(core)(None)
+            app({"PATH_INFO": REMOTE_WRITE_PATH,
+                 "REQUEST_METHOD": "POST",
+                 "CONTENT_LENGTH": str(len(body)),
+                 "wsgi.input": io.BytesIO(body)},
+                lambda status, headers: None)
 
         def push_loads(now_ms: float) -> None:
             for v in scenario.variants:
@@ -651,11 +727,76 @@ def run_scenario(scenario: Scenario) -> ScenarioResult:
                     load = collect_load(prom, v.model, v.namespace)
                 except Exception:  # noqa: BLE001 — ingest is best-effort
                     continue       # the backstop pass still covers it
-                core.observe_load(v.model, v.namespace, load)
+                if not has_stream_faults:
+                    core.observe_load(v.model, v.namespace, load)
+                    continue
+                fields = {f: getattr(load, f) for f in _LOAD_FIELDS}
+                body = snappy_compress(encode_write_request([
+                    ({"__name__": name, "model_name": v.model,
+                      "namespace": v.namespace},
+                     [(fields[fld], int(now_ms))])
+                    for name, fld in STREAM_SERIES.items()]))
+                shredded = corrupt_stream_body(plan, body)
+                if shredded is not body:
+                    post_door(shredded)
+                    continue
+                ts = skew_stream_timestamp(plan, v.model, v.namespace,
+                                           now_ms)
+                push_group(v.model, v.namespace, fields,
+                           ts_ms=ts if ts != now_ms else 0.0)
+                mult = stream_flood_multiplier(plan, v.model,
+                                               v.namespace)
+                for k in range(mult - 1):
+                    jittered = dict(fields)
+                    jittered["arrival_rate_rpm"] = \
+                        fields["arrival_rate_rpm"] * \
+                        flood_rng.uniform(0.8, 1.2)
+                    if k % 2:
+                        # phantom groups: a relabeling storm minting
+                        # ever-new identities, the attack the store cap
+                        # absorbs (store-full sheds once it saturates)
+                        push_group(
+                            f"{v.model}--flood-"
+                            f"{flood_rng.randrange(1_000_000)}",
+                            v.namespace, jittered)
+                    else:
+                        push_group(v.model, v.namespace, jittered)
+
+    restarted: set[int] = set()
+
+    def pending_restart():
+        for r in restart_rules:
+            if id(r) in restarted:
+                continue
+            if r.in_window(plan.cycle, plan.now_s):
+                return r
+        return None
+
+    def restart_controller(now_ms: float) -> None:
+        """The controller process dies and comes back: fresh Reconciler,
+        fresh emitter/decision log (in-memory state is gone), fresh
+        StreamCore — warm via the checkpoint when the scenario carries
+        one. Cluster (kube) and telemetry (prom) survive, of course."""
+        nonlocal rec, core, emitter
+        plan.controller_restart()     # record the trip in the evidence
+        log.info("controller restart injected",
+                 extra=kv(scenario=scenario.name, cycle=cycle,
+                          t_s=now_ms / 1000.0))
+        emitter = MetricsEmitter()
+        rec = Reconciler(kube=kube, prom=prom, emitter=emitter,
+                         now=lambda: sim.now_ms / 1000.0,
+                         sleep=lambda _s: None)
+        if scenario.streaming:
+            core = build_core()
 
     def on_tick(now_ms: float) -> None:
         nonlocal next_reconcile
         prom.scrape(now_ms)
+        if restart_rules:
+            rule = pending_restart()
+            if rule is not None:
+                restarted.add(id(rule))
+                restart_controller(now_ms)
         meter_tick(now_ms)
         if core is not None:
             push_loads(now_ms)

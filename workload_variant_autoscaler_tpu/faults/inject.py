@@ -14,11 +14,21 @@ Two mechanisms, one plan:
 `exception_for_kube_fault` is the single mapping from a scheduled kube
 fault kind to the exception a real apiserver client would surface, so the
 in-memory hook and any future RestKube-level wrapper cannot diverge.
+
+Streaming-ingest faults (stream-flood / stream-corrupt-payload /
+stream-clock-skew) are consulted by the harness that FEEDS the stream
+(the twin's push_loads tick, the chaos tests' senders) rather than by
+the core itself — the point is to batter the real door from outside, so
+the shedding/quarantine defenses under test stay byte-identical to
+production. `stream_flood_multiplier`, `corrupt_stream_body`, and
+`skew_stream_timestamp` are those senders' single source of truth.
 """
 
 from __future__ import annotations
 
 import math
+import random
+import zlib
 
 from ..collector.prometheus import PromAPI, Sample
 from ..obs.trace import add_event
@@ -86,6 +96,58 @@ def apply_prom_fault(plan: FaultPlan | None, promql: str,
     # as a broken scrape, not as fresh truth
     return [Sample(labels=s.labels, value=s.value,
                    timestamp=s.timestamp - rule.skew_s) for s in samples]
+
+
+def stream_flood_multiplier(plan: FaultPlan | None, model: str,
+                            ns: str) -> int:
+    """How many times the sender should replay this group's push right
+    now (1 = no flood). The multiplier rides the rule's labels
+    ({"multiplier": N}, default 100) so one rule describes the whole
+    flash crowd."""
+    if plan is None:
+        return 1
+    rule = plan.stream_fault(plan_mod.STREAM_FLOOD, f"{model}:{ns}")
+    if rule is None:
+        return 1
+    add_event("fault-injected", dependency=plan_mod.DEP_STREAM,
+              kind=rule.kind, match=rule.match, target=f"{model}:{ns}")
+    return rule.multiplier()
+
+
+def corrupt_stream_body(plan: FaultPlan | None, body: bytes) -> bytes:
+    """Shred a remote-write body per an active stream-corrupt-payload
+    window: seeded bit flips (plus a guaranteed non-empty result, so an
+    empty body still arrives broken). Deterministic per (plan.seed,
+    body) — byte-identical chaos reruns are a suite invariant."""
+    if plan is None:
+        return body
+    rule = plan.stream_fault(plan_mod.STREAM_CORRUPT)
+    if rule is None:
+        return body
+    add_event("fault-injected", dependency=plan_mod.DEP_STREAM,
+              kind=rule.kind, match=rule.match, bytes=len(body))
+    rng = random.Random(
+        ((plan.seed * 1_000_003) ^ zlib.crc32(body)) & 0xFFFFFFFF)
+    out = bytearray(body or b"\x00")
+    for _ in range(max(1, len(out) // 64)):
+        out[rng.randrange(len(out))] ^= 1 << rng.randrange(8)
+    return bytes(out)
+
+
+def skew_stream_timestamp(plan: FaultPlan | None, model: str, ns: str,
+                          ts_ms: float) -> float:
+    """Shift a streamed sample timestamp `skew_s` into the FUTURE per an
+    active stream-clock-skew window (a pushing ingester with a broken
+    clock; the quarantine vet must refuse it, where prom-clock-skew's
+    past shift tests the staleness gate instead)."""
+    if plan is None:
+        return ts_ms
+    rule = plan.stream_fault(plan_mod.STREAM_CLOCK_SKEW, f"{model}:{ns}")
+    if rule is None:
+        return ts_ms
+    add_event("fault-injected", dependency=plan_mod.DEP_STREAM,
+              kind=rule.kind, match=rule.match, target=f"{model}:{ns}")
+    return ts_ms + rule.skew_s * 1000.0
 
 
 class FaultyPromAPI:

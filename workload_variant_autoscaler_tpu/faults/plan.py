@@ -2,7 +2,8 @@
 
 A `FaultPlan` is a declarative schedule of dependency misbehavior —
 Prometheus timeouts, partial series, NaN samples, clock-skewed scrapes,
-kube 409-conflict storms, watch-stream drops, ConfigMap disappearance —
+kube 409-conflict storms, watch-stream drops, ConfigMap disappearance,
+remote-write floods, corrupted stream payloads, controller restarts —
 that the injection hooks (faults/inject.py, InMemoryKube.attach_fault_plan,
 SimPromAPI(fault_plan=...), the emulator server's WVA_FAULT_PLAN env)
 consult at call time. The SAME plan object (or its JSON form) drives unit
@@ -31,6 +32,8 @@ DEP_PROMETHEUS = "prometheus"
 DEP_KUBE = "kube"
 DEP_WATCH = "watch"
 DEP_NODE_POOL = "node-pool"
+DEP_STREAM = "stream"
+DEP_CONTROLLER = "controller"
 
 # fault kinds (the fault matrix; see docs/robustness.md)
 PROM_TIMEOUT = "prom-timeout"        # query raises TimeoutError
@@ -59,18 +62,44 @@ SPOT_RECLAIM = "spot-reclaim"        # matching nodes vanish from LISTs
                                      # per-node draw is stable for the
                                      # whole window — a reclaimed node
                                      # stays gone, it does not flap)
+STREAM_FLOOD = "stream-flood"        # remote-write arrival amplification:
+                                     # the streaming hooks replay each
+                                     # matching push N× per tick with
+                                     # seeded per-copy jitter plus
+                                     # phantom groups (a flash crowd or
+                                     # a misconfigured relabeling storm);
+                                     # N via labels {"multiplier": N},
+                                     # default 100
+STREAM_CORRUPT = "stream-corrupt-payload"  # matching remote-write bodies
+                                     # have seeded byte flips applied
+                                     # before decode (a proxy shredding
+                                     # frames; the door must 400, meter,
+                                     # and keep serving)
+STREAM_CLOCK_SKEW = "stream-clock-skew"  # streamed sample timestamps
+                                     # shifted by skew_s into the future
+                                     # (an ingester with a broken clock;
+                                     # quarantine must catch it)
+CONTROLLER_RESTART = "controller-restart"  # the controller process dies
+                                     # and restarts at the window edge:
+                                     # the harness rebuilds Reconciler +
+                                     # StreamCore from scratch (warm via
+                                     # WVA_STREAM_CHECKPOINT if set)
 
 PROM_KINDS = (PROM_TIMEOUT, PROM_PARTIAL, PROM_NAN, PROM_CLOCK_SKEW,
               PROM_LABEL_DROP, PROM_OUTAGE)
 KUBE_KINDS = (KUBE_CONFLICT, KUBE_ERROR, KUBE_NOT_FOUND)
 NODE_POOL_KINDS = (NODE_POOL_DRAIN, SPOT_RECLAIM)
-ALL_KINDS = PROM_KINDS + KUBE_KINDS + NODE_POOL_KINDS + (WATCH_DROP,)
+STREAM_KINDS = (STREAM_FLOOD, STREAM_CORRUPT, STREAM_CLOCK_SKEW)
+ALL_KINDS = PROM_KINDS + KUBE_KINDS + NODE_POOL_KINDS \
+    + STREAM_KINDS + (WATCH_DROP, CONTROLLER_RESTART)
 
 _KIND_DEPS = {
     **{k: DEP_PROMETHEUS for k in PROM_KINDS},
     **{k: DEP_KUBE for k in KUBE_KINDS},
     **{k: DEP_NODE_POOL for k in NODE_POOL_KINDS},
+    **{k: DEP_STREAM for k in STREAM_KINDS},
     WATCH_DROP: DEP_WATCH,
+    CONTROLLER_RESTART: DEP_CONTROLLER,
 }
 
 
@@ -118,14 +147,26 @@ class FaultRule:
         if not 0.0 <= self.probability <= 1.0:
             raise ValueError(f"probability must be in [0,1], got "
                              f"{self.probability}")
-        if self.kind == PROM_CLOCK_SKEW and self.skew_s <= 0.0:
-            raise ValueError("prom-clock-skew needs skew_s > 0")
+        if self.kind in (PROM_CLOCK_SKEW, STREAM_CLOCK_SKEW) \
+                and self.skew_s <= 0.0:
+            raise ValueError(f"{self.kind} needs skew_s > 0")
         if self.kind == PROM_LABEL_DROP and not self.labels:
             raise ValueError("prom-label-drop needs a non-empty labels map")
+        if self.kind == STREAM_FLOOD and self.labels:
+            mult = self.labels.get("multiplier", 1)
+            if not isinstance(mult, (int, float)) or mult < 1:
+                raise ValueError("stream-flood multiplier must be >= 1")
 
     @property
     def dep(self) -> str:
         return _KIND_DEPS[self.kind]
+
+    def multiplier(self) -> int:
+        """stream-flood amplification factor (labels {"multiplier": N},
+        default 100 — the seeded flash-crowd scale the bench pins)."""
+        if self.labels and "multiplier" in self.labels:
+            return max(int(self.labels["multiplier"]), 1)
+        return 100
 
     def in_window(self, cycle: int, now_s: float) -> bool:
         if cycle < self.after_cycle:
@@ -227,6 +268,19 @@ class FaultPlan:
     def watch_dropping(self) -> bool:
         """True while a watch-drop window is active (events swallowed)."""
         return self._active((WATCH_DROP,), "") is not None
+
+    def stream_fault(self, kind: str, text: str = "") -> Optional[FaultRule]:
+        """First active streaming-ingest rule of `kind` matching `text`
+        ("model:namespace" for flood/skew, "" for corrupt-payload which
+        intercepts whole request bodies), or None."""
+        return self._active((kind,), text)
+
+    def controller_restart(self) -> Optional[FaultRule]:
+        """First active controller-restart rule, or None. The harness
+        restarts the controller ONCE per rule window (tracking which
+        windows already fired is the harness's job — a dead process
+        cannot consult a plan)."""
+        return self._active((CONTROLLER_RESTART,), "")
 
     def node_fault(self, node_name: str, pool: str) -> Optional[FaultRule]:
         """First active node-pool rule (drain/reclaim) covering this node,

@@ -168,6 +168,15 @@ INFERNO_HOST_DEVICE_TRANSFERS_TOTAL = "inferno_host_device_transfers_total"
 # reaction-latency distribution the event-driven core exists to shrink
 INFERNO_STREAM_EVENTS_TOTAL = "inferno_stream_events_total"
 INFERNO_STREAM_LAG_SECONDS = "inferno_stream_lag_seconds"
+# streaming overload/quarantine accounting (docs/robustness.md,
+# "Streaming fault matrix"): every event the ingest door refuses is
+# COUNTED with a reason, never silently dropped — the shed counter plus
+# a converging backstop pass is the overload contract; the checkpoint
+# counter makes warm-restart outcomes (restored vs discarded) alertable;
+# the debounce gauge shows the adaptive window widening under a storm
+INFERNO_STREAM_SHED_TOTAL = "inferno_stream_shed_total"
+INFERNO_STREAM_CHECKPOINT_TOTAL = "inferno_stream_checkpoint_total"
+INFERNO_STREAM_DEBOUNCE_MS = "inferno_stream_debounce_ms"
 
 LABEL_DEPENDENCY = "dependency"
 LABEL_OUTCOME = "outcome"
@@ -187,6 +196,40 @@ SOURCE_WATCH = "watch"
 SOURCE_BACKSTOP = "backstop"
 STREAM_SOURCES = (SOURCE_REMOTE_WRITE, SOURCE_SCRAPE, SOURCE_WATCH,
                   SOURCE_BACKSTOP)
+
+LABEL_REASON = "reason"
+# the single source of truth for stream shed reasons (the `reason`
+# label values of inferno_stream_shed_total): overload shedding first,
+# quarantine verdicts second, codec/poller failures last
+SHED_BODY_TOO_LARGE = "body-too-large"
+SHED_STORE_FULL = "store-full"
+SHED_QUEUE_FULL = "queue-full"
+SHED_DECODE_ERROR = "decode-error"
+SHED_QUARANTINE_NAN = "quarantine-nan"
+SHED_QUARANTINE_NEGATIVE = "quarantine-negative"
+SHED_QUARANTINE_TIMESTAMP = "quarantine-timestamp"
+SHED_QUARANTINE_LABELS = "quarantine-labels"
+SHED_SOURCE_QUARANTINED = "source-quarantined"
+SHED_SCRAPE_ERROR = "scrape-error"
+STREAM_SHED_REASONS = (
+    SHED_BODY_TOO_LARGE, SHED_STORE_FULL, SHED_QUEUE_FULL,
+    SHED_DECODE_ERROR, SHED_QUARANTINE_NAN, SHED_QUARANTINE_NEGATIVE,
+    SHED_QUARANTINE_TIMESTAMP, SHED_QUARANTINE_LABELS,
+    SHED_SOURCE_QUARANTINED, SHED_SCRAPE_ERROR,
+)
+
+LABEL_EVENT = "event"
+# checkpoint lifecycle events (the `event` label values of
+# inferno_stream_checkpoint_total): a restore either succeeds or the
+# file is explicitly discarded with the reason class
+CHECKPOINT_SAVE = "save"
+CHECKPOINT_RESTORE = "restore"
+CHECKPOINT_DISCARD_CORRUPT = "discard-corrupt"
+CHECKPOINT_DISCARD_STALE = "discard-stale"
+STREAM_CHECKPOINT_EVENTS = (
+    CHECKPOINT_SAVE, CHECKPOINT_RESTORE,
+    CHECKPOINT_DISCARD_CORRUPT, CHECKPOINT_DISCARD_STALE,
+)
 
 LABEL_CONDITION_TYPE = "type"
 
@@ -323,12 +366,13 @@ class MetricsEmitter:
         )
         # degradation ladder (docs/robustness.md): the rung each variant
         # — and the whole cycle — landed on, so "fleet is degraded" is an
-        # alertable series, not a log-grep (0=healthy 1=stale-cache
-        # 2=limited 3=hold)
+        # alertable series, not a log-grep (0=healthy 1=stream-degraded
+        # 2=stale-cache 3=limited 4=hold)
         self.degradation_state = Gauge(
             INFERNO_DEGRADATION_STATE,
             "Degradation-ladder rung the variant's last cycle landed on "
-            "(0=healthy, 1=stale-cache, 2=limited, 3=hold)",
+            "(0=healthy, 1=stream-degraded, 2=stale-cache, 3=limited, "
+            "4=hold)",
             [LABEL_VARIANT_NAME, LABEL_NAMESPACE],
             registry=self.registry,
         )
@@ -460,6 +504,32 @@ class MetricsEmitter:
             "streaming core to the re-sized allocation being published",
             buckets=(0.01, 0.025, 0.05, 0.075, 0.1, 0.25, 0.5, 1.0,
                      2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+            registry=self.registry,
+        )
+        # overload/quarantine shedding + warm-restart checkpoint
+        # lifecycle + the adaptive debounce window: the three series that
+        # make "streaming under fire" observable (docs/robustness.md)
+        self.stream_shed = Counter(
+            INFERNO_STREAM_SHED_TOTAL.removesuffix("_total"),
+            "Events the streaming ingest door refused, by reason "
+            "(overload caps, quarantine verdicts, decode failures, "
+            "scrape-poller errors) — shed work is metered here and "
+            "re-covered by a backstop/scrape pass, never silently lost",
+            [LABEL_REASON], registry=self.registry,
+        )
+        self.stream_checkpoint = Counter(
+            INFERNO_STREAM_CHECKPOINT_TOTAL.removesuffix("_total"),
+            "Warm-restart checkpoint lifecycle events (save: state "
+            "persisted after a cycle; restore: a restart resumed scoped "
+            "operation; discard-corrupt/discard-stale: the file was "
+            "rejected and the controller cold-started)",
+            [LABEL_EVENT], registry=self.registry,
+        )
+        self.stream_debounce_ms = Gauge(
+            INFERNO_STREAM_DEBOUNCE_MS,
+            "Effective debounce window of the streaming core in "
+            "milliseconds — widens adaptively under sustained event "
+            "storms, narrows back with hysteresis when the storm ebbs",
             registry=self.registry,
         )
         # perf-model drift (beyond-reference: the reference never compares
@@ -606,6 +676,20 @@ class MetricsEmitter:
     def emit_stream_lag(self, seconds: float) -> None:
         """One consumed load change's observed->published wall time."""
         self.stream_lag.observe(seconds)
+
+    def emit_stream_shed(self, reason: str) -> None:
+        """One event refused at the streaming ingest door. Thread-safe
+        by construction — called from ingest WSGI threads, the scrape
+        poller, and the consumer's escalation valve alike."""
+        self.stream_shed.labels(**{LABEL_REASON: reason}).inc()
+
+    def emit_stream_checkpoint(self, event: str) -> None:
+        """One warm-restart checkpoint lifecycle event."""
+        self.stream_checkpoint.labels(**{LABEL_EVENT: event}).inc()
+
+    def emit_stream_debounce_ms(self, value: float) -> None:
+        """Publish the adaptive debounce window currently in effect."""
+        self.stream_debounce_ms.set(value)
 
     def emit_pool_capacity_metrics(self, capacity: dict[str, int]) -> None:
         """Replace the per-generation inventory gauge wholesale each

@@ -12,7 +12,8 @@ See docs/observability.md ("Streaming reconcile") for the operational
 story and docs/user-guide/configuration.md for the knobs.
 """
 
-from .core import FALLBACK_INTERVAL_S, StreamCore
+from .checkpoint import CheckpointError, load_checkpoint, save_checkpoint
+from .core import FALLBACK_INTERVAL_S, ShedError, StreamCore
 from .ingest import (
     REMOTE_WRITE_PATH,
     STREAM_SERIES,
@@ -31,6 +32,7 @@ from .remotewrite import (
 from .state import FleetSnapshot, StreamState
 
 __all__ = [
+    "CheckpointError",
     "DebouncedQueue",
     "Drained",
     "FALLBACK_INTERVAL_S",
@@ -39,13 +41,16 @@ __all__ = [
     "REMOTE_WRITE_PATH",
     "STREAM_SERIES",
     "ScrapePoller",
+    "ShedError",
     "StreamCore",
     "StreamState",
     "WireError",
     "encode_write_request",
     "ingest_write_request",
+    "load_checkpoint",
     "parse_write_request",
     "remote_write_middleware",
+    "save_checkpoint",
     "snappy_compress",
     "snappy_decompress",
 ]

@@ -1,0 +1,95 @@
+"""Crash-safe warm-restart checkpoint: versioned, CRC-guarded, atomic.
+
+A controller restart under load used to discard ALL cross-cycle state —
+the resident `FleetSnapshot`, the scale-down stabilization history, the
+consumed-signature store — forcing a cold full pass and inviting a
+decision flap exactly when the fleet is least stable. This module
+persists that state (`WVA_STREAM_CHECKPOINT`) so a restarted streaming
+core resumes SCOPED operation where the old process stopped.
+
+File format, designed for torn writes and version drift:
+
+    line 1   JSON header: {"magic": "wva-stream-ckpt", "version": 1,
+             "crc": <crc32 of the body bytes>}
+    line 2+  JSON body (one object, the core's checkpoint payload)
+
+- **Atomic**: the file is written to `<path>.tmp` and `os.replace`d
+  into place, so a crash mid-save leaves the previous checkpoint
+  intact, never a half-written one.
+- **Torn-write tolerant**: a truncated or bit-flipped file fails the
+  CRC (or the JSON parse) and is DISCARDED — the caller falls back to
+  today's cold full pass. A checkpoint can only ever be wrong by being
+  absent, never by being silently corrupt.
+- **Versioned**: an unknown `version` (an old binary reading a new
+  file, or vice versa) is discarded the same way. No migration logic —
+  a cold start costs one backstop pass.
+
+Staleness is the CALLER's policy (the core compares the payload's
+wall-clock `taken_at` against `WVA_STREAM_CHECKPOINT_MAX_AGE_S`): this
+module only guarantees that what loads is exactly what was saved.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+CHECKPOINT_MAGIC = "wva-stream-ckpt"
+CHECKPOINT_VERSION = 1
+
+
+class CheckpointError(ValueError):
+    """Unusable checkpoint file (missing, torn, corrupt, or from an
+    incompatible version) — the caller discards and cold-starts."""
+
+
+def save_checkpoint(path: str, payload: dict) -> None:
+    """Serialize `payload` to `path` atomically. Raises OSError on an
+    unwritable destination; never leaves a partial file behind."""
+    body = json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    header = json.dumps({
+        "magic": CHECKPOINT_MAGIC,
+        "version": CHECKPOINT_VERSION,
+        "crc": zlib.crc32(body) & 0xFFFFFFFF,
+    }, sort_keys=True, separators=(",", ":")).encode("utf-8")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(header + b"\n" + body)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str) -> dict:
+    """Read and verify a checkpoint. Raises CheckpointError on ANY
+    defect (absent file included) — callers treat every failure mode
+    identically: discard and cold-start."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        raise CheckpointError(f"unreadable checkpoint: {e}") from e
+    head, sep, body = raw.partition(b"\n")
+    if not sep:
+        raise CheckpointError("torn checkpoint: missing body")
+    try:
+        header = json.loads(head)
+    except ValueError as e:
+        raise CheckpointError(f"corrupt checkpoint header: {e}") from e
+    if not isinstance(header, dict) \
+            or header.get("magic") != CHECKPOINT_MAGIC:
+        raise CheckpointError("not a stream checkpoint")
+    if header.get("version") != CHECKPOINT_VERSION:
+        raise CheckpointError(
+            f"unsupported checkpoint version {header.get('version')!r}")
+    if header.get("crc") != zlib.crc32(body) & 0xFFFFFFFF:
+        raise CheckpointError("checkpoint CRC mismatch (torn write?)")
+    try:
+        payload = json.loads(body)
+    except ValueError as e:
+        raise CheckpointError(f"corrupt checkpoint body: {e}") from e
+    if not isinstance(payload, dict):
+        raise CheckpointError("checkpoint body is not an object")
+    return payload

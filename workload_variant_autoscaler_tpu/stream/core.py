@@ -30,21 +30,52 @@ Scoped solving is sound when per-variant decisions are separable —
 always true in unlimited mode (each variant independently picks its
 best allocation). In limited mode capacity couples variants, so every
 event batch ESCALATES to a full pass (still debounced, still
-event-driven — only the scope widens).
+event-driven — only the scope widens), and concurrent escalations
+COALESCE into one pending backstop pass so a flood costs one full
+cycle, not N.
+
+Streaming under fire (docs/robustness.md, "Streaming fault matrix"):
+the core survives three failure families the happy path ignores.
+
+- **Overload.** The ingest store is capped (`WVA_STREAM_MAX_GROUPS`)
+  and the queue depth-bounded (`WVA_STREAM_MAX_QUEUE`); refused events
+  are METERED on `inferno_stream_shed_total{reason}` and folded into a
+  full-pass request, never silently lost. Sustained storms widen the
+  debounce window adaptively (up to `WVA_STREAM_MAX_DEBOUNCE_MS`, with
+  hysteresis back down), and an escalation valve — queue saturation or
+  a pending event older than `WVA_STREAM_LAG_BUDGET_MS` — coalesces
+  the whole backlog into ONE backstop full pass instead of churning
+  scoped micro-cycles. Every such transition surfaces as the
+  `stream-degraded` rung on the degradation ladder.
+- **Poisoned input.** Semantically-poisoned observations (NaN/inf,
+  negative loads, out-of-order or far-future sample timestamps) are
+  quarantined at the door; repeated poison trips a per-source
+  `CircuitBreaker` (`WVA_STREAM_QUARANTINE_THRESHOLD`), closing the
+  push door (HTTP 429) while the `ScrapePoller` fallback covers the
+  fleet until the breaker half-opens.
+- **Crash.** After each cycle the core checkpoints its resident state
+  (`WVA_STREAM_CHECKPOINT`, stream/checkpoint.py): a restart restores
+  the snapshot, the cross-cycle decision state, and the consumed
+  signatures, resuming SCOPED operation without a decision flap.
+  Corrupt or stale (`WVA_STREAM_CHECKPOINT_MAX_AGE_S`) checkpoints are
+  discarded — metered, cold full pass, exactly today's behavior.
 
 Observability: every ingested delta counts on
-`inferno_stream_events_total{source}`; every consumed change observes
+`inferno_stream_events_total{source}`; every refused one on
+`inferno_stream_shed_total{reason}`; every consumed change observes
 load-change-seen -> allocation-published wall time on
 `inferno_stream_lag_seconds`. Each micro-cycle is its own flight-
 recorder trace (a `reconcile` root span carrying `stream_scope`), so
 `/debug/traces` shows per-event mini-traces between backstop cycles.
 
-Thread contract: `observe_load`/`ingest_fields`/`note_kick` may be
-called from any thread (ingest WSGI workers, the scrape poller, watch
-listeners); everything they touch is behind `self._lock` or the
-queue's own lock (wvalint WVL404 enforces this package-wide).
-`process_once`/`run` belong to the single consumer thread, which is
-the only thread that ever calls into the Reconciler.
+Thread contract: `observe_load`/`ingest_fields`/`ingest_push`/
+`note_kick` may be called from any thread (ingest WSGI workers, the
+scrape poller, watch listeners); everything they touch is behind
+`self._lock` or the queue's own lock (wvalint WVL404 enforces this
+package-wide; WVL405 additionally demands a visible bound on every
+container a stream class grows in a loop). `process_once`/`run` belong
+to the single consumer thread, which is the only thread that ever
+calls into the Reconciler.
 """
 
 from __future__ import annotations
@@ -56,6 +87,15 @@ from typing import Optional
 
 from ..collector import CollectedLoad
 from ..metrics import (
+    CHECKPOINT_DISCARD_CORRUPT,
+    CHECKPOINT_DISCARD_STALE,
+    CHECKPOINT_RESTORE,
+    CHECKPOINT_SAVE,
+    SHED_QUARANTINE_NAN,
+    SHED_QUARANTINE_NEGATIVE,
+    SHED_QUARANTINE_TIMESTAMP,
+    SHED_QUEUE_FULL,
+    SHED_STORE_FULL,
     SOURCE_BACKSTOP,
     SOURCE_REMOTE_WRITE,
     SOURCE_SCRAPE,
@@ -63,8 +103,10 @@ from ..metrics import (
 )
 from ..solver.incremental import DEFAULT_EPSILON, quantize
 from ..utils import get_logger, kv, parse_float_or
+from ..utils.backoff import CircuitBreaker
+from .checkpoint import CheckpointError, load_checkpoint, save_checkpoint
 from .queue import DebouncedQueue
-from .state import StreamState
+from .state import FleetSnapshot, StreamState
 
 log = get_logger("wva.stream")
 
@@ -76,12 +118,44 @@ DEFAULT_DEBOUNCE_MS = 25.0
 # interval (mirrors controller.reconciler.DEFAULT_INTERVAL_SECONDS)
 FALLBACK_INTERVAL_S = 60.0
 
+# overload / quarantine / checkpoint knob defaults (each overridable by
+# env or operator ConfigMap; docs/user-guide/configuration.md)
+DEFAULT_MAX_GROUPS = 4096.0       # WVA_STREAM_MAX_GROUPS
+DEFAULT_MAX_QUEUE = 1024.0        # WVA_STREAM_MAX_QUEUE
+DEFAULT_MAX_BODY_BYTES = 1048576.0   # WVA_STREAM_MAX_BODY_BYTES (1 MiB)
+DEFAULT_LAG_BUDGET_MS = 5000.0    # WVA_STREAM_LAG_BUDGET_MS
+DEFAULT_MAX_DEBOUNCE_MS = 250.0   # WVA_STREAM_MAX_DEBOUNCE_MS
+DEFAULT_STORM_EVENTS = 256.0      # WVA_STREAM_STORM_EVENTS
+DEFAULT_QUARANTINE_THRESHOLD = 8.0   # WVA_STREAM_QUARANTINE_THRESHOLD
+DEFAULT_CHECKPOINT_MAX_AGE_S = 120.0  # WVA_STREAM_CHECKPOINT_MAX_AGE_S
+# hard literal ceilings backing the knob-derived caps: whatever the
+# ConfigMap says, no stream container outgrows these (wvalint WVL405)
+HARD_MAX_GROUPS = 65536
+HARD_MAX_QUEUE = 65536
+# a pushed sample stamped further than this into the future is poison
+# (a skewed sender clock would otherwise pin "newest wins" forever)
+FAR_FUTURE_SLACK_S = 60.0
+
 _LOAD_FIELDS = ("arrival_rate_rpm", "avg_input_tokens",
                 "avg_output_tokens", "avg_ttft_ms", "avg_itl_ms")
 # a load is solvable once the sizing inputs exist; latency series are
 # advisory (status/drift display) and default to the last seen value
 _REQUIRED_FIELDS = ("arrival_rate_rpm", "avg_input_tokens",
                     "avg_output_tokens")
+
+# stream-pressure causes that are not 1:1 with a shed reason
+PRESSURE_LAG_BUDGET = "lag-budget"
+PRESSURE_FLOOD = "flood"
+PRESSURE_LIMITED_COALESCE = "limited-coalesce"
+
+
+class ShedError(RuntimeError):
+    """An event refused at the ingest door; `reason` is the
+    inferno_stream_shed_total label value already metered for it."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.reason = reason
 
 
 @dataclass
@@ -92,6 +166,9 @@ class _Accum:
     fields: dict = field(default_factory=dict)
     updated_at: float = 0.0
     consumed_sig: Optional[tuple] = None
+    # newest admitted sample wall-clock timestamp (ms; 0 = never
+    # stamped) — the out-of-order quarantine baseline
+    sample_ts_ms: float = 0.0
 
     def load(self) -> Optional[CollectedLoad]:
         if any(f not in self.fields for f in _REQUIRED_FIELDS):
@@ -135,13 +212,33 @@ class StreamCore:
             debounce_s = self._knob("WVA_STREAM_DEBOUNCE_MS",
                                     DEFAULT_DEBOUNCE_MS) / 1000.0
         self.queue = DebouncedQueue(debounce_s=debounce_s,
-                                    clock=self.clock)
+                                    clock=self.clock,
+                                    max_pending=self._max_queue())
         self._lock = threading.Lock()
         self._store: dict[tuple, _Accum] = {}
         self._next_full_deadline: Optional[float] = None
         self._scrape_targets: tuple = ()
         # pre-cycle hook (the goodput twin advances its FaultPlan here)
         self._on_cycle_start = None
+        # -- streaming-under-fire state (all guarded by self._lock) ----
+        # adaptive debounce ladder: base is the configured window, the
+        # effective window doubles under storms and halves back down
+        self._base_debounce_s = self.queue.debounce_s
+        self._debounce_s = self.queue.debounce_s
+        # the pressure cause the NEXT cycle will be marked with (the
+        # stream-degraded rung); set by ingest threads and the valve,
+        # consumed by the consumer at _execute
+        self._pressure: Optional[str] = None
+        # limited-mode escalation coalescing: the clock reading of the
+        # last EVENT-escalated full pass — the first escalation after
+        # quiet runs immediately; follow-ups inside the lag budget ride
+        # one pending backstop pass
+        self._last_escalation_at: Optional[float] = None
+        self._deferred: dict = {}            # (model, ns) -> Pending
+        # per-source quarantine breakers (utils/backoff.py)
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._poller_thread = None
+        self._maybe_restore()
 
     # -- knobs ------------------------------------------------------------
 
@@ -149,6 +246,11 @@ class StreamCore:
         raw = (os.environ.get(key)
                or self.rec.state.last_operator_cm.get(key))
         return parse_float_or(raw, default)
+
+    def _knob_str(self, key: str, default: str = "") -> str:
+        raw = (os.environ.get(key)
+               or self.rec.state.last_operator_cm.get(key))
+        return raw if raw else default
 
     def _epsilon(self) -> float:
         eps = self._knob("WVA_SOLVE_EPSILON", DEFAULT_EPSILON)
@@ -158,6 +260,78 @@ class StreamCore:
         snap = self.state.snapshot
         cm = snap.operator_cm if snap is not None else {}
         return cm.get("WVA_LIMITED_MODE", "").lower() == "true"
+
+    def _max_groups(self) -> int:
+        cap = self._knob("WVA_STREAM_MAX_GROUPS", DEFAULT_MAX_GROUPS)
+        return int(min(max(cap, 1.0), HARD_MAX_GROUPS))
+
+    def _max_queue(self) -> int:
+        cap = self._knob("WVA_STREAM_MAX_QUEUE", DEFAULT_MAX_QUEUE)
+        return int(min(max(cap, 1.0), HARD_MAX_QUEUE))
+
+    def max_body_bytes(self) -> int:
+        """Request-body cap for POST /api/v1/write (the 413 threshold;
+        read by the ingest middleware)."""
+        return int(max(self._knob("WVA_STREAM_MAX_BODY_BYTES",
+                                  DEFAULT_MAX_BODY_BYTES), 1024.0))
+
+    def _lag_budget_s(self) -> float:
+        ms = self._knob("WVA_STREAM_LAG_BUDGET_MS", DEFAULT_LAG_BUDGET_MS)
+        return max(ms, 0.0) / 1000.0
+
+    # -- quarantine (any thread) ------------------------------------------
+
+    def _breaker(self, source: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(source)
+            if br is None:
+                threshold = int(max(self._knob(
+                    "WVA_STREAM_QUARANTINE_THRESHOLD",
+                    DEFAULT_QUARANTINE_THRESHOLD), 1.0))
+                br = CircuitBreaker(f"stream-{source}",
+                                    failure_threshold=threshold,
+                                    reset_after_s=FALLBACK_INTERVAL_S,
+                                    clock=self.clock)
+                self._breakers[source] = br
+            return br
+
+    def source_quarantined(self, source: str) -> bool:
+        """True while `source`'s breaker is OPEN (cooldown not yet
+        elapsed): the push door answers 429 and the ScrapePoller
+        fallback covers the fleet. Once the cooldown elapses the
+        breaker reads half-open and one probe is admitted again."""
+        with self._lock:
+            br = self._breakers.get(source)
+        if br is None:
+            return False
+        return br.state_code() == CircuitBreaker.STATE_CODES[
+            CircuitBreaker.OPEN]
+
+    def _vet(self, key: tuple, fields: dict,
+             ts_ms: float) -> Optional[str]:
+        """Semantic quarantine verdict for one observation, or None if
+        clean. ts_ms is the sample's wall-clock stamp (0 = unstamped,
+        e.g. the scrape path — timestamp checks skipped)."""
+        for k, v in fields.items():
+            if k not in _LOAD_FIELDS:
+                continue
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                return SHED_QUARANTINE_NAN
+            if v != v or v in (float("inf"), float("-inf")):
+                return SHED_QUARANTINE_NAN
+            if v < 0.0:
+                return SHED_QUARANTINE_NEGATIVE
+        if ts_ms:
+            if ts_ms / 1000.0 > self.rec.now() + FAR_FUTURE_SLACK_S:
+                return SHED_QUARANTINE_TIMESTAMP
+            with self._lock:
+                acc = self._store.get(key)
+                if acc is not None and acc.sample_ts_ms \
+                        and ts_ms < acc.sample_ts_ms:
+                    return SHED_QUARANTINE_TIMESTAMP
+        return None
 
     # -- ingest (any thread) ----------------------------------------------
 
@@ -186,25 +360,74 @@ class StreamCore:
                       t: Optional[float] = None) -> bool:
         """Partial-update ingest (remote-write requests may carry any
         subset of the load series). Counts one event per call; a
-        signature flip arms the debounced queue."""
+        signature flip arms the debounced queue. Never raises: a
+        quarantined or shed observation reads as 'no change' (the shed
+        counter and the breaker still record it — use ingest_push for
+        the raising variant the HTTP door needs)."""
+        try:
+            return self.ingest_push(model, namespace, fields,
+                                    source=source, t=t)
+        except ShedError:
+            return False
+
+    def ingest_push(self, model: str, namespace: str, fields: dict,
+                    ts_ms: float = 0.0,
+                    source: str = SOURCE_REMOTE_WRITE,
+                    t: Optional[float] = None) -> bool:
+        """The vetted ingest door: quarantines poisoned observations
+        and sheds past the store/queue caps, raising ShedError with the
+        metered reason. Returns True when a change was enqueued."""
         now = self.clock() if t is None else t
-        self.emitter.emit_stream_event(source)
         key = (model, namespace)
+        breaker = self._breaker(source)
+        reason = self._vet(key, fields, ts_ms)
+        if reason is not None:
+            self.emitter.emit_stream_shed(reason)
+            breaker.record_failure()
+            raise ShedError(reason, f"{model}/{namespace}: {reason}")
+        shed = None
+        changed = False
         with self._lock:
             acc = self._store.get(key)
             if acc is None:
-                acc = _Accum()
-                self._store[key] = acc
-            acc.fields.update({k: float(v) for k, v in fields.items()
-                               if k in _LOAD_FIELDS})
-            acc.updated_at = now
-            load = acc.load()
-            if load is None:
-                return False
-            changed = self._signature(load) != acc.consumed_sig
-        if changed:
-            self.queue.offer(key, source, t=now)
+                if len(self._store) >= self._max_groups():
+                    shed = SHED_STORE_FULL
+                else:
+                    acc = _Accum()
+                    self._store[key] = acc
+            if acc is not None:
+                acc.fields.update({k: float(v)
+                                   for k, v in fields.items()
+                                   if k in _LOAD_FIELDS})
+                acc.updated_at = now
+                if ts_ms:
+                    acc.sample_ts_ms = max(acc.sample_ts_ms, ts_ms)
+                load = acc.load()
+                changed = (load is not None
+                           and self._signature(load) != acc.consumed_sig)
+        if shed is not None:
+            # the observation is lost but not silently: metered, and a
+            # full pass (which re-collects everything) is requested so
+            # decisions still converge
+            self._shed_overload(shed, source, now)
+            raise ShedError(shed, f"{model}/{namespace}: {shed}")
+        self.emitter.emit_stream_event(source)
+        breaker.record_success()
+        if changed and not self.queue.offer(key, source, t=now):
+            # queue at depth cap: the store holds the data, only the
+            # scoped wake is lost — coalesce into a full-pass request
+            self._shed_overload(SHED_QUEUE_FULL, source, now)
         return changed
+
+    def _shed_overload(self, reason: str, source: str,
+                       now: float) -> None:
+        """Meter one overload shed, raise stream pressure (the next
+        cycle lands on the stream-degraded rung), and fold the lost
+        work into a coalesced full-pass request."""
+        self.emitter.emit_stream_shed(reason)
+        with self._lock:
+            self._pressure = reason
+        self.queue.request_full(source, t=now)
 
     def note_kick(self, source: str = SOURCE_WATCH) -> None:
         """A watch event / probe kick: a debounced full-fleet pass."""
@@ -258,10 +481,13 @@ class StreamCore:
         push updated DURING the pass are left alone: the push is newer
         truth and its event is still pending."""
         loads = dict(self.state.cycle_loads)
+        cap = self._max_groups()
         with self._lock:
             for group, load in loads.items():
                 acc = self._store.get(group)
                 if acc is None:
+                    if len(self._store) >= min(cap, HARD_MAX_GROUPS):
+                        continue
                     acc = _Accum()
                     self._store[group] = acc
                 elif acc.updated_at > t_start:
@@ -280,6 +506,64 @@ class StreamCore:
                           if g not in loads and acc.updated_at < horizon]:
                 del self._store[group]
 
+    def _merge_deferred_locked(self, events: dict) -> dict:
+        """Fold the limited-mode deferral buffer into a full plan's
+        drained events (earliest observation wins — the lag histogram
+        must measure from the FIRST moment a change was visible).
+        Caller holds self._lock."""
+        merged = dict(self._deferred)
+        for key, pending in events.items():
+            prev = merged.get(key)
+            if prev is None or pending.t_observed < prev.t_observed:
+                merged[key] = pending
+        self._deferred = {}
+        return merged
+
+    def _defer_events_locked(self, events: dict) -> None:
+        """Buffer a limited-mode drain for the ONE coalesced escalation
+        pass. Caller holds self._lock. Bounded: past the queue cap the
+        extra keys only lose their lag samples — the coalesced full
+        pass re-collects every group regardless."""
+        for key, pending in events.items():
+            prev = self._deferred.get(key)
+            if prev is not None:
+                if pending.t_observed < prev.t_observed:
+                    self._deferred[key] = pending
+            elif len(self._deferred) < min(self._max_queue(),
+                                           HARD_MAX_QUEUE):
+                self._deferred[key] = pending
+
+    def _adapt_debounce(self, n_events: int) -> None:
+        """Adaptive debounce ladder: a drain at/over the storm
+        threshold doubles the window (up to WVA_STREAM_MAX_DEBOUNCE_MS);
+        a drain at half the threshold or less halves it back toward the
+        configured base. The asymmetric thresholds are the hysteresis —
+        a storm hovering at the boundary cannot make the window flap."""
+        if n_events <= 0:
+            return
+        storm = int(max(self._knob("WVA_STREAM_STORM_EVENTS",
+                                   DEFAULT_STORM_EVENTS), 1.0))
+        ceil_s = max(self._knob("WVA_STREAM_MAX_DEBOUNCE_MS",
+                                DEFAULT_MAX_DEBOUNCE_MS), 0.0) / 1000.0
+        with self._lock:
+            cur = self._debounce_s
+            if n_events >= storm:
+                new = min(max(cur * 2.0, self._base_debounce_s),
+                          max(ceil_s, self._base_debounce_s))
+                widened = True
+            elif n_events * 2 <= storm:
+                new = max(cur / 2.0, self._base_debounce_s)
+                widened = False
+            else:
+                return
+            if new == cur:
+                return
+            self._debounce_s = new
+            if widened:
+                self._pressure = PRESSURE_FLOOD
+        self.queue.set_window(new)
+        self.emitter.emit_stream_debounce_ms(new * 1000.0)
+
     def _claim(self) -> Optional[_Plan]:
         now = self.clock()
         with self._lock:
@@ -289,16 +573,56 @@ class StreamCore:
             drained = self.queue.drain(now, force=True)
             source = (drained.full.source if drained.full is not None
                       else SOURCE_BACKSTOP)
-            return _Plan(kind="full", source=source,
-                         events=drained.events)
+            with self._lock:
+                events = self._merge_deferred_locked(drained.events)
+            return _Plan(kind="full", source=source, events=events)
+        # escalation valve: a saturated queue or a pending event older
+        # than the lag budget means scoped micro-cycles are losing the
+        # race — coalesce the whole backlog into ONE backstop full pass
+        depth, oldest_age, _ = self.queue.stats(now)
+        budget = self._lag_budget_s()
+        saturated = depth >= self._max_queue()
+        lag_blown = depth > 0 and budget > 0.0 and oldest_age >= budget
+        if saturated or lag_blown:
+            drained = self.queue.drain(now, force=True)
+            source = (drained.full.source if drained.full is not None
+                      else SOURCE_BACKSTOP)
+            with self._lock:
+                self._pressure = (SHED_QUEUE_FULL if saturated
+                                  else PRESSURE_LAG_BUDGET)
+                events = self._merge_deferred_locked(drained.events)
+            return _Plan(kind="full", source=source, events=events)
         drained = self.queue.drain(now)
         if not drained:
             return None
+        self._adapt_debounce(len(drained.events))
         if drained.full is not None or self._limited_mode():
             source = (drained.full.source if drained.full is not None
                       else SOURCE_BACKSTOP)
-            return _Plan(kind="full", source=source,
-                         events=drained.events)
+            with self._lock:
+                coalesce = (drained.full is None
+                            and self._last_escalation_at is not None
+                            and budget > 0.0
+                            and now - self._last_escalation_at < budget)
+                if coalesce:
+                    # limited-mode storm: an escalated pass just ran —
+                    # defer this drain onto ONE pending backstop pass
+                    # at the lag-budget horizon instead of churning N
+                    self._defer_events_locked(drained.events)
+                    horizon = self._last_escalation_at + budget
+                    if horizon < deadline:
+                        self._next_full_deadline = horizon
+                    self._pressure = PRESSURE_LIMITED_COALESCE
+                    events = None
+                else:
+                    if drained.full is None:
+                        # an event-escalated limited-mode pass anchors
+                        # the coalescing window
+                        self._last_escalation_at = now
+                    events = self._merge_deferred_locked(drained.events)
+            if events is None:
+                return None
+            return _Plan(kind="full", source=source, events=events)
         scope, loads = self._scope_for(drained.events)
         if not scope:
             # events for models outside the fleet: nothing to solve
@@ -311,8 +635,14 @@ class StreamCore:
             return None
         with self._lock:
             hook = self._on_cycle_start
+            pressure, self._pressure = self._pressure, None
         if hook is not None:
             hook()
+        # the cycle serving a pressured backlog is marked: the
+        # reconciler folds this into the degradation ladder as the
+        # stream-degraded rung (visible on DecisionRecords too)
+        with self._lock:
+            self.state.stream_pressure = pressure
         result = None
         delay = FALLBACK_INTERVAL_S
         t_start = self.clock()
@@ -328,6 +658,8 @@ class StreamCore:
         except Exception as e:  # noqa: BLE001 — run_forever's catch, here
             log.error("stream cycle failed",
                       extra=kv(kind=plan.kind, error=str(e)))
+        with self._lock:
+            self.state.stream_pressure = None
         if plan.kind == "full":
             now = self.clock()
             with self._lock:
@@ -341,6 +673,8 @@ class StreamCore:
             self._mark_consumed(plan.events)
         if result is not None and plan.events:
             self._observe_lag(plan, result)
+        if result is not None:
+            self._maybe_checkpoint()
         return result
 
     def _observe_lag(self, plan: _Plan, result) -> None:
@@ -357,6 +691,162 @@ class StreamCore:
             if plan.kind == "full" or any(k in published for k in keys):
                 self.emitter.emit_stream_lag(
                     max(now - pending.t_observed, 0.0))
+
+    # -- warm-restart checkpoint (consumer thread) ------------------------
+
+    def _checkpoint_path(self) -> str:
+        return self._knob_str("WVA_STREAM_CHECKPOINT")
+
+    def _checkpoint_payload(self) -> dict:
+        st = self.state
+        snap = st.snapshot
+        now = self.clock()
+        with self._lock:
+            deadline = self._next_full_deadline
+            # monotonic readings do not survive a restart: persist AGES
+            # relative to now, re-anchored on the restoring clock
+            store = [[m, ns, dict(acc.fields),
+                      max(now - acc.updated_at, 0.0), acc.sample_ts_ms,
+                      (list(acc.consumed_sig)
+                       if acc.consumed_sig is not None else None)]
+                     for (m, ns), acc in self._store.items()]
+        from ..controller.crd import va_to_dict
+        return {
+            "taken_at": self.rec.now(),
+            "backstop_remaining_s": (max(deadline - now, 0.0)
+                                     if deadline is not None else None),
+            "snapshot": None if snap is None else {
+                "operator_cm": dict(snap.operator_cm),
+                "accelerator_cm": snap.accelerator_cm,
+                "service_class_cm": dict(snap.service_class_cm),
+                "interval_s": snap.interval_s,
+                "taken_at": snap.taken_at,
+                "vas": {key: va_to_dict(va)
+                        for key, va in snap.vas.items()},
+            },
+            "cross_cycle": {
+                "cycle_index": st.cycle_index,
+                "recommendations": {k: [list(p) for p in v]
+                                    for k, v in st.recommendations.items()},
+                "drift_strikes": dict(st.drift_strikes),
+                "tpu_util_misses": {k: list(v)
+                                    for k, v in st.tpu_util_misses.items()},
+                "probe_targets": {k: list(v)
+                                  for k, v in st.probe_targets.items()},
+                "last_operator_cm": dict(st.last_operator_cm),
+                "shared_ns_warned": list(st.shared_ns_warned),
+                "last_capacity": dict(st.last_capacity),
+            },
+            "merged": {name: [[list(k), v]
+                              for k, v in getattr(st, name).items()]
+                       for name in ("power", "conditions", "drift",
+                                    "rungs")},
+            "store": store,
+        }
+
+    def _maybe_checkpoint(self) -> None:
+        path = self._checkpoint_path()
+        if not path:
+            return
+        try:
+            save_checkpoint(path, self._checkpoint_payload())
+        except Exception as e:  # noqa: BLE001 — checkpointing is best-effort
+            log.warning("stream checkpoint save failed",
+                        extra=kv(error=str(e)))
+            return
+        self.emitter.emit_stream_checkpoint(CHECKPOINT_SAVE)
+
+    def _maybe_restore(self) -> None:
+        """Warm restart: called once from __init__. Every failure mode
+        degrades to exactly the cold-start behavior the core had before
+        checkpoints existed — metered, logged, never raised."""
+        path = self._checkpoint_path()
+        if not path or not os.path.exists(path):
+            return
+        try:
+            payload = load_checkpoint(path)
+        except CheckpointError as e:
+            log.warning("stream checkpoint discarded",
+                        extra=kv(reason="corrupt", error=str(e)))
+            self.emitter.emit_stream_checkpoint(CHECKPOINT_DISCARD_CORRUPT)
+            return
+        max_age = max(self._knob("WVA_STREAM_CHECKPOINT_MAX_AGE_S",
+                                 DEFAULT_CHECKPOINT_MAX_AGE_S), 0.0)
+        age = self.rec.now() - float(payload.get("taken_at") or 0.0)
+        if age < 0.0 or age > max_age:
+            log.warning("stream checkpoint discarded",
+                        extra=kv(reason="stale", age_s=round(age, 3)))
+            self.emitter.emit_stream_checkpoint(CHECKPOINT_DISCARD_STALE)
+            return
+        try:
+            self._apply_checkpoint(payload)
+        except Exception as e:  # noqa: BLE001 — a bad checkpoint must not block startup
+            log.warning("stream checkpoint discarded",
+                        extra=kv(reason="unusable", error=str(e)))
+            self.emitter.emit_stream_checkpoint(CHECKPOINT_DISCARD_CORRUPT)
+            return
+        log.info("stream checkpoint restored",
+                 extra=kv(age_s=round(age, 3)))
+        self.emitter.emit_stream_checkpoint(CHECKPOINT_RESTORE)
+
+    def _apply_checkpoint(self, payload: dict) -> None:
+        from ..controller.crd import va_from_dict
+        st = self.state
+        snap_d = payload.get("snapshot")
+        snapshot = None
+        if snap_d is not None:
+            snapshot = FleetSnapshot(
+                operator_cm=dict(snap_d["operator_cm"]),
+                accelerator_cm=snap_d["accelerator_cm"],
+                service_class_cm=dict(snap_d["service_class_cm"]),
+                interval_s=float(snap_d["interval_s"]),
+                vas={key: va_from_dict(obj)
+                     for key, obj in snap_d["vas"].items()},
+                taken_at=float(snap_d["taken_at"]),
+            )
+        cc = payload.get("cross_cycle", {})
+        merged = payload.get("merged", {})
+        store_rows = payload.get("store", [])
+        remaining = payload.get("backstop_remaining_s")
+        # parse-before-mutate: everything above raised already if the
+        # payload is structurally wrong; from here on it is all-or-most
+        st.snapshot = snapshot
+        st.cycle_index = int(cc.get("cycle_index", 0))
+        st.recommendations = {k: [tuple(p) for p in v]
+                              for k, v in
+                              cc.get("recommendations", {}).items()}
+        st.drift_strikes = {k: int(v)
+                            for k, v in cc.get("drift_strikes", {}).items()}
+        st.tpu_util_misses = {k: tuple(v) for k, v in
+                              cc.get("tpu_util_misses", {}).items()}
+        st.probe_targets = {k: (str(v[0]), float(v[1])) for k, v in
+                            cc.get("probe_targets", {}).items()}
+        st.last_operator_cm = dict(cc.get("last_operator_cm", {}))
+        st.shared_ns_warned = tuple(cc.get("shared_ns_warned", ()))
+        st.last_capacity = {k: int(v)
+                            for k, v in cc.get("last_capacity", {}).items()}
+        for name in ("power", "conditions", "drift", "rungs"):
+            setattr(st, name,
+                    {tuple(k): v for k, v in merged.get(name, [])})
+        now = self.clock()
+        with self._lock:
+            self._store = {}
+            for row in store_rows:
+                if len(self._store) >= HARD_MAX_GROUPS:
+                    break
+                model, ns, fields, age_s, ts_ms, sig = row
+                self._store[(str(model), str(ns))] = _Accum(
+                    fields={str(k): float(v) for k, v in fields.items()},
+                    updated_at=now - max(float(age_s), 0.0),
+                    sample_ts_ms=float(ts_ms),
+                    consumed_sig=(tuple(sig) if sig is not None
+                                  else None),
+                )
+            if remaining is not None:
+                self._next_full_deadline = now + max(float(remaining), 0.0)
+            self._scrape_targets = tuple(sorted(
+                {(va.spec.model_id, va.namespace)
+                 for va in snapshot.vas.values()})) if snapshot else ()
 
     def process_once(self) -> list:
         """Drain-and-execute until nothing is actionable. Synchronous —
@@ -376,10 +866,14 @@ class StreamCore:
     def run(self, stop: threading.Event) -> None:
         """The production consumer loop: process, then sleep until the
         earliest of (debounce window closing, backstop deadline), woken
-        immediately by the first offer after idle."""
+        immediately by the first offer after idle. Joins the scrape
+        poller on the way out — no thread outlives the stop event."""
         from .ingest import ScrapePoller
 
-        ScrapePoller(self, stop).start()
+        poller = ScrapePoller(self, stop)
+        thread = poller.start()
+        with self._lock:
+            self._poller_thread = thread
         while not stop.is_set():
             try:
                 self.process_once()
@@ -399,6 +893,8 @@ class StreamCore:
                 nd = self.queue.next_deadline()
                 if nd is not None:
                     stop.wait(min(max(nd - self.clock(), 0.0), 0.5))
+        if thread is not None:
+            thread.join(timeout=5.0)
 
     def scrape_targets(self) -> tuple:
         with self._lock:

@@ -52,28 +52,39 @@ class Drained:
         return bool(self.events) or self.full is not None
 
 
+DEFAULT_MAX_PENDING = 1024   # mirrors core's WVA_STREAM_MAX_QUEUE default
+
+
 class DebouncedQueue:
     def __init__(self, debounce_s: float = DEFAULT_DEBOUNCE_S,
-                 clock=time.time):
+                 clock=time.time, max_pending: int = DEFAULT_MAX_PENDING):
         self.debounce_s = max(float(debounce_s), 0.0)
         self.clock = clock
+        self.max_pending = max(int(max_pending), 1)
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._events: dict = {}          # key -> Pending (earliest wins)
         self._full: Optional[Pending] = None
         self._armed_at: Optional[float] = None
 
-    def offer(self, key, source: str, t: Optional[float] = None) -> None:
+    def offer(self, key, source: str, t: Optional[float] = None) -> bool:
         """Enqueue a change event for `key`. Re-offers of a pending key
         keep the EARLIEST observation time (the lag histogram measures
-        from the first moment the change was visible)."""
+        from the first moment the change was visible). Returns False —
+        without enqueueing — when the pending map is at its depth cap
+        and `key` is not already riding it; the caller must meter the
+        shed and fold the loss into a full-pass request."""
         with self._lock:
             now = self.clock() if t is None else t
+            if (key not in self._events
+                    and len(self._events) >= self.max_pending):
+                return False
             if self._armed_at is None:
                 self._armed_at = now
             self._events.setdefault(key, Pending(t_observed=now,
                                                  source=source))
         self._wake.set()
+        return True
 
     def request_full(self, source: str, t: Optional[float] = None) -> None:
         """Enqueue a full-fleet pass (watch events, escalations). Bursts
@@ -89,6 +100,32 @@ class DebouncedQueue:
     def pending(self) -> int:
         with self._lock:
             return len(self._events) + (1 if self._full is not None else 0)
+
+    def set_window(self, debounce_s: float) -> None:
+        """Retarget the debounce window (the adaptive-debounce ladder in
+        stream/core.py widens it under storms, narrows it back with
+        hysteresis). An already-armed window is left to close on the OLD
+        deadline — retroactively stretching it would penalize events
+        that arrived under the narrow contract."""
+        with self._lock:
+            self.debounce_s = max(float(debounce_s), 0.0)
+
+    def stats(self, now: Optional[float] = None) -> tuple:
+        """(pending depth, age in seconds of the OLDEST pending
+        observation, whether a full pass is queued) — the saturation
+        signals the escalation valve keys on."""
+        with self._lock:
+            now = self.clock() if now is None else now
+            oldest = None
+            for p in self._events.values():
+                if oldest is None or p.t_observed < oldest:
+                    oldest = p.t_observed
+            if self._full is not None and (oldest is None
+                                           or self._full.t_observed < oldest):
+                oldest = self._full.t_observed
+            age = 0.0 if oldest is None else max(now - oldest, 0.0)
+            depth = len(self._events) + (1 if self._full is not None else 0)
+            return depth, age, self._full is not None
 
     def ready(self, now: Optional[float] = None) -> bool:
         """True once the debounce window armed by the first un-drained

@@ -31,6 +31,13 @@ class WireError(ValueError):
     """Malformed snappy or protobuf payload (maps to HTTP 400)."""
 
 
+# decompression bomb guard: refuse any snappy stream whose header
+# promises more than this many uncompressed bytes (64 MiB — orders of
+# magnitude above any real WriteRequest; the HTTP door additionally
+# caps the COMPRESSED body via WVA_STREAM_MAX_BODY_BYTES)
+MAX_UNCOMPRESSED_BYTES = 1 << 26
+
+
 # -- varints ----------------------------------------------------------------
 
 
@@ -66,7 +73,23 @@ def _uvarint(value: int) -> bytes:
 
 
 def snappy_decompress(data: bytes) -> bytes:
+    """Decode one snappy block stream. Adversarial bytes — truncations,
+    bit flips, length-field corruption, decompression bombs — raise
+    WireError and nothing else (the fuzz corpus in tests/ pins this)."""
+    try:
+        return _snappy_decompress(data)
+    except WireError:
+        raise
+    except Exception as e:  # noqa: BLE001 — adversarial bytes map to WireError
+        raise WireError(f"malformed snappy stream: {e}") from e
+
+
+def _snappy_decompress(data: bytes) -> bytes:
     expected, i = _read_uvarint(data, 0)
+    if expected > MAX_UNCOMPRESSED_BYTES:
+        raise WireError(
+            f"snappy header promises {expected} bytes (cap "
+            f"{MAX_UNCOMPRESSED_BYTES})")
     out = bytearray()
     n = len(data)
     while i < n:
@@ -111,6 +134,10 @@ def snappy_decompress(data: bytes) -> bytes:
         start = len(out) - offset
         for k in range(length):
             out.append(out[start + k])
+        if len(out) > expected:
+            # a copy-amplified stream overrunning its own header is a
+            # bomb, not a payload: stop before building it
+            raise WireError("snappy output exceeds header length")
     if len(out) != expected:
         raise WireError(
             f"snappy length mismatch: got {len(out)}, header {expected}")
@@ -215,11 +242,19 @@ def _parse_timeseries(buf: bytes) -> TimeSeries:
 
 
 def parse_write_request(buf: bytes) -> list[TimeSeries]:
-    out = []
-    for number, wire_type, payload in _fields(buf):
-        if number == 1 and wire_type == 2:
-            out.append(_parse_timeseries(payload))
-    return out
+    """Parse one WriteRequest. Like the snappy decoder, every failure
+    mode on adversarial bytes is a WireError — a WSGI worker must never
+    see a bare IndexError/struct.error escape the codec."""
+    try:
+        out = []
+        for number, wire_type, payload in _fields(buf):
+            if number == 1 and wire_type == 2:
+                out.append(_parse_timeseries(payload))
+        return out
+    except WireError:
+        raise
+    except Exception as e:  # noqa: BLE001 — adversarial bytes map to WireError
+        raise WireError(f"malformed WriteRequest: {e}") from e
 
 
 # -- encoder (the test/bench sender half) -----------------------------------

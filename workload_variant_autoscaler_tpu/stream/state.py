@@ -79,6 +79,12 @@ class StreamState:
         # full_name -> CollectedLoad pushed by the ingest layer; consumed
         # by _prepare in place of a Prometheus round-trip (mode "stream")
         self.stream_loads: Optional[dict] = None
+        # set by the streaming core when the cycle it is about to run
+        # serves a pressured backlog (overload shed, blown lag budget,
+        # coalesced limited-mode escalation): the reconciler marks such
+        # cycles with the stream-degraded ladder rung; cleared by the
+        # core right after the cycle
+        self.stream_pressure: Optional[str] = None
         # (model, namespace) -> the CollectedLoad THIS cycle actually
         # sized on, recorded by _prepare; after a full pass the core
         # folds these into its ingest store as the consumed signatures,
