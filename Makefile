@@ -51,6 +51,14 @@ bench-fuse: ## Fused decision program vs staged pipeline: 512-variant load-shift
 fuse-smoke: ## Abbreviated fused-path run (64 variants, ~3s): zero retraces over 10 steady-state cycles, exactly one bulk d2h per sizing group
 	$(PY) bench_fuse.py --smoke
 
+.PHONY: bench-shard
+bench-shard: ## Mesh-sharded fleet solve: 512/2048/8192-variant forced-full walls on a forced 8-device host mesh, sharded churn transfer audit, vectorized-greedy >=3x (writes BENCH_shard_r13.json; honors WVA_BENCH_* budget/stagger knobs)
+	$(PY) bench_shard.py
+
+.PHONY: shard-smoke
+shard-smoke: ## Abbreviated sharded run (64/128 variants, ~90s): zero retraces over a 10-cycle churn run, exactly one bulk d2h crossing the sharded boundary per cycle
+	$(PY) bench_shard.py --smoke
+
 .PHONY: bench-stream
 bench-stream: ## Streaming reconcile lag: 512 variants, remote-write ingest, p50/p99 load-change->published vs the polled baseline (writes BENCH_stream_r11.json)
 	$(PY) bench_stream.py
@@ -79,7 +87,7 @@ bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO 
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo
 
-LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_profile.py bench_fuse.py bench_stream.py bench_streamchaos.py __graft_entry__.py
+LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_profile.py bench_fuse.py bench_shard.py bench_stream.py bench_streamchaos.py __graft_entry__.py
 
 .PHONY: lint
 lint: ## Static analysis gate: ruff+mypy when installed, wvalint always (rule catalog: docs/developer-guide/wvalint.md)

@@ -1,0 +1,318 @@
+"""bench_shard: the mesh-sharded resident fleet solve at 8192 variants.
+
+BENCH_solve_r07 put a 512-variant steady-state reconcile cycle at
+~727 ms wall (incremental path; 886.5 ms forced-full) — "what 512
+costs today". This bench measures what the sharded fleet pipeline
+(WVA_SHARDED_FLEET over a forced 8-device host mesh,
+XLA_FLAGS=--xla_force_host_platform_device_count=8) does to a FORCED
+FULL analyze+optimize pass as the variant axis grows 512 → 2048 → 8192:
+
+- per-size forced-full analyze+optimize walls, sharded vs unsharded
+  (IncrementalSolveEngine with full_every=1: every lane re-solves,
+  every cycle);
+- the headline claim: the 8192-variant sharded forced-full
+  analyze+optimize wall lands within 2x the committed 512-variant
+  cycle wall (R07_CYCLE_MS below) — a 16x wider fleet for no more
+  than twice what one cycle costs today;
+- a 10-cycle churn run on the sharded resident arena: ZERO retraces
+  after warm-up, scatter-only h2d (no whole-slab upload), exactly one
+  bulk d2h per sizing group per cycle;
+- the vectorized greedy (WVA_VECTOR_GREEDY) vs the sequential list
+  scheduler on the 4096-variant no-sharing capacity-limited shape:
+  the >= 3x claim.
+
+Timing claims retry on the WVA_BENCH_* stagger (bench.py
+resolve_budget / WVA_BENCH_RETRY_INTERVAL_S) so one noisy co-tenant
+burst doesn't fail the run. Writes BENCH_shard_r13.json;
+tests/test_perf_claims.py asserts the committed artifact clears the
+claims and that docs/observability.md quotes it. `--smoke`
+(`make shard-smoke`, tier-1 via tests/test_shard.py) runs small and
+only asserts the invariants.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("LOG_LEVEL", "error")
+# the sharded fleet pipeline exists on the batched XLA path only
+os.environ.setdefault("WVA_NATIVE_KERNEL", "false")
+# vector-greedy exactness requires f64 value comparison (greedy.py);
+# the test suite runs x64 for the same reason
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+from workload_variant_autoscaler_tpu.utils.platform import force_cpu  # noqa: E402
+
+MESH_DEVICES = 8
+force_cpu(n_devices=MESH_DEVICES)
+
+from bench import resolve_budget  # noqa: E402
+
+OUT = "BENCH_shard_r13.json"
+STEADY_CYCLES = 10
+# committed BENCH_solve_r07.json: 512-variant steady-state cycle wall
+# (incremental path, the number the sharding work is scoped against)
+R07_CYCLE_MS = 727.2
+SIZES = (512, 2048, 8192)
+SMOKE_SIZES = (64, 128)
+GREEDY_N = 4096
+
+
+def fleet_spec(n: int, *, distinct_loads: bool = False,
+               limited: bool = False, load_step: float = 0.0):
+    """The bench_collect fleet shape (8 models, 7 load levels), scaled
+    to n variants. `distinct_loads` gives every variant its own rate
+    (no lane dedup); `limited` switches to the capacity-bounded
+    optimizer with ample chips (uncontended pools)."""
+    from workload_variant_autoscaler_tpu.models import make_slice
+    from workload_variant_autoscaler_tpu.models.spec import (
+        AllocationData,
+        ModelSliceProfile,
+        ModelTarget,
+        OptimizerSpec,
+        ServerLoadSpec,
+        ServerSpec,
+        ServiceClassSpec,
+        SystemSpec,
+    )
+
+    n_models = 8
+    models = [f"llama-8b-m{i}" for i in range(n_models)]
+    return SystemSpec(
+        accelerators=[make_slice("v5e", 1, "1x1")],
+        profiles=[ModelSliceProfile(model=m, accelerator="v5e-1",
+                                    alpha=6.973, beta=0.027, gamma=5.2,
+                                    delta=0.1, max_batch_size=64,
+                                    at_tokens=128)
+                  for m in models],
+        service_classes=[ServiceClassSpec(
+            name="Premium", priority=1,
+            model_targets=tuple(ModelTarget(model=m, slo_itl=24.0,
+                                            slo_ttft=500.0)
+                                for m in models))],
+        servers=[ServerSpec(
+            name=f"chat-{i}", service_class="Premium",
+            model=models[i % n_models], min_num_replicas=1,
+            current_alloc=AllocationData(
+                accelerator="v5e-1", num_replicas=1,
+                load=ServerLoadSpec(
+                    arrival_rate=load_step + (
+                        1200.0 + i * 0.37 if distinct_loads
+                        else 1200.0 + (i % 7) * 60.0),
+                    avg_in_tokens=128,
+                    avg_out_tokens=128)))
+            for i in range(n)],
+        capacity={"v5e": 50_000_000} if limited else {},
+        optimizer=OptimizerSpec(unlimited=not limited,
+                                saturation_policy="None"),
+    )
+
+
+def _engine_cycle(spec, engine, fm) -> float:
+    """One analyze+optimize pass through the engine; returns wall ms."""
+    from workload_variant_autoscaler_tpu.models import System
+    from workload_variant_autoscaler_tpu.solver import Manager, Optimizer
+
+    system = System()
+    opt_spec = system.set_from_spec(spec)
+    t0 = time.perf_counter()
+    engine.calculate(system, backend="batched", fleet_mesh=fm,
+                     optimizer_spec=opt_spec)
+    Manager(system, Optimizer(opt_spec)).optimize(warm=engine.warm_start())
+    wall = (time.perf_counter() - t0) * 1000.0
+    n = len(system.generate_solution().allocations)
+    assert n == len(spec.servers), n
+    engine.finish_cycle(system)
+    return wall
+
+
+def forced_full_walls(n: int, sharded: bool) -> dict:
+    """Forced-full analyze+optimize walls (full_every=1: no lane is
+    skipped, every cycle re-solves the whole fleet). One compile
+    cycle, then 5 timed cycles over shifting fleet-wide load."""
+    from workload_variant_autoscaler_tpu.parallel import fleet_mesh
+    from workload_variant_autoscaler_tpu.solver import IncrementalSolveEngine
+
+    fm = fleet_mesh(MESH_DEVICES) if sharded else None
+    engine = IncrementalSolveEngine(epsilon=0.0, full_every=1)
+    _engine_cycle(fleet_spec(n), engine, fm)            # compile
+    walls = [_engine_cycle(fleet_spec(n, load_step=25.0 * (i + 1)),
+                           engine, fm)
+             for i in range(5)]
+    return {
+        "variants": n,
+        "sharded": sharded,
+        "analyze_optimize_ms_p50": round(statistics.median(walls), 1),
+        "analyze_optimize_ms": [round(w, 1) for w in walls],
+    }
+
+
+def churn_run(n: int) -> dict:
+    """STEADY_CYCLES sharded incremental cycles after warm-up, a small
+    load churn each cycle: per-cycle retraces, transfer counts, and the
+    sharded-boundary tallies from the JaxAudit deltas."""
+    from workload_variant_autoscaler_tpu.obs.profile import JAX_AUDIT
+    from workload_variant_autoscaler_tpu.parallel import fleet_mesh
+    from workload_variant_autoscaler_tpu.solver import IncrementalSolveEngine
+
+    fm = fleet_mesh(MESH_DEVICES)
+    engine = IncrementalSolveEngine(epsilon=0.05, full_every=0)
+    _engine_cycle(fleet_spec(n), engine, fm)            # warm-up
+    per_cycle = []
+    # one discarded churn cycle first: the warm-up packed a FRESH slab
+    # (full upload), so the first in-place scatter — and its one-time
+    # compile — happens here, not inside the measured run
+    for i in range(-1, STEADY_CYCLES):
+        # churn a handful of variants well past epsilon: the arena
+        # re-packs by scattering only the changed lanes
+        from dataclasses import replace as dc_replace
+
+        spec = fleet_spec(n)
+        churned = [
+            dc_replace(srv, current_alloc=dc_replace(
+                srv.current_alloc, load=dc_replace(
+                    srv.current_alloc.load,
+                    arrival_rate=srv.current_alloc.load.arrival_rate
+                    + 300.0 * (i + 2))))
+            for srv in spec.servers[:5]]
+        spec = dc_replace(spec, servers=churned + list(spec.servers[5:]))
+        before = JAX_AUDIT.snapshot()
+        _engine_cycle(spec, engine, fm)
+        if i < 0:
+            continue
+        delta = JAX_AUDIT.delta(before, JAX_AUDIT.snapshot())
+        per_cycle.append({
+            "retraces": sum(delta.get("retraces", {}).values()),
+            "d2h": delta.get("transfers", {}).get("d2h", 0),
+            "h2d": delta.get("transfers", {}).get("h2d", 0),
+            "sharded": delta.get("sharded", {}),
+        })
+    return {
+        "cycles": STEADY_CYCLES,
+        "mesh_devices": MESH_DEVICES,
+        "retraces_total": sum(c["retraces"] for c in per_cycle),
+        "d2h_per_cycle": sorted({c["d2h"] for c in per_cycle}),
+        "h2d_per_cycle": sorted({c["h2d"] for c in per_cycle}),
+        "sharded_d2h_per_cycle": sorted(
+            {c["sharded"].get(f"d2h@{MESH_DEVICES}", 0)
+             for c in per_cycle}),
+    }
+
+
+def greedy_compare(n: int) -> dict:
+    """solve_greedy on the no-sharing capacity-limited shape: the
+    sequential list scheduler vs the vectorized component sweep, same
+    System, published allocations asserted identical."""
+    from workload_variant_autoscaler_tpu.models import SaturationPolicy, System
+    from workload_variant_autoscaler_tpu.solver.greedy import solve_greedy
+
+    system = System()
+    system.set_from_spec(fleet_spec(n, distinct_loads=True, limited=True))
+    system.calculate(backend="batched")
+
+    def run(mode: str) -> tuple[float, dict]:
+        os.environ["WVA_VECTOR_GREEDY"] = mode
+        t0 = time.perf_counter()
+        solve_greedy(system, SaturationPolicy.NONE)
+        wall = (time.perf_counter() - t0) * 1000.0
+        out = {name: (a.accelerator, a.num_replicas, a.cost, a.value)
+               for name, a in ((s.name, s.allocation)
+                               for s in system.servers.values())
+               if a is not None}
+        return wall, out
+
+    try:
+        run("on")                       # compile the sweep
+        seq = [run("off") for _ in range(5)]
+        vec = [run("on") for _ in range(5)]
+    finally:
+        os.environ.pop("WVA_VECTOR_GREEDY", None)
+    assert seq[0][1] == vec[0][1], "vector greedy diverged from sequential"
+    assert len(seq[0][1]) == n
+    seq_ms = statistics.median(w for w, _ in seq)
+    vec_ms = statistics.median(w for w, _ in vec)
+    return {
+        "variants": n,
+        "shape": "no-sharing capacity-limited (distinct loads)",
+        "sequential_ms_p50": round(seq_ms, 2),
+        "vector_ms_p50": round(vec_ms, 2),
+        "speedup": round(seq_ms / vec_ms, 2),
+    }
+
+
+def measure(sizes) -> dict:
+    walls = {}
+    for n in sizes:
+        walls[str(n)] = {
+            "unsharded": forced_full_walls(n, sharded=False),
+            "sharded": forced_full_walls(n, sharded=True),
+        }
+    return walls
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv[1:]
+
+    steady = churn_run(SMOKE_SIZES[1] if smoke else 512)
+    assert steady["retraces_total"] == 0, steady
+    assert steady["d2h_per_cycle"] == [1], \
+        f"expected exactly one bulk readback per cycle: {steady}"
+    assert steady["sharded_d2h_per_cycle"] == [1], \
+        f"the bulk readback must cross the sharded boundary: {steady}"
+
+    if smoke:
+        walls = measure(SMOKE_SIZES)
+        print(json.dumps({
+            "bench": "shard-smoke", "sizes": list(SMOKE_SIZES),
+            "mesh_devices": MESH_DEVICES,
+            "steady_state": steady,
+            "walls": walls,
+        }), flush=True)
+        return
+
+    # timing claims retry on the bench stagger: a co-tenant burst on
+    # this box is transient, a real regression is not
+    budget = resolve_budget(os.environ)
+    retry_s = float(os.environ.get("WVA_BENCH_RETRY_INTERVAL_S", "120"))
+    deadline = time.monotonic() + budget["window"]
+    attempts = 0
+    while True:
+        attempts += 1
+        walls = measure(SIZES)
+        greedy = greedy_compare(GREEDY_N)
+        headline = walls["8192"]["sharded"]["analyze_optimize_ms_p50"]
+        vs_512_cycle = headline / R07_CYCLE_MS
+        ok = vs_512_cycle <= 2.0 and greedy["speedup"] >= 3.0
+        if ok or time.monotonic() + retry_s >= deadline:
+            break
+        time.sleep(retry_s)
+
+    out = {
+        "metric": "sharded_full_pass_ms_8192",
+        "bench": "shard",
+        "value": headline,
+        "unit": "ms analyze+optimize, 8192-variant forced full pass, "
+                f"{MESH_DEVICES}-device host mesh",
+        "mesh_devices": MESH_DEVICES,
+        "r07_cycle_wall_ms": R07_CYCLE_MS,
+        "vs_512_cycle_wall": round(vs_512_cycle, 3),
+        "attempts": attempts,
+        "walls": walls,
+        "steady_state": steady,
+        "greedy": greedy,
+    }
+    assert out["vs_512_cycle_wall"] <= 2.0, out
+    assert out["greedy"]["speedup"] >= 3.0, out
+    with open(OUT, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,12 @@ from workload_variant_autoscaler_tpu.utils.platform import force_cpu
 
 force_cpu(n_devices=8)
 
+# The 8-virtual-device CPU mesh above is an artifact of the test harness:
+# every transfer/retrace pin in the suite describes the single-device
+# reality WVA_SHARDED_FLEET=auto would otherwise flip to "on" here.
+# Sharded-fleet tests opt in explicitly by forcing the knob to "on".
+os.environ.setdefault("WVA_SHARDED_FLEET", "off")
+
 import jax
 
 # float64 on CPU for tight numerical cross-checks against the numpy

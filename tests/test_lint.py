@@ -143,7 +143,7 @@ class TestCallArity:
     ["workload_variant_autoscaler_tpu", "tools", "tests", "bench.py",
      "bench_loop.py", "bench_collect.py", "bench_goodput.py",
      "bench_profile.py", "bench_fuse.py", "bench_stream.py",
-     "__graft_entry__.py"],
+     "bench_shard.py", "__graft_entry__.py"],
 ])
 def test_package_lints_clean(paths):
     """The gate itself: the shipped source must lint clean — every rule
@@ -1117,7 +1117,8 @@ class TestKnobParity:
         # drivers read WVA_* knobs too (WVA_BENCH_*, WVA_GOODPUT_*)
         for sub in ("workload_variant_autoscaler_tpu", "tools", "tests",
                     "bench.py", "bench_loop.py", "bench_collect.py",
-                    "bench_goodput.py", "bench_profile.py"):
+                    "bench_goodput.py", "bench_profile.py",
+                    "bench_shard.py"):
             for fp in wvalint.iter_py_files([os.path.join(REPO, sub)]):
                 files.append(fp)
                 with open(fp, encoding="utf-8") as f:

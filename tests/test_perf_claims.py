@@ -443,6 +443,62 @@ def test_streamchaos_claims_match_artifact():
     assert f"{art['lag_budget_ms']:.0f} ms budget" in flat
 
 
+def test_shard_claims_match_artifact():
+    """Round-13 sharded fleet arena: the committed BENCH_shard_r13.json
+    must (a) justify the headline — the 8192-variant sharded forced-full
+    analyze+optimize wall within 2x the committed BENCH_solve_r07
+    512-variant cycle wall it cites (cross-checked against the r07
+    artifact, so the baseline can't drift), (b) hold the steady-state
+    transfer discipline — zero retraces, one bulk sharded d2h per churn
+    cycle, (c) clear the >=3x vectorized-greedy floor on the 4096-variant
+    no-sharing shape, and (d) match docs/observability.md."""
+    art = _artifact("BENCH_shard_r13.json")
+    assert art["bench"] == "shard"
+    assert art["mesh_devices"] == 8
+    r07 = _artifact("BENCH_solve_r07.json")
+    assert art["r07_cycle_wall_ms"] == \
+        r07["incremental"]["cycle_wall_ms_p50"], \
+        "the cited r07 cycle-wall baseline drifted from BENCH_solve_r07"
+    sharded_8192 = art["walls"]["8192"]["sharded"]
+    assert sharded_8192["variants"] == 8192 and sharded_8192["sharded"]
+    assert art["value"] == sharded_8192["analyze_optimize_ms_p50"]
+    assert art["vs_512_cycle_wall"] == pytest.approx(
+        art["value"] / art["r07_cycle_wall_ms"], abs=0.01)
+    assert art["vs_512_cycle_wall"] <= 2.0, \
+        "artifact no longer justifies the flat-to-8192 headline"
+    # every size carries both pipelines, measured on the same fleet
+    for size, walls in art["walls"].items():
+        assert walls["unsharded"]["variants"] == int(size)
+        assert not walls["unsharded"]["sharded"]
+        assert walls["sharded"]["sharded"]
+    # (b) steady-state churn: resident slabs re-scatter without
+    # recompiling; one bulk sharded readback per cycle
+    steady = art["steady_state"]
+    assert steady["retraces_total"] == 0
+    assert steady["d2h_per_cycle"] == [1]
+    assert steady["sharded_d2h_per_cycle"] == [1]
+    assert steady["h2d_per_cycle"] == [16]  # 1 scatter index + 15 columns
+    # (c) the vectorized greedy floor, with identical-allocation shape
+    greedy = art["greedy"]
+    assert greedy["variants"] == 4096
+    assert greedy["speedup"] >= 3.0, \
+        "artifact no longer justifies the >=3x vectorized-greedy claim"
+    assert greedy["speedup"] == pytest.approx(
+        greedy["sequential_ms_p50"] / greedy["vector_ms_p50"], abs=0.01)
+    # (d) doc parity: observability.md quotes this artifact
+    doc = (REPO / "docs" / "observability.md").read_text()
+    flat = " ".join(doc.split())
+    assert f"**{art['value']:.1f} ms** sharded" in flat, \
+        "observability.md's 8192 sharded wall drifted from the artifact"
+    assert f"**{art['vs_512_cycle_wall']}×**" in flat, \
+        "observability.md's vs-r07 ratio drifted from the artifact"
+    assert f"{art['r07_cycle_wall_ms']} ms" in flat
+    assert f"{greedy['sequential_ms_p50']} ms sequential" in flat
+    assert f"**{greedy['vector_ms_p50']} ms**" in flat
+    assert f"**{greedy['speedup']}×**" in flat, \
+        "observability.md's greedy speedup drifted from the artifact"
+
+
 def test_capstone_claims_match_baseline_json():
     """Round-5 whole-fleet capstone: every quoted tail and the headline
     must equal the committed BASELINE.json entry, and the entry itself

@@ -1,0 +1,475 @@
+"""Sharded fleet solve (WVA_SHARDED_FLEET) and the vectorized greedy.
+
+The load-bearing properties, pinned here:
+
+- the lane mesh is a PLACEMENT knob, never a result knob: sharded and
+  unsharded engines publish identical allocations through 210 cycles of
+  randomized fleet churn (grow/shrink, epsilon-straddling load jitter,
+  capacity changes, degradation rungs);
+- the sharded resident arena's donated scatter produces device slabs
+  BIT-IDENTICAL to a from-scratch upload of the same rows (compared by
+  bit pattern — rho/rate_star lanes legitimately hold NaN);
+- per-shard padding lanes stay invisible to the solve-lane ledger and
+  to `inferno_solve_lanes`;
+- the vectorized greedy sweep resolves uncontended pool-connected
+  components to exactly the sequential list scheduler's allocations,
+  and contended components fall back to that scheduler verbatim.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+import helpers
+from test_incremental_solve import (
+    ChurnDriver,
+    assert_solutions_equal,
+    make_spec,
+)
+
+from workload_variant_autoscaler_tpu.models import (
+    Allocation,
+    SaturationPolicy,
+    System,
+)
+from workload_variant_autoscaler_tpu.obs.profile import JAX_AUDIT
+from workload_variant_autoscaler_tpu.ops.arena import (
+    CandidateArena,
+    ShardedFleetArena,
+)
+from workload_variant_autoscaler_tpu.parallel import (
+    candidate_mesh,
+    fleet_mesh,
+    is_lane_mesh,
+    padded_lanes,
+)
+from workload_variant_autoscaler_tpu.solver import (
+    IncrementalSolveEngine,
+    Manager,
+    Optimizer,
+)
+from workload_variant_autoscaler_tpu.solver.greedy import (
+    _vector_fast_pass,
+    solve_greedy,
+    vector_greedy_enabled,
+)
+
+
+def bits(a) -> np.ndarray:
+    """Bit-pattern view for exactness checks: elementwise `==` reports
+    False for identical NaNs (rho/rate_star lanes hold them
+    legitimately), so equality must compare the bytes."""
+    return np.ascontiguousarray(np.asarray(a)).view(np.uint8)
+
+
+def assert_bit_equal(a, b, msg=""):
+    assert np.asarray(a).dtype == np.asarray(b).dtype, msg
+    np.testing.assert_array_equal(bits(a), bits(b), err_msg=msg)
+
+
+# ---------------------------------------------------------------------------
+# mesh edge cases
+# ---------------------------------------------------------------------------
+
+class TestFleetMesh:
+    def test_single_device_degenerates_to_unsharded(self):
+        # a 1-device lane mesh would be the unsharded program with
+        # extra dispatch; the builder refuses it instead
+        assert fleet_mesh(1) is None
+        assert is_lane_mesh(None) is False
+
+    def test_axis_binding(self):
+        assert is_lane_mesh(fleet_mesh(2))
+        assert not is_lane_mesh(candidate_mesh(2))
+
+    def test_padded_lanes_per_shard(self):
+        # each of `shards` contiguous shards holds a multiple of m
+        # (and at least m) lanes
+        assert padded_lanes(5, 16, 8) == 128
+        assert padded_lanes(130, 16, 8) == 256   # non-divisible batch
+        assert padded_lanes(1, 16, 2) == 32
+        assert padded_lanes(16, 16, 1) == 16     # degenerate: global pad
+        assert padded_lanes(8192, 16, 8) == 8192
+        for b, m, s in [(5, 16, 8), (130, 16, 8), (1, 16, 2), (77, 16, 4)]:
+            total = padded_lanes(b, m, s)
+            assert total >= b
+            assert total % s == 0
+            assert (total // s) % m == 0
+
+    def test_pad_to_multiple_default_byte_identical(self):
+        from workload_variant_autoscaler_tpu.ops.batched import (
+            SLOTargets,
+            make_queue_batch,
+        )
+        from workload_variant_autoscaler_tpu.parallel import pad_to_multiple
+
+        q = make_queue_batch([6.9, 3.2], [0.03, 0.01], [5.2, 2.4],
+                             [0.1, 0.04], [128.0, 128.0], [128.0, 200.0],
+                             [16, 23])
+        slo = SLOTargets(ttft=np.asarray([500.0, 2000.0], q.alpha.dtype),
+                         itl=np.asarray([24.0, 80.0], q.alpha.dtype),
+                         tps=np.asarray([0.0, 0.0], q.alpha.dtype))
+        qa, sa, ba = pad_to_multiple(q, slo, 16)
+        qb, sb, bb = pad_to_multiple(q, slo, 16, shards=1)
+        assert ba == bb
+        for name in qa._fields:
+            assert_bit_equal(getattr(qa, name), getattr(qb, name), name)
+        for name in sa._fields:
+            assert_bit_equal(getattr(sa, name), getattr(sb, name), name)
+
+    def test_pad_to_multiple_per_shard(self):
+        from workload_variant_autoscaler_tpu.ops.batched import (
+            SLOTargets,
+            make_queue_batch,
+        )
+        from workload_variant_autoscaler_tpu.parallel import pad_to_multiple
+
+        q = make_queue_batch([6.9] * 5, [0.03] * 5, [5.2] * 5, [0.1] * 5,
+                             [128.0] * 5, [128.0] * 5, [16] * 5)
+        slo = SLOTargets(ttft=np.asarray([500.0] * 5, q.alpha.dtype),
+                         itl=np.asarray([24.0] * 5, q.alpha.dtype),
+                         tps=np.asarray([0.0] * 5, q.alpha.dtype))
+        qp, _sp, b = pad_to_multiple(q, slo, 16, shards=8)
+        assert b == 5
+        assert qp.batch_size == padded_lanes(5, 16, 8) == 128
+        valid = np.asarray(qp.valid)
+        assert valid[:5].all() and not valid[5:].any()
+        # real lanes ride through untouched
+        assert_bit_equal(np.asarray(qp.alpha)[:5], np.asarray(q.alpha))
+
+    def test_mesh_rebuild_on_device_count_change(self):
+        # Mesh identity (hash/eq) covers device assignment AND axis
+        # names: the lru-cached sharded programs can never serve a
+        # stale executable after the mesh is rebuilt with a different
+        # device count, and the candidate mesh can never alias the
+        # lane mesh over the same devices.
+        m2, m4 = fleet_mesh(2), fleet_mesh(4)
+        assert m2 != m4 and hash(m2) != hash(m4)
+        assert m2 == fleet_mesh(2)  # rebuild with same devices: equal
+        assert candidate_mesh(2) != m2
+
+
+# ---------------------------------------------------------------------------
+# the sharded resident arena
+# ---------------------------------------------------------------------------
+
+ROWS = dict(
+    alpha=[6.973, 3.2, 9.0, 5.0, 7.7], beta=[0.027, 0.012, 0.06, 0.03, 0.01],
+    gamma=[5.2, 2.4, 7.0, 4.0, 6.1], delta=[0.1, 0.04, 0.15, 0.08, 0.02],
+    in_tokens=[128.0] * 5, out_tokens=[128.0, 128.0, 200.0, 256.0, 64.0],
+    max_batch=[16, 23, 20, 23, 64],
+    ttft=[500.0, 500.0, 2000.0, 2000.0, 500.0],
+    itl=[24.0, 24.0, 80.0, 80.0, 24.0],
+    tps=[0.0] * 5,
+    demand=[3.0, 4.5, 1.0, 2.0, 8.0], min_replicas=[1, 1, 0, 2, 1],
+    cost_rate=[20.0, 80.0, 80.0, 340.0, 20.0],
+)
+
+
+def _fields(q, slo, epi):
+    for name in q._fields:
+        yield name, getattr(q, name)
+    for name in slo._fields:
+        yield "slo_" + name, getattr(slo, name)
+    if epi is not None:
+        for name in epi._fields:
+            yield "epi_" + name, getattr(epi, name)
+
+
+class TestShardedFleetArena:
+    def test_full_upload_then_scatter_then_noop(self):
+        mesh = fleet_mesh(8)
+        arena = ShardedFleetArena(mesh)
+
+        before = JAX_AUDIT.snapshot()
+        q, _slo, _epi = arena.pack(dict(ROWS))
+        d = JAX_AUDIT.delta(before, JAX_AUDIT.snapshot())
+        assert q.batch_size == padded_lanes(5, 16, 8) == 128
+        assert arena.full_uploads == 1
+        # whole-slab upload: one h2d per column, tallied per shard count
+        assert d["transfers"]["h2d"] == 15
+        assert d["sharded"] == {"h2d@8": 15}
+
+        rows = {k: list(v) for k, v in ROWS.items()}
+        rows["alpha"][2] = 9.5
+        before = JAX_AUDIT.snapshot()
+        arena.pack(rows)
+        d = JAX_AUDIT.delta(before, JAX_AUDIT.snapshot())
+        assert arena.scatter_packs == 1 and arena.lanes_scattered == 1
+        # incremental scatter: ONE index upload + one value slice per
+        # column — never a whole-slab h2d on churn
+        assert d["transfers"]["h2d"] == 16
+        assert d["sharded"]["h2d@8"] == 16
+
+        before = JAX_AUDIT.snapshot()
+        arena.pack(rows)             # identical rows: zero transfers
+        d = JAX_AUDIT.delta(before, JAX_AUDIT.snapshot())
+        assert arena.noop_packs == 1
+        assert d["transfers"] == {}
+
+    def test_scatter_bitwise_equals_fresh_upload(self):
+        mesh = fleet_mesh(8)
+        churned = ShardedFleetArena(mesh)
+        churned.pack(dict(ROWS))
+        rows = {k: list(v) for k, v in ROWS.items()}
+        rows["alpha"][0] = 7.25
+        rows["demand"][4] = 9.75
+        out_scatter = churned.pack(rows)
+        assert churned.scatter_packs == 1
+
+        fresh = ShardedFleetArena(mesh)
+        out_fresh = fresh.pack(rows)
+        for (name, a), (_n, b) in zip(_fields(*out_scatter),
+                                      _fields(*out_fresh)):
+            assert_bit_equal(a, b, name)
+
+    def test_pack_matches_unsharded_arena_on_real_lanes(self):
+        mesh = fleet_mesh(8)
+        sharded = ShardedFleetArena(mesh).pack(dict(ROWS))
+        plain = CandidateArena().pack(dict(ROWS))
+        for (name, a), (_n, b) in zip(_fields(*sharded), _fields(*plain)):
+            assert_bit_equal(np.asarray(a)[:5], np.asarray(b)[:5], name)
+        # per-shard padding carries the same benign fills the global
+        # padding does: every padded lane is invalid
+        valid = np.asarray(sharded[0].valid)
+        assert valid[:5].all() and not valid[5:].any()
+
+
+# ---------------------------------------------------------------------------
+# the ledger: padding lanes are invisible
+# ---------------------------------------------------------------------------
+
+class TestLedgerPadding:
+    def test_solve_lane_ledger_excludes_per_shard_padding(self):
+        servers = [helpers.server_spec(name=f"v{i}:ns", model="m-a",
+                                       arrival_rpm=300.0 + 40.0 * i)
+                   for i in range(3)]
+        spec = make_spec(servers, {"v5e": 400})
+
+        plain = System()
+        plain.set_from_spec(spec)
+        plain.calculate(backend="batched")
+        lanes = plain.last_solve_lanes
+        assert 0 < lanes < padded_lanes(lanes, 16, 8)
+
+        sharded = System()
+        sharded.set_from_spec(spec)
+        sharded.calculate(backend="batched", mesh=fleet_mesh(8))
+        assert sharded.last_solve_lanes == lanes
+        assert sharded.last_unique_lanes == plain.last_unique_lanes
+
+    def test_inferno_solve_lanes_sharded_reconciler(self, monkeypatch):
+        # full wiring: WVA_SHARDED_FLEET=on routes the reconciler's
+        # engine pass over the lane mesh; the emitted lane counts must
+        # describe candidates, not the 128-lane padded shard batch
+        from test_incremental_solve import make_cluster, set_load
+
+        monkeypatch.setenv("WVA_SHARDED_FLEET", "on")
+        _kube, prom, emitter, rec = make_cluster(("llama-8b", "llama-8x"))
+        set_load(prom, "llama-8b", 40.0)
+        set_load(prom, "llama-8x", 25.0)
+        rec.reconcile()
+        assert emitter.value("inferno_solve_lanes", state="solved") == 2
+        # steady state over the sharded resident arena: cached lanes
+        rec.reconcile()
+        assert emitter.value("inferno_solve_lanes", state="solved") == 0
+        assert emitter.value("inferno_solve_lanes", state="skipped") == 2
+
+
+# ---------------------------------------------------------------------------
+# sharded == unsharded through 210 cycles of randomized churn
+# ---------------------------------------------------------------------------
+
+def _engine_cycle(spec, engine, fm, rungs, cycle_rung):
+    system = System()
+    opt_spec = system.set_from_spec(spec)
+    engine.calculate(system, backend="batched", fleet_mesh=fm,
+                     optimizer_spec=opt_spec, rungs=rungs,
+                     cycle_rung=cycle_rung)
+    Manager(system, Optimizer(opt_spec)).optimize(warm=engine.warm_start())
+    solution = system.generate_solution()
+    engine.finish_cycle(system)
+    return solution
+
+
+@pytest.mark.parametrize("unlimited,policy,vector", [
+    (True, "None", "off"),
+    (False, "RoundRobin", "on"),
+])
+def test_sharded_churn_equivalence(unlimited, policy, vector, monkeypatch):
+    """210 cycles of seeded churn through BOTH pipelines — the lane-mesh
+    engine (and, limited-mode, the force-enabled vectorized greedy)
+    against the plain engine — requiring identical published allocations
+    every cycle, forced-full boundaries (full_every=7) included."""
+    monkeypatch.setenv("WVA_VECTOR_GREEDY", "off")
+    eps = 0.05
+    fm = fleet_mesh(8)
+    assert fm is not None
+    d_mesh = ChurnDriver(seed=0x13D, epsilon=eps)
+    d_ref = ChurnDriver(seed=0x13D, epsilon=eps)
+    e_mesh = IncrementalSolveEngine(epsilon=eps, full_every=7)
+    e_ref = IncrementalSolveEngine(epsilon=eps, full_every=7)
+    for cycle in range(210):
+        d_mesh.churn()
+        d_ref.churn()
+        rung = "stale-cache" if d_mesh.rungs else "healthy"
+        monkeypatch.setenv("WVA_VECTOR_GREEDY", vector)
+        sol_mesh = _engine_cycle(
+            make_spec(d_mesh.servers(), d_mesh.capacity, unlimited, policy),
+            e_mesh, fm, dict(d_mesh.rungs), rung)
+        monkeypatch.setenv("WVA_VECTOR_GREEDY", "off")
+        sol_ref = _engine_cycle(
+            make_spec(d_ref.servers(), d_ref.capacity, unlimited, policy),
+            e_ref, None, dict(d_ref.rungs), rung)
+        assert_solutions_equal(sol_mesh, sol_ref, cycle)
+
+
+# ---------------------------------------------------------------------------
+# the vectorized greedy
+# ---------------------------------------------------------------------------
+
+def set_candidates(system, server_name, candidates):
+    server = system.servers[server_name]
+    server.all_allocations = {a.accelerator: a for a in candidates}
+
+
+def alloc(acc, replicas, cost, value=None):
+    a = Allocation(accelerator=acc, num_replicas=replicas, cost=cost)
+    a.value = cost if value is None else value
+    return a
+
+
+def build_random_fleet(seed, n=24):
+    rng = random.Random(seed)
+    servers = [helpers.server_spec(
+        name=f"s{i:03d}",
+        service_class=rng.choice(["Premium", "Freemium"]))
+        for i in range(n)]
+    cap = {"v5e": rng.randint(0, 60), "v5p": rng.randint(0, 60)}
+    system, _ = helpers.make_system(servers, capacity=cap)
+    accs = ["v5e-1", "v5e-4", "v5p-4"]
+    for i in range(n):
+        cands = []
+        for acc in rng.sample(accs, rng.randint(0, len(accs))):
+            cands.append(alloc(acc, rng.randint(0, 4),
+                               cost=rng.choice([10.0, 20.0, 20.0, 40.0]),
+                               value=rng.choice([5.0, 10.0, 10.0, 30.0])))
+        set_candidates(system, f"s{i:03d}", cands)
+    return system
+
+
+def snap(system):
+    out = {}
+    for name, s in system.servers.items():
+        a = s.allocation
+        out[name] = None if a is None else (
+            a.accelerator, a.num_replicas, a.cost, a.value)
+    return out
+
+
+class TestVectorGreedy:
+    def test_knob_parsing(self, monkeypatch):
+        monkeypatch.setenv("WVA_VECTOR_GREEDY", "off")
+        assert not vector_greedy_enabled(10**6)
+        monkeypatch.setenv("WVA_VECTOR_GREEDY", "on")
+        assert vector_greedy_enabled(1)
+        monkeypatch.setenv("WVA_VECTOR_GREEDY", "auto")
+        assert not vector_greedy_enabled(1023)
+        assert vector_greedy_enabled(1024)
+        monkeypatch.setenv("WVA_VECTOR_GREEDY_MIN", "64")
+        assert vector_greedy_enabled(64)
+
+    def test_auto_floor_keeps_small_fleets_sequential(self, monkeypatch):
+        monkeypatch.delenv("WVA_VECTOR_GREEDY", raising=False)
+        system = build_random_fleet(1, n=4)
+        assert _vector_fast_pass(system, None, dict(system.capacity)) is None
+
+    def test_uncontended_component_resolved_in_sweep(self, monkeypatch):
+        monkeypatch.setenv("WVA_VECTOR_GREEDY", "on")
+        servers = [helpers.server_spec(name=f"s{i}") for i in range(3)]
+        system, _ = helpers.make_system(servers, capacity={"v5e": 100})
+        for i in range(3):
+            set_candidates(system, f"s{i}",
+                           [alloc("v5e-1", 2, 40.0), alloc("v5e-4", 1, 80.0)])
+        remaining = _vector_fast_pass(system, None, dict(system.capacity))
+        assert remaining == set()   # whole component fits: nothing left
+        for i in range(3):
+            assert system.servers[f"s{i}"].allocation.accelerator == "v5e-1"
+
+    def test_contended_component_falls_back_sequential(self, monkeypatch):
+        # scarce capacity: the sweep must hand the WHOLE component to
+        # the sequential scheduler, which gives priority its due
+        monkeypatch.setenv("WVA_VECTOR_GREEDY", "on")
+        servers = [
+            helpers.server_spec(name="free", service_class="Freemium"),
+            helpers.server_spec(name="prem", service_class="Premium"),
+        ]
+        system, _ = helpers.make_system(servers, capacity={"v5e": 2})
+        set_candidates(system, "free", [alloc("v5e-1", 2, 40.0)])
+        set_candidates(system, "prem", [alloc("v5e-1", 2, 40.0)])
+        remaining = _vector_fast_pass(system, None, dict(system.capacity))
+        assert remaining == {"free", "prem"}
+        solve_greedy(system, SaturationPolicy.NONE)
+        assert system.servers["prem"].allocation is not None
+        assert system.servers["free"].allocation is None
+
+    def test_vanished_accelerator_stays_unallocated(self, monkeypatch):
+        # a min-value candidate whose accelerator left the cluster:
+        # the sequential loop skips the server without advancing —
+        # the sweep must reproduce that, consuming no capacity
+        monkeypatch.setenv("WVA_VECTOR_GREEDY", "on")
+        servers = [helpers.server_spec(name="a"),
+                   helpers.server_spec(name="b")]
+        system, _ = helpers.make_system(servers, capacity={"v5e": 2})
+        set_candidates(system, "a", [alloc("ghost-acc", 1, 5.0),
+                                     alloc("v5e-1", 2, 40.0)])
+        set_candidates(system, "b", [alloc("v5e-1", 2, 40.0)])
+        solve_greedy(system, SaturationPolicy.NONE)
+        assert system.servers["a"].allocation is None
+        assert system.servers["b"].allocation is not None
+
+    @pytest.mark.parametrize("policy", list(SaturationPolicy))
+    def test_randomized_equivalence(self, policy, monkeypatch):
+        """Forced-on sweep vs sequential across random fleets: mixed
+        priorities, partial candidate sets, scarce and ample pools,
+        every saturation policy — identical allocations, costs, and
+        values every time."""
+        for seed in range(30):
+            sys_seq = build_random_fleet(seed)
+            sys_vec = build_random_fleet(seed)
+            monkeypatch.setenv("WVA_VECTOR_GREEDY", "off")
+            solve_greedy(sys_seq, policy)
+            monkeypatch.setenv("WVA_VECTOR_GREEDY", "on")
+            solve_greedy(sys_vec, policy)
+            assert snap(sys_seq) == snap(sys_vec), (seed, policy)
+
+
+# ---------------------------------------------------------------------------
+# the smoke bench: tier-1 wiring for `make shard-smoke`
+# ---------------------------------------------------------------------------
+
+def test_shard_smoke_bench_passes():
+    """`make shard-smoke` in-suite: the abbreviated sharded run
+    (bench_shard.py --smoke) asserts zero retraces over a 10-cycle churn
+    run on the forced 8-device host mesh and exactly ONE bulk d2h —
+    crossing the sharded boundary — per cycle. Run as a subprocess: the
+    bench pins its own env (forced device count, x64, XLA backend)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench_shard.py"), "--smoke"],
+        capture_output=True, text=True, cwd=repo, timeout=420)
+    assert r.returncode == 0, f"shard smoke failed:\n{r.stdout}\n{r.stderr}"
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["bench"] == "shard-smoke"
+    assert line["mesh_devices"] == 8
+    assert line["steady_state"]["retraces_total"] == 0
+    assert line["steady_state"]["d2h_per_cycle"] == [1]
+    assert line["steady_state"]["sharded_d2h_per_cycle"] == [1]

@@ -1934,6 +1934,7 @@ def _check_stage_literals(path: str, tree: ast.Module,
 _READBACK_DIRS = (
     os.path.join("workload_variant_autoscaler_tpu", "models"),
     os.path.join("workload_variant_autoscaler_tpu", "ops"),
+    os.path.join("workload_variant_autoscaler_tpu", "parallel"),
 )
 _AUDIT_CALLS = ("note_transfer", "note_readback")
 

@@ -812,6 +812,7 @@ class Reconciler:
         engine_backend = translate.engine_backend()
         ttft_percentile = translate.ttft_percentile(operator_cm)
         engine_mesh = translate.engine_mesh(engine_backend)
+        fleet_mesh = translate.sharded_fleet_mesh(engine_backend)
         # scoped micro-cycles bypass the incremental engine (its caches
         # describe the FULL fleet; a scoped pass must not advance or
         # prune them) and solve the event's sub-batch directly, through
@@ -835,6 +836,7 @@ class Reconciler:
         if solve_engine is not None:
             stats = solve_engine.calculate(
                 system, backend=engine_backend, mesh=engine_mesh,
+                fleet_mesh=fleet_mesh,
                 ttft_percentile=ttft_percentile,
                 optimizer_spec=optimizer_spec,
                 rungs=dict(result.degraded),
@@ -843,8 +845,12 @@ class Reconciler:
             self.emitter.emit_solve_metrics(
                 stats.modes, stats.lanes_solved, stats.lanes_skipped)
         else:
-            system.calculate(backend=engine_backend, mesh=engine_mesh,
-                             ttft_percentile=ttft_percentile)
+            # scoped micro-cycles stay unsharded: their sub-batches are
+            # tiny and the stream arena is single-device resident.
+            system.calculate(
+                backend=engine_backend,
+                mesh=engine_mesh or (fleet_mesh if scope is None else None),
+                ttft_percentile=ttft_percentile)
             solve_modes = dict.fromkeys(system.servers, SOLVE_FULL)
             self.emitter.emit_solve_metrics(
                 {SOLVE_FULL: len(system.servers)},

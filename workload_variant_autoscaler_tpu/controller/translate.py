@@ -468,6 +468,46 @@ def engine_mesh(backend: str):
     return candidate_mesh(n)
 
 
+def sharded_fleet_mesh(backend: str):
+    """Optional variant/lane-axis device mesh for whole-fleet solves.
+
+    WVA_SHARDED_FLEET: "auto" (default — shard when more than one local
+    device exists), "on" (shard; still degenerates to the unsharded
+    program on a 1-device host), or "off". WVA_FLEET_MESH_DEVICES
+    ("all" default, or a device count) bounds the mesh size. Forced
+    multi-device CPU testing works via
+    XLA_FLAGS=--xla_force_host_platform_device_count=N. Only meaningful
+    for the batched backend; ignored (with a warning) otherwise."""
+    raw = os.environ.get("WVA_SHARDED_FLEET", "auto").strip().lower()
+    if raw in ("", "off", "0", "false", "no"):
+        return None
+    if raw not in ("on", "1", "true", "yes", "auto"):
+        log.warning("bad WVA_SHARDED_FLEET, ignoring", extra=kv(value=raw))
+        return None
+    if backend != "batched":
+        if raw != "auto":
+            log.warning("WVA_SHARDED_FLEET ignored: fleet sharding "
+                        "requires the batched backend",
+                        extra=kv(backend=backend))
+        return None
+    from ..parallel import fleet_mesh
+
+    size = os.environ.get("WVA_FLEET_MESH_DEVICES", "all").strip().lower()
+    n = None
+    if size and size != "all":
+        try:
+            n = int(size)
+        except ValueError:
+            n = 0
+        if n <= 0:
+            log.warning("bad WVA_FLEET_MESH_DEVICES, ignoring",
+                        extra=kv(value=size))
+            n = None
+    # fleet_mesh returns None below two devices: "auto" and "on" both
+    # degenerate to the unsharded program on a single-device host
+    return fleet_mesh(n)
+
+
 def add_server_info_to_system_data(
     spec: SystemSpec, va: crd.VariantAutoscaling, class_name: str,
     demand_headroom: float = 0.0,

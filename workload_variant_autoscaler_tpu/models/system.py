@@ -91,7 +91,11 @@ class System:
         self.arena = None
         # candidate lanes examined by the LAST calculate() call (kernel
         # lanes + zero-load fast-path allocations) — the number the
-        # incremental engine's skip telemetry is measured against
+        # incremental engine's skip telemetry is measured against.
+        # Counted from _candidate_pairs, never from packed batches:
+        # padding (global or per-shard on a lane mesh) must stay
+        # invisible to the ledger and to inferno_solve_lanes
+        # (tests/test_shard.py pins this)
         self.last_solve_lanes = 0
         # distinct lanes the fused path actually dispatched after
         # identical-lane dedup (_dedup_rows); equals the sized-lane
@@ -329,13 +333,19 @@ class System:
     def _pack_group(self, rows, bucket: int, mesh):
         """Device-ready (q, slo, epi|None) for one group: the resident
         arena's scatter path when attached (bit-identical arrays to the
-        list path), else make_queue_batch + pad_to_multiple."""
+        list path), else make_queue_batch + pad_to_multiple. A sharded
+        fleet arena serves lane-mesh packs (its slabs are resident on
+        that mesh); the plain arena serves unsharded packs only."""
         import jax.numpy as jnp
 
         from ..ops.batched import SLOTargets, make_queue_batch
+        from ..parallel import is_lane_mesh, pad_to_multiple
 
-        if self.arena is not None and mesh is None:
-            return self.arena.pack(rows, quantum=bucket)
+        if self.arena is not None:
+            arena_mesh = getattr(self.arena, "mesh", None)
+            if (mesh is None and arena_mesh is None) or (
+                    arena_mesh is not None and arena_mesh == mesh):
+                return self.arena.pack(rows, quantum=bucket)
         q = make_queue_batch(rows["alpha"], rows["beta"], rows["gamma"],
                              rows["delta"], rows["in_tokens"],
                              rows["out_tokens"], rows["max_batch"])
@@ -345,9 +355,8 @@ class System:
             itl=jnp.asarray(rows["itl"], dtype),
             tps=jnp.asarray(rows["tps"], dtype),
         )
-        from ..parallel import pad_to_multiple
-
-        q, slo, _ = pad_to_multiple(q, slo, bucket)
+        shards = int(mesh.devices.size) if is_lane_mesh(mesh) else 1
+        q, slo, _ = pad_to_multiple(q, slo, bucket, shards=shards)
         epi = None
         if "demand" in rows:
             from ..ops.fused import make_epilogue_batch
@@ -364,7 +373,14 @@ class System:
         # only change when the fleet crosses a 16-candidate boundary, and
         # every crossed bucket stays in jit's executable cache. Padded
         # lanes are benign invalid queues (valid=False -> feasible=False).
-        return 16 if mesh is None else math.lcm(16, int(mesh.devices.size))
+        # A lane mesh keeps the plain 16 quantum: its padding lands
+        # per-shard (parallel.mesh.padded_lanes), so each shard's lane
+        # count is the multiple-of-16 and the total follows from it.
+        from ..parallel import is_lane_mesh
+
+        if mesh is None or is_lane_mesh(mesh):
+            return 16
+        return math.lcm(16, int(mesh.devices.size))
 
     @staticmethod
     def _pallas_interpret() -> bool:
@@ -446,8 +462,13 @@ class System:
         # C-level tolist() then plain-float indexing (a numpy scalar
         # extraction per field per lane is measurably slower at fleet
         # scale, and tolist's float conversion is the same
-        # nearest-double value)
-        (host,) = JAX_AUDIT.note_readback(packed)
+        # nearest-double value). On a lane mesh this is also the single
+        # gather of the still-sharded result, tallied per shard count.
+        from ..parallel import is_lane_mesh
+
+        (host,) = JAX_AUDIT.note_readback(
+            packed,
+            shards=int(mesh.devices.size) if is_lane_mesh(mesh) else 1)
         rows_h = host.tolist()
         feasible = rows_h[fused.ROW_FEASIBLE]
         replicas = rows_h[fused.ROW_REPLICAS]

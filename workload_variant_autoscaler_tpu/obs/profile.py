@@ -331,6 +331,7 @@ class JaxAudit:
         self._lock = threading.Lock()
         self._retraces: dict[str, int] = {}
         self._transfers: dict[str, int] = {}
+        self._sharded: dict[str, int] = {}
         self._compiles: list[tuple[str, float]] = []
 
     def note_trace(self, fn: str) -> None:
@@ -347,23 +348,34 @@ class JaxAudit:
         with self._lock:
             self._compiles.append((fn, seconds))
 
-    def note_transfer(self, direction: str, n: int = 1) -> None:
+    def note_transfer(self, direction: str, n: int = 1,
+                      shards: int = 1) -> None:
         """direction: "h2d" (host arrays staged onto device) or "d2h"
-        (device results pulled back to host)."""
+        (device results pulled back to host). Transfers that cross a
+        sharded boundary (the fleet arena's slab uploads and scatters,
+        the sharded decide's bulk gather) pass shards > 1 and are
+        ALSO tallied per shard count under "<direction>@<shards>" so
+        `controller profile` output separates fleet-mesh traffic from
+        single-device staging."""
         with self._lock:
             self._transfers[direction] = \
                 self._transfers.get(direction, 0) + n
+            if shards > 1:
+                key = f"{direction}@{shards}"
+                self._sharded[key] = self._sharded.get(key, 0) + n
 
-    def note_readback(self, *arrays) -> tuple:
+    def note_readback(self, *arrays, shards: int = 1) -> tuple:
         """Pull device arrays to host, counting EXACTLY what was pulled:
         the d2h counter increments by the number of arrays converted, so
         the audit can never drift from the actual readbacks the way a
         hard-coded `note_transfer("d2h", N)` literal silently did.
-        Returns the host (numpy) arrays in argument order."""
+        `shards` > 1 marks a gather from a sharded result (the fleet
+        path's single bulk d2h). Returns the host (numpy) arrays in
+        argument order."""
         import numpy  # deferred: obs/ stays stdlib-only at import time
 
         out = tuple(numpy.asarray(a) for a in arrays)
-        self.note_transfer("d2h", len(out))
+        self.note_transfer("d2h", len(out), shards=shards)
         return out
 
     def snapshot(self) -> dict:
@@ -371,6 +383,7 @@ class JaxAudit:
             return {
                 "retraces": dict(self._retraces),
                 "transfers": dict(self._transfers),
+                "sharded": dict(self._sharded),
                 "compiles": list(self._compiles),
             }
 
@@ -387,11 +400,20 @@ class JaxAudit:
             for d, n in new.get("transfers", {}).items()
             if n - old.get("transfers", {}).get(d, 0) > 0}
         compiles = new.get("compiles", [])[len(old.get("compiles", [])):]
-        return {
+        out = {
             "retraces": retraces,
             "transfers": transfers,
             "compiles": [[fn, round(s, 4)] for fn, s in compiles],
         }
+        sharded = {
+            d: n - old.get("sharded", {}).get(d, 0)
+            for d, n in new.get("sharded", {}).items()
+            if n - old.get("sharded", {}).get(d, 0) > 0}
+        # keyed per "<direction>@<shards>"; omitted when no fleet-mesh
+        # traffic occurred so unsharded records keep their exact shape
+        if sharded:
+            out["sharded"] = sharded
+        return out
 
 
 JAX_AUDIT = JaxAudit()

@@ -143,3 +143,172 @@ class CandidateArena:
                             min_replicas=i("min_replicas"),
                             cost_rate=f("cost_rate"))
         return q, slo, epi
+
+
+def _fleet_scatter_fn(mesh, n_cols: int):
+    """One jitted donated scatter updating every column slab at the
+    changed lanes in a single dispatch. Donation lets XLA update the
+    resident sharded slabs in place — no whole-slab h2d, no copy.
+    Duplicate (padded) indices carry identical values, so the scatter is
+    order-insensitive and the padding is benign. Cached per (mesh,
+    column count); shapes (slab length, index count) key XLA's own
+    executable cache, and `arena_scatter` retraces land in the audit."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..obs.profile import JAX_AUDIT
+    from ..parallel.mesh import mesh_axis
+
+    sharding = NamedSharding(mesh, PartitionSpec(mesh_axis(mesh)))
+
+    def impl(slabs, idx, vals):
+        JAX_AUDIT.note_trace("arena_scatter")
+        return tuple(s.at[idx].set(v) for s, v in zip(slabs, vals))
+
+    return jax.jit(impl, donate_argnums=(0,), out_shardings=sharding)
+
+
+# scatter index padding quantum — pins the scatter program's index shape
+# across cycles with different churn sizes (zero retraces in steady state)
+SCATTER_BUCKET = 16
+
+
+class ShardedFleetArena(CandidateArena):
+    """CandidateArena whose slabs live device-resident, sharded over the
+    variant/lane axis of `mesh` (parallel.mesh.fleet_mesh).
+
+    The inherited numpy slabs become a host mirror used purely for
+    change detection: each pack diffs the incoming rows against the
+    mirror, and only the changed lanes ride a donated scatter onto the
+    resident device slabs — steady-state churn costs O(changed) h2d, a
+    zero-diff pack costs none at all. Padding lands per-shard
+    (parallel.mesh.padded_lanes) so every shard's slab shape is a
+    multiple of the lane quantum and stays bucket-stable under churn.
+
+    Exactness: values stage through the same numpy dtypes and the same
+    device casts as CandidateArena.pack, and a scatter writes exactly
+    the lanes whose staged values differ — the resident slab is
+    bit-identical to a from-scratch upload of the mirror.
+    """
+
+    def __init__(self, mesh) -> None:
+        super().__init__()
+        self.mesh = mesh
+        # (padded lane count) -> {column: resident sharded jax.Array}
+        self._device: dict[int, dict[str, object]] = {}
+        self.full_uploads = 0     # whole-slab h2d events (1 per shape)
+        self.scatter_packs = 0    # packs served by the donated scatter
+        self.noop_packs = 0       # packs with zero changed lanes (no h2d)
+        self.lanes_scattered = 0  # total changed lanes scattered
+
+    def _padded(self, c: int, quantum: int) -> int:
+        from ..parallel.mesh import padded_lanes
+
+        return padded_lanes(c, quantum, int(self.mesh.devices.size))
+
+    def pack(self, rows: dict[str, list], quantum: int = LANE_BUCKET,
+             ):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..obs.profile import JAX_AUDIT
+        from ..parallel.mesh import mesh_axis
+
+        c = len(rows["alpha"])
+        if "occupancy" not in rows:
+            rows = dict(rows)
+            rows["occupancy"] = [int(m) * (1 + MAX_QUEUE_TO_BATCH_RATIO)
+                                 for m in rows["max_batch"]]
+        with_epi = "demand" in rows
+        b = self._padded(c, quantum)
+        fresh = b not in self._slabs
+        slab = self._slab(b)
+        columns = dict(_COLUMNS)
+        if with_epi:
+            columns.update(_EPI_COLUMNS)
+
+        # diff incoming rows against the host mirror, then update it —
+        # the mirror always holds [0, c) real lanes + [c, b) benign fills
+        changed = np.zeros(b, dtype=bool)
+        for name, (dt, fill) in columns.items():
+            new = np.full(b, fill, dtype=dt)
+            if name == "valid":
+                new[:c] = True
+            else:
+                new[:c] = rows[name]
+            buf = slab[name]
+            changed |= new != buf
+            buf[:] = new
+        self.packs += 1
+
+        fdt = np.float64 if jax.config.jax_enable_x64 else np.float32
+        dev_dtype = {name: (np.bool_ if dt is bool else
+                            np.int32 if np.issubdtype(dt, np.integer)
+                            else fdt)
+                     for name, (dt, _f) in columns.items()}
+        names = list(columns)
+        device = self._device.get(b)
+        if fresh or device is None or any(n not in device for n in names):
+            # first pack of this shape: whole-slab sharded upload (one
+            # host cast + one transfer per column, no default-device hop;
+            # astype always copies so the device buffer can never alias
+            # the mutable mirror)
+            sharding = NamedSharding(self.mesh, PartitionSpec(
+                mesh_axis(self.mesh)))
+            device = {name: jax.device_put(
+                slab[name].astype(dev_dtype[name]), sharding)
+                for name in names}
+            self._device[b] = device
+            self.full_uploads += 1
+            JAX_AUDIT.note_transfer(
+                "h2d", len(names), shards=int(self.mesh.devices.size))
+        else:
+            idx = np.nonzero(changed)[0]
+            if idx.size == 0:
+                self.noop_packs += 1
+            else:
+                self.lanes_scattered += int(idx.size)
+                self.scatter_packs += 1
+                n_idx = lane_bucket(int(idx.size), SCATTER_BUCKET)
+                # pad with repeats of the first index — duplicate scatter
+                # targets carry identical values, so padding is benign
+                idx_p = np.concatenate(
+                    [idx, np.full(n_idx - idx.size, idx[0], idx.dtype)])
+                idx_dev = jnp.asarray(idx_p, dtype=jnp.int32)
+                vals = tuple(
+                    jnp.asarray(slab[name][idx_p], dtype=dev_dtype[name])
+                    for name in names)
+                JAX_AUDIT.note_transfer(
+                    "h2d", 1 + len(names),
+                    shards=int(self.mesh.devices.size))
+                fn = _fleet_scatter_cache(self.mesh, len(names))
+                out = fn(tuple(device[name] for name in names),
+                         idx_dev, vals)
+                device = dict(zip(names, out))
+                self._device[b] = device
+
+        q = QueueBatch(**{name: device[name] for name in (
+            "alpha", "beta", "gamma", "delta", "in_tokens", "out_tokens",
+            "max_batch", "occupancy", "valid")})
+        slo = SLOTargets(ttft=device["ttft"], itl=device["itl"],
+                         tps=device["tps"])
+        if not with_epi:
+            return q, slo, None
+        from .fused import EpilogueBatch
+
+        epi = EpilogueBatch(demand=device["demand"],
+                            min_replicas=device["min_replicas"],
+                            cost_rate=device["cost_rate"])
+        return q, slo, epi
+
+
+_SCATTER_FNS: dict = {}
+
+
+def _fleet_scatter_cache(mesh, n_cols: int):
+    key = (mesh, n_cols)
+    fn = _SCATTER_FNS.get(key)
+    if fn is None:
+        fn = _SCATTER_FNS[key] = _fleet_scatter_fn(mesh, n_cols)
+    return fn

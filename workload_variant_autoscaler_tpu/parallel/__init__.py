@@ -14,7 +14,10 @@ from .mesh import (
     analyze_batch_sharded,
     candidate_mesh,
     decide_batch_sharded,
+    fleet_mesh,
+    is_lane_mesh,
     pad_to_multiple,
+    padded_lanes,
     shard_batch,
     size_batch_sharded,
 )
@@ -23,7 +26,10 @@ __all__ = [
     "analyze_batch_sharded",
     "candidate_mesh",
     "decide_batch_sharded",
+    "fleet_mesh",
+    "is_lane_mesh",
     "pad_to_multiple",
+    "padded_lanes",
     "shard_batch",
     "size_batch_sharded",
 ]

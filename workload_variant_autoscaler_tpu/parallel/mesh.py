@@ -1,10 +1,25 @@
-"""Candidate-axis sharding helpers.
+"""Candidate- and variant-axis sharding helpers.
 
-One mesh axis ("candidates") is enough: each (variant, slice-shape)
-candidate's queue solve is independent, so data parallelism over the
-batch dimension is the whole story. Lane padding reuses QueueBatch.valid,
-so padded lanes are benign (batch=1 queues marked invalid) and excluded
-from feasibility downstream.
+One mesh axis is enough: each (variant, slice-shape) candidate's queue
+solve is independent, so data parallelism over the batch dimension is
+the whole story. Lane padding reuses QueueBatch.valid, so padded lanes
+are benign (batch=1 queues marked invalid) and excluded from feasibility
+downstream.
+
+Two 1-D axis bindings share the helpers below:
+
+- "candidates" (`candidate_mesh`) — the original per-group candidate
+  axis used by WVA_MESH_DEVICES on real TPU meshes.
+- "lanes" (`fleet_mesh`) — the variant/lane axis the fleet grows along.
+  WVA_SHARDED_FLEET routes whole-fleet solves through it with padding
+  landing per-shard (each shard's lane count is a multiple of the lane
+  quantum), so shard-local shapes stay bucket-stable under fleet churn.
+
+The sharded entry points read the axis name off the mesh they are
+given, so both bindings reuse one compiled-program cache keyed by
+(k_max, mesh, percentile) — Mesh hashes by device assignment + axis
+names, so rebuilding a mesh with a different device count or axis can
+never reuse a stale executable.
 """
 
 from __future__ import annotations
@@ -26,6 +41,7 @@ from ..ops.batched import (
 )
 
 AXIS = "candidates"
+LANE_AXIS = "lanes"
 
 
 def candidate_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -33,18 +49,58 @@ def candidate_mesh(n_devices: Optional[int] = None) -> Mesh:
     devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
-    return Mesh(np.asarray(devices), (AXIS,))
+    # host device-handle list, not a device readback
+    return Mesh(np.asarray(devices), (AXIS,))  # noqa: WVL305
+
+
+def fleet_mesh(n_devices: Optional[int] = None) -> Optional[Mesh]:
+    """A 1-D mesh binding the variant/lane axis over the first n
+    (default: all) local devices. Returns None with fewer than two
+    devices: a 1-device lane mesh is the unsharded program with extra
+    dispatch, so it degenerates to the plain path instead."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    if len(devices) < 2:
+        return None
+    # host device-handle list, not a device readback
+    return Mesh(np.asarray(devices), (LANE_AXIS,))  # noqa: WVL305
+
+
+def mesh_axis(mesh: Mesh) -> str:
+    """The (single) data axis name of a 1-D candidate or lane mesh."""
+    return mesh.axis_names[0]
+
+
+def is_lane_mesh(mesh: Optional[Mesh]) -> bool:
+    """True when `mesh` binds the variant/lane axis (fleet sharding)."""
+    return mesh is not None and mesh_axis(mesh) == LANE_AXIS
+
+
+def padded_lanes(b: int, m: int, shards: int) -> int:
+    """Total lane count after per-shard padding: each of `shards` equal
+    contiguous shards holds a multiple of m (and at least m) lanes, so
+    every shard's slab shape is bucket-stable under fleet churn."""
+    per = -(-max(b, 1) // shards)
+    per = max(-(-per // m) * m, m)
+    return per * shards
 
 
 def _pad_1d(a, fill, pad: int):
     return jnp.concatenate([a, jnp.full((pad,), fill, a.dtype)])
 
 
-def pad_to_multiple(q: QueueBatch, targets: SLOTargets, m: int):
+def pad_to_multiple(q: QueueBatch, targets: SLOTargets, m: int,
+                    shards: int = 1):
     """Pad the candidate batch to a multiple of m with invalid benign lanes
-    (alpha=1, max_batch=1, valid=False). Returns (q, targets, original_b)."""
+    (alpha=1, max_batch=1, valid=False). Returns (q, targets, original_b).
+
+    With shards > 1, padding instead lands per-shard: the batch grows to
+    `padded_lanes(b, m, shards)` so each contiguous shard holds a
+    multiple of m lanes. The default (shards=1) is byte-identical to the
+    original global padding."""
     b = q.batch_size
-    pad = (-b) % m
+    pad = (padded_lanes(b, m, shards) - b) if shards > 1 else (-b) % m
     if pad == 0:
         return q, targets, b
 
@@ -71,8 +127,10 @@ def pad_to_multiple(q: QueueBatch, targets: SLOTargets, m: int):
 
 
 def shard_batch(tree, mesh: Mesh):
-    """Place every leaf with its leading axis split over the mesh."""
-    sharding = NamedSharding(mesh, P(AXIS))
+    """Place every leaf with its leading axis split over the mesh.
+    Leaves already resident with this exact sharding (the fleet arena's
+    slabs) pass through without a copy."""
+    sharding = NamedSharding(mesh, P(mesh_axis(mesh)))
     return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
 
 
@@ -106,7 +164,7 @@ def _sharded_size_fn(k_max: int, mesh: Mesh,
     fn = (partial(size_batch, k_max=k_max) if ttft_percentile is None
           else partial(size_batch_tail, k_max=k_max,
                        ttft_percentile=ttft_percentile))
-    return jax.jit(fn, out_shardings=NamedSharding(mesh, P(AXIS)))
+    return jax.jit(fn, out_shardings=NamedSharding(mesh, P(mesh_axis(mesh))))
 
 
 def analyze_batch_sharded(q: QueueBatch, rates_per_sec, k_max: int,
@@ -126,7 +184,7 @@ def analyze_batch_sharded(q: QueueBatch, rates_per_sec, k_max: int,
             q, SLOTargets(ttft=zeros, itl=zeros, tps=zeros), n)
         rates = _pad_1d(rates, 0.0, pad)
     q = shard_batch(q, mesh)
-    rates = jax.device_put(rates, NamedSharding(mesh, P(AXIS)))
+    rates = jax.device_put(rates, NamedSharding(mesh, P(mesh_axis(mesh))))
     out = _sharded_analyze_fn(k_max, mesh)(q, rates)
     return jax.tree.map(lambda a: a[:b], out)
 
@@ -135,7 +193,7 @@ def analyze_batch_sharded(q: QueueBatch, rates_per_sec, k_max: int,
 def _sharded_analyze_fn(k_max: int, mesh: Mesh):
     return jax.jit(
         partial(analyze_batch, k_max=k_max),
-        out_shardings=NamedSharding(mesh, P(AXIS)),
+        out_shardings=NamedSharding(mesh, P(mesh_axis(mesh))),
     )
 
 
@@ -147,12 +205,22 @@ def decide_batch_sharded(q: QueueBatch, targets: SLOTargets, epi,
     the per-replica re-analysis all stay on the devices that hold each
     shard — the packed [N_ROWS, B] result is the only gather. Padded
     epilogue lanes are benign zeros (zero demand -> zero replicas behind
-    the valid mask)."""
+    the valid mask).
+
+    On a lane mesh (fleet sharding) padding lands per-shard so each
+    shard's lane count is a multiple of the lane quantum; fleet-arena
+    inputs arrive already padded and sharded, making every step below a
+    no-op until the jitted call itself."""
+    from ..ops.arena import LANE_BUCKET
     from ..ops.fused import EpilogueBatch
 
     n = mesh.devices.size
     b = q.batch_size
-    q, targets, orig_b = pad_to_multiple(q, targets, n)
+    if is_lane_mesh(mesh):
+        q, targets, orig_b = pad_to_multiple(
+            q, targets, LANE_BUCKET, shards=n)
+    else:
+        q, targets, orig_b = pad_to_multiple(q, targets, n)
     pad = q.batch_size - b
     if pad:
         epi = EpilogueBatch(
@@ -177,4 +245,5 @@ def _sharded_decide_fn(k_max: int, mesh: Mesh,
     from ..ops.fused import decide_batch
 
     fn = partial(decide_batch, k_max=k_max, ttft_percentile=ttft_percentile)
-    return jax.jit(fn, out_shardings=NamedSharding(mesh, P(None, AXIS)))
+    return jax.jit(
+        fn, out_shardings=NamedSharding(mesh, P(None, mesh_axis(mesh))))

@@ -14,10 +14,28 @@ from __future__ import annotations
 
 import bisect
 import math
+import os
 from dataclasses import dataclass, field
 
 from ..models import Allocation, SaturationPolicy, System
 from ..models.entities import Server
+
+
+def vector_greedy_enabled(lanes: int) -> bool:
+    """WVA_VECTOR_GREEDY: "auto" (default — vectorize when the candidate
+    lane count reaches WVA_VECTOR_GREEDY_MIN, default 1024), "on", or
+    "off". The auto floor keeps small fleets on the sequential path,
+    where the Python loop beats kernel dispatch overhead."""
+    mode = os.environ.get("WVA_VECTOR_GREEDY", "auto").strip().lower()
+    if mode in ("off", "0", "false", "no"):
+        return False
+    if mode in ("on", "1", "true", "yes", "force"):
+        return True
+    try:
+        floor = int(os.environ.get("WVA_VECTOR_GREEDY_MIN", "1024"))
+    except ValueError:
+        floor = 1024
+    return lanes >= floor
 
 
 @dataclass
@@ -63,6 +81,234 @@ def _make_entries(system: System, only=None) -> list[_Entry]:
     return entries
 
 
+def _greedy_sweep(values, lane_server, lane_cnt, lane_pool, lane_has,
+                  pool_cap, pool_comp, srv_pool):
+    """One jitted allocation sweep over every pool-connected component.
+
+    Per server: segment-min of candidate value, then segment-min of lane
+    index among the value-minimal lanes — exactly the sequential path's
+    stable-sort tie-break (first-inserted candidate wins). Per pool:
+    segment-sum of the chosen lanes' chip counts. Per component
+    (`pool_comp` is each pool's component id): segment-reduced min of
+    the pools' fits, broadcast back to servers. A component whose every
+    pool fits its servers' first choices is PROVABLY identical to the
+    sequential greedy there (no pop can fail, so order, priority, and
+    best-effort are all no-ops); the rest fall back to the exact
+    sequential loop. All shapes arrive bucketed, so steady-state churn
+    never retraces."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..obs.profile import JAX_AUDIT
+
+    JAX_AUDIT.note_trace("greedy_sweep")
+    n_servers = srv_pool.shape[0]
+    n_pools = pool_cap.shape[0]
+    l_pad = values.shape[0]
+    min_val = jax.ops.segment_min(values, lane_server,
+                                  num_segments=n_servers)
+    lane_idx = jnp.arange(l_pad, dtype=jnp.int32)
+    first = values == min_val[lane_server]
+    chosen = jax.ops.segment_min(
+        jnp.where(first, lane_idx, l_pad), lane_server,
+        num_segments=n_servers)
+    has = chosen < l_pad
+    safe = jnp.clip(chosen, 0, l_pad - 1)
+    real = has & lane_has[safe]
+    cnt = jnp.where(real, lane_cnt[safe], 0)
+    pool = jnp.where(real, lane_pool[safe], 0)
+    demand = jax.ops.segment_sum(cnt, pool, num_segments=n_pools)
+    pool_ok = demand <= pool_cap
+    comp_ok = jax.ops.segment_min(pool_ok.astype(jnp.int32), pool_comp,
+                                  num_segments=n_pools)
+    ok = comp_ok[pool_comp[srv_pool]] > 0
+    return chosen.astype(jnp.int32), ok
+
+
+_GREEDY_SWEEP_JIT = None
+
+# lane/server/pool shape quanta: pins the sweep's compiled shapes across
+# churn cycles (the +1 guarantees at least one padded server/pool slot
+# for padded lanes and pool-less servers to point at)
+_SWEEP_LANE_BUCKET = 64
+_SWEEP_POOL_BUCKET = 16
+_INT32_MAX = 2**31 - 1
+
+
+def _bucket(n: int, quantum: int) -> int:
+    return max(-(-n // quantum) * quantum, quantum)
+
+
+def _vector_fast_pass(system: System, only, available: dict[str, int]):
+    """Resolve every uncontended pool-connected component in one jitted
+    sweep; returns the names still needing the sequential greedy, or
+    None when the vector path is disabled or inapplicable (caller runs
+    the sequential greedy over the full scope, untouched).
+
+    Exactness contract (mirrors the sequential loop bit for bit):
+    - first choice = min-value candidate, ties to first insertion order;
+    - a candidate with a vanished accelerator consumes nothing and
+      leaves its server unallocated without advancing;
+    - values compare in float64 — without jax_enable_x64 the pass
+      disables itself rather than compare in float32.
+    """
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        return None
+    mode = os.environ.get("WVA_VECTOR_GREEDY", "auto").strip().lower()
+    if mode in ("off", "0", "false", "no"):
+        return None
+    if only is None:
+        scoped = list(system.servers.values())
+    else:
+        scoped = [s for name, s in system.servers.items() if name in only]
+
+    import numpy as np
+
+    values: list[float] = []
+    lane_counts: list[int] = []   # lanes per server -> np.repeat below
+    lane_cnt: list[int] = []
+    lane_pool: list[int] = []
+    lane_has: list[bool] = []
+    lane_alloc: list[Allocation] = []
+    srv_objs: list[Server] = []
+    srv_pool: list[int] = []
+    pool_idx: dict[str, int] = {}
+    pool_names: list[str] = []
+    # (model name, accelerator name) -> (chips per replica, pool index,
+    # accelerator exists) — the per-lane resolution work collapses to
+    # one dict hit per combo (fleets share a handful of combos)
+    combo_cache: dict[tuple, tuple] = {}
+    # int-indexed union-find over pools
+    parent: list[int] = []
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def resolve(mname: str, acc_name: str) -> tuple:
+        acc = system.accelerator(acc_name)
+        if acc is None:
+            combo = (0, 0, False)
+        else:
+            model = system.model(mname)
+            units = (0 if model is None
+                     else model.num_instances(acc_name) * acc.chips)
+            pool = pool_idx.get(acc.chip)
+            if pool is None:
+                pool = pool_idx[acc.chip] = len(pool_names)
+                pool_names.append(acc.chip)
+                parent.append(pool)
+            combo = (units, pool, True)
+        combo_cache[(mname, acc_name)] = combo
+        return combo
+
+    cache_get = combo_cache.get
+    values_app = values.append
+    cnt_app = lane_cnt.append
+    pool_app = lane_pool.append
+    has_app = lane_has.append
+    alloc_app = lane_alloc.append
+    for server in scoped:
+        server.remove_allocation()
+        allocs = server.all_allocations
+        if not allocs:
+            continue
+        mname = server.model_name
+        my_first_pool = -1
+        for alloc in allocs.values():
+            combo = cache_get((mname, alloc.accelerator))
+            if combo is None:
+                combo = resolve(mname, alloc.accelerator)
+            units, pool, has = combo
+            if has:
+                if my_first_pool < 0:
+                    my_first_pool = pool
+                elif my_first_pool != pool:
+                    ra, rb = find(my_first_pool), find(pool)
+                    if ra != rb:
+                        parent[ra] = rb
+            values_app(alloc.value)
+            cnt_app(alloc.num_replicas * units)
+            pool_app(pool)
+            has_app(has)
+            alloc_app(alloc)
+        lane_counts.append(len(allocs))
+        srv_objs.append(server)
+        srv_pool.append(my_first_pool)
+
+    n_l, n_s, n_p = len(values), len(srv_objs), len(pool_names)
+    if n_s == 0:
+        return set()
+    # the auto floor is checked against the true lane count, after the
+    # cheap build: small fleets fall back without a separate counting
+    # pass over the whole fleet
+    if not vector_greedy_enabled(n_l):
+        return None
+    if sum(lane_cnt) > _INT32_MAX:
+        return None  # int32 segment sums could wrap; stay sequential
+
+    l_pad = _bucket(n_l, _SWEEP_LANE_BUCKET)
+    s_pad = _bucket(n_s + 1, _SWEEP_LANE_BUCKET)
+    p_pad = _bucket(n_p + 1, _SWEEP_POOL_BUCKET)
+
+    values_a = np.full(l_pad, np.inf, dtype=np.float64)
+    values_a[:n_l] = values
+    lane_server_a = np.full(l_pad, s_pad - 1, dtype=np.int32)
+    lane_server_a[:n_l] = np.repeat(
+        np.arange(n_s, dtype=np.int32),
+        np.asarray(lane_counts, dtype=np.int32))
+    lane_cnt_a = np.zeros(l_pad, dtype=np.int32)
+    lane_cnt_a[:n_l] = np.minimum(lane_cnt, _INT32_MAX)
+    lane_pool_a = np.zeros(l_pad, dtype=np.int32)
+    lane_pool_a[:n_l] = lane_pool
+    lane_has_a = np.zeros(l_pad, dtype=bool)
+    lane_has_a[:n_l] = lane_has
+    pool_cap_a = np.full(p_pad, _INT32_MAX, dtype=np.int32)
+    pool_cap_a[:n_p] = np.clip(
+        [available.get(c, 0) for c in pool_names], 0, _INT32_MAX)
+    pool_comp_a = np.arange(p_pad, dtype=np.int32)
+    pool_comp_a[:n_p] = [find(p) for p in range(n_p)]
+    # pool-less servers (every candidate's accelerator vanished) and the
+    # padded server slots point at the first padded pool: always fits
+    srv_pool_a = np.full(s_pad, n_p, dtype=np.int32)
+    srv_pool_raw = np.asarray(srv_pool, dtype=np.int32)
+    srv_pool_a[:n_s] = np.where(srv_pool_raw < 0, n_p, srv_pool_raw)
+
+    from ..obs.profile import JAX_AUDIT
+
+    global _GREEDY_SWEEP_JIT
+    if _GREEDY_SWEEP_JIT is None:
+        _GREEDY_SWEEP_JIT = jax.jit(_greedy_sweep)
+    JAX_AUDIT.note_transfer("h2d", 8)
+    chosen_d, ok_d = _GREEDY_SWEEP_JIT(
+        values_a, lane_server_a, lane_cnt_a, lane_pool_a, lane_has_a,
+        pool_cap_a, pool_comp_a, srv_pool_a)
+    chosen_h, ok_h = JAX_AUDIT.note_readback(chosen_d, ok_d)
+
+    remaining: set[str] = set()
+    chosen_l = np.asarray(chosen_h).tolist()
+    ok_l = np.asarray(ok_h).tolist()
+    consumed = [0] * n_p
+    for sidx, server in enumerate(srv_objs):
+        if not ok_l[sidx]:
+            remaining.add(server.name)
+            continue
+        lane = chosen_l[sidx]
+        if not lane_has[lane]:
+            continue  # vanished accelerator: stays unallocated
+        consumed[lane_pool[lane]] += lane_cnt[lane]
+        server.set_allocation(lane_alloc[lane])
+    for pool, used in enumerate(consumed):
+        if used:
+            chip = pool_names[pool]
+            available[chip] = available.get(chip, 0) - used
+    return remaining
+
+
 def solve_greedy(
     system: System,
     policy: SaturationPolicy,
@@ -70,7 +316,10 @@ def solve_greedy(
 ) -> None:
     """Entry point (reference greedy.go:35-104)."""
     available = dict(system.capacity)  # chip generation -> chips
-    entries = _make_entries(system)
+    scope = _vector_fast_pass(system, None, available)
+    if scope is not None and not scope:
+        return  # vector pass settled every server
+    entries = _make_entries(system, only=scope)
 
     if delayed_best_effort:
         unallocated = _allocate(system, entries, available)
@@ -156,9 +405,14 @@ def solve_greedy_warm(
 
     # the full algorithm, restricted to the affected components; their
     # pools are untouched by unaffected servers (disjoint by
-    # construction), so starting from the full capacity view is exact
+    # construction), so starting from the full capacity view is exact.
+    # The vector fast pass resolves the uncontended components in one
+    # jitted sweep and leaves the rest to the sequential loop.
     available = dict(system.capacity)
-    entries = _make_entries(system, only=affected)
+    scope = _vector_fast_pass(system, affected, available)
+    if scope is not None and not scope:
+        return  # vector pass settled every affected server
+    entries = _make_entries(system, only=affected if scope is None else scope)
     if delayed_best_effort:
         unallocated = _allocate(system, entries, available)
         _best_effort(system, unallocated, available, policy)

@@ -118,6 +118,9 @@ class IncrementalSolveEngine:
         self.epsilon = epsilon
         self.full_every = max(int(full_every), 0)
         self.arena = CandidateArena()
+        # lazily built when a fleet (lane) mesh is in play: resident
+        # sharded slabs, rebuilt whenever the mesh itself changes
+        self._fleet_arena = None
         self._cycle = 0
         # server name -> signature of the lane inputs the cache entry
         # was solved from, and the pristine allocation clones themselves
@@ -213,6 +216,7 @@ class IncrementalSolveEngine:
     # -- the analyze step -------------------------------------------------
 
     def calculate(self, system: System, *, backend: str, mesh=None,
+                  fleet_mesh=None,
                   ttft_percentile: Optional[float] = None,
                   optimizer_spec: Optional[OptimizerSpec] = None,
                   rungs: Optional[dict] = None,
@@ -221,10 +225,19 @@ class IncrementalSolveEngine:
         cached candidate allocations for unchanged variants, sizes only
         the changed sub-batch (through the resident arena), and
         refreshes the cache. Also precomputes the warm-start decision
-        the optimize stage consumes via warm_start()."""
+        the optimize stage consumes via warm_start().
+
+        `fleet_mesh` (WVA_SHARDED_FLEET; parallel.mesh.fleet_mesh)
+        shards the variant/lane axis: every batched pass — full AND
+        incremental — runs through the same sharded program and the
+        resident ShardedFleetArena, so the cache can never mix
+        allocations from differently-compiled pipelines. It yields to
+        an explicit candidate `mesh` (WVA_MESH_DEVICES) when both are
+        set."""
         self._cycle += 1
         rungs = rungs or {}
         optimizer_spec = optimizer_spec or OptimizerSpec()
+        eff_mesh = mesh if mesh is not None else fleet_mesh
 
         # quantized load is the solve's input (see module docstring) —
         # applied before signatures so bucket-stable jitter reads as
@@ -237,8 +250,12 @@ class IncrementalSolveEngine:
         # can never mix allocations from the two pipelines (they are
         # bit-identical by contract, but the invariant should not
         # depend on it)
+        from ..parallel import is_lane_mesh
+
         analyze_sig = (backend,
-                       int(mesh.devices.size) if mesh is not None else None,
+                       (int(eff_mesh.devices.size)
+                        if eff_mesh is not None else None),
+                       is_lane_mesh(eff_mesh),
                        ttft_percentile,
                        fused_solve_enabled())
         solve_sig = self._solve_signature(system, optimizer_spec, cycle_rung)
@@ -264,9 +281,19 @@ class IncrementalSolveEngine:
             for name, server in system.servers.items()
         }
 
-        system.arena = self.arena if mesh is None else None
+        if eff_mesh is None:
+            system.arena = self.arena
+        elif is_lane_mesh(eff_mesh):
+            if (self._fleet_arena is None
+                    or self._fleet_arena.mesh != eff_mesh):
+                from ..ops.arena import ShardedFleetArena
+
+                self._fleet_arena = ShardedFleetArena(eff_mesh)
+            system.arena = self._fleet_arena
+        else:
+            system.arena = None
         if full:
-            system.calculate(backend=backend, mesh=mesh,
+            system.calculate(backend=backend, mesh=eff_mesh,
                              ttft_percentile=ttft_percentile)
             self._alloc_cache = {}
             self._lane_sigs = {}
@@ -294,7 +321,7 @@ class IncrementalSolveEngine:
                     continue
                 skipped_lanes += self._restore(system, server,
                                                self._alloc_cache[name])
-            system.calculate(backend=backend, mesh=mesh,
+            system.calculate(backend=backend, mesh=eff_mesh,
                              ttft_percentile=ttft_percentile,
                              only=changed)
             for name in changed:
