@@ -59,6 +59,14 @@ bench-shard: ## Mesh-sharded fleet solve: 512/2048/8192-variant forced-full wall
 shard-smoke: ## Abbreviated sharded run (64/128 variants, ~90s): zero retraces over a 10-cycle churn run, exactly one bulk d2h crossing the sharded boundary per cycle
 	$(PY) bench_shard.py --smoke
 
+.PHONY: bench-adversary
+bench-adversary: ## Adversarial scenario search: seeded (1+lambda) descent minimizing goodput through the real Reconciler, double-run determinism, hardened-vs-unhardened scoring, floor promotion (writes BENCH_adversary_r14.json + tests/fixtures/adversarial_scenarios.json; WVA_ADVERSARY_* knobs)
+	$(PY) bench_adversary.py
+
+.PHONY: adversary-smoke
+adversary-smoke: ## Abbreviated adversarial search (1 generation x 2 candidates, 120s horizon, <10s): full search loop through the real twin, writes nothing
+	$(PY) bench_adversary.py --smoke
+
 .PHONY: bench-stream
 bench-stream: ## Streaming reconcile lag: 512 variants, remote-write ingest, p50/p99 load-change->published vs the polled baseline (writes BENCH_stream_r11.json)
 	$(PY) bench_stream.py
@@ -87,7 +95,7 @@ bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO 
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo
 
-LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_profile.py bench_fuse.py bench_shard.py bench_stream.py bench_streamchaos.py __graft_entry__.py
+LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_profile.py bench_fuse.py bench_shard.py bench_stream.py bench_streamchaos.py bench_adversary.py __graft_entry__.py
 
 .PHONY: lint
 lint: ## Static analysis gate: ruff+mypy when installed, wvalint always (rule catalog: docs/developer-guide/wvalint.md)

@@ -1,0 +1,227 @@
+"""bench_adversary: the adversarial scenario search's committed scoreboard.
+
+Runs the seeded (1+λ) perturb-and-select search (`emulator.adversary`)
+over the typed scenario-parameter space (`emulator.scenarios
+.adversarial`), minimizing cost-weighted goodput through the REAL
+Reconciler via `emulator.twin.run_scenario` — then re-runs the SAME
+search to prove byte-identical determinism, scores the worst-found
+scenario under the hardened controller config (the
+`WVA_DEGRADED_SCALEUP_FREEZE` shed-window guardrail plus the
+`WVA_TTFT_BACKPRESSURE` observed-latency floor), and promotes each
+generation's worst find into the committed versioned archive
+`tests/fixtures/adversarial_scenarios.json` with a per-scenario goodput
+floor — the regression floors tier-1 enforces via
+`ADVERSARIAL_SCENARIOS` (tests/test_adversary.py).
+
+tests/test_perf_claims.py asserts the committed artifact's three
+claims: the search's worst goodput is STRICTLY below the hand-written
+library's minimum (the search finds corners the hand library missed),
+the double run was byte-identical, and the hardened config's goodput on
+the worst-found scenario strictly beats the unhardened run.
+
+Everything is seeded and sim-clocked, so the artifact is byte-stable:
+`make bench-adversary` regenerates BENCH_adversary_r14.json exactly.
+Knobs (docs/user-guide/configuration.md): WVA_ADVERSARY_SEED /
+WVA_ADVERSARY_GENERATIONS / WVA_ADVERSARY_POPULATION size the search
+(the artifact and archive are only written at the committed defaults),
+WVA_ADVERSARY_OUT / WVA_ADVERSARY_ARCHIVE override the output paths.
+`--smoke` runs a down-scaled search (1 generation x 2 candidates at a
+shortened horizon), writes nothing, and prints the same record shape —
+the <10 s tier-1 liveness gate behind `make adversary-smoke`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("LOG_LEVEL", "error")
+
+from workload_variant_autoscaler_tpu.emulator.adversary import (  # noqa: E402
+    DEFAULT_GENERATIONS,
+    DEFAULT_POPULATION,
+    DEFAULT_SEED,
+    search,
+)
+from workload_variant_autoscaler_tpu.emulator.scenarios.adversarial import (  # noqa: E402
+    ARCHIVE_VERSION,
+    DURATION_S,
+    scenario_from_params,
+)
+from workload_variant_autoscaler_tpu.emulator.twin import (  # noqa: E402
+    run_scenario,
+)
+
+ARTIFACT = "BENCH_adversary_r14.json"
+ARCHIVE = os.path.join("tests", "fixtures", "adversarial_scenarios.json")
+HAND_BENCH = "BENCH_goodput_r08.json"
+
+# the shipped hardening pair (controller/reconciler.py;
+# docs/robustness.md "Adversarial scenario search"): the degraded-
+# evidence scale-up freeze — the guardrail the worst find's badput
+# decomposition demanded (degradation-held surplus from flood-amplified
+# demand) — plus the observed-TTFT backpressure floor at x2 growth for
+# the ramp-shaped corners
+HARDENED_OPERATOR = {
+    "WVA_DEGRADED_SCALEUP_FREEZE": "1",
+    "WVA_TTFT_BACKPRESSURE": "2",
+}
+
+# promoted regression floors sit this far below the measured goodput:
+# determinism makes the exact value reproducible, but the floor guards
+# intent ("never meaningfully worse"), not bit-equality of the metric
+FLOOR_MARGIN = 0.05
+
+SMOKE_GENERATIONS = 1
+SMOKE_POPULATION = 2
+SMOKE_DURATION_S = 120.0
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        raise SystemExit(f"bad {name}={raw!r}: expected an integer")
+
+
+def hand_library_min() -> float:
+    with open(HAND_BENCH, encoding="utf-8") as f:
+        doc = json.load(f)
+    return min(s["goodput_fraction"] for s in doc["scenarios"].values())
+
+
+def promote(result, seed: int, duration_s: float) -> list[dict]:
+    """Each generation's worst find, deduplicated by parameter point,
+    scored under the hardened config, and stamped with its regression
+    floor. The archived scenario carries the HARDENED operator overlay:
+    the floor pins the guardrail's behavior, not the vulnerability."""
+    promoted = []
+    seen: set[str] = set()
+    for entry in result.generation_worst:
+        point = json.dumps(entry["params"], sort_keys=True)
+        if point in seen:
+            continue
+        seen.add(point)
+        name = f"adv-r14-g{entry['generation']}"
+        hardened = run_scenario(scenario_from_params(
+            entry["params"], name=name, seed=seed, duration_s=duration_s,
+            operator_extra=HARDENED_OPERATOR))
+        floor = max(0.0, round(hardened.goodput_fraction - FLOOR_MARGIN, 6))
+        promoted.append({
+            "name": name,
+            "seed": seed,
+            "duration_s": duration_s,
+            "params": entry["params"],
+            "unhardened_goodput": entry["goodput"],
+            "hardened_goodput": round(hardened.goodput_fraction, 6),
+            "floor": floor,
+            "operator": dict(HARDENED_OPERATOR),
+        })
+    return promoted
+
+
+def main() -> int:
+    smoke = "--smoke" in sys.argv[1:]
+    seed = _env_int("WVA_ADVERSARY_SEED", DEFAULT_SEED)
+    generations = _env_int(
+        "WVA_ADVERSARY_GENERATIONS",
+        SMOKE_GENERATIONS if smoke else DEFAULT_GENERATIONS)
+    population = _env_int(
+        "WVA_ADVERSARY_POPULATION",
+        SMOKE_POPULATION if smoke else DEFAULT_POPULATION)
+    duration_s = SMOKE_DURATION_S if smoke else DURATION_S
+
+    t0 = time.perf_counter()
+    first = search(seed=seed, generations=generations,
+                   population=population, duration_s=duration_s)
+    wall_search = round(time.perf_counter() - t0, 1)
+    worst = first.worst
+
+    record = {
+        "metric": "adversarial_worst_goodput",
+        "bench": "adversary",
+        # the headline: the lowest cost-weighted goodput the search
+        # drove the real controller to (lower = worse corner found)
+        "value": worst["goodput"],
+        "unit": "useful-cost-fraction",
+        "seed": seed,
+        "generations": generations,
+        "population": population,
+        "duration_s": duration_s,
+        "budget": first.budget,
+        "worst": worst,
+    }
+
+    if smoke:
+        if len(first.evaluations) != first.budget:
+            raise SystemExit(
+                f"smoke: search ran {len(first.evaluations)} evaluations, "
+                f"budget says {first.budget}")
+        print(f"wall_s: search={wall_search}", file=sys.stderr)
+        print(json.dumps(record))
+        return 0
+
+    t0 = time.perf_counter()
+    second = search(seed=seed, generations=generations,
+                    population=population, duration_s=duration_s)
+    wall_rerun = round(time.perf_counter() - t0, 1)
+    deterministic = (json.dumps(first.to_dict(), sort_keys=True)
+                     == json.dumps(second.to_dict(), sort_keys=True))
+    if not deterministic:
+        raise SystemExit("same-seed rerun diverged: the search is NOT "
+                         "deterministic — refusing to write the artifact")
+
+    hardened = run_scenario(scenario_from_params(
+        worst["params"], name="adv-worst-hardened", seed=seed,
+        duration_s=duration_s, operator_extra=HARDENED_OPERATOR))
+    promoted = promote(first, seed, duration_s)
+
+    record.update({
+        "deterministic": deterministic,
+        "hand_library_min": round(hand_library_min(), 6),
+        "unhardened_goodput": worst["goodput"],
+        "hardened_goodput": round(hardened.goodput_fraction, 6),
+        "hardened_operator": dict(HARDENED_OPERATOR),
+        "promoted": promoted,
+        "generation_worst": first.generation_worst,
+        "evaluations": first.evaluations,
+    })
+
+    # wall clock stays OUT of the record: the artifact is byte-stable
+    # across machines (everything scored is sim-time and seeded)
+    print(f"wall_s: search={wall_search} rerun={wall_rerun}",
+          file=sys.stderr)
+    print(json.dumps(record))
+
+    overridden = any(os.environ.get(k) for k in (
+        "WVA_ADVERSARY_SEED", "WVA_ADVERSARY_GENERATIONS",
+        "WVA_ADVERSARY_POPULATION"))
+    if not overridden:
+        out = os.environ.get("WVA_ADVERSARY_OUT") or ARTIFACT
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1, sort_keys=False)
+            f.write("\n")
+        archive = {
+            "version": ARCHIVE_VERSION,
+            "seed": seed,
+            "scenarios": [
+                {"name": p["name"], "seed": p["seed"],
+                 "duration_s": p["duration_s"], "params": p["params"],
+                 "floor": p["floor"], "operator": p["operator"]}
+                for p in promoted
+            ],
+        }
+        archive_out = (os.environ.get("WVA_ADVERSARY_ARCHIVE")
+                       or ARCHIVE)
+        with open(archive_out, "w", encoding="utf-8") as f:
+            json.dump(archive, f, indent=1, sort_keys=False)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

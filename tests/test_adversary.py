@@ -1,0 +1,411 @@
+"""Red-team surface: the adversarial scenario search and its promoted
+regression floors.
+
+Three layers, cheapest first. (1) Unit mechanics with a fake evaluator:
+the typed parameter space quantizes/clamps, mutation always moves,
+the (1+λ) descent is byte-deterministic and monotone, and the fault-plan
+jitter helpers respect the plan lock and per-rule rng streams. (2) The
+committed archive `tests/fixtures/adversarial_scenarios.json`: loads,
+round-trips into runnable scenarios, and every promoted scenario's
+goodput floor HOLDS through the real Reconciler — the tier-1 regression
+teeth behind `ADVERSARIAL_SCENARIOS`. (3) The guardrail the search paid
+for: the `WVA_TTFT_BACKPRESSURE` observed-latency floor engages under a
+hot ramp (and records its clamp), while the default factor stays
+byte-identical to the pre-guardrail controller. The committed artifact's
+headline claims (search undercuts the hand library, double-run
+byte-identity, hardened beats unhardened) live in
+tests/test_perf_claims.py; `make adversary-smoke` liveness rides along
+here as a subprocess gate, same shape as the shard smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+from workload_variant_autoscaler_tpu.emulator.adversary import (
+    mutate_params,
+    sample_params,
+    search,
+)
+from workload_variant_autoscaler_tpu.emulator.scenarios import Scenario
+from workload_variant_autoscaler_tpu.emulator.scenarios.adversarial import (
+    ADVERSARIAL_SCENARIOS,
+    ARCHIVE_VERSION,
+    DEFAULT_ARCHIVE_PATH,
+    PARAM_NAMES,
+    PARAM_SPACE,
+    load_archive,
+    quantize,
+    quantized_params,
+    scenario_from_params,
+    scenarios_from_archive,
+)
+from workload_variant_autoscaler_tpu.emulator.twin import run_scenario
+from workload_variant_autoscaler_tpu.faults.plan import (
+    NODE_POOL_DRAIN,
+    PROM_OUTAGE,
+    SPOT_RECLAIM,
+    STREAM_FLOOD,
+    FaultPlan,
+    FaultRule,
+    jittered_windows,
+    reparameterized,
+)
+from workload_variant_autoscaler_tpu.obs import (
+    CLAMP_DEGRADED_FREEZE,
+    CLAMP_TTFT_BACKPRESSURE,
+)
+
+# the all-faults-off corner of the space: a plain ramp the template
+# serves on the polled, unlimited path (every zero-means-off axis at 0)
+QUIET_POINT = {
+    "base_rpm": 600.0, "ramp_mult": 2.0, "ramp_at_s": 60.0,
+    "ramp_hold_s": 120.0, "decay_mult": 0.5, "outage_at_s": 60.0,
+    "outage_dur_s": 0.0, "drain_nodes": 0.0, "fault_at_s": 120.0,
+    "fault_dur_s": 60.0, "reclaim_p": 0.0, "flood_mult": 0.0,
+    "debounce_ms": 0.0, "skew_s": 0.0, "restart_at_s": 0.0,
+}
+
+
+class TestParamSpace:
+    def test_quantize_snaps_to_grid_and_clamps(self):
+        for spec in PARAM_SPACE:
+            assert quantize(spec, spec.hi + 5 * spec.quantum) == spec.hi
+            assert quantize(spec, spec.lo - 5 * spec.quantum) == spec.lo
+            mid = (spec.lo + spec.hi) / 2.0 + spec.quantum * 0.49
+            snapped = quantize(spec, mid)
+            assert spec.lo <= snapped <= spec.hi
+            steps = (snapped - spec.lo) / spec.quantum
+            assert steps == pytest.approx(round(steps), abs=1e-6), spec.name
+
+    def test_quantized_params_rejects_unknown_and_missing_axes(self):
+        with pytest.raises(ValueError, match="unknown adversary params"):
+            quantized_params({**QUIET_POINT, "tpyo_axis": 1.0})
+        short = dict(QUIET_POINT)
+        del short["flood_mult"]
+        with pytest.raises(ValueError, match="missing adversary params"):
+            quantized_params(short)
+
+    def test_sample_params_stays_on_the_bounded_grid(self):
+        rng = random.Random(7)
+        for _ in range(25):
+            point = sample_params(rng)
+            assert set(point) == set(PARAM_NAMES)
+            for spec in PARAM_SPACE:
+                v = point[spec.name]
+                assert spec.lo <= v <= spec.hi, spec.name
+                assert v == quantize(spec, v), spec.name
+
+    def test_mutate_always_yields_a_different_in_bounds_point(self):
+        for seed in range(20):
+            rng = random.Random(seed)
+            point = sample_params(rng)
+            moved = mutate_params(point, rng)
+            assert moved != point, seed
+            for spec in PARAM_SPACE:
+                v = moved[spec.name]
+                assert spec.lo <= v <= spec.hi, (seed, spec.name)
+                assert v == quantize(spec, v), (seed, spec.name)
+
+
+class TestScenarioBuilder:
+    def test_zero_axes_mean_no_faults_polled_unlimited(self):
+        sc = scenario_from_params(QUIET_POINT, name="q", seed=1)
+        assert sc.faults == ()
+        assert sc.node_pools == ()
+        assert not sc.limited_mode
+        assert not sc.streaming
+        assert len(sc.variants) == 1 and not sc.variants[0].spot
+
+    def test_capacity_axes_build_pools_and_limited_mode(self):
+        p = {**QUIET_POINT, "drain_nodes": 3.0, "reclaim_p": 0.5}
+        sc = scenario_from_params(p, name="cap", seed=1)
+        assert sc.limited_mode
+        pools = {pool.prefix: pool.count for pool in sc.node_pools}
+        # 1 immune on-demand node + the drained pool + the reclaimable rest
+        assert pools == {"adv-keep": 1, "adv-drain": 3, "adv-flex": 4}
+        kinds = {f.kind for f in sc.faults}
+        assert kinds == {NODE_POOL_DRAIN, SPOT_RECLAIM}
+        reclaim = next(f for f in sc.faults if f.kind == SPOT_RECLAIM)
+        assert reclaim.match == "adv-flex"
+        assert reclaim.probability == 0.5
+        assert sc.variants[0].spot
+
+    def test_stream_axes_engage_streaming_with_flood_caps(self):
+        p = {**QUIET_POINT, "flood_mult": 50.0, "debounce_ms": 100.0}
+        sc = scenario_from_params(p, name="flood", seed=1)
+        assert sc.streaming
+        flood = next(f for f in sc.faults if f.kind == STREAM_FLOOD)
+        assert flood.labels == {"multiplier": 50}
+        assert sc.operator["WVA_STREAM_DEBOUNCE_MS"] == "100"
+        assert sc.operator["WVA_STREAM_MAX_GROUPS"] == "64"
+        assert sc.operator["WVA_STREAM_MAX_QUEUE"] == "32"
+
+    def test_outage_axis_gates_the_prom_outage_window(self):
+        p = {**QUIET_POINT, "outage_at_s": 90.0, "outage_dur_s": 60.0}
+        sc = scenario_from_params(p, name="out", seed=1)
+        outage = next(f for f in sc.faults if f.kind == PROM_OUTAGE)
+        assert (outage.after_s, outage.until_s) == (90.0, 150.0)
+
+    def test_same_point_rebuilds_the_identical_frozen_scenario(self):
+        a = scenario_from_params(QUIET_POINT, name="same", seed=9)
+        b = scenario_from_params(dict(QUIET_POINT), name="same", seed=9)
+        assert isinstance(a, Scenario)
+        assert a == b
+
+    def test_operator_extra_overlays_the_scenario_operator(self):
+        sc = scenario_from_params(
+            QUIET_POINT, name="hard", seed=1,
+            operator_extra={"WVA_TTFT_BACKPRESSURE": "2"})
+        assert sc.operator["WVA_TTFT_BACKPRESSURE"] == "2"
+        # the template's step bound survives the overlay
+        assert sc.operator["WVA_MAX_REPLICA_STEP"] == "3"
+
+
+class TestSearchMechanics:
+    """The (1+λ) descent, unit-tested with a fake evaluator — no twin
+    runs, so the mechanics stay cheap enough to sweep."""
+
+    @staticmethod
+    def _fake(params: dict, name: str) -> float:
+        # a smooth deterministic landscape: cheaper base demand and a
+        # bigger flood both "hurt", so descent has somewhere to go
+        return round((params["base_rpm"] / 2400.0
+                      + (100.0 - params["flood_mult"]) / 100.0) / 2.0, 6)
+
+    def test_same_seed_serializes_byte_identically(self):
+        a = search(seed=3, generations=2, population=3, evaluate=self._fake)
+        b = search(seed=3, generations=2, population=3, evaluate=self._fake)
+        assert json.dumps(a.to_dict(), sort_keys=True) \
+            == json.dumps(b.to_dict(), sort_keys=True)
+
+    def test_different_seed_walks_a_different_trajectory(self):
+        a = search(seed=3, generations=2, population=3, evaluate=self._fake)
+        b = search(seed=4, generations=2, population=3, evaluate=self._fake)
+        assert a.evaluations != b.evaluations
+
+    def test_budget_arithmetic_matches_the_audit_trail(self):
+        r = search(seed=5, generations=3, population=4, evaluate=self._fake)
+        assert r.budget == 1 + 3 * 4
+        assert len(r.evaluations) == r.budget
+        assert [e["index"] for e in r.evaluations] == list(range(r.budget))
+        assert len(r.generation_worst) == 3
+
+    def test_descent_is_monotone_in_generation_worst(self):
+        r = search(seed=6, generations=4, population=3, evaluate=self._fake)
+        worsts = [g["goodput"] for g in r.generation_worst]
+        assert worsts == sorted(worsts, reverse=True)
+        assert r.worst["goodput"] == min(e["goodput"] for e in r.evaluations)
+
+    def test_worst_tiebreaks_to_the_earliest_evaluation(self):
+        r = search(seed=7, generations=2, population=2,
+                   evaluate=lambda params, name: 0.5)
+        assert r.worst["index"] == 0
+
+    def test_evaluations_record_quantized_grid_points(self):
+        r = search(seed=8, generations=1, population=2, evaluate=self._fake)
+        for e in r.evaluations:
+            assert e["params"] == quantized_params(e["params"])
+
+
+class TestPlanJitter:
+    """Satellite: the seeded window-jitter primitives the search mutates
+    fault timelines with (faults/plan.py)."""
+
+    def _rules(self):
+        return [
+            FaultRule(kind=PROM_OUTAGE, after_s=60.0, until_s=120.0),
+            FaultRule(kind=NODE_POOL_DRAIN, match="pool-a",
+                      after_s=100.0, until_s=200.0),
+            FaultRule(kind=PROM_OUTAGE, after_cycle=2, until_cycle=4),
+        ]
+
+    def test_jitter_is_deterministic_per_seed(self):
+        a = jittered_windows(self._rules(), 5, 30.0, max_scale=0.2)
+        b = jittered_windows(self._rules(), 5, 30.0, max_scale=0.2)
+        assert a == b
+        c = jittered_windows(self._rules(), 6, 30.0, max_scale=0.2)
+        assert a != c
+
+    def test_rules_without_seconds_windows_pass_through(self):
+        out = jittered_windows(self._rules(), 5, 30.0)
+        assert out[2] == self._rules()[2]
+        assert out[0] != self._rules()[0]
+
+    def test_per_rule_streams_are_independent(self):
+        """Jittering rule i never perturbs rule j: editing a later rule
+        leaves the earlier rules' draws untouched."""
+        base = self._rules()
+        edited = self._rules()
+        edited[1] = reparameterized(edited[1], until_s=500.0)
+        a = jittered_windows(base, 11, 45.0, max_scale=0.3)
+        b = jittered_windows(edited, 11, 45.0, max_scale=0.3)
+        assert a[0] == b[0]
+        assert a[2] == b[2]
+
+    def test_jitter_clamps_start_and_minimum_duration(self):
+        rules = [FaultRule(kind=PROM_OUTAGE, after_s=1.0, until_s=2.0)]
+        for seed in range(30):
+            out = jittered_windows(rules, seed, 500.0, max_scale=0.99)
+            assert out[0].after_s >= 0.0, seed
+            assert out[0].until_s - out[0].after_s >= 1.0, seed
+
+    def test_plan_method_jitters_under_lock_and_rebuilds_rngs(self):
+        plan = FaultPlan(self._rules(), seed=3)
+        got = plan.jitter_windows(5, 30.0, max_scale=0.2)
+        assert got is plan
+        assert plan.rules == jittered_windows(self._rules(), 5, 30.0,
+                                              max_scale=0.2)
+        assert len(plan._rngs) == len(plan.rules)
+
+    def test_reparameterized_revalidates_the_mutated_rule(self):
+        rule = FaultRule(kind=SPOT_RECLAIM, match="x", probability=0.5)
+        assert reparameterized(rule, probability=0.75).probability == 0.75
+        with pytest.raises(ValueError, match="probability"):
+            reparameterized(rule, probability=1.5)
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            reparameterized(rule, kind="made-up-kind")
+
+
+class TestArchive:
+    def test_missing_archive_loads_as_empty(self, tmp_path):
+        doc = load_archive(tmp_path / "absent.json")
+        assert doc == {"version": ARCHIVE_VERSION, "scenarios": []}
+        assert scenarios_from_archive(doc) == {}
+
+    def test_wrong_version_raises(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"version": 99, "scenarios": []}))
+        with pytest.raises(ValueError, match="version"):
+            load_archive(bad)
+
+    def test_archive_entries_rebuild_with_floor_and_operator(self):
+        doc = {"version": ARCHIVE_VERSION, "scenarios": [{
+            "name": "adv-test", "seed": 21, "duration_s": 300.0,
+            "params": QUIET_POINT, "floor": 0.25,
+            "operator": {"WVA_TTFT_BACKPRESSURE": "2"},
+        }]}
+        built = scenarios_from_archive(doc)
+        sc = built["adv-test"]
+        assert sc.goodput_floor == 0.25
+        assert sc.seed == 21
+        assert sc.duration_s == 300.0
+        assert sc.operator["WVA_TTFT_BACKPRESSURE"] == "2"
+
+    def test_committed_archive_is_promoted_and_registered(self):
+        """The red-team loop actually promoted finds: the committed
+        fixture is non-empty and ADVERSARIAL_SCENARIOS mirrors it,
+        floors attached."""
+        doc = load_archive(DEFAULT_ARCHIVE_PATH)
+        assert doc["scenarios"], \
+            "no promoted adversarial scenarios committed"
+        assert set(ADVERSARIAL_SCENARIOS) \
+            == {e["name"] for e in doc["scenarios"]}
+        for entry in doc["scenarios"]:
+            sc = ADVERSARIAL_SCENARIOS[entry["name"]]
+            assert sc.goodput_floor == entry["floor"] >= 0.0
+            assert sc.seed == entry["seed"]
+            # promoted scenarios pin the HARDENED controller config
+            assert sc.operator.get("WVA_TTFT_BACKPRESSURE")
+
+
+class TestPromotedFloors:
+    """The teeth: every archived worst-found scenario re-runs through the
+    real Reconciler and must clear its committed goodput floor."""
+
+    @pytest.mark.parametrize("name", sorted(ADVERSARIAL_SCENARIOS))
+    def test_promoted_scenario_clears_its_floor(self, name):
+        sc = ADVERSARIAL_SCENARIOS[name]
+        result = run_scenario(sc)
+        assert result.goodput_fraction >= sc.goodput_floor, (
+            f"{name} regressed below its promoted floor "
+            f"{sc.goodput_floor}: {result.goodput_fraction}")
+
+
+class TestBackpressureGuardrail:
+    """The hardening the search motivated: an observed-TTFT violation
+    under standing demand raises a published-count floor
+    (`WVA_TTFT_BACKPRESSURE`), recorded as a decision clamp; at the
+    default factor the code path is byte-inert."""
+
+    HOT_RAMP = {**QUIET_POINT, "ramp_mult": 8.0, "ramp_hold_s": 180.0,
+                "decay_mult": 1.0}
+
+    def _run(self, extra=None):
+        return run_scenario(scenario_from_params(
+            self.HOT_RAMP, name="bp-probe", seed=14, duration_s=300.0,
+            operator_extra=extra))
+
+    def test_floor_engages_and_records_its_clamp(self):
+        hardened = self._run({"WVA_TTFT_BACKPRESSURE": "2"})
+        clamps = [c for r in hardened.decisions.records()
+                  for c in r.clamps if c.name == CLAMP_TTFT_BACKPRESSURE]
+        assert clamps, "hot ramp never engaged the backpressure floor"
+        assert all(c.after > c.before for c in clamps)
+        assert any("floor" in c.detail for c in clamps)
+
+    @pytest.mark.slow
+    def test_default_factor_is_byte_inert(self):
+        baseline = self._run(None)
+        explicit = self._run({"WVA_TTFT_BACKPRESSURE": "1"})
+        assert explicit.to_dict() == baseline.to_dict()
+
+
+class TestDegradedFreezeGuardrail:
+    """The other half of the hardening pair: during a streaming flood
+    the shed-window cycles carry amplified arrival evidence, and
+    `WVA_DEGRADED_SCALEUP_FREEZE` must freeze scale-UP on exactly those
+    cycles (recorded as the `degraded-scaleup-freeze` clamp) while the
+    default stays byte-identical to the pre-guardrail controller."""
+
+    FLOODED_RAMP = {**QUIET_POINT, "ramp_mult": 8.0, "ramp_at_s": 60.0,
+                    "ramp_hold_s": 180.0, "decay_mult": 1.0,
+                    "flood_mult": 100.0, "fault_at_s": 60.0,
+                    "fault_dur_s": 180.0}
+
+    def _run(self, extra=None):
+        return run_scenario(scenario_from_params(
+            self.FLOODED_RAMP, name="freeze-probe", seed=14,
+            duration_s=300.0, operator_extra=extra))
+
+    def test_freeze_engages_and_records_its_clamp(self):
+        frozen = self._run({"WVA_DEGRADED_SCALEUP_FREEZE": "1"})
+        clamps = [c for r in frozen.decisions.records()
+                  for c in r.clamps if c.name == CLAMP_DEGRADED_FREEZE]
+        assert clamps, "flooded ramp never engaged the scale-up freeze"
+        # the freeze only ever pushes a proposal DOWN to the ceiling
+        assert all(c.after < c.before for c in clamps)
+        assert all("stream pressure" in c.detail for c in clamps)
+
+    @pytest.mark.slow
+    def test_default_is_byte_inert(self):
+        baseline = self._run(None)
+        explicit = self._run({"WVA_DEGRADED_SCALEUP_FREEZE": "0"})
+        assert explicit.to_dict() == baseline.to_dict()
+
+
+def test_adversary_smoke_bench_passes():
+    """`make adversary-smoke` in-suite: the down-scaled search
+    (bench_adversary.py --smoke) runs the full (1+λ) loop through the
+    real twin at a shortened horizon and prints the record shape the
+    artifact uses. Run as a subprocess, same shape as the shard smoke."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench_adversary.py"),
+         "--smoke"],
+        capture_output=True, text=True, cwd=repo, timeout=120)
+    assert r.returncode == 0, \
+        f"adversary smoke failed:\n{r.stdout}\n{r.stderr}"
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["bench"] == "adversary"
+    assert line["metric"] == "adversarial_worst_goodput"
+    assert line["budget"] == 3
+    assert 0.0 <= line["value"] <= 1.0
+    assert line["worst"]["params"] \
+        == quantized_params(line["worst"]["params"])

@@ -115,6 +115,8 @@ class TestFitRecovery:
             assert within_tolerance(reading, 0.5), (reading, load)
         assert judged >= 10
 
+    @pytest.mark.slow   # ~20s double observation window; single-window
+    # recovery stays tier-1 via the other TestFitRecovery tests
     def test_fit_is_stable_across_runs(self):
         """Two independent observation windows produce coefficients close
         enough that alternating drift->refit->drift cannot oscillate."""

@@ -143,7 +143,7 @@ class TestCallArity:
     ["workload_variant_autoscaler_tpu", "tools", "tests", "bench.py",
      "bench_loop.py", "bench_collect.py", "bench_goodput.py",
      "bench_profile.py", "bench_fuse.py", "bench_stream.py",
-     "bench_shard.py", "__graft_entry__.py"],
+     "bench_shard.py", "bench_adversary.py", "__graft_entry__.py"],
 ])
 def test_package_lints_clean(paths):
     """The gate itself: the shipped source must lint clean — every rule
@@ -1118,7 +1118,7 @@ class TestKnobParity:
         for sub in ("workload_variant_autoscaler_tpu", "tools", "tests",
                     "bench.py", "bench_loop.py", "bench_collect.py",
                     "bench_goodput.py", "bench_profile.py",
-                    "bench_shard.py"):
+                    "bench_shard.py", "bench_adversary.py"):
             for fp in wvalint.iter_py_files([os.path.join(REPO, sub)]):
                 files.append(fp)
                 with open(fp, encoding="utf-8") as f:
@@ -1214,6 +1214,8 @@ class TestFaultKindLiterals:
                          "scenarios", "__init__.py"),
             os.path.join("workload_variant_autoscaler_tpu", "emulator",
                          "twin.py"),
+            os.path.join("workload_variant_autoscaler_tpu", "emulator",
+                         "scenarios", "adversarial.py"),
             "bench_goodput.py",
         ):
             path = os.path.join(REPO, rel)

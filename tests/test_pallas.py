@@ -61,7 +61,10 @@ class TestPallasEquivalence:
                 rtol=rtol, atol=1e-9, err_msg=field,
             )
 
-    @pytest.mark.parametrize("b", [1, 8, 37])
+    # b=37 (the off-tile interpreter-mode case, ~10s per combo) rides
+    # tier-2: B-tiling is pinned in tier-1 by test_tail_tile_b_invariance
+    @pytest.mark.parametrize(
+        "b", [1, 8, pytest.param(37, marks=pytest.mark.slow)])
     @pytest.mark.parametrize("pct", [0.9, 0.95, 0.99])
     @pytest.mark.parametrize("dtype,rtol", [
         (jnp.float64, 1e-9),

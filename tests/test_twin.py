@@ -156,6 +156,68 @@ class TestScenarioLibrary:
         assert abbreviated(sc, 120.0).variants == sc.variants
 
 
+class TestEngineKnobEquivalence:
+    """The twin's verdict must not depend on which engine implementation
+    the session happens to run: the sharded fleet arena and the fused
+    solve are PERFORMANCE paths, so the sharded combinations (fused and
+    staged) must pin the same decisions and the same goodput as the
+    conftest default (WVA_SHARDED_FLEET=off, WVA_FUSED_SOLVE on); the
+    unsharded staged-vs-fused pair is pinned by test_fused.py."""
+
+    @staticmethod
+    def _signature(result):
+        return (result.to_dict(),
+                [r.to_dict() for r in result.decisions.records()])
+
+    @pytest.mark.parametrize("sharded,fused", [
+        ("on", ""), ("on", "off"),
+    ])
+    def test_smoke_pins_decisions_and_goodput(self, smoke_result,
+                                              sharded, fused,
+                                              monkeypatch):
+        monkeypatch.setenv("WVA_SHARDED_FLEET", sharded)
+        if fused:
+            monkeypatch.setenv("WVA_FUSED_SOLVE", fused)
+        else:
+            monkeypatch.delenv("WVA_FUSED_SOLVE", raising=False)
+        again = run_scenario(abbreviated(SCENARIOS["flash-crowd"], 300.0))
+        assert self._signature(again) == self._signature(smoke_result), \
+            f"sharded={sharded} fused={fused or 'default'} diverged"
+
+
+class TestStreamDegradedAccounting:
+    """PR 12 added the stream-degraded rung between healthy and
+    stale-cache; the meter must bill cycles governed by it as
+    degradation-held (the controller KNEW it was running degraded), and
+    the scale-to-zero flap detector must NOT treat it as stale evidence
+    (a shed cycle sized on fresh pushed loads)."""
+
+    def test_stream_degraded_is_a_degraded_rung_but_not_stale(self):
+        from workload_variant_autoscaler_tpu.emulator.twin import (
+            DEGRADED_RUNGS,
+            STALE_ZERO_RUNGS,
+        )
+
+        assert "stream-degraded" in DEGRADED_RUNGS
+        assert "stream-degraded" not in STALE_ZERO_RUNGS
+        assert set(STALE_ZERO_RUNGS) == {"stale-cache", "hold"}
+
+    def test_flood_cycles_bill_degradation_held(self):
+        from workload_variant_autoscaler_tpu.emulator.scenarios import (
+            STREAMING_SCENARIOS,
+        )
+        from workload_variant_autoscaler_tpu.obs import GOODPUT_DEGRADED
+
+        result = run_scenario(
+            abbreviated(STREAMING_SCENARIOS["flash-crowd-flood"], 300.0))
+        held = sum(v.badput.get(GOODPUT_DEGRADED, 0.0)
+                   for v in result.variants)
+        assert held > 0.0, (
+            "a flood window that sheds into stream-degraded cycles must "
+            "surface as degradation-held badput, got "
+            f"{[dict(v.badput) for v in result.variants]}")
+
+
 class TestGoodputAnnotation:
     def _record(self, cycle=3):
         return DecisionRecord(trace_id="t1", cycle=cycle, ts=0.0,

@@ -49,8 +49,10 @@ from ..metrics import (
 from ..models import SaturationPolicy, System
 from ..obs import (
     CLAMP_REPLICA_STEP,
+    CLAMP_DEGRADED_FREEZE,
     CLAMP_STABILIZATION,
     CLAMP_STALE_VETO,
+    CLAMP_TTFT_BACKPRESSURE,
     HELD,
     LIMITED,
     DecisionBuilder,
@@ -908,6 +910,8 @@ class Reconciler:
         stabilization_s = self._stabilization_window(operator_cm)
         noise_margin = self._noise_margin(operator_cm)
         replica_step = self._replica_step(operator_cm)
+        backpressure = self._backpressure_factor(operator_cm)
+        freeze = self._scaleup_freeze(operator_cm)
         optimized: dict[str, crd.OptimizedAlloc] = {}
         for va, _deploy in prepared:
             key = full_name(va.name, va.namespace)
@@ -927,9 +931,18 @@ class Reconciler:
             if builder is not None:
                 builder.accelerator = alloc.accelerator
                 builder.proposed_replicas = proposed
+            prev_published = va.status.desired_optimized_alloc.num_replicas
+            bp_state = self.state.backpressure.get(key)
+            if bp_state is not None and bp_state[3] > 0:
+                # a standing backpressure floor is an OVERLAY on the
+                # solver path: the stabilization/step guards baseline on
+                # the pre-floor published count, so a released floor
+                # snaps back to the solver's answer in one cycle instead
+                # of step-bleeding the boost for many cycles
+                prev_published = min(prev_published, bp_state[3])
             alloc.num_replicas = self._stabilize_scale_down(
                 key, alloc.num_replicas, stabilization_s,
-                prev_published=va.status.desired_optimized_alloc.num_replicas,
+                prev_published=prev_published,
                 guard=self._demand_guard(system, key, noise_margin),
             )
             if builder is not None:
@@ -939,10 +952,26 @@ class Reconciler:
                                      f"noise_margin={noise_margin}")
             alloc.num_replicas = self._guard_actuation(
                 key, alloc.num_replicas,
-                prev_published=va.status.desired_optimized_alloc.num_replicas,
+                prev_published=prev_published,
                 current=_deploy.current_replicas(),
                 stale=result.degraded.get(key) == "stale-cache",
                 step=replica_step,
+                decision=builder,
+            )
+            alloc.num_replicas = self._freeze_degraded_scaleup(
+                key, alloc.num_replicas,
+                prev_published=prev_published,
+                current=_deploy.current_replicas(),
+                freeze=freeze,
+                decision=builder,
+            )
+            alloc.num_replicas = self._ttft_backpressure(
+                key, alloc.num_replicas, system,
+                prev_published=prev_published,
+                current=_deploy.current_replicas(),
+                factor=backpressure,
+                fresh=(key not in result.degraded
+                       and not self.state.stream_pressure),
                 decision=builder,
             )
             optimized[key] = alloc
@@ -1185,6 +1214,156 @@ class Reconciler:
         solver concluded, the published count moves at most `step` from
         the previous published value per cycle."""
         return int(self._cm_float(operator_cm, "WVA_MAX_REPLICA_STEP", 0.0))
+
+    def _backpressure_factor(self, operator_cm: dict[str, str]) -> float:
+        """WVA_TTFT_BACKPRESSURE: per-cycle multiplicative growth applied
+        to a variant whose OBSERVED mean TTFT violates its SLO target on
+        fresh evidence (1, the default, disables the guardrail). The
+        observed-latency feedback the queueing model lacks: the solver
+        sizes from its fitted profile, and when real queueing runs ahead
+        of the model's optimism the fleet burns SLO for cycles while the
+        solver keeps insisting the current size is fine — the worst-found
+        attack of the adversarial search (docs/robustness.md,
+        'Adversarial scenario search')."""
+        return self._cm_float(operator_cm, "WVA_TTFT_BACKPRESSURE", 1.0)
+
+    def _scaleup_freeze(self, operator_cm: dict[str, str]) -> bool:
+        """WVA_DEGRADED_SCALEUP_FREEZE: on a cycle the streaming core
+        flagged as pressure-degraded (overload shed, blown lag budget,
+        coalesced escalation), freeze scale-UP at the previously
+        published count (off by default). The evidence such a cycle
+        sized on came from a shedding window — arrival counts amplified
+        by replayed and coalesced pushes — and mass-scaling a fleet on
+        amplified evidence is the adversarial search's dominant badput
+        source (degradation-held surplus; docs/robustness.md,
+        'Adversarial scenario search'). Scale-down and same-size publish
+        are untouched, and the post-window backstop full pass re-sizes
+        on clean evidence one cycle later."""
+        return self._cm_float(
+            operator_cm, "WVA_DEGRADED_SCALEUP_FREEZE", 0.0) > 0.0
+
+    def _freeze_degraded_scaleup(self, key: str, published: int,
+                                 prev_published: int, current: int,
+                                 freeze: bool,
+                                 decision: Optional[DecisionBuilder] = None,
+                                 ) -> int:
+        """Apply the degraded-evidence scale-up freeze: cap `published`
+        at the previously published count (live deployment size on the
+        first cycle) while the cycle rides stream pressure."""
+        if not freeze or not self.state.stream_pressure:
+            return published
+        ceiling = max(prev_published if prev_published > 0 else current, 1)
+        if published <= ceiling:
+            return published
+        log.warning("degraded-evidence scale-up frozen",
+                    extra=kv(variant=key, proposed=published,
+                             frozen_at=ceiling,
+                             pressure=self.state.stream_pressure))
+        if decision is not None:
+            decision.clamp(
+                CLAMP_DEGRADED_FREEZE, published, ceiling,
+                detail=f"stream pressure ({self.state.stream_pressure}): "
+                       f"scale-up on shed-window evidence frozen")
+        return ceiling
+
+    # TTFT-backpressure floor dynamics: after a boost the latency window
+    # still averages over the pre-boost congestion, so growth pauses for
+    # this many cycles before the evidence can ask for more; the standing
+    # floor releases only once observed demand falls below this fraction
+    # of the demand that provoked the boost (releasing on the first clean
+    # window would shrink the fleet back into the very violation the
+    # floor just fixed)
+    BACKPRESSURE_COOLDOWN_CYCLES = 1
+    BACKPRESSURE_RELEASE_FRAC = 0.7
+
+    def _ttft_backpressure(self, key: str, published: int, system,
+                           prev_published: int, current: int,
+                           factor: float, fresh: bool,
+                           decision: Optional[DecisionBuilder] = None,
+                           ) -> int:
+        """Observed-SLO backpressure floor on the published count. While
+        the cycle's measured mean TTFT exceeds the variant's SLO target
+        on fresh evidence, grow a floor multiplicatively (x factor over
+        the published baseline, at most once per cooldown window so the
+        averaging window can flush pre-boost congestion) and publish at
+        least the floor. The floor then STANDS while the demand that
+        provoked it persists — a single clean window is the floor
+        working, not proof it is unnecessary — and releases when demand
+        drops, handing ramp-down to the ordinary stabilized, step-bounded
+        path. Degraded evidence never grows the floor (stale metrics are
+        not evidence either way), and growth is bounded at x factor per
+        cooldown: a corrupted latency metric cannot mass mis-scale the
+        fleet in one cycle."""
+        if factor <= 1.0:
+            self.state.backpressure.pop(key, None)
+            return published
+        floor, boost_rpm, boost_cycle, _solver_prev = \
+            self.state.backpressure.get(key, (0, 0.0, -1, 0))
+        grown = False
+        server = system.servers.get(key)
+        # the OBSERVED latency rides the CollectedLoad this cycle sized
+        # on (state.cycle_loads); the solver-facing ServerLoadSpec
+        # carries only the demand shape
+        namespace = key.partition(":")[2]
+        load = self.state.cycle_loads.get(
+            (server.model_name, namespace)) if server is not None else None
+        svc = system.service_classes.get(
+            server.service_class_name) if server is not None else None
+        target = svc.target(server.model_name) if svc is not None else None
+        if fresh and load is not None and target is not None \
+                and target.slo_ttft > 0.0:
+            if floor > 0 and load.arrival_rate_rpm \
+                    < self.BACKPRESSURE_RELEASE_FRAC * boost_rpm:
+                # demand-keyed release, judged BEFORE the latency check:
+                # the latency window lags the demand drop by an averaging
+                # window, and a floor held against demand that is gone is
+                # pure over-provision. If latency is genuinely still bad
+                # at the lower demand, the next fresh window re-engages
+                # the boost with the new demand as its reference.
+                log.info("ttft backpressure released",
+                         extra=kv(variant=key, floor=floor,
+                                  arrival_rpm=round(
+                                      load.arrival_rate_rpm, 1),
+                                  boost_rpm=round(boost_rpm, 1)))
+                self.state.backpressure.pop(key, None)
+                return published
+            if load.avg_ttft_ms > target.slo_ttft:
+                cooling = (floor > 0
+                           and self.state.cycle_index - boost_cycle
+                           <= self.BACKPRESSURE_COOLDOWN_CYCLES)
+                if not cooling:
+                    baseline = max(floor,
+                                   prev_published if prev_published > 0
+                                   else current, 1)
+                    new_floor = max(floor,
+                                    int(math.ceil(baseline * factor)))
+                    if new_floor > floor:
+                        floor, grown = new_floor, True
+                        boost_rpm = load.arrival_rate_rpm
+                        boost_cycle = self.state.cycle_index
+                        log.warning(
+                            "ttft backpressure engaged",
+                            extra=kv(variant=key,
+                                     observed_ttft_ms=round(
+                                         load.avg_ttft_ms, 1),
+                                     slo_ttft_ms=target.slo_ttft,
+                                     solver_published=published,
+                                     floor=floor))
+        if floor > 0:
+            # `published` is the post-guard SOLVER-path count: recorded
+            # so next cycle's guards baseline on it (overlay semantics)
+            self.state.backpressure[key] = (floor, boost_rpm,
+                                            boost_cycle, published)
+        if floor <= published:
+            return published
+        if decision is not None:
+            detail = (f"floor={floor}, factor={factor:g}"
+                      + (f", observed_ttft={load.avg_ttft_ms:.0f}ms > "
+                         f"slo_ttft={target.slo_ttft:.0f}ms" if grown
+                         else " (standing)"))
+            decision.clamp(CLAMP_TTFT_BACKPRESSURE, published, floor,
+                           detail=detail)
+        return floor
 
     def _guard_actuation(self, key: str, desired: int, prev_published: int,
                          current: int, stale: bool, step: int,

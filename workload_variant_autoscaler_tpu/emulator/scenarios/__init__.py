@@ -419,6 +419,7 @@ STREAMING_SCENARIOS: dict[str, Scenario] = {
 }
 
 __all__ = [
+    "ADVERSARIAL_SCENARIOS",
     "CHIP_MATRIX",
     "ChipLane",
     "GKE_POOL_LABELS",
@@ -429,3 +430,9 @@ __all__ = [
     "VariantSpec",
     "abbreviated",
 ]
+
+# imported LAST: adversarial.py reads the classes above back off this
+# (by then sufficiently-initialized) package. The archive-backed
+# adversarial registry lives in its own module so the searchable space
+# stays separate from the hand-written libraries.
+from .adversarial import ADVERSARIAL_SCENARIOS  # noqa: E402

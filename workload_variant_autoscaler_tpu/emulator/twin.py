@@ -21,8 +21,8 @@ decomposed tick by tick into badput buckets over the provisioned cost:
                       withdrawn below need)
 - `over-provisioned`  surplus replicas demand cannot use
 - `degradation-held`  mis-provision while the variant rode a degraded
-                      rung (stale-cache/limited/hold — the controller
-                      was flying on old evidence)
+                      rung (stream-degraded/stale-cache/hold — the
+                      controller was flying on degraded evidence)
 - `actuation-lagged`  the decision was right but pods were still
                       starting (scale-up landed inside the startup lag)
 
@@ -98,8 +98,18 @@ log = get_logger("wva.twin")
 # controller flew on degraded EVIDENCE). `limited` deliberately stays
 # out: an optimizer that cannot fit withdrawn capacity is
 # capacity-bound, and its SLO misses read as `under-provisioned` — the
-# bucket that answers "buy more chips", not "fix the telemetry"
-DEGRADED_RUNGS = ("stale-cache", "hold")
+# bucket that answers "buy more chips", not "fix the telemetry".
+# `stream-degraded` (the shed/lag-pressure rung PR 12 added) is in: a
+# cycle sized while the ingest door was shedding flew on partial
+# evidence, and charging its misses to under-provision/actuation-lag
+# would mis-answer "buy more chips" for what is a telemetry storm
+DEGRADED_RUNGS = ("stream-degraded", "stale-cache", "hold")
+
+# rungs where a published ZERO is the stale-flap failure the guardrail
+# forbids. Narrower than DEGRADED_RUNGS on purpose: stream-degraded
+# cycles size on FRESH (admitted) pushes — a zero there is a sizing
+# decision to judge by its badput, not a flap on absent evidence
+STALE_ZERO_RUNGS = ("stale-cache", "hold")
 
 _RUNG_LABELS = {int(s): s.label for s in DegradationState}
 
@@ -661,7 +671,7 @@ def _run_scenario(scenario: Scenario, plan: FaultPlan,
             elif st.published_once:
                 # a published variant dropping to zero on a degraded rung
                 # is the exact failure the stale-veto guardrail forbids
-                if st.rung in DEGRADED_RUNGS:
+                if st.rung in STALE_ZERO_RUNGS:
                     st.scaled_to_zero_on_stale = True
                 st.min_desired_after_publish = 0
 

@@ -24,7 +24,7 @@ import json
 import random
 import threading
 import zlib
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as _dc_replace
 from typing import Optional
 
 # dependencies
@@ -310,6 +310,23 @@ class FaultPlan:
                 return rule
         return None
 
+    # -- adversarial reparameterization (emulator/adversary.py) -----------
+
+    def jitter_windows(self, seed: int, max_shift_s: float,
+                       max_scale: float = 0.0) -> "FaultPlan":
+        """Seeded in-place jitter of every rule's seconds window (shift
+        the start by up to ±max_shift_s, stretch the duration by up to
+        ±max_scale), so the adversarial search can slide fault windows
+        without rebuilding plans by hand. Runs under the plan lock:
+        fanned-out hooks may be mid-lookup in `_active`, and the rng
+        streams are rebuilt so each rule index keeps its own draw
+        sequence (same discipline as `add`)."""
+        with self._lock:
+            self.rules = jittered_windows(
+                self.rules, seed, max_shift_s, max_scale)
+            self._rngs = [self._rule_rng(i) for i in range(len(self.rules))]
+        return self
+
     # -- scripting (JSON form: the emulator server's WVA_FAULT_PLAN) ------
 
     @classmethod
@@ -342,3 +359,46 @@ class FaultPlan:
                 for r in self.rules
             ],
         }
+
+
+# -- window reparameterization helpers (the adversarial search's mutation
+#    primitives; pure functions over rules so they compose with frozen
+#    Scenario fault tuples as well as live plans) -------------------------
+
+def reparameterized(rule: FaultRule, **overrides) -> FaultRule:
+    """A copy of `rule` with the given fields replaced. Validation
+    re-runs (`__post_init__`), so a mutated rule can never leave the
+    fault matrix — an out-of-range probability or an unknown kind fails
+    here, not deep inside a twin run."""
+    return _dc_replace(rule, **overrides)
+
+
+def jittered_windows(rules: list[FaultRule] | tuple,
+                     seed: int, max_shift_s: float,
+                     max_scale: float = 0.0) -> list[FaultRule]:
+    """Deterministically jitter the seconds windows of `rules`: each
+    rule's start shifts by uniform(-max_shift_s, +max_shift_s) and its
+    duration stretches by a factor in [1-max_scale, 1+max_scale], drawn
+    from a PER-RULE rng keyed by (seed, index) — the same stream
+    discipline as `FaultPlan._rule_rng`, so jittering rule i never
+    perturbs rule j. Rules without a seconds window pass through
+    untouched; jittered windows are clamped to start >= 0 and to a
+    minimum 1 s duration so a mutation cannot silently erase a fault."""
+    out: list[FaultRule] = []
+    for i, rule in enumerate(rules):
+        if rule.after_s is None and rule.until_s is None:
+            out.append(rule)
+            continue
+        rng = random.Random((seed * 1_000_003 + i) & 0xFFFFFFFF)
+        shift = rng.uniform(-max_shift_s, max_shift_s)
+        scale = 1.0 + (rng.uniform(-max_scale, max_scale)
+                       if max_scale > 0.0 else 0.0)
+        start = rule.after_s if rule.after_s is not None else 0.0
+        new_start = max(round(start + shift, 3), 0.0)
+        after_s = new_start if rule.after_s is not None else None
+        until_s = rule.until_s
+        if until_s is not None:
+            duration = max((until_s - start) * scale, 1.0)
+            until_s = round(new_start + duration, 3)
+        out.append(_dc_replace(rule, after_s=after_s, until_s=until_s))
+    return out

@@ -18,9 +18,11 @@ at module load without cycles):
 """
 
 from .decision import (
+    CLAMP_DEGRADED_FREEZE,
     CLAMP_REPLICA_STEP,
     CLAMP_STABILIZATION,
     CLAMP_STALE_VETO,
+    CLAMP_TTFT_BACKPRESSURE,
     GOODPUT_BUCKETS,
     GOODPUT_DEGRADED,
     GOODPUT_LAGGED,
@@ -63,9 +65,11 @@ from .trace import (
 )
 
 __all__ = [
+    "CLAMP_DEGRADED_FREEZE",
     "CLAMP_REPLICA_STEP",
     "CLAMP_STABILIZATION",
     "CLAMP_STALE_VETO",
+    "CLAMP_TTFT_BACKPRESSURE",
     "Clamp",
     "DecisionBuilder",
     "DecisionInputs",

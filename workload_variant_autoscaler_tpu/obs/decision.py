@@ -51,6 +51,8 @@ LIMITED = "limited"        # optimize failed: conditions only, no new alloc
 CLAMP_STABILIZATION = "stabilization-window"
 CLAMP_REPLICA_STEP = "replica-step"
 CLAMP_STALE_VETO = "stale-scale-to-zero-veto"
+CLAMP_TTFT_BACKPRESSURE = "ttft-backpressure"
+CLAMP_DEGRADED_FREEZE = "degraded-scaleup-freeze"
 
 
 @dataclass(frozen=True)

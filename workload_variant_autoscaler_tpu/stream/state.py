@@ -67,6 +67,14 @@ class StreamState:
         self.last_operator_cm: dict[str, str] = {}
         self.shared_ns_warned: tuple[str, ...] = ()
         self.last_capacity: dict[str, int] = {}
+        # full_name -> (floor, rpm_at_boost, boost_cycle, solver_prev):
+        # the standing TTFT-backpressure floor
+        # (reconciler._ttft_backpressure) — the minimum published count
+        # held while the demand that provoked an observed-latency
+        # violation persists; solver_prev is the pre-floor published
+        # count the stabilization/step guards baseline on, so a released
+        # floor snaps back to the solver's answer in one cycle
+        self.backpressure: dict[str, tuple[int, float, int, int]] = {}
         # -- cycle-scoped state, rebuilt at each reconcile() entry ------
         self.cycle_builders: dict = {}
         self.deadline = None                  # utils.Deadline
