@@ -35,6 +35,14 @@ bench-loop-churn: ## Steady-state incremental-solve bench: 512 variants, 1% chur
 bench-goodput: ## Fleet goodput digital twin: all six scenarios, seeded + sim-time (regenerates BENCH_goodput_r08.json byte-identically)
 	$(PY) bench_goodput.py
 
+.PHONY: goodput-live-smoke
+goodput-live-smoke: ## Abbreviated flash-crowd run with the online GoodputMeter attached (<10s): asserts twin==online per-tick ledger equality
+	$(PY) bench_goodput_live.py --smoke
+
+.PHONY: bench-goodput-live
+bench-goodput-live: ## Twin-vs-online GoodputMeter equivalence across the full scenario library (writes nothing; exits non-zero on any ledger drift)
+	$(PY) bench_goodput_live.py
+
 .PHONY: bench-profile
 bench-profile: ## Cycle wall-clock attribution: 512-variant load-shift cycle, sampler on, determinism double-run (writes BENCH_profile_r09.json)
 	$(PY) bench_profile.py
@@ -95,7 +103,7 @@ bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO 
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo
 
-LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_profile.py bench_fuse.py bench_shard.py bench_stream.py bench_streamchaos.py bench_adversary.py __graft_entry__.py
+LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_goodput_live.py bench_profile.py bench_fuse.py bench_shard.py bench_stream.py bench_streamchaos.py bench_adversary.py __graft_entry__.py
 
 .PHONY: lint
 lint: ## Static analysis gate: ruff+mypy when installed, wvalint always (rule catalog: docs/developer-guide/wvalint.md)

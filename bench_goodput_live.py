@@ -1,0 +1,117 @@
+"""bench_goodput_live: twin-vs-online GoodputMeter equivalence gate.
+
+The meter extraction (obs/goodput.py) promises that the digital twin
+and the RUNNING controller score a fleet with the exact same
+arithmetic. This driver proves it end to end: it runs scenarios from
+`emulator.scenarios.SCENARIOS` through `emulator.twin.run_scenario`
+with a SECOND GoodputMeter attached to the embedded Reconciler's live
+feed path (`attach_goodput_meter(self_tick=False)` — the same
+`_feed_goodput` flush/observe_cycle wiring a WVA_GOODPUT_LIVE
+controller runs every cycle), then asserts the two meters produced
+
+- identical per-tick ledger rings (every tick's cost / demand /
+  SLO-attained demand / bucket split), and
+- identical per-variant accounting (cost, demand, SLO demand, and the
+  full badput bucket decomposition).
+
+Any drift between the twin's meter and the online feed path — a
+reordered float op, a missed observe_cycle field, a window mismatch —
+fails the run with the first differing tick.
+
+`--smoke` runs one abbreviated flash-crowd pass (<10 s; the tier-1
+gate `make goodput-live-smoke` and tests/test_perf_claims.py's
+subprocess gate). The full run covers every scenario and prints a
+per-scenario equivalence line. Knobs: WVA_GOODPUT_SCENARIOS=<comma
+list> restricts the full run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("LOG_LEVEL", "error")
+
+from workload_variant_autoscaler_tpu.emulator.scenarios import (  # noqa: E402
+    SCENARIOS,
+    abbreviated,
+)
+from workload_variant_autoscaler_tpu.emulator.twin import (  # noqa: E402
+    run_scenario,
+)
+from workload_variant_autoscaler_tpu.obs.goodput import (  # noqa: E402
+    GoodputMeter,
+)
+
+SMOKE_DURATION_S = 300.0
+
+
+def assert_equivalent(twin: GoodputMeter, online: GoodputMeter) -> int:
+    """Hard-compare the two meters; returns the tick count on success,
+    raises AssertionError naming the first divergence otherwise."""
+    twin_ticks = twin.ledger()
+    online_ticks = online.ledger()
+    assert len(twin_ticks) == len(online_ticks), (
+        f"tick ring lengths differ: twin {len(twin_ticks)} "
+        f"vs online {len(online_ticks)}")
+    for i, (a, b) in enumerate(zip(twin_ticks, online_ticks)):
+        assert a == b, f"tick {i} (t={a['t']}) differs: {a} vs {b}"
+    twin_keys = sorted(led.key for led in twin.variants())
+    online_keys = sorted(led.key for led in online.variants())
+    assert twin_keys == online_keys, (
+        f"variant sets differ: {twin_keys} vs {online_keys}")
+    for led in twin.variants():
+        other = online.variant(led.key)
+        mine = (led.cost_s, led.demand_s, led.slo_demand_s, led.buckets)
+        theirs = (other.cost_s, other.demand_s, other.slo_demand_s,
+                  other.buckets)
+        assert mine == theirs, (
+            f"variant {led.key} ledgers differ: {mine} vs {theirs}")
+    return len(twin_ticks)
+
+
+def run_one(name: str, scenario) -> dict:
+    online = GoodputMeter(window_s=scenario.duration_s)
+    t0 = time.perf_counter()
+    result = run_scenario(scenario, online_meter=online)
+    wall_s = time.perf_counter() - t0
+    ticks = assert_equivalent(result.meter, online)
+    summary = online.summary()
+    return {
+        "scenario": name,
+        "ticks": ticks,
+        "variants": summary["variants"],
+        "goodput_fraction": round(summary["goodput_fraction"], 4),
+        "wall_s": round(wall_s, 1),
+    }
+
+
+def main() -> int:
+    if "--smoke" in sys.argv[1:]:
+        scenario = abbreviated(SCENARIOS["flash-crowd"], SMOKE_DURATION_S)
+        line = run_one("flash-crowd", scenario)
+        print(json.dumps(dict(line, bench="goodput-live-smoke",
+                              equivalent=True,
+                              duration_s=SMOKE_DURATION_S)))
+        return 0
+    wanted = [s for s in
+              (os.environ.get("WVA_GOODPUT_SCENARIOS") or "").split(",")
+              if s.strip()]
+    names = wanted or list(SCENARIOS)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        raise SystemExit(f"unknown scenario(s) {unknown}; "
+                         f"known: {sorted(SCENARIOS)}")
+    lines = [run_one(name, SCENARIOS[name]) for name in names]
+    for line in lines:
+        print(f"twin==online OK: {line}", file=sys.stderr)
+    print(json.dumps({"bench": "goodput-live", "equivalent": True,
+                      "scenarios": lines}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

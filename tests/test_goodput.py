@@ -1,0 +1,584 @@
+"""The shared GoodputMeter (obs/goodput.py) and its live wiring.
+
+The meter was extracted from the digital twin so the RUNNING controller
+scores itself with the same arithmetic. This suite pins
+
+- the metering core: warmup, the useful/over split, the badput
+  attribution branches (under / lagged / degradation-held), the
+  stale-zero guardrail flag, flush/annotate, the rolling window;
+- the rung-int mirror against controller.degradation.DegradationState
+  (obs/ is stdlib-only, so the ladder is mirrored, not imported);
+- the live feed path end to end on the in-memory cluster: the
+  WVA_GOODPUT_LIVE / WVA_GOODPUT_WINDOW_S knobs, per-cycle ticking,
+  the inferno_goodput_* exports, and goodput annotations landing on
+  REAL DecisionRecords (satellite: replacement-not-mutation semantics
+  and replay() surviving annotation);
+- twin-vs-online equivalence on an abbreviated scenario (the full
+  gate is `make goodput-live-smoke`, run here as a subprocess);
+- the /debug/goodput route, the `controller goodput` CLI, and the
+  <5 ms per-512-variant-cycle overhead budget.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+from test_scenarios import PROFILE_8B_V5E1, make_fleet_cluster, set_load
+
+from workload_variant_autoscaler_tpu.controller.degradation import (
+    DegradationState,
+)
+from workload_variant_autoscaler_tpu.obs import (
+    GOODPUT_DEGRADED,
+    GOODPUT_LAGGED,
+    GOODPUT_OVER,
+    GOODPUT_UNDER,
+    GOODPUT_USEFUL,
+    DecisionBuilder,
+    DecisionLog,
+    GoodputMeter,
+    TickSample,
+    debug_middleware,
+)
+from workload_variant_autoscaler_tpu.obs import goodput as goodput_mod
+from workload_variant_autoscaler_tpu.obs.goodput import (
+    RUNG_HEALTHY,
+    RUNG_LABELS,
+    RUNG_STALE_CACHE,
+    UNPUBLISHED,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NS = "default"
+VARIANT = "chat-8b"
+KEY = f"{VARIANT}:{NS}"
+
+
+def one_variant_meter(price_per_hour=3600.0, slo_ttft_ms=500.0,
+                      window_s=900.0) -> GoodputMeter:
+    """A meter with one registered variant priced so one replica bills
+    exactly 1 $/s — bucket costs read directly as replica-seconds."""
+    meter = GoodputMeter(window_s=window_s)
+    meter.register(VARIANT, NS, price_per_hour=price_per_hour,
+                   slo_ttft_ms=slo_ttft_ms, model="llama-8b")
+    return meter
+
+
+def publish(meter, desired, envelope_rps, rung=RUNG_HEALTHY,
+            cycle_rung=RUNG_HEALTHY):
+    meter.observe_cycle(published={KEY: desired},
+                        envelopes={KEY: envelope_rps},
+                        rungs={KEY: rung}, cycle_rung=cycle_rung)
+
+
+class TestMeterCore:
+    def test_warmup_bills_nothing(self):
+        meter = one_variant_meter()
+        meter.tick(1.0, 1.0, {KEY: TickSample(demand_rps=10.0, replicas=3)})
+        led = meter.variant(KEY)
+        assert led.cost_s == 0.0 and led.buckets == {}
+        assert meter.summary()["goodput_fraction"] == 0.0
+
+    def test_useful_and_over_split_on_healthy_rung(self):
+        meter = one_variant_meter()
+        publish(meter, desired=3, envelope_rps=30.0)     # r* = 10 rps
+        meter.tick(1.0, 1.0, {KEY: TickSample(demand_rps=10.0, replicas=3)})
+        led = meter.variant(KEY)
+        # 1 replica needed, 3 provisioned: 1 useful + 2 over
+        assert led.buckets[GOODPUT_USEFUL] == pytest.approx(1.0)
+        assert led.buckets[GOODPUT_OVER] == pytest.approx(2.0)
+        assert led.slo_demand_s == pytest.approx(10.0)
+
+    def test_surplus_on_degraded_rung_is_degradation_held(self):
+        meter = one_variant_meter()
+        publish(meter, desired=3, envelope_rps=30.0, rung=RUNG_STALE_CACHE)
+        meter.tick(1.0, 1.0, {KEY: TickSample(demand_rps=10.0, replicas=3)})
+        led = meter.variant(KEY)
+        assert led.buckets[GOODPUT_DEGRADED] == pytest.approx(2.0)
+        assert GOODPUT_OVER not in led.buckets
+
+    def test_cycle_rung_floors_the_variant_rung(self):
+        meter = one_variant_meter()
+        publish(meter, desired=3, envelope_rps=30.0, rung=RUNG_HEALTHY,
+                cycle_rung=RUNG_STALE_CACHE)
+        meter.tick(1.0, 1.0, {KEY: TickSample(demand_rps=10.0, replicas=3)})
+        assert GOODPUT_DEGRADED in meter.variant(KEY).buckets
+
+    def test_undersized_decision_is_under_provisioned(self):
+        meter = one_variant_meter()
+        publish(meter, desired=1, envelope_rps=10.0)     # r* = 10 rps
+        meter.tick(1.0, 1.0, {KEY: TickSample(demand_rps=25.0, replicas=1)})
+        led = meter.variant(KEY)
+        # the decision itself was too small (n_req=3 > desired=1): the
+        # whole provisioned cost is mis-sizing, not actuation lag
+        assert led.buckets == {GOODPUT_UNDER: pytest.approx(1.0)}
+        assert led.slo_demand_s == 0.0
+
+    def test_right_decision_still_starting_is_actuation_lagged(self):
+        meter = one_variant_meter()
+        publish(meter, desired=3, envelope_rps=30.0)     # r* = 10 rps
+        meter.tick(1.0, 1.0, {KEY: TickSample(demand_rps=25.0, replicas=1)})
+        assert meter.variant(KEY).buckets == {
+            GOODPUT_LAGGED: pytest.approx(1.0)}
+
+    def test_withdrawn_pool_turns_lag_into_under(self):
+        meter = one_variant_meter()
+        publish(meter, desired=3, envelope_rps=30.0)
+        meter.tick(1.0, 1.0, {KEY: TickSample(
+            demand_rps=25.0, replicas=1, pool_limit=2)})
+        assert meter.variant(KEY).buckets == {
+            GOODPUT_UNDER: pytest.approx(1.0)}
+
+    def test_ttft_breach_overrides_replica_coverage(self):
+        meter = one_variant_meter(slo_ttft_ms=500.0)
+        publish(meter, desired=3, envelope_rps=30.0)
+        meter.tick(1.0, 1.0, {KEY: TickSample(
+            demand_rps=10.0, replicas=3, ttft_ms=(900.0, 800.0))})
+        led = meter.variant(KEY)
+        # the envelope said healthy but measured TTFT broke SLO: the
+        # empirical judge wins, and on a healthy rung with enough
+        # replicas that reads as under-provisioned capacity
+        assert led.buckets == {GOODPUT_UNDER: pytest.approx(3.0)}
+        assert led.slo_demand_s == 0.0
+
+    def test_zero_publish_on_stale_rung_sets_guardrail_flag(self):
+        meter = one_variant_meter()
+        publish(meter, desired=2, envelope_rps=20.0)
+        assert meter.variant(KEY).min_desired_after_publish == 2
+        publish(meter, desired=0, envelope_rps=0.0, rung=RUNG_STALE_CACHE)
+        led = meter.variant(KEY)
+        assert led.scaled_to_zero_on_stale is True
+        assert led.min_desired_after_publish == 0
+
+    def test_zero_publish_on_healthy_rung_is_not_a_flap(self):
+        meter = one_variant_meter()
+        publish(meter, desired=2, envelope_rps=20.0)
+        publish(meter, desired=0, envelope_rps=0.0)
+        assert meter.variant(KEY).scaled_to_zero_on_stale is False
+
+    def test_unpublished_variant_keeps_sentinel(self):
+        meter = one_variant_meter()
+        publish(meter, desired=0, envelope_rps=0.0)
+        assert meter.variant(KEY).min_desired_after_publish == UNPUBLISHED
+
+    def test_flush_drains_interval_and_annotates_dominant_bucket(self):
+        meter = one_variant_meter()
+        publish(meter, desired=3, envelope_rps=30.0)
+        meter.tick(1.0, 1.0, {KEY: TickSample(demand_rps=10.0, replicas=3)})
+        calls = []
+        totals = meter.flush(7, annotate=lambda *a, **kw: calls.append(
+            (a, kw)) or True)
+        assert totals[GOODPUT_USEFUL] == pytest.approx(1.0)
+        assert totals[GOODPUT_OVER] == pytest.approx(2.0)
+        (args, kwargs), = calls
+        assert args == (VARIANT, NS, 7, GOODPUT_OVER)
+        assert "interval cost" in kwargs["detail"]
+        # drained: a second flush has nothing left
+        assert meter.flush(8, annotate=lambda *a, **kw: calls.append(
+            (a, kw))) == {}
+        assert len(calls) == 1
+        # lifetime buckets survive the drain
+        assert meter.variant(KEY).buckets[GOODPUT_USEFUL] > 0.0
+
+    def test_flush_cycle_zero_drains_without_annotating(self):
+        meter = one_variant_meter()
+        publish(meter, desired=1, envelope_rps=10.0)
+        meter.tick(1.0, 1.0, {KEY: TickSample(demand_rps=5.0, replicas=1)})
+        calls = []
+        totals = meter.flush(0, annotate=lambda *a, **kw: calls.append(a))
+        assert totals and calls == []
+
+    def test_rolling_window_prunes_ticks(self):
+        meter = one_variant_meter(window_s=10.0)
+        publish(meter, desired=1, envelope_rps=10.0)
+        for t in range(30):
+            meter.tick(float(t), 1.0,
+                       {KEY: TickSample(demand_rps=5.0, replicas=1)})
+        ledger = meter.ledger()
+        assert len(ledger) == 11          # ticks at t in [19, 29]
+        assert ledger[0]["t"] == 19.0
+        # re-clipping narrows further without touching the ring
+        assert len(meter.ledger(window_s=3.0)) == 4
+        assert len(meter.ledger()) == 11
+
+    def test_summary_partitions_cost_exactly(self):
+        meter = one_variant_meter()
+        publish(meter, desired=3, envelope_rps=30.0)
+        meter.tick(1.0, 1.0, {KEY: TickSample(demand_rps=10.0, replicas=3)})
+        meter.tick(2.0, 1.0, {KEY: TickSample(demand_rps=35.0, replicas=3)})
+        s = meter.summary()
+        assert s["cost_dollar_seconds"] == pytest.approx(6.0)
+        assert s["goodput_fraction"] + sum(s["badput"].values()) == \
+            pytest.approx(1.0)
+        assert 0.0 < s["slo_attainment"] < 1.0
+
+    def test_attainment_by_model_aggregates_lifetime_demand(self):
+        meter = one_variant_meter()
+        meter.register("chat-8b-b", NS, price_per_hour=3600.0,
+                       slo_ttft_ms=500.0, model="llama-8b")
+        for key, desired in ((KEY, 3), (f"chat-8b-b:{NS}", 1)):
+            meter.observe_cycle(published={key: desired},
+                                envelopes={key: desired * 10.0},
+                                rungs={})
+        meter.tick(1.0, 1.0, {
+            KEY: TickSample(demand_rps=10.0, replicas=3),
+            f"chat-8b-b:{NS}": TickSample(demand_rps=25.0, replicas=1),
+        })
+        att = meter.attainment_by_model()
+        # both variants share the model: one aggregate ratio
+        assert set(att) == {("llama-8b", NS)}
+        assert att[("llama-8b", NS)] == pytest.approx(10.0 / 35.0)
+
+    def test_register_is_idempotent_metadata_refresh(self):
+        meter = one_variant_meter()
+        publish(meter, desired=1, envelope_rps=10.0)
+        meter.tick(1.0, 1.0, {KEY: TickSample(demand_rps=5.0, replicas=1)})
+        before = meter.variant(KEY).cost_s
+        led = meter.register(VARIANT, NS, price_per_hour=7200.0,
+                             slo_ttft_ms=250.0)
+        assert led is meter.variant(KEY)
+        assert led.cost_s == before          # accounting never resets
+        assert led.price_per_hour == 7200.0
+
+
+def test_rung_mirror_matches_degradation_ladder():
+    """obs/ is stdlib-only, so the rung ints are mirrored, not imported:
+    this is the pin that keeps the mirror from rotting."""
+    assert RUNG_LABELS == {int(s): s.label for s in DegradationState}
+    labels = set(RUNG_LABELS.values())
+    assert set(goodput_mod.DEGRADED_RUNGS) < labels
+    assert set(goodput_mod.STALE_ZERO_RUNGS) < labels
+
+
+def test_twin_reexports_the_shared_rung_policy():
+    from workload_variant_autoscaler_tpu.emulator import twin
+
+    assert twin.DEGRADED_RUNGS is goodput_mod.DEGRADED_RUNGS
+    assert twin.STALE_ZERO_RUNGS is goodput_mod.STALE_ZERO_RUNGS
+
+
+# -- the live feed path on the in-memory cluster ----------------------------
+
+
+def live_cluster(window_s=900.0):
+    """One-variant fleet cluster with an attached meter, a controllable
+    reconcile clock (30 s cycles), and an emulated HPA that actuates
+    each published count before the next cycle — so observed replicas
+    track decisions and useful cost accrues."""
+    from workload_variant_autoscaler_tpu.controller import Deployment
+
+    kube, prom, emitter, rec = make_fleet_cluster([
+        (VARIANT, "llama-8b", "v5e-1", "premium", [PROFILE_8B_V5E1], 1),
+    ])
+    clock = [10_000.0]
+    rec.now = lambda: clock[0]
+    meter = rec.attach_goodput_meter(GoodputMeter(window_s=window_s))
+    set_load(prom, "llama-8b", 40.0, 128.0, 128.0)
+
+    def cycle(n=1, advance_s=30.0):
+        for _ in range(n):
+            clock[0] += advance_s
+            rec.reconcile()
+            va = kube.get_variant_autoscaling(VARIANT, NS)
+            desired = va.status.desired_optimized_alloc.num_replicas
+            kube.put_deployment(Deployment(name=VARIANT, namespace=NS,
+                                           spec_replicas=desired,
+                                           status_replicas=desired))
+
+    return kube, prom, emitter, rec, meter, cycle
+
+
+class TestLiveFeedPath:
+    def test_env_knobs_attach_and_size_the_meter(self, monkeypatch):
+        monkeypatch.setenv("WVA_GOODPUT_LIVE", "1")
+        monkeypatch.setenv("WVA_GOODPUT_WINDOW_S", "120")
+        _kube, _prom, _emitter, rec = make_fleet_cluster([
+            (VARIANT, "llama-8b", "v5e-1", "premium",
+             [PROFILE_8B_V5E1], 1),
+        ])
+        assert rec.goodput_meter is not None
+        assert rec.goodput_meter.window_s == 120.0
+
+    def test_no_meter_without_the_knob(self):
+        _kube, _prom, _emitter, rec = make_fleet_cluster([
+            (VARIANT, "llama-8b", "v5e-1", "premium",
+             [PROFILE_8B_V5E1], 1),
+        ])
+        assert rec.goodput_meter is None
+        rec.reconcile()                      # no meter: no feed, no crash
+
+    def test_cycles_register_tick_and_export(self):
+        _kube, _prom, emitter, _rec, meter, cycle = live_cluster()
+        cycle(3)
+        led = meter.variant(KEY)
+        assert led.price_per_hour == 20.0    # v5e-1 cost from the CM
+        assert led.slo_ttft_ms == 500.0      # premium class SLO
+        assert led.published_once and led.r_star > 0.0
+        # cycle 1 published, cycles 2..3 billed the elapsed intervals
+        assert len(meter.ledger()) == 2
+        assert led.cost_s > 0.0
+        s = meter.summary()
+        assert s["goodput_fraction"] > 0.0
+        assert emitter.value("inferno_goodput_fraction") == \
+            pytest.approx(s["goodput_fraction"])
+        assert emitter.value("inferno_badput_cost_seconds_total",
+                             bucket=GOODPUT_USEFUL) == \
+            pytest.approx(led.buckets[GOODPUT_USEFUL])
+        assert emitter.value("inferno_slo_attainment_ratio",
+                             model_name="llama-8b", namespace=NS) \
+            is not None
+
+    def test_live_decision_records_gain_goodput_annotations(self):
+        _kube, _prom, _emitter, rec, _meter, cycle = live_cluster()
+        cycle(3)
+        # the interval between cycles 1 and 2 was governed by cycle 1's
+        # publication; its REAL record now explains where the cost went
+        annotated = [r for r in (rec.decisions.latest(VARIANT, NS),)
+                     if r.goodput_bucket]
+        records = rec.decisions.snapshot(variant=VARIANT, limit=10)
+        buckets = {r["cycle"]: r["goodput_bucket"] for r in records}
+        assert buckets[1] != "" and buckets[2] != ""
+        assert buckets[3] == ""              # interval still open
+        assert annotated or buckets         # explain shows goodput: lines
+
+    def test_replay_reproduces_published_count_from_annotated_record(self):
+        _kube, _prom, _emitter, rec, _meter, cycle = live_cluster()
+        cycle(3)
+        annotated = [r for r in (
+            rec.decisions._records and list(rec.decisions._records) or [])
+            if r.goodput_bucket]
+        assert annotated, "no annotated live record"
+        for rec_ in annotated:
+            assert rec_.replay() == rec_.published_replicas
+
+
+class TestAnnotateGoodputSemantics:
+    """Satellite: annotate_goodput under the scoped-stream shape — the
+    same variant republished within the ring at different cycles."""
+
+    def _log_with_republished_variant(self):
+        log = DecisionLog(capacity=8)
+        for cyc in (1, 2):
+            b = DecisionBuilder(variant=VARIANT, namespace=NS)
+            b.proposed_replicas = b.published_replicas = cyc + 1
+            log.record(b.freeze(trace_id=f"t{cyc}", cycle=cyc, ts=float(cyc)))
+        return log
+
+    def test_replacement_not_mutation_targets_exact_cycle(self):
+        log = self._log_with_republished_variant()
+        before = log.latest(VARIANT, NS)     # the cycle-2 record
+        assert log.annotate_goodput(VARIANT, NS, 1, GOODPUT_OVER,
+                                    detail="interval 1") is True
+        records = {r.cycle: r for r in log._records}
+        assert records[1].goodput_bucket == GOODPUT_OVER
+        assert records[2].goodput_bucket == ""
+        # the newer record object is untouched (immutable), and the
+        # cycle-1 record was REPLACED, not mutated in place
+        assert log.latest(VARIANT, NS) is before
+        assert records[1].goodput_detail == "interval 1"
+
+    def test_annotating_both_cycles_keeps_distinct_attributions(self):
+        log = self._log_with_republished_variant()
+        assert log.annotate_goodput(VARIANT, NS, 1, GOODPUT_OVER)
+        assert log.annotate_goodput(VARIANT, NS, 2, GOODPUT_UNDER)
+        records = {r.cycle: r for r in log._records}
+        assert records[1].goodput_bucket == GOODPUT_OVER
+        assert records[2].goodput_bucket == GOODPUT_UNDER
+
+    def test_rotated_cycle_returns_false(self):
+        log = DecisionLog(capacity=1)
+        for cyc in (1, 2):
+            b = DecisionBuilder(variant=VARIANT, namespace=NS)
+            log.record(b.freeze(trace_id="t", cycle=cyc, ts=float(cyc)))
+        assert log.annotate_goodput(VARIANT, NS, 1, GOODPUT_OVER) is False
+
+    def test_unknown_bucket_rejected(self):
+        log = self._log_with_republished_variant()
+        with pytest.raises(ValueError):
+            log.annotate_goodput(VARIANT, NS, 1, "misfiled")
+
+    def test_replay_survives_annotation(self):
+        log = self._log_with_republished_variant()
+        log.annotate_goodput(VARIANT, NS, 2, GOODPUT_USEFUL)
+        replaced = {r.cycle: r for r in log._records}[2]
+        assert replaced.replay() == replaced.published_replicas == 3
+
+
+# -- twin-vs-online equivalence + the committed smoke gate ------------------
+
+
+def test_twin_and_online_meters_produce_identical_ledgers():
+    from workload_variant_autoscaler_tpu.emulator.scenarios import (
+        SCENARIOS,
+        abbreviated,
+    )
+    from workload_variant_autoscaler_tpu.emulator.twin import run_scenario
+
+    scenario = abbreviated(SCENARIOS["flash-crowd"], 300.0)
+    online = GoodputMeter(window_s=scenario.duration_s)
+    result = run_scenario(scenario, online_meter=online)
+    twin = result.meter
+    assert twin.ledger() == online.ledger()
+    assert sorted(led.key for led in twin.variants()) == \
+        sorted(led.key for led in online.variants())
+    for led in twin.variants():
+        other = online.variant(led.key)
+        assert (led.cost_s, led.demand_s, led.slo_demand_s) == \
+            (other.cost_s, other.demand_s, other.slo_demand_s)
+        assert led.buckets == other.buckets
+
+
+def test_goodput_live_smoke_bench_passes():
+    """`make goodput-live-smoke` in-suite: the abbreviated flash-crowd
+    run with the online meter attached (bench_goodput_live.py --smoke)
+    asserts twin==online per-tick ledger equality end to end. Run as a
+    subprocess, same shape as the profile/shard smokes."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_goodput_live.py"),
+         "--smoke"],
+        capture_output=True, text=True, cwd=REPO, timeout=240)
+    assert r.returncode == 0, \
+        f"goodput live smoke failed:\n{r.stdout}\n{r.stderr}"
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["bench"] == "goodput-live-smoke"
+    assert line["equivalent"] is True
+    assert line["ticks"] > 0
+
+
+# -- overhead budget --------------------------------------------------------
+
+
+def test_meter_overhead_under_5ms_per_512_variant_cycle():
+    """The acceptance budget: tick + flush + observe_cycle for a full
+    512-variant fleet stays under 5 ms per reconcile cycle."""
+    meter = GoodputMeter(window_s=900.0)
+    keys = []
+    for i in range(512):
+        name = f"v{i:03d}"
+        meter.register(name, NS, price_per_hour=20.0, slo_ttft_ms=500.0,
+                       model=f"m{i % 16}")
+        keys.append(f"{name}:{NS}")
+    published = {k: 2 for k in keys}
+    envelopes = {k: 40.0 for k in keys}
+    meter.observe_cycle(published=published, envelopes=envelopes, rungs={})
+    samples = {k: TickSample(demand_rps=30.0, replicas=2, ttft_ms=(80.0,))
+               for k in keys}
+
+    cycles = 20
+    start = time.perf_counter()
+    for c in range(1, cycles + 1):
+        meter.tick(float(c) * 30.0, 30.0, samples)
+        meter.flush(c)
+        meter.observe_cycle(published=published, envelopes=envelopes,
+                            rungs={})
+    per_cycle = (time.perf_counter() - start) / cycles
+    assert per_cycle < 0.005, \
+        f"meter overhead {per_cycle * 1e3:.2f} ms/cycle exceeds the 5 ms budget"
+
+
+# -- the read surfaces: /debug/goodput + the CLI ----------------------------
+
+
+class TestDebugRouteAndCli:
+    def test_debug_goodput_route_serves_inside_metrics_server(self):
+        from urllib.request import urlopen
+
+        _kube, _prom, emitter, rec, meter, cycle = live_cluster()
+        cycle(4)
+        server, _thread, _rel = emitter.serve(
+            0, addr="127.0.0.1",
+            debug_middleware=debug_middleware(rec.tracer, rec.decisions,
+                                              rec.profiler,
+                                              rec.goodput_meter))
+        try:
+            port = server.server_address[1]
+            base = f"http://127.0.0.1:{port}"
+            body = json.load(urlopen(f"{base}/debug/goodput"))
+            assert body["summary"]["variants"] == 1
+            assert body["summary"]["goodput_fraction"] > 0.0
+            assert len(body["ticks"]) == 3
+            # ?window=N re-clips to the trailing N seconds
+            clipped = json.load(urlopen(f"{base}/debug/goodput?window=30"))
+            assert len(clipped["ticks"]) == 2
+            assert clipped["summary"]["window_s"] == 30.0
+        finally:
+            server.shutdown()
+
+    def test_debug_goodput_404_without_attached_meter(self):
+        from urllib.error import HTTPError
+        from urllib.request import urlopen
+
+        _kube, _prom, emitter, rec = make_fleet_cluster([
+            (VARIANT, "llama-8b", "v5e-1", "premium",
+             [PROFILE_8B_V5E1], 1),
+        ])
+        server, _thread, _rel = emitter.serve(
+            0, addr="127.0.0.1",
+            debug_middleware=debug_middleware(rec.tracer, rec.decisions,
+                                              rec.profiler,
+                                              rec.goodput_meter))
+        try:
+            port = server.server_address[1]
+            with pytest.raises(HTTPError) as exc:
+                urlopen(f"http://127.0.0.1:{port}/debug/goodput")
+            assert exc.value.code == 404
+        finally:
+            server.shutdown()
+
+    def _dump(self, tmp_path):
+        _kube, _prom, _emitter, _rec, meter, cycle = live_cluster()
+        cycle(4)
+        path = tmp_path / "goodput.json"
+        path.write_text(json.dumps({"summary": meter.summary(),
+                                    "ticks": meter.ledger()},
+                                   default=str))
+        return path
+
+    def test_goodput_cli_renders_ledger(self, tmp_path, capsys):
+        from workload_variant_autoscaler_tpu.controller.__main__ import (
+            goodput_main,
+        )
+
+        assert goodput_main(["--file", str(self._dump(tmp_path))]) == 0
+        out = capsys.readouterr().out
+        assert "goodput ledger" in out
+        assert "goodput fraction:" in out
+        assert "slo attainment:" in out
+
+    def test_goodput_cli_json_roundtrip(self, tmp_path, capsys):
+        from workload_variant_autoscaler_tpu.controller.__main__ import (
+            goodput_main,
+        )
+
+        assert goodput_main(["--file", str(self._dump(tmp_path)),
+                             "--json"]) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["summary"]["variants"] == 1
+        assert parsed["ticks"]
+
+    def test_goodput_cli_explains_missing_meter(self, capsys):
+        """A controller without WVA_GOODPUT_LIVE 404s the route; the CLI
+        turns that into exit 1 with a hint, not a traceback."""
+        from urllib.request import urlopen  # noqa: F401 — exercised below
+
+        from workload_variant_autoscaler_tpu.controller.__main__ import (
+            goodput_main,
+        )
+        from workload_variant_autoscaler_tpu.metrics import MetricsEmitter
+
+        emitter = MetricsEmitter()
+        server, _thread, _rel = emitter.serve(
+            0, addr="127.0.0.1",
+            debug_middleware=debug_middleware(None, None))
+        try:
+            port = server.server_address[1]
+            rc = goodput_main(["--url", f"http://127.0.0.1:{port}"])
+            assert rc == 1
+            assert "WVA_GOODPUT_LIVE" in capsys.readouterr().err
+        finally:
+            server.shutdown()

@@ -142,8 +142,9 @@ class TestCallArity:
 @pytest.mark.parametrize("paths", [
     ["workload_variant_autoscaler_tpu", "tools", "tests", "bench.py",
      "bench_loop.py", "bench_collect.py", "bench_goodput.py",
-     "bench_profile.py", "bench_fuse.py", "bench_stream.py",
-     "bench_shard.py", "bench_adversary.py", "__graft_entry__.py"],
+     "bench_goodput_live.py", "bench_profile.py", "bench_fuse.py",
+     "bench_stream.py", "bench_shard.py", "bench_adversary.py",
+     "__graft_entry__.py"],
 ])
 def test_package_lints_clean(paths):
     """The gate itself: the shipped source must lint clean — every rule
@@ -1117,7 +1118,8 @@ class TestKnobParity:
         # drivers read WVA_* knobs too (WVA_BENCH_*, WVA_GOODPUT_*)
         for sub in ("workload_variant_autoscaler_tpu", "tools", "tests",
                     "bench.py", "bench_loop.py", "bench_collect.py",
-                    "bench_goodput.py", "bench_profile.py",
+                    "bench_goodput.py", "bench_goodput_live.py",
+                    "bench_profile.py",
                     "bench_shard.py", "bench_adversary.py"):
             for fp in wvalint.iter_py_files([os.path.join(REPO, sub)]):
                 files.append(fp)
@@ -1325,6 +1327,78 @@ class TestStageCoverage:
         with open(fp, encoding="utf-8") as f:
             trees = {fp: ast_mod.parse(f.read(), fp)}
         assert wvalint._stage_coverage_findings([fp], trees) == []
+
+
+# -- debug-route auth parity (WVL307) ----------------------------------------
+
+GATED_ROUTES = frozenset({"/debug/traces", "/debug/decisions"})
+DEBUG_PY = os.path.join("workload_variant_autoscaler_tpu", "obs", "debug.py")
+
+
+def lint_routes(source: str, path: str = DEBUG_PY):
+    return [f.code for f in wvalint.lint_source(
+        path, source, gated_routes=GATED_ROUTES)]
+
+
+class TestDebugRouteGating:
+    """WVL307 — every /debug/<route> string mounted in obs/debug.py
+    must appear in the auth-gate suite
+    (test_metrics_auth.py::TestDebugRoutesAuthGated), so a new
+    flight-recorder route cannot ship without 401/403 coverage."""
+
+    def test_ungated_route_fires(self):
+        assert "WVL307" in lint_routes(
+            "ROUTES = ('/debug/traces', '/debug/leak')\n")
+
+    def test_gated_routes_pass(self):
+        assert "WVL307" not in lint_routes(
+            "ROUTES = ('/debug/traces', '/debug/decisions')\n")
+
+    def test_non_debug_strings_ignored(self):
+        assert "WVL307" not in lint_routes(
+            "x = '/metrics'\ny = 'debug/not-a-route'\n")
+
+    def test_only_the_mount_module_checked(self):
+        # consumers (CLIs, tests, docs tooling) may name any route
+        assert "WVL307" not in lint_routes(
+            "ROUTES = ('/debug/leak',)\n", path="tools/zz.py")
+
+    def test_noqa_suppresses_and_is_not_stale(self):
+        src = ("# a deliberately unlisted internal route\n"
+               "X = '/debug/leak'  # noq" "a: WVL307\n")
+        assert lint_routes(src) == []
+
+    def test_rule_inactive_without_vocabulary(self):
+        # partial scans (no auth-test file in scope) must not flag
+        # every mounted route
+        src = "ROUTES = ('/debug/leak',)\n"
+        assert "WVL307" not in [f.code for f in wvalint.lint_source(
+            DEBUG_PY, src)]
+
+    def test_repo_vocab_extraction_matches_router_table(self):
+        import ast as ast_mod
+
+        from workload_variant_autoscaler_tpu.obs import DEBUG_ROUTES
+
+        auth_py = os.path.join(REPO, "tests", "test_metrics_auth.py")
+        with open(auth_py, encoding="utf-8") as f:
+            tree = ast_mod.parse(f.read(), auth_py)
+        vocab = wvalint._gated_routes_from_trees({auth_py: tree})
+        assert vocab == frozenset(DEBUG_ROUTES)
+        assert "/debug/goodput" in vocab
+
+    def test_real_mount_module_is_clean_under_repo_vocab(self):
+        import ast as ast_mod
+
+        auth_py = os.path.join(REPO, "tests", "test_metrics_auth.py")
+        with open(auth_py, encoding="utf-8") as f:
+            vocab = wvalint._gated_routes_from_trees(
+                {auth_py: ast_mod.parse(f.read(), auth_py)})
+        mount = os.path.join(REPO, DEBUG_PY)
+        with open(mount, encoding="utf-8") as f:
+            codes = [x.code for x in wvalint.lint_source(
+                mount, f.read(), gated_routes=vocab)]
+        assert "WVL307" not in codes
 
 
 class TestUnauditedReadback:

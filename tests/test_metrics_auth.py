@@ -212,18 +212,27 @@ class TestServeEndToEnd:
 
 class TestDebugRoutesAuthGated:
     """The flight recorder's read surface (/debug/traces,
-    /debug/decisions, /debug/profile) mounts INSIDE the auth gate:
-    serve() wraps ONE app — debug middleware first, then the gate in
-    front — so every debug route 401s/403s exactly like /metrics, and a
-    new route can never ship outside the gate by construction."""
+    /debug/decisions, /debug/profile, /debug/goodput) mounts INSIDE the
+    auth gate: serve() wraps ONE app — debug middleware first, then the
+    gate in front — so every debug route 401s/403s exactly like
+    /metrics, and a new route can never ship outside the gate by
+    construction. The gating tests enumerate obs.DEBUG_ROUTES (the
+    router table itself), so a freshly mounted route is covered the
+    moment it exists; the literal manifest below is wvalint's WVL307
+    vocabulary and is pinned to the router table by
+    test_manifest_matches_mounted_router_table — mounting a route
+    without adding it here fails both the linter and that pin."""
 
-    DEBUG_ROUTES = ("/debug/traces", "/debug/decisions", "/debug/profile")
+    DEBUG_ROUTES = ("/debug/traces", "/debug/decisions", "/debug/profile",
+                    "/debug/goodput")
 
     @pytest.fixture()
     def served(self):
         from workload_variant_autoscaler_tpu.obs import (
             DecisionLog,
+            GoodputMeter,
             Profiler,
+            TickSample,
             Tracer,
             debug_middleware,
         )
@@ -234,13 +243,26 @@ class TestDebugRoutesAuthGated:
             pass
         profiler = Profiler(capacity=4)
         profiler.observe(tracer.traces()[0], cycle=1, ts=0.0)
+        meter = GoodputMeter(window_s=60.0)
+        meter.register("chat-8b", "default",
+                       price_per_hour=3600.0, slo_ttft_ms=500.0)
+        meter.observe_cycle(published={"chat-8b:default": 1},
+                            envelopes={"chat-8b:default": 100.0},
+                            rungs={})
+        meter.tick(1.0, 1.0, {"chat-8b:default": TickSample(
+            demand_rps=50.0, ttft_ms=(100.0,), replicas=1)})
         gate = KubeAuthGate(granted_kube())
         server, thread, _rel = emitter.serve(
             0, addr="127.0.0.1", auth_gate=gate,
             debug_middleware=debug_middleware(tracer, DecisionLog(4),
-                                              profiler))
+                                              profiler, meter))
         yield f"http://127.0.0.1:{server.server_address[1]}"
         server.shutdown()
+
+    def test_manifest_matches_mounted_router_table(self):
+        from workload_variant_autoscaler_tpu.obs import DEBUG_ROUTES
+
+        assert self.DEBUG_ROUTES == DEBUG_ROUTES
 
     def _get(self, url, token=None):
         req = urllib.request.Request(url)
@@ -253,7 +275,9 @@ class TestDebugRoutesAuthGated:
             return e.code, dict(e.headers), e.read()
 
     def test_all_debug_routes_401_without_token(self, served):
-        for route in self.DEBUG_ROUTES:
+        from workload_variant_autoscaler_tpu.obs import DEBUG_ROUTES
+
+        for route in DEBUG_ROUTES:
             status, headers, body = self._get(served + route)
             assert status == 401, route
             # the ONE middleware path: the same bearer challenge (and no
@@ -262,7 +286,9 @@ class TestDebugRoutesAuthGated:
             assert b"traces" not in body and b"profiles" not in body, route
 
     def test_all_debug_routes_401_with_forged_token(self, served):
-        for route in self.DEBUG_ROUTES:
+        from workload_variant_autoscaler_tpu.obs import DEBUG_ROUTES
+
+        for route in DEBUG_ROUTES:
             status, _h, _b = self._get(served + route, token="forged")
             assert status == 401, route
 
@@ -280,10 +306,18 @@ class TestDebugRoutesAuthGated:
                                      token=TOKEN)
         assert status == 200
         assert json_mod.loads(body)["decisions"] == []
+        status, _h, body = self._get(served + "/debug/goodput",
+                                     token=TOKEN)
+        assert status == 200
+        payload = json_mod.loads(body)
+        assert payload["summary"]["ticks"] == 1
+        assert len(payload["ticks"]) == 1
 
     def test_rbacless_token_403_on_debug_routes(self, served=None):
         from workload_variant_autoscaler_tpu.obs import (
+            DEBUG_ROUTES,
             DecisionLog,
+            GoodputMeter,
             Profiler,
             Tracer,
             debug_middleware,
@@ -293,10 +327,10 @@ class TestDebugRoutesAuthGated:
         kube = InMemoryKube()
         kube.grant_token(TOKEN, USER)   # authenticates, no RBAC grant
         inner = debug_middleware(Tracer(capacity=2), DecisionLog(2),
-                                 Profiler(capacity=2))(
+                                 Profiler(capacity=2), GoodputMeter())(
             lambda env, sr: (sr("200 OK", []), [b""])[1])
         gated = wrap_wsgi(inner, KubeAuthGate(kube))
-        for route in self.DEBUG_ROUTES:
+        for route in DEBUG_ROUTES:
             captured = {}
 
             def start_response(status, hdrs):

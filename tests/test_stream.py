@@ -347,6 +347,51 @@ class TestScopedCycles:
         assert rec.emitter.value("inferno_stream_lag_seconds_count") >= 1.0
 
 
+class TestScopedCycleProfileLedger:
+    """Scoped micro-cycles must fold into the Profiler ring like any
+    other cycle: the exact-partition invariant holds on their records,
+    and the record carries the scope width (`stream_scope`) so the
+    ledger distinguishes a 4-variant wake from a full polled pass."""
+
+    def test_scoped_trace_folds_into_ring_with_exact_partition(self):
+        _kube, rec, core = stream_cluster(n_variants=16, n_models=4)
+        baseline = rec.profiler.records()[0]     # the full pass
+        core.observe_load("llama-8b-m0", NS, mk_load(9600.0))
+        results = drain_now(core)
+        assert len(results) == 1 and len(results[0].processed) == 4
+        scoped = rec.profiler.records()[0]
+        assert scoped.cycle == baseline.cycle + 1
+        # the scope width is the flipped group's variant count; the
+        # baseline full pass carries the 0 sentinel
+        assert scoped.stream_scope == 4
+        assert baseline.stream_scope == 0
+        # exact partition on the scoped record, raw and serialized
+        assert sum(scoped.buckets.values()) == \
+            pytest.approx(scoped.wall_ms, abs=1e-9)
+        d = scoped.to_dict()
+        assert d["stream_scope"] == 4
+        assert sum(d["buckets"].values()) == pytest.approx(
+            d["wall_ms"], abs=1e-3)
+
+    def test_full_cycle_serialized_shape_is_unchanged(self):
+        """`stream_scope` is omitted from full-cycle dicts so polled
+        deployments (and saved --file dumps) keep their exact shape."""
+        _kube, rec, _core = stream_cluster(n_variants=8, n_models=4)
+        full = rec.profiler.records()[0].to_dict()
+        assert "stream_scope" not in full
+
+    def test_render_marks_streaming_micro_cycles(self):
+        from workload_variant_autoscaler_tpu.obs.profile import \
+            render_profile
+        _kube, rec, core = stream_cluster(n_variants=8, n_models=4)
+        core.observe_load("llama-8b-m1", NS, mk_load(9600.0))
+        drain_now(core)
+        scoped, full = rec.profiler.records()[0], rec.profiler.records()[-1]
+        assert "streaming micro-cycle, scope 2 variant(s)" in \
+            render_profile(scoped.to_dict())
+        assert "micro-cycle" not in render_profile(full.to_dict())
+
+
 # -- overload protection: valve, adaptive debounce, limited-mode storm ------
 
 

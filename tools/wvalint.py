@@ -54,6 +54,14 @@ Rules (suppress per-line with `# noqa` or `# noqa: WVLxxx`):
           hop the inferno_host_device_transfers_total series silently
           misses (numpy-only reference modules are exempt: they cannot
           hold device arrays)
+  WVL307  debug-route auth parity: a `/debug/<route>` string mounted in
+          obs/debug.py that the auth-gate suite
+          (tests/test_metrics_auth.py::TestDebugRoutesAuthGated) never
+          names — a flight-recorder route that could ship outside the
+          401/403 coverage. The suite's class-level route manifest is
+          the vocabulary; routes must be added there (where the gating
+          tests and the manifest==DEBUG_ROUTES pin pick them up) before
+          the linter accepts the mount.
   WVL311  config-knob doc parity: a `WVA_*` knob read from os.environ in
           package/tools code with no row in docs/user-guide/configuration.md
           (a knob operators can't discover)
@@ -1927,6 +1935,62 @@ def _check_stage_literals(path: str, tree: ast.Module,
     return findings
 
 
+# -- debug-route auth parity (WVL307) ----------------------------------------
+
+# the mount surface and the vocabulary source: every /debug/<route>
+# string in the debug middleware must appear (as a literal) inside the
+# auth-gate suite's route manifest, so a new route cannot ship without
+# 401/403 coverage
+DEBUG_MODULE_SUFFIX = os.path.join("obs", "debug.py")
+AUTH_TEST_SUFFIX = os.path.join("tests", "test_metrics_auth.py")
+AUTH_TEST_CLASS = "TestDebugRoutesAuthGated"
+# a route literal, exactly: the bare "/debug/" dispatch prefix and
+# prose mentioning /debug/... (docstrings) are not mounts
+_DEBUG_ROUTE_RE = re.compile(r"/debug/[A-Za-z0-9_.-]+\Z")
+
+
+def _gated_routes_from_trees(trees: dict[str, ast.Module],
+                             ) -> frozenset | None:
+    """The WVL307 vocabulary: every `/debug/...` string literal inside
+    the auth-gate suite's class body (the manifest tuple plus any route
+    a test names directly). None when the suite is out of scope —
+    partial runs must not flag every mounted route."""
+    for fp, tree in trees.items():
+        if not os.path.abspath(fp).endswith(AUTH_TEST_SUFFIX):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and \
+                    node.name == AUTH_TEST_CLASS:
+                routes = {n.value for n in ast.walk(node)
+                          if isinstance(n, ast.Constant)
+                          and isinstance(n.value, str)
+                          and _DEBUG_ROUTE_RE.fullmatch(n.value)}
+                return frozenset(routes) if routes else None
+    return None
+
+
+def _check_debug_route_gating(path: str, tree: ast.Module,
+                              gated: frozenset) -> list[Finding]:
+    """WVL307 — see the module docstring. Only the mount module is
+    checked: route strings elsewhere (docs, CLIs, tests) are consumers,
+    not mounts."""
+    if not os.path.abspath(path).endswith(DEBUG_MODULE_SUFFIX):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                _DEBUG_ROUTE_RE.fullmatch(node.value) and \
+                node.value not in gated:
+            findings.append(Finding(
+                path, node.lineno, "WVL307",
+                f"debug route {node.value!r} is not named in "
+                f"{AUTH_TEST_SUFFIX}::{AUTH_TEST_CLASS} — a "
+                "flight-recorder route outside the auth-gate suite's "
+                "401/403 coverage"))
+    return findings
+
+
 # -- unaudited device readback (WVL305) --------------------------------------
 
 # the modules whose functions may hold jax arrays on the decision path:
@@ -2109,8 +2173,8 @@ def _stage_coverage_findings(files: list[str],
 
 _STRUCTURAL_CODES = frozenset({
     "WVL001", "WVL002", "WVL003", "WVL101", "WVL102", "WVL103", "WVL104",
-    "WVL105", "WVL106", "WVL305", "WVL401", "WVL402", "WVL403", "WVL404",
-    "WVL405",
+    "WVL105", "WVL106", "WVL305", "WVL307", "WVL401", "WVL402", "WVL403",
+    "WVL404", "WVL405",
 })
 
 
@@ -2120,6 +2184,7 @@ def lint_source(path: str, source: str,
                 classes: dict[str, tuple[set, bool]] | None = None,
                 fault_kinds: frozenset | None = None,
                 stages: frozenset | None = None,
+                gated_routes: frozenset | None = None,
                 ) -> list[Finding]:
     try:
         tree = ast.parse(source, path)
@@ -2155,6 +2220,8 @@ def lint_source(path: str, source: str,
     if stages:
         findings += _check_stage_literals(path, tree, stages)
         active.add("WVL322")
+    if gated_routes:
+        findings += _check_debug_route_gating(path, tree, gated_routes)
 
     noqa = _noqa_lines(source)
     fired_by_line: dict[int, set[str]] = {}
@@ -2217,10 +2284,11 @@ def main(argv=None) -> int:
         trees, os.path.join("faults", "plan.py"), "ALL_KINDS")
     stages = _vocab_from_trees(
         trees, os.path.join("metrics", "__init__.py"), "RECONCILE_STAGES")
+    gated_routes = _gated_routes_from_trees(trees)
     findings: list[Finding] = []
     for fp in files:
         findings += lint_source(fp, sources[fp], sigs, rets, classes,
-                                fault_kinds, stages)
+                                fault_kinds, stages, gated_routes)
     findings += _metrics_doc_findings(files, sources)
     findings += _knob_parity_findings(files, sources, trees)
     findings += _stage_coverage_findings(files, trees)

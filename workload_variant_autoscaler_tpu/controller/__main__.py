@@ -17,6 +17,9 @@ Usage:
     python -m workload_variant_autoscaler_tpu.controller profile \
         [--cycle N] [--url http://HOST:METRICS_PORT] [--json]
 
+    python -m workload_variant_autoscaler_tpu.controller goodput \
+        [--window N] [--url http://HOST:METRICS_PORT] [--json]
+
 The `explain` subcommand renders a variant's latest DecisionRecord —
 the solve inputs, every clamp applied, and the published replica count,
 reproducible from the record alone — fetched from a running
@@ -30,6 +33,12 @@ The `profile` subcommand renders a cycle's full wall-clock attribution
 a text flamegraph with exclusive/inclusive columns, the JAX self-audit
 delta, and the sampled residual itemization when WVA_PROFILE_SAMPLE_HZ
 was on.
+
+The `goodput` subcommand renders the live GoodputMeter's rolling ledger
+(docs/observability.md "Live goodput"): the windowed goodput fraction,
+SLO attainment, and the badput decomposition, fetched from a running
+controller's /debug/goodput endpoint. Requires WVA_GOODPUT_LIVE=1 on
+the controller (the route 404s when no meter is attached).
 """
 
 from __future__ import annotations
@@ -118,6 +127,87 @@ def profile_main(argv) -> int:
         print(json.dumps(record, indent=2, default=str))
     else:
         print(render_profile(record))
+    return 0
+
+
+def render_goodput(payload: dict) -> str:
+    """Text rendering of the /debug/goodput payload: the windowed
+    headline numbers plus the badput decomposition, one line each."""
+    summary = payload.get("summary", {}) if isinstance(payload, dict) else {}
+    lines = [
+        "goodput ledger (rolling window "
+        f"{summary.get('window_s', 0.0):g} s, "
+        f"{summary.get('ticks', 0)} ticks, "
+        f"{summary.get('variants', 0)} variants)",
+        f"  goodput fraction:  {summary.get('goodput_fraction', 0.0):.1%} "
+        "of provisioned $·s was SLO-attained spend",
+        f"  slo attainment:    {summary.get('slo_attainment', 0.0):.1%} "
+        f"of {summary.get('demand_seconds', 0.0):.1f} demand-seconds",
+        f"  provisioned cost:  {summary.get('cost_dollar_seconds', 0.0):.4f}"
+        " $·s",
+    ]
+    badput = summary.get("badput", {}) or {}
+    if badput:
+        lines.append("  badput:")
+        for bucket, frac in sorted(badput.items(),
+                                   key=lambda kv: -kv[1]):
+            lines.append(f"    {bucket:<22s} {frac:.1%}")
+    else:
+        lines.append("  badput:            none billed in window")
+    return "\n".join(lines)
+
+
+def goodput_main(argv) -> int:
+    """The fleet-efficiency read path: how useful was the fleet's spend
+    lately. Exits 0 with the rendered ledger, 1 when the controller has
+    no live meter attached (WVA_GOODPUT_LIVE unset)."""
+    parser = argparse.ArgumentParser(
+        prog="python -m workload_variant_autoscaler_tpu.controller goodput",
+        description="Render the live GoodputMeter's rolling ledger "
+                    "(goodput fraction, SLO attainment, badput buckets)")
+    parser.add_argument("--window", type=int, default=None, metavar="N",
+                        help="re-clip the ledger to the trailing N "
+                             "seconds (default: the meter's full "
+                             "WVA_GOODPUT_WINDOW_S window)")
+    parser.add_argument("--url",
+                        default=os.environ.get("WVA_DEBUG_URL",
+                                               "http://127.0.0.1:8080"),
+                        help="base URL of the controller's metrics/debug "
+                             "server (default http://127.0.0.1:8080)")
+    parser.add_argument("--file", default=None, metavar="PATH",
+                        help="read a saved /debug/goodput JSON payload "
+                             "instead of querying a live controller")
+    parser.add_argument("--json", action="store_true",
+                        help="print the raw payload JSON (summary + "
+                             "per-tick entries) instead of the rendered "
+                             "ledger")
+    args = parser.parse_args(argv)
+
+    if args.file:
+        with open(args.file, encoding="utf-8") as f:
+            payload = json.load(f)
+    else:
+        from urllib.error import HTTPError
+        from urllib.parse import urlencode
+        from urllib.request import urlopen
+
+        query = f"?{urlencode({'window': args.window})}" \
+            if args.window is not None else ""
+        url = f"{args.url.rstrip('/')}/debug/goodput{query}"
+        try:
+            with urlopen(url, timeout=10.0) as resp:  # noqa: S310 — operator-supplied URL
+                payload = json.load(resp)
+        except HTTPError as e:
+            if e.code == 404:
+                print("no live goodput meter (start the controller with "
+                      "WVA_GOODPUT_LIVE=1)", file=sys.stderr)
+                return 1
+            raise
+
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+    else:
+        print(render_goodput(payload))
     return 0
 
 
@@ -212,6 +302,8 @@ def main(argv=None) -> int:
         return explain_main(argv[1:])
     if argv and argv[0] == "profile":
         return profile_main(argv[1:])
+    if argv and argv[0] == "goodput":
+        return goodput_main(argv[1:])
     parser = argparse.ArgumentParser(description="TPU-native workload variant autoscaler")
     parser.add_argument("--metrics-port", type=int, default=8080,
                         help="port for the emitted /metrics endpoint")
@@ -381,12 +473,14 @@ def main(argv=None) -> int:
             certfile=args.metrics_cert or None, keyfile=args.metrics_key or None,
             client_cafile=args.metrics_client_ca or None,
             auth_gate=auth_gate,
-            # the flight recorder's read surface (/debug/traces,
-            # /debug/decisions, /debug/profile — docs/observability.md),
-            # inside the auth gate when one is configured
+            # the flight recorder's read surface (the obs.DEBUG_ROUTES
+            # table — docs/observability.md), inside the auth gate when
+            # one is configured; the goodput route serves only when
+            # WVA_GOODPUT_LIVE attached a meter in Reconciler.__init__
             debug_middleware=debug_middleware(reconciler.tracer,
                                               reconciler.decisions,
-                                              reconciler.profiler),
+                                              reconciler.profiler,
+                                              reconciler.goodput_meter),
             stream_middleware=stream_middleware,
         )
     except ValueError as e:

@@ -58,8 +58,10 @@ from ..obs import (
     DecisionBuilder,
     DecisionInputs,
     DecisionLog,
+    GoodputMeter,
     Profiler,
     ResidualSampler,
+    TickSample,
     Tracer,
 )
 from ..obs import trace as obs_trace
@@ -215,6 +217,20 @@ class Reconciler:
         # cycles; (re)built lazily from the WVA_SOLVE_* knobs and
         # dropped when WVA_INCREMENTAL_SOLVE turns off
         self._solve_engine_obj: Optional[IncrementalSolveEngine] = None
+        # live goodput meter (obs/goodput.py — the twin's meter, shared):
+        # attached explicitly via attach_goodput_meter() or automatically
+        # when WVA_GOODPUT_LIVE is on. None keeps the reconcile path
+        # meter-free. The per-cycle capture dicts are filled by
+        # _record_decision (NOT read back from the decision ring, whose
+        # capacity can be smaller than the fleet) and consumed by
+        # _feed_goodput in the cycle's finally.
+        self._goodput_meter: Optional[GoodputMeter] = None
+        self._goodput_self_tick = True
+        self._goodput_last_tick: Optional[float] = None
+        self._goodput_published: dict[str, int] = {}
+        self._goodput_observed: dict[str, tuple] = {}
+        if os.environ.get("WVA_GOODPUT_LIVE", "").lower() in ("1", "true"):
+            self.attach_goodput_meter()
 
     # -- StreamState accessors --------------------------------------------
     # The historical private-attribute names, kept as properties over
@@ -538,6 +554,8 @@ class Reconciler:
         self.state.cycle_loads = {}
         self._cycle_index += 1
         self._cycle_builders = {}
+        self._goodput_published = {}
+        self._goodput_observed = {}
         # WVA_PROFILE_SAMPLE_HZ: the residual itemizer — a stdlib stack
         # sampler on THIS thread that breaks the ledger's unattributed /
         # stage-exclusive Python time down by caller. Wall-clock based,
@@ -643,6 +661,15 @@ class Reconciler:
                     samples, removed, int(cycle_state))
             self.emitter.emit_circuit_metrics(
                 {name: b.state_code() for name, b in self.breakers.items()})
+            if self._goodput_meter is not None:
+                # the live goodput feed runs INSIDE the cycle's finally
+                # so scoped micro-cycles and raising cycles meter too;
+                # observability only — it must never (re-)fail the cycle
+                try:
+                    self._feed_goodput(int(cycle_state))
+                except Exception as e:  # noqa: BLE001
+                    log.warning("goodput meter feed failed",
+                                extra=kv(error=str(e)))
             self.state.scope = None
             self.state.stream_loads = None
 
@@ -1060,9 +1087,86 @@ class Reconciler:
             builder.outcome = outcome
         if reason:
             builder.reason = reason
+        if self._goodput_meter is not None:
+            # capture for the goodput feed: what this cycle published
+            # and what it observed (rate, TTFT, pre-publish replicas)
+            self._goodput_published[key] = published
+            inp = builder.inputs
+            self._goodput_observed[key] = (inp.arrival_rate_rpm,
+                                           inp.avg_ttft_ms,
+                                           inp.current_replicas)
         self.decisions.record(builder.freeze(
             trace_id=obs_trace.current_trace_id() or "",
             cycle=self._cycle_index, ts=self.now()))
+
+    # -- live goodput metering (obs/goodput.py) ---------------------------
+
+    def attach_goodput_meter(self, meter: Optional[GoodputMeter] = None, *,
+                             self_tick: bool = True) -> GoodputMeter:
+        """Attach a GoodputMeter to the live feed path: every reconcile
+        (polled loop and streaming micro-cycles alike) registers its
+        candidates' pricing/SLOs, ticks the elapsed interval from the
+        loads/TTFT it observed, folds in what it published (counts +
+        capacity envelopes + degradation rungs), annotates the ended
+        cycle's DecisionRecords with the interval's dominant badput
+        bucket, and exports the inferno_goodput_* series.
+
+        `self_tick=False` leaves `tick()` to an external driver that
+        has ground truth — the digital twin in the equivalence harness
+        (`emulator.twin.run_scenario(online_meter=...)`).
+
+        With no `meter` given, one is built with the WVA_GOODPUT_WINDOW_S
+        rolling window (default 900 s). Returns the attached meter."""
+        if meter is None:
+            window = parse_float_or(
+                os.environ.get("WVA_GOODPUT_WINDOW_S"), 900.0)
+            meter = GoodputMeter(window_s=window)
+        self._goodput_meter = meter
+        self._goodput_self_tick = self_tick
+        self._goodput_last_tick = None
+        return meter
+
+    @property
+    def goodput_meter(self) -> Optional[GoodputMeter]:
+        return self._goodput_meter
+
+    def _feed_goodput(self, cycle_rung: int) -> None:
+        """One cycle's worth of live metering, run from the cycle's
+        finally. Self-tick mode bills the interval since the previous
+        cycle from what THIS cycle observed per decided variant — the
+        live approximation of the twin's ground-truth ticks (absent
+        variants simply don't bill); with self-tick off the external
+        driver owns `tick()` and this feed contributes only the cycle
+        observations, which is what makes twin-vs-online equivalence
+        assertable."""
+        meter = self._goodput_meter
+        if self._goodput_self_tick:
+            now = self.now()
+            last = self._goodput_last_tick
+            self._goodput_last_tick = now
+            if last is not None and now > last:
+                samples = {
+                    key: TickSample(
+                        demand_rps=rpm / 60.0,
+                        ttft_ms=(ttft,) if ttft > 0.0 else (),
+                        replicas=replicas)
+                    for key, (rpm, ttft, replicas)
+                    in self._goodput_observed.items()}
+                meter.tick(now, now - last, samples)
+        # the interval that just ended was governed by the PREVIOUS
+        # cycle's publication: annotate those records
+        flushed = meter.flush(self._cycle_index - 1,
+                              annotate=self.decisions.annotate_goodput)
+        meter.observe_cycle(
+            published=dict(self._goodput_published),
+            envelopes=self.capacity_envelopes(),
+            rungs={full_name(n, ns): rung for (n, ns), rung
+                   in self._degradation.gauge_samples().items()},
+            cycle_rung=cycle_rung)
+        summary = meter.summary()
+        self.emitter.emit_goodput_metrics(
+            summary["goodput_fraction"], flushed,
+            meter.attainment_by_model())
 
     def _emit_conditions(self) -> None:
         """CR conditions as inferno_condition_status series (post-write
@@ -1491,7 +1595,7 @@ class Reconciler:
 
             preferred = class_by_key.get(va_listed.spec.slo_class_ref.key, "")
             try:
-                _target, class_name = translate.find_model_slo_in_spec(
+                target, class_name = translate.find_model_slo_in_spec(
                     system_spec, model, preferred_class=preferred
                 )
             except (KeyError, ValueError) as e:
@@ -1514,6 +1618,12 @@ class Reconciler:
             if cost != cost:
                 result.skipped[key] = "missing accelerator cost"
                 continue
+            if self._goodput_meter is not None:
+                # the meter needs the variant's pricing + TTFT SLO to
+                # judge its spend; idempotent metadata refresh per cycle
+                self._goodput_meter.register(
+                    va_listed.name, va_listed.namespace, model=model,
+                    price_per_hour=cost, slo_ttft_ms=target.slo_ttft)
 
             if deploy_index is not None:
                 deploy = deploy_index.get((va_listed.namespace, name))

@@ -48,12 +48,11 @@ from __future__ import annotations
 
 import io
 import json
-import math
 import os
 import random
 import shutil
 import tempfile
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..controller import (
     ACCELERATOR_CM_NAME,
@@ -78,12 +77,13 @@ from ..faults import (
     stream_flood_multiplier,
 )
 from ..metrics import MetricsEmitter
-from ..obs.decision import (
-    GOODPUT_DEGRADED,
-    GOODPUT_LAGGED,
-    GOODPUT_OVER,
-    GOODPUT_UNDER,
-    GOODPUT_USEFUL,
+from ..obs.decision import GOODPUT_USEFUL
+from ..obs.goodput import (
+    DEGRADED_RUNGS,
+    STALE_ZERO_RUNGS,
+    UNPUBLISHED,
+    GoodputMeter,
+    TickSample,
 )
 from ..utils import full_name, get_logger, kv
 from .engine import Fleet, MetricsSink, Request, Simulation, SliceModelConfig
@@ -94,22 +94,11 @@ from .simprom import MultiPromAPI, SimPromAPI
 
 log = get_logger("wva.twin")
 
-# rungs whose mis-provision is charged to `degradation-held` (the
-# controller flew on degraded EVIDENCE). `limited` deliberately stays
-# out: an optimizer that cannot fit withdrawn capacity is
-# capacity-bound, and its SLO misses read as `under-provisioned` — the
-# bucket that answers "buy more chips", not "fix the telemetry".
-# `stream-degraded` (the shed/lag-pressure rung PR 12 added) is in: a
-# cycle sized while the ingest door was shedding flew on partial
-# evidence, and charging its misses to under-provision/actuation-lag
-# would mis-answer "buy more chips" for what is a telemetry storm
-DEGRADED_RUNGS = ("stream-degraded", "stale-cache", "hold")
-
-# rungs where a published ZERO is the stale-flap failure the guardrail
-# forbids. Narrower than DEGRADED_RUNGS on purpose: stream-degraded
-# cycles size on FRESH (admitted) pushes — a zero there is a sizing
-# decision to judge by its badput, not a flap on absent evidence
-STALE_ZERO_RUNGS = ("stale-cache", "hold")
+# DEGRADED_RUNGS / STALE_ZERO_RUNGS moved to obs.goodput with the
+# meter extraction (this PR); re-exported above because the rung
+# policy is part of the twin's public story and tests import it here.
+__all__ = ["DEGRADED_RUNGS", "STALE_ZERO_RUNGS", "ScenarioResult",
+           "VariantResult", "run_scenario"]
 
 _RUNG_LABELS = {int(s): s.label for s in DegradationState}
 
@@ -175,38 +164,21 @@ class _FanSink(MetricsSink):
 
 @dataclass
 class _VariantState:
-    """Per-variant live state + goodput accumulators."""
+    """Per-variant sim-side state. The goodput ACCOUNTING lives in the
+    shared `obs.goodput.VariantLedger` (`ledger`) — the twin keeps only
+    what the emulation itself needs: the fleet, the TTFT recorder, and
+    the actually-serving replica count actuation lags behind."""
 
     spec: VariantSpec
     fleet: Fleet
     recorder: _TTFTRecorder
     price_per_hour: float
-    desired: int = 0            # last published replica count
+    ledger: object = None       # obs.goodput.VariantLedger
     actual: int = 1             # replicas actually serving (startup lag)
-    r_star: float = 0.0         # SLO-feasible req/s per replica (envelope)
-    rung: str = "healthy"       # degradation rung governing the interval
-    published_once: bool = False
-    min_desired_after_publish: int = 10**9
-    scaled_to_zero_on_stale: bool = False
-    # accumulators, all in "dollar-seconds" of provisioned cost
-    cost_s: float = 0.0
-    buckets: dict = field(default_factory=dict)
-    demand_s: float = 0.0       # integral of ground-truth demand (req)
-    slo_demand_s: float = 0.0   # the SLO-attained part of it
-    # per-reconcile-interval bucket costs, flushed into DecisionRecord
-    # annotations at each cycle boundary
-    interval_buckets: dict = field(default_factory=dict)
 
     @property
     def key(self) -> str:
         return full_name(self.spec.name, self.spec.namespace)
-
-    def add(self, bucket: str, cost: float) -> None:
-        if cost <= 0.0:
-            return
-        self.buckets[bucket] = self.buckets.get(bucket, 0.0) + cost
-        self.interval_buckets[bucket] = \
-            self.interval_buckets.get(bucket, 0.0) + cost
 
 
 @dataclass
@@ -266,6 +238,10 @@ class ScenarioResult:
     # injected clock — so a scenario rerun traces byte-identically
     # (asserted by tests/test_twin.py)
     tracer: object = None
+    # obs.goodput.GoodputMeter the twin drove (kept out of to_dict):
+    # per-tick ring + per-variant ledgers, compared against an
+    # online-attached meter by the equivalence harness
+    meter: object = None
 
     @property
     def cost_dollar_seconds(self) -> float:
@@ -438,8 +414,18 @@ def _seed_kube(scenario: Scenario, kube: InMemoryKube,
             ))
 
 
-def run_scenario(scenario: Scenario) -> ScenarioResult:
-    """Run one scenario to completion and return its goodput ledger."""
+def run_scenario(scenario: Scenario,
+                 online_meter: GoodputMeter | None = None,
+                 ) -> ScenarioResult:
+    """Run one scenario to completion and return its goodput ledger.
+
+    `online_meter`: an optional second GoodputMeter attached to the
+    Reconciler's live feed path (`Reconciler.attach_goodput_meter`,
+    self-tick disabled) while the twin drives its own meter from ground
+    truth — the twin-vs-online equivalence harness
+    (`bench_goodput_live.py`) runs both and asserts identical per-tick
+    ledgers.
+    """
     plan = FaultPlan(list(scenario.faults), seed=scenario.seed)
     restart_rules = [r for r in plan.rules
                      if r.kind == CONTROLLER_RESTART]
@@ -455,7 +441,7 @@ def run_scenario(scenario: Scenario) -> ScenarioResult:
             os.path.join(ckpt_dir, "stream.ckpt")
     try:
         return _run_scenario(scenario, plan, restart_rules,
-                             operator_extra)
+                             operator_extra, online_meter)
     finally:
         if ckpt_dir is not None:
             shutil.rmtree(ckpt_dir, ignore_errors=True)
@@ -463,6 +449,7 @@ def run_scenario(scenario: Scenario) -> ScenarioResult:
 
 def _run_scenario(scenario: Scenario, plan: FaultPlan,
                   restart_rules: list, operator_extra: dict[str, str],
+                  online_meter: GoodputMeter | None = None,
                   ) -> ScenarioResult:
     kube = InMemoryKube()
     _seed_kube(scenario, kube, operator_extra)
@@ -482,6 +469,17 @@ def _run_scenario(scenario: Scenario, plan: FaultPlan,
             spec=v, fleet=fleet, recorder=recorder,
             price_per_hour=v.cost_per_hour))
 
+    # the SAME meter class the live Reconciler drives (obs.goodput),
+    # here fed from ground truth in sim time; the window keeps the
+    # whole run so the score sheet is lifetime, like before the
+    # extraction
+    meter = GoodputMeter(window_s=scenario.duration_s)
+    for st in states:
+        st.ledger = meter.register(
+            st.spec.name, st.spec.namespace, model=st.spec.model,
+            price_per_hour=st.price_per_hour,
+            slo_ttft_ms=st.spec.slo_ttft_ms)
+
     sim = Simulation(fleets, seed=scenario.seed)
     backends = [SimPromAPI(sink, v.model, v.namespace, fault_plan=plan)
                 for sink, v in zip(sinks, scenario.variants)]
@@ -489,6 +487,8 @@ def _run_scenario(scenario: Scenario, plan: FaultPlan,
     emitter = MetricsEmitter()
     rec = Reconciler(kube=kube, prom=prom, emitter=emitter,
                      now=lambda: sim.now_ms / 1000.0, sleep=lambda _s: None)
+    if online_meter is not None:
+        rec.attach_goodput_meter(online_meter, self_tick=False)
 
     for i, (v, fleet) in enumerate(zip(scenario.variants, fleets)):
         gen = PoissonLoadGenerator(
@@ -527,7 +527,7 @@ def _run_scenario(scenario: Scenario, plan: FaultPlan,
         st.fleet.set_replicas(max(n, 0), now_ms)
         kube.put_deployment(Deployment(
             name=st.spec.name, namespace=st.spec.namespace,
-            spec_replicas=st.desired or st.actual,
+            spec_replicas=st.ledger.desired or st.actual,
             status_replicas=st.actual))
         sim.kick()
 
@@ -537,7 +537,8 @@ def _run_scenario(scenario: Scenario, plan: FaultPlan,
         In limited mode the target is additionally clamped to what the
         generation pool can host: pods cannot schedule onto drained or
         reclaimed nodes."""
-        target = st.desired if st.published_once else st.actual
+        target = st.ledger.desired if st.ledger.published_once \
+            else st.actual
         limit = pool_limit(st, gen_capacity())
         if limit is not None:
             target = min(target, limit)
@@ -558,69 +559,32 @@ def _run_scenario(scenario: Scenario, plan: FaultPlan,
                              extra=kv(variant=st.spec.name,
                                       had=st.actual, fit=limit))
                     set_actual(st, limit, now_ms)
-        for st in states:
-            d = rate_at(now_ms / 1000.0, st.spec.schedule) / 60.0  # req/s
-            ttfts = st.recorder.take_until(now_ms)
-            if not st.published_once or st.r_star <= 0.0:
-                continue    # warmup: nothing published to judge yet
-            n = len(st.fleet.all_replicas())    # draining still bills
-            price_s = st.price_per_hour / 3600.0
-            cost = n * price_s * tick_s
-            st.cost_s += cost
-            if d > 0.0:
-                st.demand_s += d * tick_s
-            n_req = int(math.ceil(d / st.r_star)) if d > 0.0 else 0
-            limit = pool_limit(st, capacity)
-            latency_ok = (not ttfts or
-                          sum(ttfts) / len(ttfts) <= st.spec.slo_ttft_ms)
-            if n >= n_req and latency_ok:
-                if d > 0.0:
-                    st.slo_demand_s += d * tick_s
-                st.add(GOODPUT_USEFUL, min(n, n_req) * price_s * tick_s)
-                surplus = (n - n_req) * price_s * tick_s
-                st.add(GOODPUT_DEGRADED if st.rung in DEGRADED_RUNGS
-                       else GOODPUT_OVER, surplus)
-            else:
-                # the whole provisioned cost served SLO-violating load:
-                # attribute it to WHY the controller was wrong
-                if st.rung in DEGRADED_RUNGS:
-                    bucket = GOODPUT_DEGRADED
-                elif (n < n_req <= st.desired
-                        and (limit is None or limit >= n_req)):
-                    # the published decision was right and the pool could
-                    # host it — pods were simply still starting. A pool
-                    # that CANNOT host the right count is withdrawn
-                    # capacity: under-provisioned, not lag
-                    bucket = GOODPUT_LAGGED
-                else:
-                    bucket = GOODPUT_UNDER
-                st.add(bucket, cost)
-
-    def flush_interval(ended_cycle: int) -> None:
-        """Stamp the interval's dominant badput bucket onto the cycle's
-        DecisionRecords (the audit-trail half of the goodput story)."""
-        for st in states:
-            buckets = st.interval_buckets
-            st.interval_buckets = {}
-            if not buckets or ended_cycle <= 0:
-                continue
-            total = sum(buckets.values())
-            badput = {b: c for b, c in buckets.items()
-                      if b != GOODPUT_USEFUL}
-            if badput and max(badput.values()) > 0.0:
-                bucket = max(sorted(badput), key=lambda b: badput[b])
-                share = badput[bucket] / total if total > 0 else 0.0
-            else:
-                bucket, share = GOODPUT_USEFUL, 1.0
-            rec.decisions.annotate_goodput(
-                st.spec.name, st.spec.namespace, ended_cycle, bucket,
-                detail=f"{share:.0%} of {total:.4f} $·s interval cost")
+        # ground truth for the tick: sim demand, the recorder's TTFT
+        # completions, and the fleet's billing replica count (draining
+        # still bills) — then the SHARED meter does the attribution
+        samples = {
+            st.key: TickSample(
+                demand_rps=rate_at(now_ms / 1000.0,
+                                   st.spec.schedule) / 60.0,
+                ttft_ms=tuple(st.recorder.take_until(now_ms)),
+                replicas=len(st.fleet.all_replicas()),
+                pool_limit=pool_limit(st, capacity))
+            for st in states
+        }
+        meter.tick(now_ms / 1000.0, tick_s, samples)
+        if online_meter is not None:
+            # equivalence mode: the online meter sees the SAME ground
+            # truth ticks; its cycle observations come from the live
+            # Reconciler feed instead of the twin's kube reads
+            online_meter.tick(now_ms / 1000.0, tick_s, samples)
 
     def begin_cycle() -> None:
         """Per-cycle bookkeeping shared by the polled loop and the
-        streaming core (which runs it via its on_cycle_start hook)."""
+        streaming core (which runs it via its on_cycle_start hook):
+        stamp the ended interval's dominant badput bucket onto its
+        DecisionRecords (the audit-trail half of the goodput story)."""
         nonlocal cycle
-        flush_interval(cycle)
+        meter.flush(cycle, rec.decisions.annotate_goodput)
         plan.begin_cycle()
         cycle += 1
 
@@ -649,31 +613,27 @@ def _run_scenario(scenario: Scenario, plan: FaultPlan,
         cycle_rung = int(emitter.value(
             "inferno_cycle_degradation_state") or 0)
         rung_ints = {label: value for value, label in _RUNG_LABELS.items()}
+        published = {}
         for st in states:
-            variant_rung = rung_ints.get(rungs.get(st.key, "healthy"), 0)
-            st.rung = _RUNG_LABELS[max(variant_rung, cycle_rung)]
             va = kube.get_variant_autoscaling(st.spec.name,
-                                             st.spec.namespace)
-            desired = va.status.desired_optimized_alloc.num_replicas
+                                              st.spec.namespace)
+            published[st.key] = \
+                va.status.desired_optimized_alloc.num_replicas
+        meter.observe_cycle(
+            published=published, envelopes=envelopes,
+            rungs={st.key: rung_ints.get(rungs.get(st.key, "healthy"), 0)
+                   for st in states},
+            cycle_rung=cycle_rung)
+        # the meter judged the publication; now the SIM actuates it
+        # (scale-down immediate, scale-up behind pod-startup lag)
+        for st in states:
+            desired = published[st.key]
             if desired > 0:
-                st.desired = desired
-                st.published_once = True
-                st.min_desired_after_publish = min(
-                    st.min_desired_after_publish, desired)
-                cap = envelopes.get(st.key, 0.0)
-                if cap > 0.0:
-                    st.r_star = cap / desired
                 if desired < st.actual:
                     apply_target(st, now_ms)     # scale-down: immediate
                 elif desired > st.actual:
                     sim.schedule(delay_ms, "call",
                                  lambda t, st=st: apply_target(st, t))
-            elif st.published_once:
-                # a published variant dropping to zero on a degraded rung
-                # is the exact failure the stale-veto guardrail forbids
-                if st.rung in STALE_ZERO_RUNGS:
-                    st.scaled_to_zero_on_stale = True
-                st.min_desired_after_publish = 0
 
     # streaming mode (stream/core.py): the core owns the loop — each
     # tick pushes the scraped loads through the ingest door and calls
@@ -796,6 +756,8 @@ def _run_scenario(scenario: Scenario, plan: FaultPlan,
         rec = Reconciler(kube=kube, prom=prom, emitter=emitter,
                          now=lambda: sim.now_ms / 1000.0,
                          sleep=lambda _s: None)
+        if online_meter is not None:
+            rec.attach_goodput_meter(online_meter, self_tick=False)
         if scenario.streaming:
             core = build_core()
 
@@ -819,20 +781,21 @@ def _run_scenario(scenario: Scenario, plan: FaultPlan,
 
     sim.run_until(scenario.duration_s * 1000.0, on_tick=on_tick,
                   tick_ms=tick_s * 1000.0)
-    flush_interval(cycle)
+    meter.flush(cycle, rec.decisions.annotate_goodput)
 
     variants = [
         VariantResult(
             name=st.spec.name, namespace=st.spec.namespace,
             chip=st.spec.chip, price_per_hour=st.price_per_hour,
-            cost_dollar_seconds=st.cost_s,
-            demand_seconds=st.demand_s,
-            slo_demand_seconds=st.slo_demand_s,
-            badput=dict(st.buckets),
+            cost_dollar_seconds=st.ledger.cost_s,
+            demand_seconds=st.ledger.demand_s,
+            slo_demand_seconds=st.ledger.slo_demand_s,
+            badput=dict(st.ledger.buckets),
             min_desired_after_publish=(
-                st.min_desired_after_publish
-                if st.min_desired_after_publish < 10**9 else 0),
-            scaled_to_zero_on_stale=st.scaled_to_zero_on_stale,
+                st.ledger.min_desired_after_publish
+                if st.ledger.min_desired_after_publish < UNPUBLISHED
+                else 0),
+            scaled_to_zero_on_stale=st.ledger.scaled_to_zero_on_stale,
         )
         for st in states
     ]
@@ -841,4 +804,5 @@ def _run_scenario(scenario: Scenario, plan: FaultPlan,
         cycles=cycle, raised_cycles=raised, fault_trips=len(plan.trips),
         goodput_floor=scenario.goodput_floor, variants=variants,
         decisions=rec.decisions, emitter=emitter, tracer=rec.tracer,
+        meter=meter,
     )

@@ -177,6 +177,15 @@ INFERNO_STREAM_LAG_SECONDS = "inferno_stream_lag_seconds"
 INFERNO_STREAM_SHED_TOTAL = "inferno_stream_shed_total"
 INFERNO_STREAM_CHECKPOINT_TOTAL = "inferno_stream_checkpoint_total"
 INFERNO_STREAM_DEBOUNCE_MS = "inferno_stream_debounce_ms"
+# live goodput metering (obs/goodput.py, fed by the Reconciler when a
+# GoodputMeter is attached — WVA_GOODPUT_LIVE): the twin's offline
+# judgment metric as a first-class scrape surface. The badput counter's
+# `bucket` label partitions the WHOLE provisioned cost (the `useful`
+# bucket is exported too), so sum-over-buckets is total spend and any
+# bucket/sum ratio is a badput fraction.
+INFERNO_GOODPUT_FRACTION = "inferno_goodput_fraction"
+INFERNO_BADPUT_COST_SECONDS_TOTAL = "inferno_badput_cost_seconds_total"
+INFERNO_SLO_ATTAINMENT_RATIO = "inferno_slo_attainment_ratio"
 
 LABEL_DEPENDENCY = "dependency"
 LABEL_OUTCOME = "outcome"
@@ -264,6 +273,12 @@ LABEL_NAMESPACE = "namespace"
 LABEL_DIRECTION = "direction"
 LABEL_REASON = "reason"
 LABEL_ACCELERATOR_TYPE = "accelerator_type"
+LABEL_MODEL_NAME = "model_name"
+# the `bucket` label values of inferno_badput_cost_seconds_total are
+# the GOODPUT_* constants of obs/decision.py (useful /
+# under-provisioned / over-provisioned / degradation-held /
+# actuation-lagged)
+LABEL_BUCKET = "bucket"
 
 
 class MetricsEmitter:
@@ -541,6 +556,31 @@ class MetricsEmitter:
             [LABEL_VARIANT_NAME, LABEL_NAMESPACE, LABEL_METRIC],
             registry=self.registry,
         )
+        # live goodput (obs/goodput.py GoodputMeter): the twin's
+        # fleet-efficiency judgment metric, computed by the RUNNING
+        # controller each cycle. Registered unconditionally (scrape
+        # parity); the series stay at their zero values until a meter is
+        # attached (WVA_GOODPUT_LIVE / Reconciler.attach_goodput_meter).
+        self.goodput_fraction = Gauge(
+            INFERNO_GOODPUT_FRACTION,
+            "Useful share of the fleet's provisioned chip-cost over the "
+            "rolling goodput window (WVA_GOODPUT_WINDOW_S), in [0, 1]",
+            registry=self.registry,
+        )
+        self.badput_cost_seconds = Counter(
+            INFERNO_BADPUT_COST_SECONDS_TOTAL.removesuffix("_total"),
+            "Provisioned cost (dollar-seconds) accumulated per goodput "
+            "bucket — useful plus the four badput buckets "
+            "(under-provisioned / over-provisioned / degradation-held / "
+            "actuation-lagged), partitioning total spend exactly",
+            [LABEL_BUCKET], registry=self.registry,
+        )
+        self.slo_attainment_ratio = Gauge(
+            INFERNO_SLO_ATTAINMENT_RATIO,
+            "SLO-attained share of the demand-seconds each model served "
+            "since the meter attached, in [0, 1]",
+            [LABEL_MODEL_NAME, LABEL_NAMESPACE], registry=self.registry,
+        )
 
     def emit_solution_time(self, msec: float) -> None:
         self.solution_time.set(msec)
@@ -600,6 +640,27 @@ class MetricsEmitter:
                 if count > 0:
                     self.host_device_transfers.labels(
                         **{LABEL_DIRECTION: direction}).inc(count)
+
+    def emit_goodput_metrics(self, fraction: float,
+                             bucket_costs: dict,
+                             attainment: dict) -> None:
+        """One cycle's goodput ledger roll-up (obs/goodput.py). The
+        fraction gauge carries the rolling-window share; the badput
+        counter accrues exactly the just-flushed interval's $·s per
+        bucket (zero-cost buckets emit nothing — scrapes see only
+        buckets that ever billed); attainment keys are
+        (model_name, namespace)."""
+        with self._lock:
+            self.goodput_fraction.set(fraction)
+            for bucket, cost in bucket_costs.items():
+                if cost > 0.0:
+                    self.badput_cost_seconds.labels(
+                        **{LABEL_BUCKET: bucket}).inc(cost)
+            for (model_name, namespace), ratio in attainment.items():
+                self.slo_attainment_ratio.labels(**{
+                    LABEL_MODEL_NAME: model_name,
+                    LABEL_NAMESPACE: namespace,
+                }).set(ratio)
 
     # -- incremental (scoped-cycle) updates of the wholesale gauges -----
     # The streaming core's scoped micro-cycles touch a handful of

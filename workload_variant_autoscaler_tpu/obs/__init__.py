@@ -13,8 +13,12 @@ at module load without cycles):
   partition of the cycle wall into exclusive buckets + an unattributed
   residual), the JAX self-audit (retraces / compiles / host<->device
   transfers), and the text flamegraph renderers.
-- `debug`: the /debug/traces + /debug/decisions + /debug/profile WSGI
-  routes mounted on the metrics server.
+- `goodput`: the sim/live-agnostic GoodputMeter — SLO-attained
+  demand-seconds over chip-cost-seconds, badput partitioned into the
+  GOODPUT_* buckets; driven by the digital twin in sim time and by the
+  live Reconciler per cycle, with identical arithmetic.
+- `debug`: the /debug/<route> WSGI routes mounted on the metrics
+  server (the route table is `debug.DEBUG_ROUTES`).
 """
 
 from .decision import (
@@ -40,7 +44,14 @@ from .decision import (
     explain_text,
     record_from_dict,
 )
-from .debug import debug_middleware
+from .debug import DEBUG_ROUTES, debug_middleware
+from .goodput import (
+    DEGRADED_RUNGS,
+    STALE_ZERO_RUNGS,
+    GoodputMeter,
+    TickSample,
+    VariantLedger,
+)
 from .profile import (
     JAX_AUDIT,
     UNATTRIBUTED,
@@ -71,6 +82,8 @@ __all__ = [
     "CLAMP_STALE_VETO",
     "CLAMP_TTFT_BACKPRESSURE",
     "Clamp",
+    "DEBUG_ROUTES",
+    "DEGRADED_RUNGS",
     "DecisionBuilder",
     "DecisionInputs",
     "DecisionLog",
@@ -81,6 +94,7 @@ __all__ = [
     "GOODPUT_OVER",
     "GOODPUT_UNDER",
     "GOODPUT_USEFUL",
+    "GoodputMeter",
     "HELD",
     "JAX_AUDIT",
     "JaxAudit",
@@ -89,10 +103,13 @@ __all__ = [
     "ProfileRecord",
     "Profiler",
     "ResidualSampler",
+    "STALE_ZERO_RUNGS",
     "Span",
+    "TickSample",
     "Trace",
     "Tracer",
     "UNATTRIBUTED",
+    "VariantLedger",
     "add_event",
     "build_record",
     "current_span",

@@ -31,9 +31,9 @@ from .trace import _capacity_from_env
 
 DEFAULT_DECISION_BUFFER = 256
 
-# goodput-attribution buckets (emulator/twin.py's fleet meter): where the
-# chip-cost-seconds governed by this decision went. "" = not metered
-# (production records outside a twin run).
+# goodput-attribution buckets (obs/goodput.py's GoodputMeter, driven by
+# both the twin and the live reconciler): where the chip-cost-seconds
+# governed by this decision went. "" = not metered (no meter attached).
 GOODPUT_USEFUL = "useful"
 GOODPUT_UNDER = "under-provisioned"
 GOODPUT_OVER = "over-provisioned"

@@ -225,7 +225,9 @@ class ProfileRecord:
     """One cycle's wall-clock attribution. `buckets` (incl. the
     `unattributed` residual) partitions `wall_ms` exactly; `python_ms`
     is the untraced-Python rollup (stage-exclusive + unattributed) —
-    the headline, because it is the fusion target."""
+    the headline, because it is the fusion target. `stream_scope` > 0
+    marks a streaming micro-cycle (stream/core.py) and carries how many
+    variants the event window covered; 0 = a full polled cycle."""
 
     trace_id: str
     cycle: int
@@ -236,6 +238,7 @@ class ProfileRecord:
     tree: dict
     residual_by_caller: dict[str, float] = field(default_factory=dict)
     jax: dict = field(default_factory=dict)
+    stream_scope: int = 0
 
     @property
     def unattributed_ms(self) -> float:
@@ -262,7 +265,7 @@ class ProfileRecord:
         buckets[UNATTRIBUTED] = unattributed
         stage_ms = sum(v for k, v in buckets.items()
                        if k.startswith("stage:"))
-        return {
+        out = {
             "trace_id": self.trace_id,
             "cycle": self.cycle,
             "ts": round(self.ts, 3),
@@ -278,6 +281,11 @@ class ProfileRecord:
                                    key=lambda kv: -kv[1])},
             "jax": self.jax,
         }
+        # omitted on full cycles so their serialized shape is unchanged
+        # (same idiom as the JAX audit's "sharded" key)
+        if self.stream_scope > 0:
+            out["stream_scope"] = self.stream_scope
+        return out
 
 
 def build_record(trace: Trace, cycle: int, ts: float,
@@ -315,6 +323,9 @@ def build_record(trace: Trace, cycle: int, ts: float,
         tree=_aggregate_tree(trace, shares_by_id),
         residual_by_caller=dict(residual or {}),
         jax=dict(jax_delta or {}),
+        # the reconciler tags scoped micro-cycle roots with how many
+        # variants the event window covered (stream/core.py wakes)
+        stream_scope=int(root.attributes.get("stream_scope", 0) or 0),
     )
 
 
@@ -565,11 +576,14 @@ def render_profile(rec: dict) -> str:
     the bucket ledger, the flamegraph, the JAX self-audit, and the
     sampled residual itemization when present."""
     wall = rec.get("wall_ms", 0.0)
+    scope = rec.get("stream_scope", 0)
     lines = [
         f"cycle {rec.get('cycle')} trace {rec.get('trace_id')} — "
         f"wall {wall:.3f} ms, attributed "
         f"{rec.get('attributed_fraction', 0.0) * 100.0:.1f}% "
-        f"(python orchestration {rec.get('python_ms', 0.0):.3f} ms)",
+        f"(python orchestration {rec.get('python_ms', 0.0):.3f} ms)"
+        + (f" — streaming micro-cycle, scope {scope} variant(s)"
+           if scope else ""),
         "",
         "bucket ledger (exclusive wall; sums to the cycle wall exactly):",
     ]
