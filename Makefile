@@ -67,6 +67,14 @@ bench-shard: ## Mesh-sharded fleet solve: 512/2048/8192-variant forced-full wall
 shard-smoke: ## Abbreviated sharded run (64/128 variants, ~90s): zero retraces over a 10-cycle churn run, exactly one bulk d2h crossing the sharded boundary per cycle
 	$(PY) bench_shard.py --smoke
 
+.PHONY: bench-hier
+bench-hier: ## Hierarchical two-level solve: 8k/16k/32k-variant staggered forced-full walls (sublinear, 32k < 4x 8k) + warm-vs-cold restart-to-first-decision from the arena checkpoint (writes BENCH_hier_r18.json; honors WVA_BENCH_* budget/stagger knobs)
+	$(PY) bench_hier.py
+
+.PHONY: hier-smoke
+hier-smoke: ## Abbreviated hierarchical run (256/512 variants, <10s): stagger never re-solves the whole fleet in one steady cycle, warm restart restores and skips the forced full pass
+	$(PY) bench_hier.py --smoke
+
 .PHONY: bench-adversary
 bench-adversary: ## Adversarial scenario search: seeded (1+lambda) descent minimizing goodput through the real Reconciler, double-run determinism, hardened-vs-unhardened scoring, floor promotion (writes BENCH_adversary_r14.json + tests/fixtures/adversarial_scenarios.json; WVA_ADVERSARY_* knobs)
 	$(PY) bench_adversary.py
@@ -103,7 +111,7 @@ bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO 
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo
 
-LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_goodput_live.py bench_profile.py bench_fuse.py bench_shard.py bench_stream.py bench_streamchaos.py bench_adversary.py __graft_entry__.py
+LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_goodput_live.py bench_profile.py bench_fuse.py bench_shard.py bench_hier.py bench_stream.py bench_streamchaos.py bench_adversary.py __graft_entry__.py
 
 .PHONY: lint
 lint: ## Static analysis gate: ruff+mypy when installed, wvalint always (rule catalog: docs/developer-guide/wvalint.md)

@@ -1,0 +1,324 @@
+"""bench_hier: the hierarchical two-level solve at 8k-32k variants.
+
+BENCH_shard_r13 put the 8192-variant FLAT forced-full analyze+optimize
+pass on the 8-device lane mesh within 2x the committed 512-variant
+cycle wall — but that pass is still one monolithic O(fleet)
+pack-and-solve, and it recurs fleet-wide every WVA_SOLVE_FULL_EVERY
+cycles, and a restarted controller pays it cold. This bench measures
+what the hierarchical engine (WVA_HIER_SOLVE, solver/hierarchy.py)
+does to both walls:
+
+- per-size steady-state FORCED-FULL walls with two-level ON at
+  8192 / 16384 / 32768 variants: the fleet is sharded into
+  pool-connected super-shards whose forced-full phases are
+  hash-staggered, so the worst steady cycle re-solves only the shards
+  due that cycle — the headline claim is SUBLINEAR growth, the
+  32k worst-cycle wall under 4x the 8k worst-cycle wall (a 4x wider
+  fleet for less than 4x the wall; the flat path's forced-full wall
+  at the same sizes is recorded alongside for scale);
+- restart-to-first-decision: a controller restarted against a warm
+  arena checkpoint (WVA_ARENA_CHECKPOINT) lands its first
+  analyze+optimize decision in under one reconcile cycle interval
+  (DEFAULT_INTERVAL_SECONDS), skipping the cold O(fleet) all-forced
+  pass whose wall is recorded next to it.
+
+Timing claims retry on the WVA_BENCH_* stagger (bench.py
+resolve_budget / WVA_BENCH_RETRY_INTERVAL_S) so one noisy co-tenant
+burst doesn't fail the run. Writes BENCH_hier_r18.json;
+tests/test_perf_claims.py asserts the committed artifact clears the
+claims and that docs/observability.md quotes it. `--smoke`
+(`make hier-smoke`, tier-1 via tests/test_hier.py) runs small and
+only asserts the invariants (stagger never re-solves the whole fleet
+in one steady cycle; the warm restart restores and solves no lanes on
+an unchanged fleet).
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("LOG_LEVEL", "error")
+# the sharded fleet pipeline exists on the batched XLA path only
+os.environ.setdefault("WVA_NATIVE_KERNEL", "false")
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+from workload_variant_autoscaler_tpu.utils.platform import force_cpu  # noqa: E402
+
+MESH_DEVICES = 8
+force_cpu(n_devices=MESH_DEVICES)
+
+from bench import resolve_budget  # noqa: E402
+from bench_shard import fleet_spec  # noqa: E402
+
+OUT = "BENCH_hier_r18.json"
+SIZES = (8192, 16384, 32768)
+SMOKE_SIZES = (256, 512)
+# one reconcile cycle (controller/reconciler.py DEFAULT_INTERVAL_SECONDS):
+# the restart claim's budget — a warm restart must decide within it
+CYCLE_INTERVAL_S = 60.0
+FULL_EVERY = 16
+# sized so shard count (ceil(n / target)) never exceeds FULL_EVERY at
+# the largest fleet: the hash-offset phases are then distinct mod
+# FULL_EVERY and AT MOST ONE super-shard pays forced-full per cycle —
+# per-cycle forced work is bounded by SHARD_TARGET lanes, constant in
+# fleet size, which is what makes the forced wall sublinear. 4096
+# (512 lanes/device on the 8-device mesh) keeps each shard large
+# enough that the vectorized per-shard solve amortizes its dispatch
+SHARD_TARGET = 4096
+EPSILON = 0.05
+
+
+def _cycle(spec, engine, fm) -> tuple[float, object]:
+    """One analyze+optimize pass through the engine; wall ms + stats."""
+    from workload_variant_autoscaler_tpu.models import System
+    from workload_variant_autoscaler_tpu.solver import Manager, Optimizer
+
+    system = System()
+    opt_spec = system.set_from_spec(spec)
+    # drain the garbage of the UNTIMED fleet rebuild above before the
+    # timer starts, and keep the collector off inside it: at 32k
+    # variants a gen-2 pass over the freshly built System costs a
+    # couple hundred ms and lands at random cycles, which would charge
+    # rebuild garbage to whichever solve happens to trigger it
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        stats = engine.calculate(system, backend="batched", fleet_mesh=fm,
+                                 optimizer_spec=opt_spec)
+        Manager(system,
+                Optimizer(opt_spec)).optimize(warm=engine.warm_start())
+        wall = (time.perf_counter() - t0) * 1000.0
+    finally:
+        gc.enable()
+    n = len(system.generate_solution().allocations)
+    assert n == len(spec.servers), n
+    engine.finish_cycle(system)
+    return wall, stats
+
+
+def hier_forced_walls(n: int, shard_target: int = SHARD_TARGET) -> dict:
+    """Steady-state walls over one full FULL_EVERY stagger window with
+    two-level ON: the fleet never changes, so every lane a cycle solves
+    is a staggered forced-full re-solve of the shards whose phase came
+    due. The headline number is the WORST cycle in the window."""
+    from workload_variant_autoscaler_tpu.parallel import fleet_mesh
+    from workload_variant_autoscaler_tpu.solver import HierarchicalSolveEngine
+
+    fm = fleet_mesh(MESH_DEVICES)
+    engine = HierarchicalSolveEngine(epsilon=EPSILON,
+                                     full_every=FULL_EVERY,
+                                     shard_target=shard_target,
+                                     min_variants=0)
+    spec = fleet_spec(n)
+    first_ms, stats = _cycle(spec, engine, fm)      # all-forced + compile
+    shards = stats.shards
+    walls, forced_lanes = [], []
+    for _ in range(FULL_EVERY):
+        wall, stats = _cycle(spec, engine, fm)
+        walls.append(wall)
+        forced_lanes.append(stats.modes.get("full", 0))
+    assert max(forced_lanes) < n, \
+        f"stagger failed: a steady cycle re-solved the whole fleet ({n})"
+    assert sum(forced_lanes) == n, \
+        f"every lane must come due exactly once per window: {forced_lanes}"
+    return {
+        "variants": n,
+        "shards": shards,
+        "full_every": FULL_EVERY,
+        "first_full_pass_ms": round(first_ms, 1),
+        "forced_wall_ms_max": round(max(walls), 1),
+        "forced_lanes_max_cycle": max(forced_lanes),
+        "window_walls_ms": [round(w, 1) for w in walls],
+    }
+
+
+def flat_forced_walls(n: int) -> dict:
+    """The r13 flat comparator: one monolithic forced-full
+    analyze+optimize pass (full_every=1, every lane, every cycle)."""
+    from workload_variant_autoscaler_tpu.parallel import fleet_mesh
+    from workload_variant_autoscaler_tpu.solver import IncrementalSolveEngine
+
+    fm = fleet_mesh(MESH_DEVICES)
+    engine = IncrementalSolveEngine(epsilon=0.0, full_every=1)
+    spec = fleet_spec(n)
+    _cycle(spec, engine, fm)                        # compile
+    walls = [_cycle(spec, engine, fm)[0] for _ in range(2)]
+    return {"variants": n, "forced_full_ms_min": round(min(walls), 1)}
+
+
+def _mk_engine(shard_target: int, ckpt=None):
+    from workload_variant_autoscaler_tpu.solver import HierarchicalSolveEngine
+
+    return HierarchicalSolveEngine(epsilon=EPSILON,
+                                   full_every=FULL_EVERY,
+                                   shard_target=shard_target,
+                                   min_variants=0,
+                                   checkpoint_path=ckpt,
+                                   checkpoint_every=1)
+
+
+def restart_probe(kind: str, n: int, shard_target: int,
+                  ckpt: str) -> None:
+    """Runs INSIDE a fresh subprocess: one restarted controller's path
+    to its first decision. `cold` pays the all-forced O(fleet) pass
+    (plus compile — a real restart has no XLA cache); `warm` restores
+    the arena checkpoint and lands in the incremental steady state."""
+    from workload_variant_autoscaler_tpu.parallel import fleet_mesh
+
+    fm = fleet_mesh(MESH_DEVICES)
+    engine = _mk_engine(shard_target, ckpt if kind == "warm" else None)
+    if kind == "warm":
+        assert engine.ckpt_events["restore"] == 1, engine.ckpt_events
+    _, stats = _cycle(fleet_spec(n), engine, fm)
+    if kind == "warm":
+        assert stats.restored, stats
+        assert stats.lanes_solved < n, \
+            f"warm restart paid the cold all-forced pass ({stats})"
+    print(json.dumps({"kind": kind, "lanes_solved": stats.lanes_solved,
+                      "restored": stats.restored}), flush=True)
+
+
+def restart_leg(n: int, shard_target: int = SHARD_TARGET,
+                in_process: bool = False) -> dict:
+    """Cold vs warm restart-to-first-decision, each measured as a FRESH
+    PROCESS (interpreter + jax + compile all included — what a real
+    controller restart pays). Cold: the all-forced O(fleet) pass.
+    Warm: restore the arena checkpoint, adopt signatures + slabs, and
+    decide incrementally — the forced full pass never runs.
+    `in_process` (smoke) skips the subprocesses and times engine
+    construction + first cycle only."""
+    import subprocess
+
+    from workload_variant_autoscaler_tpu.parallel import fleet_mesh
+
+    fm = fleet_mesh(MESH_DEVICES)
+    spec = fleet_spec(n)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "arena.ckpt")
+        engine = _mk_engine(shard_target, path)
+        for _ in range(3):                          # settle + save
+            _cycle(spec, engine, fm)
+
+        if in_process:
+            t0 = time.perf_counter()
+            warm = _mk_engine(shard_target, path)
+            _, stats = _cycle(spec, warm, fm)
+            warm_ms = (time.perf_counter() - t0) * 1000.0
+            assert warm.ckpt_events["restore"] == 1, warm.ckpt_events
+            assert stats.restored, stats
+            assert stats.lanes_solved < n, stats
+            t0 = time.perf_counter()
+            _cycle(spec, _mk_engine(shard_target), fm)
+            cold_ms = (time.perf_counter() - t0) * 1000.0
+            probes = {"warm": {"lanes_solved": stats.lanes_solved,
+                               "restored": True}}
+        else:
+            def probe(kind: str) -> tuple[float, dict]:
+                t0 = time.perf_counter()
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--restart-probe", kind, str(n), str(shard_target),
+                     path],
+                    capture_output=True, text=True,
+                    cwd=os.path.dirname(os.path.abspath(__file__)))
+                wall = (time.perf_counter() - t0) * 1000.0
+                assert r.returncode == 0, \
+                    f"{kind} probe failed:\n{r.stdout}\n{r.stderr}"
+                return wall, json.loads(r.stdout.strip().splitlines()[-1])
+
+            warm_ms, warm_stats = probe("warm")
+            cold_ms, _cold_stats = probe("cold")
+            probes = {"warm": warm_stats}
+
+    return {
+        "variants": n,
+        "measured": "in-process" if in_process else "fresh subprocess",
+        "cold_first_decision_ms": round(cold_ms, 1),
+        "warm_restart_to_first_decision_ms": round(warm_ms, 1),
+        "warm_lanes_solved": probes["warm"]["lanes_solved"],
+        "cycle_interval_s": CYCLE_INTERVAL_S,
+    }
+
+
+def measure(sizes, shard_target: int = SHARD_TARGET) -> dict:
+    return {str(n): {"hier": hier_forced_walls(n, shard_target),
+                     "flat": flat_forced_walls(n)}
+            for n in sizes}
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    if argv[:1] == ["--restart-probe"]:
+        kind, n, shard_target, ckpt = argv[1:5]
+        restart_probe(kind, int(n), int(shard_target), ckpt)
+        return
+    smoke = "--smoke" in argv
+
+    if smoke:
+        # a 64-variant shard target keeps several shards in play at
+        # smoke sizes so the stagger invariants stay meaningful;
+        # in-process restart keeps the smoke under its 10 s budget
+        walls = measure(SMOKE_SIZES, shard_target=64)
+        restart = restart_leg(SMOKE_SIZES[1], shard_target=64,
+                              in_process=True)
+        print(json.dumps({
+            "bench": "hier-smoke", "sizes": list(SMOKE_SIZES),
+            "mesh_devices": MESH_DEVICES,
+            "walls": walls,
+            "restart": restart,
+        }), flush=True)
+        return
+
+    # timing claims retry on the bench stagger: a co-tenant burst on
+    # this box is transient, a real regression is not
+    budget = resolve_budget(os.environ)
+    retry_s = float(os.environ.get("WVA_BENCH_RETRY_INTERVAL_S", "120"))
+    deadline = time.monotonic() + budget["window"]
+    attempts = 0
+    while True:
+        attempts += 1
+        walls = measure(SIZES)
+        restart = restart_leg(SIZES[-1])
+        wall_8k = walls["8192"]["hier"]["forced_wall_ms_max"]
+        wall_32k = walls["32768"]["hier"]["forced_wall_ms_max"]
+        ratio = wall_32k / wall_8k
+        warm_ok = (restart["warm_restart_to_first_decision_ms"]
+                   < CYCLE_INTERVAL_S * 1000.0)
+        if (ratio < 4.0 and warm_ok) \
+                or time.monotonic() + retry_s >= deadline:
+            break
+        time.sleep(retry_s)
+
+    out = {
+        "metric": "hier_forced_wall_ms_32768",
+        "bench": "hier",
+        "value": wall_32k,
+        "unit": "ms analyze+optimize, worst steady cycle in one "
+                f"{FULL_EVERY}-cycle stagger window, 32768 variants, "
+                f"{MESH_DEVICES}-device host mesh",
+        "mesh_devices": MESH_DEVICES,
+        "shard_target": SHARD_TARGET,
+        "full_every": FULL_EVERY,
+        "forced_wall_32k_vs_8k": round(ratio, 3),
+        "attempts": attempts,
+        "walls": walls,
+        "restart": restart,
+    }
+    assert out["forced_wall_32k_vs_8k"] < 4.0, out
+    assert out["restart"]["warm_restart_to_first_decision_ms"] \
+        < CYCLE_INTERVAL_S * 1000.0, out
+    with open(OUT, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,461 @@
+"""Hierarchical two-level solve (WVA_HIER_SOLVE) + warm cold-start.
+
+The load-bearing properties, pinned here:
+
+- the shard layout is a SCHEDULING knob, never a result knob: the
+  hierarchical engine publishes identical allocations to the flat
+  from-scratch solve through 210 cycles of randomized fleet churn
+  (grow/shrink, epsilon-straddling load jitter, capacity changes,
+  degradation rungs) — both optimizer parametrizations;
+- forced-full cycles are hash-staggered per super-shard: a steady
+  fleet never re-solves everything on one cycle, and every lane comes
+  due exactly once per WVA_SOLVE_FULL_EVERY window;
+- the arena checkpoint restores an engine that decides exactly what a
+  never-restarted engine decides, and every corruption path (torn
+  file, CRC flip, version skew, stale age, config mismatch) falls
+  back to the cold full pass — never a crash, never a partial
+  restore;
+- `WVA_HIER_SOLVE=off` hands the reconciler the plain
+  IncrementalSolveEngine class, byte-for-byte the r13 flat path;
+- a `ShardedFleetArena` that shrinks mid-churn resets stale lanes to
+  the benign-invalid fills and keeps the solve-lane ledger counting
+  real lanes only.
+"""
+
+from __future__ import annotations
+
+import types
+
+import numpy as np
+import pytest
+
+import helpers
+from test_incremental_solve import (
+    ChurnDriver,
+    assert_solutions_equal,
+    make_spec,
+    run_cycle,
+)
+from test_shard import ROWS, _fields, assert_bit_equal
+
+from workload_variant_autoscaler_tpu.models import System
+from workload_variant_autoscaler_tpu.ops.arena import ShardedFleetArena
+from workload_variant_autoscaler_tpu.parallel import fleet_mesh
+from workload_variant_autoscaler_tpu.solver import (
+    HierarchicalSolveEngine,
+    IncrementalSolveEngine,
+    Manager,
+    Optimizer,
+)
+from workload_variant_autoscaler_tpu.stream.checkpoint import (
+    ARENA_CHECKPOINT_MAGIC,
+    ARENA_CHECKPOINT_VERSION,
+    CheckpointError,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+EPS = 0.05
+
+
+def hier_engine(**kw):
+    kw.setdefault("epsilon", EPS)
+    kw.setdefault("full_every", 7)
+    kw.setdefault("shard_target", 4)
+    kw.setdefault("min_variants", 1)
+    return HierarchicalSolveEngine(**kw)
+
+
+# ---------------------------------------------------------------------------
+# equivalence: the hierarchical solve is invisible in the decisions
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("unlimited,policy",
+                         [(True, "None"), (False, "RoundRobin")])
+def test_randomized_churn_equivalence(unlimited, policy):
+    """210 cycles of randomized churn: the hierarchical engine (small
+    shards, staggered forced-full) publishes exactly the flat
+    from-scratch solve's decisions, both optimizer parametrizations."""
+    driver = ChurnDriver(seed=0x41E5, epsilon=EPS)
+    engine = hier_engine()
+    cached_cycles = forced_lanes = 0
+    for cycle in range(210):
+        driver.churn()
+        servers = driver.servers()
+        rung = "stale-cache" if driver.rungs else "healthy"
+        spec = make_spec(servers, driver.capacity, unlimited, policy)
+        sol_h, stats = run_cycle(engine=engine, spec=spec,
+                                 rungs=dict(driver.rungs), cycle_rung=rung)
+        scratch = IncrementalSolveEngine(epsilon=EPS, full_every=1)
+        sol_ref, _ = run_cycle(engine=scratch, spec=spec,
+                               rungs=dict(driver.rungs), cycle_rung=rung)
+        assert_solutions_equal(sol_h, sol_ref, cycle)
+        if stats.lanes_skipped:
+            cached_cycles += 1
+        forced_lanes += stats.modes.get("full", 0)
+    # the run must actually exercise the two-level machinery, not
+    # degenerate into all-full or all-cached cycles
+    assert cached_cycles > 100, cached_cycles
+    assert forced_lanes > 50, forced_lanes
+
+
+# ---------------------------------------------------------------------------
+# staggered forced-full phases
+# ---------------------------------------------------------------------------
+
+def test_stagger_never_resolves_whole_fleet_in_one_cycle():
+    """Steady fleet, shards >> full_every slots: every lane comes due
+    exactly once per window, and the max lanes any single cycle solves
+    is bounded by the stagger — never the whole fleet at once."""
+    full_every = 4
+    servers = [helpers.server_spec(name=f"v{i}:ns", model="m-a",
+                                   arrival_rpm=300.0 + 40.0 * i)
+               for i in range(24)]
+    spec = make_spec(servers, {"v5e": 4000})
+    engine = hier_engine(full_every=full_every, shard_target=2)
+    _, stats = run_cycle(spec=spec, engine=engine)     # all-forced cycle
+    n_shards = stats.shards
+    assert n_shards > full_every
+    per_cycle = []
+    for _ in range(full_every):
+        _, stats = run_cycle(spec=spec, engine=engine)
+        per_cycle.append(stats.modes.get("full", 0))
+    assert sum(per_cycle) == len(servers), per_cycle
+    assert max(per_cycle) < len(servers), per_cycle
+    # phase spreading: no cycle solves more than its share of shards,
+    # ceil(n_shards / full_every) shards' worth of lanes
+    worst_shards = -(-n_shards // full_every)
+    assert max(per_cycle) <= worst_shards * (
+        -(-len(servers) // n_shards) + 2), (per_cycle, n_shards)
+
+
+def test_stagger_phases_cover_all_residues():
+    phases = {HierarchicalSolveEngine._phase(sid, 16) for sid in range(64)}
+    assert phases == set(range(16))
+
+
+# ---------------------------------------------------------------------------
+# warm cold-start: the arena checkpoint
+# ---------------------------------------------------------------------------
+
+def drive(engine, driver, cycles, start=0):
+    sols = []
+    for cycle in range(start, start + cycles):
+        driver.churn()
+        rung = "stale-cache" if driver.rungs else "healthy"
+        spec = make_spec(driver.servers(), driver.capacity, True, "None")
+        sol, stats = run_cycle(spec=spec, engine=engine,
+                               rungs=dict(driver.rungs), cycle_rung=rung)
+        sols.append((sol, stats))
+    return sols
+
+
+class TestWarmColdStart:
+    def test_restored_equals_never_restarted(self, tmp_path):
+        """A restarted engine restored from its checkpoint decides
+        exactly what the engine that never went away decides — and the
+        restore cycle is incremental, not the cold all-forced pass."""
+        path = str(tmp_path / "arena.ckpt")
+        da, db = ChurnDriver(seed=7, epsilon=EPS), ChurnDriver(seed=7,
+                                                               epsilon=EPS)
+        a = hier_engine(checkpoint_path=path, checkpoint_every=1)
+        b = hier_engine()
+        for (sa, _), (sb, _) in zip(drive(a, da, 12), drive(b, db, 12)):
+            assert_solutions_equal(sa, sb, 0)
+
+        a2 = hier_engine(checkpoint_path=path, checkpoint_every=1)
+        assert a2.ckpt_events["restore"] == 1, a2.ckpt_events
+        ra, rb = drive(a2, da, 14), drive(b, db, 14)
+        _, first = ra[0]
+        assert first.restored and not first.full
+        for cycle, ((sa, _), (sb, _)) in enumerate(zip(ra, rb)):
+            assert_solutions_equal(sa, sb, cycle)
+
+    def test_restore_skips_forced_full_on_lane_mesh(self, tmp_path):
+        """On the 8-device lane mesh the restored engine pre-stages the
+        saved slabs: a post-restore pack never re-uploads a whole slab
+        (scatter/no-op only), and an unchanged fleet solves no lanes."""
+        path = str(tmp_path / "arena.ckpt")
+        fm = fleet_mesh(8)
+        servers = [helpers.server_spec(name=f"v{i}:ns", model="m-a",
+                                       arrival_rpm=300.0 + 40.0 * i)
+                   for i in range(12)]
+        spec = make_spec(servers, {"v5e": 4000})
+
+        def cycle(engine):
+            system = System()
+            opt_spec = system.set_from_spec(spec)
+            stats = engine.calculate(system, backend="batched",
+                                     fleet_mesh=fm,
+                                     optimizer_spec=opt_spec)
+            Manager(system, Optimizer(opt_spec)).optimize(
+                warm=engine.warm_start())
+            sol = system.generate_solution()
+            engine.finish_cycle(system)
+            return sol, stats
+
+        a = hier_engine(checkpoint_path=path, checkpoint_every=1,
+                        full_every=32)
+        sol_before, _ = cycle(a)
+        a2 = hier_engine(checkpoint_path=path, checkpoint_every=1,
+                         full_every=32)
+        assert a2.ckpt_events["restore"] == 1
+        sol_after, stats = cycle(a2)
+        assert stats.restored
+        assert stats.lanes_solved == 0, stats
+        for arena in a2._shard_arenas.values():
+            assert arena.full_uploads <= 1, arena.full_uploads
+        assert_solutions_equal(sol_after, sol_before, 0)
+
+    def test_checkpoint_saves_respect_cadence(self, tmp_path):
+        path = str(tmp_path / "arena.ckpt")
+        engine = hier_engine(checkpoint_path=path, checkpoint_every=4)
+        drive(engine, ChurnDriver(seed=3, epsilon=EPS), 9)
+        # cycles 4 and 8 save; 1-3/5-7/9 don't
+        assert engine.ckpt_events["save"] == 2, engine.ckpt_events
+
+
+class TestCheckpointCorruption:
+    """Torn / CRC / version-skew / stale-age arena checkpoints each
+    fall back to the cold full pass: no crash, no partial restore."""
+
+    @pytest.fixture()
+    def saved(self, tmp_path):
+        path = str(tmp_path / "arena.ckpt")
+        engine = hier_engine(checkpoint_path=path, checkpoint_every=1)
+        drive(engine, ChurnDriver(seed=11, epsilon=EPS), 6)
+        return path
+
+    def _assert_cold(self, engine, event):
+        assert engine.ckpt_events[event] == 1, engine.ckpt_events
+        assert not engine._alloc_cache and not engine._restored_digests
+        assert not engine._restored_arena
+        _, stats = drive(engine,
+                         ChurnDriver(seed=11, epsilon=EPS), 1)[0]
+        assert stats.full and not stats.restored
+
+    def test_torn_file(self, saved):
+        raw = open(saved, "rb").read()
+        open(saved, "wb").write(raw[: len(raw) // 2])
+        self._assert_cold(hier_engine(checkpoint_path=saved),
+                          "discard_corrupt")
+
+    def test_crc_flip(self, saved):
+        raw = bytearray(open(saved, "rb").read())
+        raw[-5] ^= 0xFF
+        open(saved, "wb").write(bytes(raw))
+        self._assert_cold(hier_engine(checkpoint_path=saved),
+                          "discard_corrupt")
+
+    def test_version_skew(self, saved):
+        payload = load_checkpoint(saved, magic=ARENA_CHECKPOINT_MAGIC,
+                                  version=ARENA_CHECKPOINT_VERSION)
+        save_checkpoint(saved, payload, magic=ARENA_CHECKPOINT_MAGIC,
+                        version=ARENA_CHECKPOINT_VERSION + 1)
+        self._assert_cold(hier_engine(checkpoint_path=saved),
+                          "discard_corrupt")
+
+    def test_stale_age(self, saved):
+        self._assert_cold(
+            hier_engine(checkpoint_path=saved,
+                        checkpoint_max_age_s=1e-6),
+            "discard_stale")
+
+    def test_config_mismatch(self, saved):
+        self._assert_cold(
+            hier_engine(checkpoint_path=saved, epsilon=0.01),
+            "discard_config")
+
+    def test_missing_file_is_silent(self, tmp_path):
+        engine = hier_engine(
+            checkpoint_path=str(tmp_path / "never-written.ckpt"))
+        assert not any(engine.ckpt_events.values())
+
+    def test_mangled_body_fields(self, saved):
+        """A structurally valid checkpoint with a mangled body is a
+        corrupt checkpoint, not a crash or a partial restore."""
+        payload = load_checkpoint(saved, magic=ARENA_CHECKPOINT_MAGIC,
+                                  version=ARENA_CHECKPOINT_VERSION)
+        payload["lanes"] = "not-a-dict"
+        save_checkpoint(saved, payload, magic=ARENA_CHECKPOINT_MAGIC,
+                        version=ARENA_CHECKPOINT_VERSION)
+        self._assert_cold(hier_engine(checkpoint_path=saved),
+                          "discard_corrupt")
+
+    def test_stream_and_arena_magics_are_disjoint(self, tmp_path):
+        """The arena checkpoint reuses stream/checkpoint.py but under
+        its own magic: neither file parses as the other kind."""
+        path = str(tmp_path / "x.ckpt")
+        save_checkpoint(path, {"taken_at": 1.0})
+        with pytest.raises(CheckpointError):
+            load_checkpoint(path, magic=ARENA_CHECKPOINT_MAGIC,
+                            version=ARENA_CHECKPOINT_VERSION)
+        save_checkpoint(path, {"taken_at": 1.0},
+                        magic=ARENA_CHECKPOINT_MAGIC,
+                        version=ARENA_CHECKPOINT_VERSION)
+        with pytest.raises(CheckpointError):
+            load_checkpoint(path)
+
+
+# ---------------------------------------------------------------------------
+# the reconciler's engine selection
+# ---------------------------------------------------------------------------
+
+class TestEngineSelection:
+    def _reconciler(self):
+        from workload_variant_autoscaler_tpu.controller.reconciler import (
+            Reconciler,
+        )
+        r = Reconciler.__new__(Reconciler)
+        r._solve_engine_obj = None
+        r.state = types.SimpleNamespace(last_operator_cm={})
+        return r
+
+    def test_off_restores_the_flat_engine_class(self):
+        """WVA_HIER_SOLVE=off must hand back the EXACT r13 class — not
+        a subclass with min_variants pinned high — so the flat code
+        path runs byte-for-byte."""
+        r = self._reconciler()
+        engine = r._solve_engine({"WVA_HIER_SOLVE": "off"})
+        assert type(engine) is IncrementalSolveEngine
+        # and flipping back rebuilds the hierarchical engine
+        engine2 = r._solve_engine({"WVA_HIER_SOLVE": "auto"})
+        assert type(engine2) is HierarchicalSolveEngine
+
+    def test_auto_defaults_and_knob_plumbing(self):
+        r = self._reconciler()
+        e = r._solve_engine({})
+        assert type(e) is HierarchicalSolveEngine
+        assert e.min_variants == 2048 and e.shard_target == 1024
+        assert e.checkpoint_path is None
+        assert r._solve_engine({}) is e          # stable across cycles
+        e2 = r._solve_engine({
+            "WVA_HIER_SOLVE": "on",
+            "WVA_HIER_SHARD_VARIANTS": "256",
+            "WVA_ARENA_CHECKPOINT": "/tmp/wva-arena-test.ckpt",
+            "WVA_ARENA_CHECKPOINT_EVERY": "4",
+            "WVA_ARENA_CHECKPOINT_MAX_AGE_S": "120"})
+        assert e2 is not e
+        assert e2.min_variants == 0 and e2.shard_target == 256
+        assert e2.checkpoint_path == "/tmp/wva-arena-test.ckpt"
+        assert e2.checkpoint_every == 4
+        assert e2.checkpoint_max_age_s == 120.0
+
+    def test_small_fleet_delegates_to_flat_path(self):
+        """Below WVA_HIER_MIN_VARIANTS the engine delegates to the flat
+        parent cycle (shards=0): tiny fleets keep the r13 behavior even
+        in auto mode."""
+        engine = hier_engine(min_variants=1000)
+        driver = ChurnDriver(seed=5, epsilon=EPS)
+        _, stats = drive(engine, driver, 1)[0]
+        assert stats.shards == 0
+        forced = hier_engine(min_variants=0)
+        _, stats = drive(forced, ChurnDriver(seed=5, epsilon=EPS), 1)[0]
+        assert stats.shards > 0
+
+
+# ---------------------------------------------------------------------------
+# ShardedFleetArena shrink
+# ---------------------------------------------------------------------------
+
+class TestArenaShrink:
+    def test_shrink_resets_stale_lanes_to_benign_fills(self):
+        """Packing fewer rows into a resident slab must leave NOTHING
+        of the removed lanes behind: the shrunk pack is bit-identical
+        to a fresh arena packing only the survivors."""
+        mesh = fleet_mesh(8)
+        arena = ShardedFleetArena(mesh)
+        arena.pack(dict(ROWS))                       # 5 lanes resident
+        shrunk_rows = {k: list(v)[:2] for k, v in ROWS.items()}
+        out_shrunk = arena.pack(shrunk_rows)
+
+        fresh = ShardedFleetArena(mesh)
+        out_fresh = fresh.pack(shrunk_rows)
+        for (name, a), (_n, b) in zip(_fields(*out_shrunk),
+                                      _fields(*out_fresh)):
+            assert_bit_equal(a, b, name)
+        valid = np.asarray(out_shrunk[0].valid)
+        assert valid[:2].all() and not valid[2:].any()
+
+    def test_ledger_counts_real_lanes_only_after_shrink(self):
+        """Mid-churn fleet shrink through the engine: the solve-lane
+        ledger tracks the live fleet, never the stale arena rows."""
+        fm = fleet_mesh(8)
+        engine = hier_engine(full_every=0, shard_target=100)
+
+        def cycle(n, bump=0.0):
+            servers = [helpers.server_spec(name=f"v{i}:ns", model="m-a",
+                                           arrival_rpm=300.0 + bump
+                                           + 40.0 * i)
+                       for i in range(n)]
+            spec = make_spec(servers, {"v5e": 4000})
+            system = System()
+            opt_spec = system.set_from_spec(spec)
+            engine.calculate(system, backend="batched", fleet_mesh=fm,
+                             optimizer_spec=opt_spec)
+            Manager(system, Optimizer(opt_spec)).optimize(
+                warm=engine.warm_start())
+            sol = system.generate_solution()
+            engine.finish_cycle(system)
+            return system, sol
+
+        def flat_lanes(n):
+            servers = [helpers.server_spec(name=f"v{i}:ns", model="m-a",
+                                           arrival_rpm=300.0 + 40.0 * i)
+                       for i in range(n)]
+            system = System()
+            system.set_from_spec(make_spec(servers, {"v5e": 4000}))
+            system.calculate(backend="batched")
+            return system.last_solve_lanes
+
+        system, _ = cycle(9)
+        assert system.last_solve_lanes == flat_lanes(9)
+        # fleet shrinks 9 -> 3 and the survivors' loads churn past
+        # epsilon, so all three re-solve: the ledger must count exactly
+        # the live fleet's lanes, never the six stale arena rows
+        system, sol = cycle(3, bump=1000.0)
+        assert system.last_solve_lanes == flat_lanes(3), \
+            "stale arena rows leaked into the solve-lane ledger"
+        assert len(sol.allocations) == 3
+        # a scratch engine on the shrunk fleet agrees exactly
+        scratch = IncrementalSolveEngine(epsilon=EPS, full_every=1)
+        servers = [helpers.server_spec(name=f"v{i}:ns", model="m-a",
+                                       arrival_rpm=1300.0 + 40.0 * i)
+                   for i in range(3)]
+        ref, _ = run_cycle(spec=make_spec(servers, {"v5e": 4000}),
+                           engine=scratch)
+        assert_solutions_equal(sol, ref, 0)
+
+
+# ---------------------------------------------------------------------------
+# the smoke bench: tier-1 wiring for `make hier-smoke`
+# ---------------------------------------------------------------------------
+
+def test_hier_smoke_bench_passes():
+    """`make hier-smoke` in-suite: the abbreviated hierarchical run
+    (bench_hier.py --smoke) asserts the stagger invariants (no steady
+    cycle re-solves the whole fleet; every lane comes due once per
+    window) and the warm-restart invariants (restore event, no
+    all-forced pass). Run as a subprocess: the bench pins its own env
+    (forced device count, x64, XLA backend)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench_hier.py"), "--smoke"],
+        capture_output=True, text=True, cwd=repo, timeout=420)
+    assert r.returncode == 0, f"hier smoke failed:\n{r.stdout}\n{r.stderr}"
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["bench"] == "hier-smoke"
+    assert line["mesh_devices"] == 8
+    for size, walls in line["walls"].items():
+        hier = walls["hier"]
+        assert hier["forced_lanes_max_cycle"] < int(size)
+        assert hier["shards"] > 1
+    restart = line["restart"]
+    assert restart["warm_lanes_solved"] < restart["variants"]
+    assert restart["warm_restart_to_first_decision_ms"] \
+        < restart["cycle_interval_s"] * 1000.0

@@ -143,8 +143,8 @@ class TestCallArity:
     ["workload_variant_autoscaler_tpu", "tools", "tests", "bench.py",
      "bench_loop.py", "bench_collect.py", "bench_goodput.py",
      "bench_goodput_live.py", "bench_profile.py", "bench_fuse.py",
-     "bench_stream.py", "bench_shard.py", "bench_adversary.py",
-     "__graft_entry__.py"],
+     "bench_stream.py", "bench_shard.py", "bench_hier.py",
+     "bench_adversary.py", "__graft_entry__.py"],
 ])
 def test_package_lints_clean(paths):
     """The gate itself: the shipped source must lint clean — every rule
@@ -1120,7 +1120,8 @@ class TestKnobParity:
                     "bench.py", "bench_loop.py", "bench_collect.py",
                     "bench_goodput.py", "bench_goodput_live.py",
                     "bench_profile.py",
-                    "bench_shard.py", "bench_adversary.py"):
+                    "bench_shard.py", "bench_hier.py",
+                    "bench_adversary.py"):
             for fp in wvalint.iter_py_files([os.path.join(REPO, sub)]):
                 files.append(fp)
                 with open(fp, encoding="utf-8") as f:

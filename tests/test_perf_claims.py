@@ -585,3 +585,59 @@ def test_adversary_claims_match_artifact():
         "robustness.md's hardened goodput drifted from the artifact"
     assert (f"{art['generations']} generations × "
             f"{art['population']} candidates") in flat
+
+
+def test_hier_claims_match_artifact():
+    """Round-18 hierarchical two-level solve: the committed
+    BENCH_hier_r18.json must (a) justify the sublinear headline — the
+    32768-variant staggered forced-full wall under 4x the 8192-variant
+    wall for a 4x larger fleet, (b) hold the stagger invariant at every
+    size — no steady cycle re-solves the whole fleet, (c) justify the
+    warm cold-start headline — restart-to-first-decision from a warm
+    arena checkpoint inside one reconcile interval, measured as a fresh
+    subprocess (interpreter + jax import + compile, what a real
+    controller restart pays) alongside the cold all-forced pass, and
+    (d) match docs/observability.md."""
+    art = _artifact("BENCH_hier_r18.json")
+    assert art["bench"] == "hier"
+    assert art["mesh_devices"] == 8
+    hier_32k = art["walls"]["32768"]["hier"]
+    assert hier_32k["variants"] == 32768
+    assert art["value"] == hier_32k["forced_wall_ms_max"]
+    wall_8k = art["walls"]["8192"]["hier"]["forced_wall_ms_max"]
+    assert art["forced_wall_32k_vs_8k"] == pytest.approx(
+        art["value"] / wall_8k, abs=0.01)
+    assert art["forced_wall_32k_vs_8k"] < 4.0, \
+        "artifact no longer justifies the sublinear forced-full headline"
+    for size, walls in art["walls"].items():
+        hier = walls["hier"]
+        assert hier["variants"] == int(size)
+        assert hier["shards"] > 1
+        assert hier["full_every"] == art["full_every"]
+        # the stagger invariant: the worst steady cycle re-solved one
+        # super-shard's lanes, never the whole fleet
+        assert 0 < hier["forced_lanes_max_cycle"] < int(size)
+        assert hier["forced_wall_ms_max"] == max(hier["window_walls_ms"])
+        assert len(hier["window_walls_ms"]) == art["full_every"]
+        assert walls["flat"]["variants"] == int(size)
+    restart = art["restart"]
+    assert restart["variants"] == 32768
+    assert restart["measured"] == "fresh subprocess"
+    # the warm probe restored every lane from the checkpoint: no lane
+    # was re-solved before the first decision
+    assert restart["warm_lanes_solved"] == 0
+    budget_ms = restart["cycle_interval_s"] * 1000.0
+    assert restart["warm_restart_to_first_decision_ms"] < budget_ms, \
+        "artifact no longer justifies the one-cycle warm-restart headline"
+    # (d) doc parity: observability.md quotes this artifact
+    doc = (REPO / "docs" / "observability.md").read_text()
+    flat = " ".join(doc.split())
+    assert f"**{art['value']:.1f} ms**" in flat, \
+        "observability.md's 32k forced wall drifted from the artifact"
+    assert f"**{art['forced_wall_32k_vs_8k']}×**" in flat, \
+        "observability.md's 32k-vs-8k ratio drifted from the artifact"
+    assert f"**{restart['warm_restart_to_first_decision_ms']:.1f} ms**" \
+        in flat, \
+        "observability.md's warm-restart claim drifted from the artifact"
+    assert f"{restart['cold_first_decision_ms']:.1f} ms" in flat
+    assert f"{restart['cycle_interval_s']:.0f} s" in flat

@@ -1999,6 +1999,10 @@ _READBACK_DIRS = (
     os.path.join("workload_variant_autoscaler_tpu", "models"),
     os.path.join("workload_variant_autoscaler_tpu", "ops"),
     os.path.join("workload_variant_autoscaler_tpu", "parallel"),
+    # the solver gained device work in r13 (vectorized greedy sweep)
+    # and r18 (hierarchical shard arenas / checkpoint slab staging):
+    # its readbacks answer to the same audit discipline
+    os.path.join("workload_variant_autoscaler_tpu", "solver"),
 )
 _AUDIT_CALLS = ("note_transfer", "note_readback")
 

@@ -67,9 +67,16 @@ from ..obs import (
 from ..obs import trace as obs_trace
 from ..solver import (
     SOLVE_FULL,
+    HierarchicalSolveEngine,
     IncrementalSolveEngine,
     Manager,
     Optimizer,
+)
+from ..solver.hierarchy import (
+    DEFAULT_CHECKPOINT_EVERY,
+    DEFAULT_CHECKPOINT_MAX_AGE_S,
+    DEFAULT_MIN_VARIANTS,
+    DEFAULT_SHARD_TARGET,
 )
 from ..solver.incremental import (
     DEFAULT_EPSILON,
@@ -397,11 +404,25 @@ class Reconciler:
         raw = self._solve_knob("WVA_INCREMENTAL_SOLVE", operator_cm)
         return raw.strip().lower() not in ("off", "false", "0", "disabled")
 
+    def _hier_solve_mode(self, operator_cm=None) -> str:
+        """WVA_HIER_SOLVE: the hierarchical two-level engine
+        (solver/hierarchy.py). `auto` (default) uses it with the
+        WVA_HIER_MIN_VARIANTS small-fleet delegate floor, `on` forces
+        the two-level path at any fleet size, `off` restores the flat
+        engine byte-for-byte."""
+        raw = self._solve_knob("WVA_HIER_SOLVE",
+                               operator_cm).strip().lower()
+        if raw in ("off", "false", "0", "disabled"):
+            return "off"
+        if raw in ("on", "true", "1", "enabled"):
+            return "on"
+        return "auto"
+
     def _solve_engine(self, operator_cm=None) -> Optional[IncrementalSolveEngine]:
         """The cycle's incremental solve engine, or None when disabled.
-        A knob change (epsilon / forced-full cadence) rebuilds the
-        engine — the next cycle runs full, which is exactly what a
-        changed quantization requires."""
+        A knob change (epsilon / forced-full cadence / hier layout /
+        checkpointing) rebuilds the engine — the next cycle runs full,
+        which is exactly what a changed quantization requires."""
         if not self._incremental_solve_enabled(operator_cm):
             self._solve_engine_obj = None
             return None
@@ -414,10 +435,46 @@ class Reconciler:
         if epsilon < 0:
             epsilon = DEFAULT_EPSILON
         engine = self._solve_engine_obj
-        if engine is None or engine.epsilon != epsilon \
-                or engine.full_every != max(full_every, 0):
-            engine = IncrementalSolveEngine(epsilon=epsilon,
-                                            full_every=full_every)
+        mode = self._hier_solve_mode(operator_cm)
+        if mode == "off":
+            if engine is None \
+                    or type(engine) is not IncrementalSolveEngine \
+                    or engine.epsilon != epsilon \
+                    or engine.full_every != max(full_every, 0):
+                engine = IncrementalSolveEngine(epsilon=epsilon,
+                                                full_every=full_every)
+                self._solve_engine_obj = engine
+            return engine
+        shard_target = max(int(parse_float_or(
+            self._solve_knob("WVA_HIER_SHARD_VARIANTS", operator_cm),
+            DEFAULT_SHARD_TARGET)), 1)
+        min_variants = (0 if mode == "on" else max(int(parse_float_or(
+            self._solve_knob("WVA_HIER_MIN_VARIANTS", operator_cm),
+            DEFAULT_MIN_VARIANTS)), 0))
+        ckpt_path = self._solve_knob("WVA_ARENA_CHECKPOINT",
+                                     operator_cm).strip()
+        ckpt_every = max(int(parse_float_or(
+            self._solve_knob("WVA_ARENA_CHECKPOINT_EVERY", operator_cm),
+            DEFAULT_CHECKPOINT_EVERY)), 1)
+        ckpt_age = parse_float_or(
+            self._solve_knob("WVA_ARENA_CHECKPOINT_MAX_AGE_S",
+                             operator_cm),
+            DEFAULT_CHECKPOINT_MAX_AGE_S)
+        if engine is None \
+                or type(engine) is not HierarchicalSolveEngine \
+                or engine.epsilon != epsilon \
+                or engine.full_every != max(full_every, 0) \
+                or engine.shard_target != shard_target \
+                or engine.min_variants != min_variants \
+                or (engine.checkpoint_path or "") != ckpt_path \
+                or engine.checkpoint_every != ckpt_every \
+                or engine.checkpoint_max_age_s != ckpt_age:
+            engine = HierarchicalSolveEngine(
+                epsilon=epsilon, full_every=full_every,
+                shard_target=shard_target, min_variants=min_variants,
+                checkpoint_path=ckpt_path or None,
+                checkpoint_every=ckpt_every,
+                checkpoint_max_age_s=ckpt_age)
             self._solve_engine_obj = engine
         return engine
 
@@ -873,6 +930,9 @@ class Reconciler:
             solve_modes = solve_engine.solve_modes
             self.emitter.emit_solve_metrics(
                 stats.modes, stats.lanes_solved, stats.lanes_skipped)
+            if isinstance(solve_engine, HierarchicalSolveEngine):
+                self.emitter.emit_hier_solve(
+                    stats.shards, solve_engine.drain_ckpt_events())
         else:
             # scoped micro-cycles stay unsharded: their sub-batches are
             # tiny and the stream arena is single-device resident.

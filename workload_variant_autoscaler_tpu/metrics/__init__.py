@@ -148,6 +148,12 @@ INFERNO_COLLECTION_SECONDS = "inferno_collection_seconds"
 # that PROVE steady-state analyze+optimize is O(changed-variants)
 INFERNO_SOLVE_MODE_TOTAL = "inferno_solve_mode_total"
 INFERNO_SOLVE_LANES = "inferno_solve_lanes"
+# hierarchical two-level solve (solver/hierarchy.py): the super-shard
+# partition size and the warm cold-start checkpoint lifecycle — restarts
+# that skipped the forced full pass are visible here, as is every
+# discarded (torn/stale/reconfigured) arena checkpoint
+INFERNO_HIER_SHARDS = "inferno_hier_shards"
+INFERNO_ARENA_CHECKPOINT_TOTAL = "inferno_arena_checkpoint_total"
 # limited-mode inventory visibility: schedulable chips per TPU generation
 # as the collector saw them this cycle — a maintenance drain or a spot
 # reclamation wave reads as this series SHRINKING, never as a kube error
@@ -465,6 +471,23 @@ class MetricsEmitter:
             "fast path; skipped: reused from the signature cache)",
             [LABEL_STATE], registry=self.registry,
         )
+        self.hier_shards = Gauge(
+            INFERNO_HIER_SHARDS,
+            "Super-shards in the hierarchical solve's current partition "
+            "(0 while the flat engine or the small-fleet delegate path "
+            "is in effect) — forced-full work per cycle is bounded by "
+            "the largest single shard, not the fleet",
+            registry=self.registry,
+        )
+        self.arena_checkpoint = Counter(
+            INFERNO_ARENA_CHECKPOINT_TOTAL.removesuffix("_total"),
+            "Warm cold-start arena checkpoint lifecycle events (save: "
+            "solve state persisted; restore: a restarted controller "
+            "skipped the forced full pass; discard-corrupt/discard-"
+            "stale/discard-config: the file was rejected and the engine "
+            "cold-started; save-error: a failed write, never fatal)",
+            [LABEL_EVENT], registry=self.registry,
+        )
         # limited-mode chip inventory, per generation: a draining node
         # pool or a spot-reclamation wave is visible as this gauge
         # shrinking cycle over cycle
@@ -624,6 +647,17 @@ class MetricsEmitter:
                 **{LABEL_STATE: STATE_SOLVED}).set(lanes_solved)
             self.solve_lanes.labels(
                 **{LABEL_STATE: STATE_SKIPPED}).set(lanes_skipped)
+
+    def emit_hier_solve(self, shards: int, ckpt_events: dict) -> None:
+        """One cycle's hierarchical-solve telemetry: the partition size
+        gauge and any arena-checkpoint lifecycle events drained from the
+        engine (event keys normalized to dashed label values)."""
+        with self._lock:
+            self.hier_shards.set(shards)
+            for event, count in ckpt_events.items():
+                if count > 0:
+                    self.arena_checkpoint.labels(**{
+                        LABEL_EVENT: event.replace("_", "-")}).inc(count)
 
     def emit_jax_audit(self, delta: dict) -> None:
         """One cycle's JAX self-audit delta (obs.JaxAudit.delta shape):

@@ -25,6 +25,7 @@ mutation of these buffers is caught statically.
 
 from __future__ import annotations
 
+import base64
 import math
 
 import numpy as np
@@ -85,6 +86,46 @@ class CandidateArena:
             self._slabs[b] = slab
             self.slab_allocs += 1
         return slab
+
+    # -- warm cold-start snapshot (solver/hierarchy.py checkpoint) --------
+
+    def snapshot_slabs(self) -> dict:
+        """JSON-serializable image of the resident host mirrors: bucket
+        -> column -> {dtype, base64 raw bytes}. Exact byte round-trip —
+        a restored arena diffs its first pack against precisely the
+        mirrors the checkpointed process last packed."""
+        return {
+            str(b): {name: {"dtype": buf.dtype.str,
+                            "data": base64.b64encode(
+                                buf.tobytes()).decode("ascii")}
+                     for name, buf in slab.items()}
+            for b, slab in self._slabs.items()
+        }
+
+    def restore_slabs(self, snap: dict) -> None:
+        """Rebuild the host mirrors from snapshot_slabs() output. Raises
+        ValueError on ANY malformed entry (unknown column, wrong length,
+        missing column) — the checkpoint loader treats that like a CRC
+        failure: discard and cold-start, never a partial restore."""
+        known = dict(_COLUMNS)
+        known.update(_EPI_COLUMNS)
+        restored: dict[int, dict[str, np.ndarray]] = {}
+        for b_key, cols in snap.items():
+            b = int(b_key)
+            if set(cols) != set(known):
+                raise ValueError(f"arena slab {b}: column set mismatch")
+            slab = {}
+            for name, rec in cols.items():
+                arr = np.frombuffer(
+                    base64.b64decode(rec["data"]),
+                    dtype=np.dtype(rec["dtype"])).copy()
+                if arr.shape != (b,):
+                    raise ValueError(
+                        f"arena slab {b}.{name}: length mismatch")
+                slab[name] = arr
+            restored[b] = slab
+        # commit only after every slab validated (no partial restore)
+        self._slabs.update(restored)
 
     def pack(self, rows: dict[str, list], quantum: int = LANE_BUCKET,
              ):
@@ -205,6 +246,38 @@ class ShardedFleetArena(CandidateArena):
         from ..parallel.mesh import padded_lanes
 
         return padded_lanes(c, quantum, int(self.mesh.devices.size))
+
+    def restore_slabs(self, snap: dict) -> None:
+        """Restore the host mirrors AND stage them onto the mesh, so the
+        first post-restart pack rides the donated scatter (O(changed)
+        h2d) instead of a whole-slab upload — the warm cold-start's
+        device leg."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..obs.profile import JAX_AUDIT
+        from ..parallel.mesh import mesh_axis
+
+        super().restore_slabs(snap)
+        fdt = np.float64 if jax.config.jax_enable_x64 else np.float32
+        columns = dict(_COLUMNS)
+        columns.update(_EPI_COLUMNS)
+        dev_dtype = {name: (np.bool_ if dt is bool else
+                            np.int32 if np.issubdtype(dt, np.integer)
+                            else fdt)
+                     for name, (dt, _f) in columns.items()}
+        sharding = NamedSharding(self.mesh,
+                                 PartitionSpec(mesh_axis(self.mesh)))
+        for b, slab in self._slabs.items():
+            if b in self._device:
+                continue
+            self._device[b] = {
+                name: jax.device_put(
+                    slab[name].astype(dev_dtype[name]), sharding)
+                for name in columns}
+            self.full_uploads += 1
+            JAX_AUDIT.note_transfer(
+                "h2d", len(columns), shards=int(self.mesh.devices.size))
 
     def pack(self, rows: dict[str, list], quantum: int = LANE_BUCKET,
              ):

@@ -4,6 +4,7 @@ and the incremental steady-state engine (signature-gated re-solving)."""
 
 from .solver import Solver, WarmStart
 from .greedy import solve_greedy, solve_greedy_warm
+from .hierarchy import HierarchicalSolveEngine, sig_digest
 from .incremental import (
     SOLVE_CACHED,
     SOLVE_FULL,
@@ -17,6 +18,7 @@ from .incremental import (
 from .optimizer import Manager, Optimizer
 
 __all__ = [
+    "HierarchicalSolveEngine",
     "IncrementalSolveEngine",
     "Manager",
     "Optimizer",
@@ -29,6 +31,7 @@ __all__ = [
     "WarmStart",
     "quantize",
     "quantize_load",
+    "sig_digest",
     "solve_greedy",
     "solve_greedy_warm",
 ]

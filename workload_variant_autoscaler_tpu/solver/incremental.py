@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
+from functools import lru_cache
 from typing import Optional
 
 from ..models import System
@@ -82,6 +83,19 @@ def quantize(value: float, epsilon: float) -> float:
     return math.exp(round(math.log(value) / step) * step)
 
 
+@lru_cache(maxsize=1 << 16)
+def _quantized_load(arrival_rate: float, avg_in_tokens: int,
+                    avg_out_tokens: int, epsilon: float) -> ServerLoadSpec:
+    # ServerLoadSpec is frozen, so the memoized instance can be shared
+    # across every server that lands in the same bucket; at fleet scale
+    # this turns the per-lane log/exp quantization into a dict hit
+    return ServerLoadSpec(
+        arrival_rate=quantize(arrival_rate, epsilon),
+        avg_in_tokens=int(round(quantize(avg_in_tokens, epsilon))),
+        avg_out_tokens=int(round(quantize(avg_out_tokens, epsilon))),
+    )
+
+
 def quantize_load(load: Optional[ServerLoadSpec],
                   epsilon: float) -> Optional[ServerLoadSpec]:
     """Quantized view of a server load: arrival rate and token means
@@ -90,11 +104,8 @@ def quantize_load(load: Optional[ServerLoadSpec],
     zero-load fast path and the invalid-load guards see exact values."""
     if load is None or epsilon <= 0:
         return load
-    return ServerLoadSpec(
-        arrival_rate=quantize(load.arrival_rate, epsilon),
-        avg_in_tokens=int(round(quantize(load.avg_in_tokens, epsilon))),
-        avg_out_tokens=int(round(quantize(load.avg_out_tokens, epsilon))),
-    )
+    return _quantized_load(load.arrival_rate, load.avg_in_tokens,
+                           load.avg_out_tokens, epsilon)
 
 
 @dataclass
@@ -106,6 +117,11 @@ class SolveStats:
     lanes_solved: int = 0
     lanes_skipped: int = 0
     modes: dict = field(default_factory=dict)  # mode -> variant count
+    # hierarchical two-level solve (solver/hierarchy.py) telemetry;
+    # zeros on the flat engine so downstream consumers need no isinstance
+    shards: int = 0         # super-shards in this cycle's partition
+    shards_solved: int = 0  # shards that dispatched any lanes
+    restored: bool = False  # first cycle after a warm checkpoint restore
 
 
 class IncrementalSolveEngine:

@@ -38,20 +38,30 @@ import zlib
 CHECKPOINT_MAGIC = "wva-stream-ckpt"
 CHECKPOINT_VERSION = 1
 
+# The hierarchical solve engine's warm cold-start snapshot (resident
+# arena slabs + per-variant solve signatures + warm-greedy seed) rides
+# the same file format under its own magic/version so a stream
+# checkpoint can never be mistaken for an arena checkpoint or vice
+# versa — a mismatch is a clean discard, not a mis-restore.
+ARENA_CHECKPOINT_MAGIC = "wva-arena-ckpt"
+ARENA_CHECKPOINT_VERSION = 1
+
 
 class CheckpointError(ValueError):
     """Unusable checkpoint file (missing, torn, corrupt, or from an
     incompatible version) — the caller discards and cold-starts."""
 
 
-def save_checkpoint(path: str, payload: dict) -> None:
+def save_checkpoint(path: str, payload: dict, *,
+                    magic: str = CHECKPOINT_MAGIC,
+                    version: int = CHECKPOINT_VERSION) -> None:
     """Serialize `payload` to `path` atomically. Raises OSError on an
     unwritable destination; never leaves a partial file behind."""
     body = json.dumps(payload, sort_keys=True,
                       separators=(",", ":")).encode("utf-8")
     header = json.dumps({
-        "magic": CHECKPOINT_MAGIC,
-        "version": CHECKPOINT_VERSION,
+        "magic": magic,
+        "version": version,
         "crc": zlib.crc32(body) & 0xFFFFFFFF,
     }, sort_keys=True, separators=(",", ":")).encode("utf-8")
     tmp = path + ".tmp"
@@ -62,7 +72,9 @@ def save_checkpoint(path: str, payload: dict) -> None:
     os.replace(tmp, path)
 
 
-def load_checkpoint(path: str) -> dict:
+def load_checkpoint(path: str, *,
+                    magic: str = CHECKPOINT_MAGIC,
+                    version: int = CHECKPOINT_VERSION) -> dict:
     """Read and verify a checkpoint. Raises CheckpointError on ANY
     defect (absent file included) — callers treat every failure mode
     identically: discard and cold-start."""
@@ -78,10 +90,9 @@ def load_checkpoint(path: str) -> dict:
         header = json.loads(head)
     except ValueError as e:
         raise CheckpointError(f"corrupt checkpoint header: {e}") from e
-    if not isinstance(header, dict) \
-            or header.get("magic") != CHECKPOINT_MAGIC:
-        raise CheckpointError("not a stream checkpoint")
-    if header.get("version") != CHECKPOINT_VERSION:
+    if not isinstance(header, dict) or header.get("magic") != magic:
+        raise CheckpointError(f"not a {magic} checkpoint")
+    if header.get("version") != version:
         raise CheckpointError(
             f"unsupported checkpoint version {header.get('version')!r}")
     if header.get("crc") != zlib.crc32(body) & 0xFFFFFFFF:
