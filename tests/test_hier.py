@@ -99,6 +99,73 @@ def test_randomized_churn_equivalence(unlimited, policy):
     assert forced_lanes > 50, forced_lanes
 
 
+def make_v5e_spec(servers, capacity):
+    """Limited-mode spec whose accelerator catalog is a SINGLE chip
+    generation (v5e slices only): every server's candidate set is one
+    generation — the common homogeneous-fleet shape."""
+    from test_incremental_solve import (
+        PROFILES,
+        SERVICE_CLASSES,
+        SLICES,
+        SystemSpec,
+    )
+    from workload_variant_autoscaler_tpu.models.spec import OptimizerSpec
+
+    return SystemSpec(
+        accelerators=[s for s in SLICES if s.chip == "v5e"],
+        profiles=list(PROFILES), service_classes=list(SERVICE_CLASSES),
+        servers=list(servers),
+        capacity={g: c for g, c in capacity.items() if g == "v5e"},
+        optimizer=OptimizerSpec(unlimited=False,
+                                saturation_policy="RoundRobin"),
+    )
+
+
+def test_limited_homogeneous_fleet_equivalence():
+    """Limited mode over a single accelerator generation: the
+    capacity-coupled partition must key single-candidate components
+    (regression: union-find only seeded by servers with >=2 candidate
+    generations -> KeyError on every hierarchical cycle), and decisions
+    still equal the flat from-scratch solve through churn."""
+    driver = ChurnDriver(seed=0xB0B, epsilon=EPS)
+    engine = hier_engine()
+    for cycle in range(60):
+        driver.churn()
+        rung = "stale-cache" if driver.rungs else "healthy"
+        spec = make_v5e_spec(driver.servers(), driver.capacity)
+        sol_h, stats = run_cycle(engine=engine, spec=spec,
+                                 rungs=dict(driver.rungs), cycle_rung=rung)
+        scratch = IncrementalSolveEngine(epsilon=EPS, full_every=1)
+        sol_ref, _ = run_cycle(engine=scratch, spec=spec,
+                               rungs=dict(driver.rungs), cycle_rung=rung)
+        assert_solutions_equal(sol_h, sol_ref, cycle)
+        assert stats.shards >= 1
+    # the fleet really was single-generation components (pool-less
+    # zero-candidate servers aside), solved through the decomposed
+    # (non-fallback) path
+    assert engine.last_capacity_slices is not None
+    pool_sets = engine.last_partition.pool_sets.values()
+    assert all(pools <= {"v5e"} for pools in pool_sets)
+    assert any(pools == {"v5e"} for pools in pool_sets)
+
+
+def test_unlimited_shard_memo_prunes_deleted_servers():
+    """The separable-mode shard-assignment memo must stay bounded by
+    the live fleet under churn, not accumulate every name ever seen."""
+    def fleet(n, bump=0.0):
+        return [helpers.server_spec(name=f"v{i}:ns", model="m-a",
+                                    arrival_rpm=300.0 + bump + 40.0 * i)
+                for i in range(n)]
+
+    # shard_target=100 keeps n_shards constant across the shrink, so
+    # pruning (not the n_shards-change reset) is what's exercised
+    engine = hier_engine(shard_target=100)
+    run_cycle(spec=make_spec(fleet(9), {}), engine=engine)
+    assert len(engine._shard_of_memo) == 9
+    run_cycle(spec=make_spec(fleet(3, bump=1000.0), {}), engine=engine)
+    assert set(engine._shard_of_memo) == {f"v{i}:ns" for i in range(3)}
+
+
 # ---------------------------------------------------------------------------
 # staggered forced-full phases
 # ---------------------------------------------------------------------------

@@ -269,6 +269,10 @@ class HierarchicalSolveEngine(IncrementalSolveEngine):
                         name.encode("utf-8")) % n_shards
                 shard_of[name] = sid
                 members.setdefault(sid, []).append(name)
+            if len(memo) > len(shard_of):
+                # churn deleted servers: drop their entries so the memo
+                # stays bounded by the live fleet, not its history
+                self._shard_of_memo = dict(shard_of)
             return Partition(n_shards, shard_of, members, pool_sets)
 
         # capacity-coupled: union-find over the chip generations of each
@@ -290,6 +294,12 @@ class HierarchicalSolveEngine(IncrementalSolveEngine):
                 system.accelerators[a].chip
                 for a in server.candidate_accelerators(system.accelerators)})
             server_chips[name] = chips
+            # seed EVERY chip into the union-find: a generation that only
+            # ever appears as a server's sole candidate (homogeneous
+            # fleet) would otherwise never enter `parent`, and the
+            # comp_min lookup below would miss its component
+            for chip in chips:
+                find(chip)
             for chip in chips[1:]:
                 ra, rb = find(chips[0]), find(chip)
                 if ra != rb:
