@@ -114,19 +114,54 @@ Rules (suppress per-line with `# noqa` or `# noqa: WVLxxx`):
           literal ceiling is the memory-exhaustion bug the overload
           defenses exist to prevent. A WVL405 noqa comment marks a
           deliberate exception.
+  WVL501  traced-body purity: a side effect inside a body reached from a
+          jax.jit/pjit/_AuditedJit/pallas_call entry (time.*, random.*,
+          logging, print, lock acquisition, global/self mutation,
+          in-place mutation of a non-local container). `note_trace()`
+          is the one allowlisted effect; `.at[...].set/add` functional
+          updates are pure and exempt.
+  WVL502  retrace-stability: a non-array Python argument flowing into a
+          jit boundary that is neither declared static
+          (static_argnums/static_argnames, partial-bound, donated) nor
+          shape-relevant-and-bounded; plus call sites that feed a static
+          parameter an unbounded fleet-size-dependent expression instead
+          of the bucket vocabulary (k_max_bucket/lane_bucket/...)
+  WVL503  donation soundness: a name passed at a donate_argnums position
+          of a jit entry is read again on some path after the call — the
+          buffer was handed to XLA and may alias the output
+  WVL504  implicit host sync: bool()/int()/float()/.item()/.tolist(),
+          iteration, or an if/while condition on a jax array value in
+          host code whose enclosing function never routes through
+          note_transfer/note_readback (the implicit-conversion gap
+          WVL305's explicit np.asarray/block_until_ready check leaves)
+  WVL505  mesh-constant baking: a traced body calls
+          jax.devices()/device_count()/local_device_count() or closes
+          over a module constant derived from them — the device count
+          gets baked into the compiled program as a Python constant
+          instead of arriving as a shaped argument or mesh axis
 
   WVL005  stale suppression: a `# noqa: WVLxxx` comment naming a rule
           that does not fire on that line (audited only for rule
           families active in the current run; foreign codes like BLE001
           are left to the tools that own them)
 
-Exit status: number of findings (0 = clean).
+CLI: `python tools/wvalint.py [paths...] [--json] [--select CODES]
+[--ignore CODES] [--no-cache]`. Selectors are comma-separated code
+prefixes; a trailing run of `x` wildcards (`WVL5xx` selects the whole
+family). Results are cached per scan in `.wvalint_cache.json`
+(override path with WVA_LINT_CACHE, `off` disables), keyed on the
+linter's own source plus every file's content hash.
+
+Exit status: number of findings capped at 125 (0 = clean;
+2 may also mean an argparse usage error, which prints to stderr).
 """
 
 from __future__ import annotations
 
+import argparse
 import ast
 import builtins
+import hashlib
 import json
 import os
 import re
@@ -148,6 +183,47 @@ class Finding:
         return f"{self.path}:{self.line}: {self.code} {self.message}"
 
 
+# -- tree index -------------------------------------------------------------
+#
+# Every rule family re-walks the same trees; on the full repo that is
+# tens of millions of iter_child_nodes calls and over half the wall
+# time. One pre-order pass per tree records each node's Euler span
+# (begin, end) in a shared order list, after which any subtree walk is
+# a list slice. Entries hold strong refs to their order list, so node
+# ids cannot be recycled while indexed.
+
+_NODE_ORDER: dict[int, tuple[list, int, int]] = {}
+_NODE_PARENT: dict[int, object] = {}
+
+
+def _index_tree(tree) -> None:
+    if id(tree) in _NODE_ORDER:
+        return
+    order: list = []
+    stack: list = [(tree, None)]
+    while stack:
+        node, begin = stack.pop()
+        if begin is not None:
+            _NODE_ORDER[id(node)] = (order, begin, len(order))
+            continue
+        stack.append((node, len(order)))
+        order.append(node)
+        for child in reversed(list(ast.iter_child_nodes(node))):
+            _NODE_PARENT[id(child)] = node
+            stack.append((child, None))
+
+
+def _fast_walk(node):
+    """ast.walk over an indexed subtree in O(span) slice time; plain
+    ast.walk for nodes outside any indexed tree (small synthesized
+    expressions)."""
+    rec = _NODE_ORDER.get(id(node))
+    if rec is None:
+        return ast.walk(node)
+    order, begin, end = rec
+    return iter(order[begin:end])
+
+
 def _noqa_lines(source: str) -> dict[int, set[str] | None]:
     """line -> None (blanket noqa) or set of codes."""
     out: dict[int, set[str] | None] = {}
@@ -164,72 +240,62 @@ def _noqa_lines(source: str) -> dict[int, set[str] | None]:
 # -- structural rules (ast) ------------------------------------------------
 
 
-class _StructuralVisitor(ast.NodeVisitor):
-    def __init__(self, path: str):
-        self.path = path
-        self.findings: list[Finding] = []
+def _structural_findings(path: str, tree: ast.Module) -> list:
+    """WVL101..WVL106 in one flat pass over the indexed tree (the old
+    NodeVisitor dispatch was pure traversal overhead; none of these
+    rules needs ancestry context beyond the parent map)."""
+    findings: list = []
 
-    def add(self, node: ast.AST, code: str, msg: str) -> None:
-        self.findings.append(
-            Finding(self.path, getattr(node, "lineno", 0), code, msg))
+    def add(node, code, msg):
+        findings.append(
+            Finding(path, getattr(node, "lineno", 0), code, msg))
 
-    def visit_FunctionDef(self, node):
-        self._check_defaults(node)
-        self.generic_visit(node)
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def _check_defaults(self, node) -> None:
-        for d in list(node.args.defaults) + [
-                d for d in node.args.kw_defaults if d is not None]:
-            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
-                self.add(d, "WVL101",
-                         f"mutable default argument in {node.name}()")
-
-    def visit_ExceptHandler(self, node):
-        if node.type is None:
-            self.add(node, "WVL102", "bare `except:` (catch something)")
-        self.generic_visit(node)
-
-    def visit_JoinedStr(self, node):
-        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
-            self.add(node, "WVL103", "f-string without placeholders")
-        # do NOT recurse into format specs: `f"{x:>7.2f}"` builds a
-        # constant-only JoinedStr for the spec, which is not a finding
-        for v in node.values:
-            if isinstance(v, ast.FormattedValue):
-                self.visit(v.value)
-            # plain constants carry nothing to check
-
-    def visit_Compare(self, node):
-        for op, comp in zip(node.ops, node.comparators):
-            if isinstance(op, (ast.Eq, ast.NotEq)) and (
-                    (isinstance(comp, ast.Constant) and comp.value is None)
-                    or (isinstance(node.left, ast.Constant)
-                        and node.left.value is None)):
-                self.add(node, "WVL104",
-                         "comparison to None with ==/!= (use is/is not)")
-        self.generic_visit(node)
-
-    def visit_Assert(self, node):
-        if isinstance(node.test, ast.Tuple) and node.test.elts:
-            self.add(node, "WVL105",
-                     "assert on a non-empty tuple is always true")
-        self.generic_visit(node)
-
-    def visit_Dict(self, node):
-        seen: set = set()
-        for k in node.keys:
-            if isinstance(k, ast.Constant):
-                try:
-                    hashable = k.value
-                except Exception:  # pragma: no cover
-                    continue
-                if hashable in seen:
-                    self.add(k, "WVL106",
-                             f"duplicate dict key {k.value!r}")
-                seen.add(hashable)
-        self.generic_visit(node)
+    for node in _fast_walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None]:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    add(d, "WVL101",
+                        f"mutable default argument in {node.name}()")
+        elif isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                add(node, "WVL102", "bare `except:` (catch something)")
+        elif isinstance(node, ast.JoinedStr):
+            # `f"{x:>7.2f}"` builds a constant-only JoinedStr for the
+            # format spec, which is not a finding
+            parent = _NODE_PARENT.get(id(node))
+            if isinstance(parent, ast.FormattedValue) and \
+                    parent.format_spec is node:
+                continue
+            if not any(isinstance(v, ast.FormattedValue)
+                       for v in node.values):
+                add(node, "WVL103", "f-string without placeholders")
+        elif isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                        (isinstance(comp, ast.Constant)
+                         and comp.value is None)
+                        or (isinstance(node.left, ast.Constant)
+                            and node.left.value is None)):
+                    add(node, "WVL104",
+                        "comparison to None with ==/!= (use is/is not)")
+        elif isinstance(node, ast.Assert):
+            if isinstance(node.test, ast.Tuple) and node.test.elts:
+                add(node, "WVL105",
+                    "assert on a non-empty tuple is always true")
+        elif isinstance(node, ast.Dict):
+            seen: set = set()
+            for k in node.keys:
+                if isinstance(k, ast.Constant):
+                    try:
+                        hashable = k.value
+                    except Exception:  # pragma: no cover
+                        continue
+                    if hashable in seen:
+                        add(k, "WVL106",
+                            f"duplicate dict key {k.value!r}")
+                    seen.add(hashable)
+    return findings
 
 
 # -- name resolution (symtable) -------------------------------------------
@@ -242,8 +308,16 @@ _BUILTINS = set(dir(builtins)) | {
 }
 
 
+_MODULE_BINDINGS_MEMO: dict[int, tuple] = {}
+
+
 def _module_bindings(tree: ast.Module) -> set[str]:
-    """Names bound anywhere at module level (incl. conditional imports)."""
+    """Names bound anywhere at module level (incl. conditional imports).
+    Memoized per tree: several rule families ask for the same module's
+    bindings (the memo pins the tree so its id cannot recycle)."""
+    hit = _MODULE_BINDINGS_MEMO.get(id(tree))
+    if hit is not None and hit[0] is tree:
+        return hit[1]
     names: set[str] = set()
 
     class TopCollector(ast.NodeVisitor):
@@ -273,7 +347,7 @@ def _module_bindings(tree: ast.Module) -> set[str]:
     # walk everything: a name assigned inside `if TYPE_CHECKING:` or a
     # try/except import fallback is still a module binding
     TopCollector().generic_visit(tree)
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 names.add((a.asname or a.name).split(".")[0])
@@ -291,14 +365,31 @@ def _module_bindings(tree: ast.Module) -> set[str]:
             names.add(node.id)
         elif isinstance(node, ast.Global):
             names.update(node.names)
+    _MODULE_BINDINGS_MEMO[id(tree)] = (tree, names)
     return names
+
+
+_SYMTABLE_MEMO: dict[int, tuple] = {}
+
+
+def _symtable_for(path: str, source: str, tree: ast.Module):
+    """One symtable per parsed module — WVL001 and WVL002/003 both need
+    it; compiling the source twice per file is pure waste."""
+    hit = _SYMTABLE_MEMO.get(id(tree))
+    if hit is not None and hit[0] is tree:
+        return hit[1]
+    try:
+        table = symtable.symtable(source, path, "exec")
+    except SyntaxError:
+        table = None
+    _SYMTABLE_MEMO[id(tree)] = (tree, table)
+    return table
 
 
 def _undefined_names(path: str, source: str,
                      tree: ast.Module) -> list[Finding]:
-    try:
-        table = symtable.symtable(source, path, "exec")
-    except SyntaxError:
+    table = _symtable_for(path, source, tree)
+    if table is None:
         return []
     module_names = _module_bindings(tree)
     if "*" in module_names:
@@ -307,7 +398,7 @@ def _undefined_names(path: str, source: str,
     # map name -> first use line, from ast (symtable has no line info for
     # references)
     use_lines: dict[str, int] = {}
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
             use_lines.setdefault(node.id, node.lineno)
 
@@ -340,9 +431,8 @@ def _undefined_names(path: str, source: str,
 def _unused(path: str, source: str, tree: ast.Module) -> list[Finding]:
     """Unused imports (module scope) and unused locals (function scope)."""
     findings: list[Finding] = []
-    try:
-        table = symtable.symtable(source, path, "exec")
-    except SyntaxError:
+    table = _symtable_for(path, source, tree)
+    if table is None:
         return []
 
     # module-level import lines (__future__ imports are directives)
@@ -357,7 +447,7 @@ def _unused(path: str, source: str, tree: ast.Module) -> list[Finding]:
                     import_lines[a.asname or a.name] = node.lineno
 
     exported = set()
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         if (isinstance(node, ast.Assign)
                 and any(isinstance(t, ast.Name) and t.id == "__all__"
                         for t in node.targets)
@@ -369,7 +459,7 @@ def _unused(path: str, source: str, tree: ast.Module) -> list[Finding]:
     # names referenced anywhere in the module (incl. inside defs) and
     # names re-exported via explicit `from x import y as y` convention
     referenced: set[str] = set()
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
             referenced.add(node.id)
         elif isinstance(node, ast.Attribute):
@@ -391,22 +481,18 @@ def _unused(path: str, source: str, tree: ast.Module) -> list[Finding]:
     assign_lines: dict[tuple[int, str], int] = {}
     fn_reads: dict[int, set[str]] = {}
 
-    class FnVisitor(ast.NodeVisitor):
-        def visit_FunctionDef(self, fn):
-            reads = fn_reads.setdefault(fn.lineno, set())
-            for node in ast.walk(fn):
-                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                        and isinstance(node.targets[0], ast.Name):
-                    key = (fn.lineno, node.targets[0].id)
-                    assign_lines.setdefault(key, node.lineno)
-                elif isinstance(node, ast.Name) and isinstance(
-                        node.ctx, ast.Load):
-                    reads.add(node.id)
-            self.generic_visit(fn)
-
-        visit_AsyncFunctionDef = visit_FunctionDef
-
-    FnVisitor().visit(tree)
+    for fn in _fast_walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        reads = fn_reads.setdefault(fn.lineno, set())
+        for node in _fast_walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                key = (fn.lineno, node.targets[0].id)
+                assign_lines.setdefault(key, node.lineno)
+            elif isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load):
+                reads.add(node.id)
 
     def child_free_names(tb: symtable.SymbolTable) -> set:
         """Names read as free variables by any descendant scope — the
@@ -470,7 +556,7 @@ def _collect_signatures(trees: dict[str, ast.Module]) -> dict[str, list[_Sig]]:
     dynamic dispatch can't be resolved statically)."""
     sigs: dict[str, list[_Sig]] = {}
     for tree in trees.values():
-        for node in ast.walk(tree):
+        for node in _fast_walk(tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             a = node.args
@@ -497,7 +583,7 @@ def _collect_signatures(trees: dict[str, ast.Module]) -> dict[str, list[_Sig]]:
 def _check_calls(path: str, tree: ast.Module,
                  sigs: dict[str, list[_Sig]]) -> list[Finding]:
     findings: list[Finding] = []
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         if not isinstance(node, ast.Call):
             continue
         # bare-name calls only: an attribute call's receiver type is
@@ -540,15 +626,29 @@ def _check_calls(path: str, tree: ast.Module,
 
 def _walk_own(fn):
     """Walk a def's own body, pruning nested defs/lambdas/classes (their
-    returns/yields belong to them)."""
-    stack = list(ast.iter_child_nodes(fn))
-    while stack:
-        node = stack.pop()
+    returns/yields belong to them). Indexed trees skip whole pruned
+    subtrees via their Euler spans."""
+    rec = _NODE_ORDER.get(id(fn))
+    if rec is None:
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+        return
+    order, begin, end = rec
+    i = begin + 1
+    while i < end:
+        node = order[i]
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.Lambda, ast.ClassDef)):
+            i = _NODE_ORDER[id(node)][2]
             continue
         yield node
-        stack.extend(ast.iter_child_nodes(node))
+        i += 1
 
 
 def _collect_return_arities(
@@ -558,7 +658,7 @@ def _collect_return_arities(
     literal tuple)."""
     rets: dict[str, list[tuple]] = {}
     for tree in trees.values():
-        for node in ast.walk(tree):
+        for node in _fast_walk(tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             arities: set[int] | None
@@ -590,11 +690,17 @@ def _collect_return_arities(
     return rets
 
 
+_FN_BINDINGS_MEMO: dict[int, tuple] = {}
+
+
 def _fn_local_bindings(fn) -> set:
     """Names bound in a def's own scope: params, assigned names, nested
     def/class names, imports. Used to detect shadowing of module-level
     functions (a call through a parameter must not resolve to the
-    same-named module def)."""
+    same-named module def). Memoized per def node."""
+    hit = _FN_BINDINGS_MEMO.get(id(fn))
+    if hit is not None and hit[0] is fn:
+        return hit[1]
     a = fn.args
     names = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
     if a.vararg:
@@ -621,6 +727,7 @@ def _fn_local_bindings(fn) -> set:
                 if al.name != "*":
                     names.add(al.asname or al.name)
         stack.extend(ast.iter_child_nodes(node))
+    _FN_BINDINGS_MEMO[id(fn)] = (fn, names)
     return names
 
 
@@ -630,18 +737,21 @@ def _check_unpack_arity(path: str, tree: ast.Module,
     tuple of a different length — the unpacking slice of mypy's
     return-type checking (bare-name calls only, same conservatism as
     WVL201; names shadowed by an enclosing scope's params/locals are
-    skipped). Also flags unpacking an un-awaited all-async callee."""
+    skipped). Also flags unpacking an un-awaited all-async callee.
+    Candidate Assign nodes are rare, so shadowing is computed lazily
+    from the indexed parent chain instead of a full visitor pass."""
     findings: list[Finding] = []
 
-    def visit(node, shadowed: frozenset) -> None:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            shadowed = shadowed | _fn_local_bindings(node)
-        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
-            check(node, shadowed)
-        for child in ast.iter_child_nodes(node):
-            visit(child, shadowed)
+    def shadow_set(node) -> set:
+        out: set = set()
+        cur = _NODE_PARENT.get(id(node))
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out |= _fn_local_bindings(cur)
+            cur = _NODE_PARENT.get(id(cur))
+        return out
 
-    def check(node: ast.Assign, shadowed: frozenset) -> None:
+    def check(node: ast.Assign) -> None:
         target = node.targets[0]
         if not isinstance(target, (ast.Tuple, ast.List)):
             return
@@ -655,11 +765,11 @@ def _check_unpack_arity(path: str, tree: ast.Module,
                 value.func, ast.Name):
             return
         name = value.func.id
-        if name in shadowed:
-            return  # call through a param/local, not the module def
         cand = rets.get(name)
         if not cand:
             return
+        if name in shadow_set(node):
+            return  # call through a param/local, not the module def
         all_async = all(is_async for _a, is_async in cand)
         any_async = any(is_async for _a, is_async in cand)
         if not awaited and all_async:
@@ -684,7 +794,9 @@ def _check_unpack_arity(path: str, tree: ast.Module,
                 path, node.lineno, "WVL202",
                 f"{name}() returns {got} value(s), unpacked into {n}"))
 
-    visit(tree, frozenset())
+    for node in _fast_walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            check(node)
     return findings
 
 
@@ -701,7 +813,7 @@ class _Cls:
 def _collect_classes(trees: dict[str, ast.Module]) -> dict[str, _Cls]:
     classes: dict[str, _Cls] = {}
     for tree in trees.values():
-        for node in ast.walk(tree):
+        for node in _fast_walk(tree):
             if not isinstance(node, ast.ClassDef):
                 continue
             attrs: set = set()
@@ -735,7 +847,7 @@ def _collect_classes(trees: dict[str, ast.Module]) -> dict[str, _Cls]:
                         and isinstance(call.args[0], ast.Name)
                         and call.args[0].id in ("self", "cls"))
 
-            for sub in ast.walk(node):
+            for sub in _fast_walk(node):
                 if isinstance(sub, ast.Attribute) and isinstance(
                         sub.ctx, (ast.Store, ast.Del)) and isinstance(
                         sub.value, ast.Name) and sub.value.id in (
@@ -765,7 +877,7 @@ def _collect_classes(trees: dict[str, ast.Module]) -> dict[str, _Cls]:
                 classes[node.name] = _Cls(attrs, bases, open_)
     # module-level monkey-patching: C.attr = ... / setattr(C, ...)
     for tree in trees.values():
-        for node in ast.walk(tree):
+        for node in _fast_walk(tree):
             if isinstance(node, ast.Attribute) and isinstance(
                     node.ctx, ast.Store) and isinstance(
                     node.value, ast.Name) and node.value.id in classes:
@@ -825,7 +937,7 @@ def _check_self_attrs(path: str, tree: ast.Module,
     — the self-receiver slice of mypy's attribute checking (the one
     receiver whose type IS statically known)."""
     findings: list[Finding] = []
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         if not isinstance(node, ast.ClassDef):
             continue
         info = resolved.get(node.name)
@@ -887,9 +999,9 @@ def check_metrics_doc(metrics_source: str, doc_text: str,
     if not consts:
         return []
     referenced: set[str] = set()
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         if isinstance(node, ast.ClassDef) and node.name == "MetricsEmitter":
-            for sub in ast.walk(node):
+            for sub in _fast_walk(node):
                 if isinstance(sub, ast.Name) and isinstance(
                         sub.ctx, ast.Load) and sub.id in consts:
                     referenced.add(sub.id)
@@ -1073,8 +1185,15 @@ def _self_mutations(fn, *, include_globals: set | None = None,
     yield from walk(fn, False)
 
 
+_CLASS_LOCKS_MEMO: dict[int, tuple] = {}
+
+
 def _class_lock_attrs(cls_node: ast.ClassDef) -> dict[str, bool]:
-    """lock-typed self attributes -> reentrant? (nested classes pruned)."""
+    """lock-typed self attributes -> reentrant? (nested classes pruned).
+    Memoized: the WVL401/402/403 families all ask for the same class."""
+    hit = _CLASS_LOCKS_MEMO.get(id(cls_node))
+    if hit is not None and hit[0] is cls_node:
+        return hit[1]
     locks: dict[str, bool] = {}
     stack = list(cls_node.body)
     while stack:
@@ -1090,6 +1209,7 @@ def _class_lock_attrs(cls_node: ast.ClassDef) -> dict[str, bool]:
                             t.value.id == "self":
                         locks[t.attr] = factory in _REENTRANT_FACTORIES
         stack.extend(ast.iter_child_nodes(node))
+    _CLASS_LOCKS_MEMO[id(cls_node)] = (cls_node, locks)
     return locks
 
 
@@ -1221,7 +1341,7 @@ def _check_module_lock_discipline(path: str,
         return []
     module_names = _module_bindings(tree)
 
-    funcs = [n for n in ast.walk(tree)
+    funcs = [n for n in _fast_walk(tree)
              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
     guarded: set = set()
 
@@ -1261,7 +1381,7 @@ def _check_module_lock_discipline(path: str,
                     f"but mutated lock-free in {fn.name}()"))
         # `global x; x = ...` stores
         decls = _global_decls(fn)
-        for node in ast.walk(fn):
+        for node in _fast_walk(fn):
             if isinstance(node, ast.Name) and \
                     isinstance(node.ctx, ast.Store) and \
                     node.id in decls and node.id in guarded:
@@ -1276,7 +1396,7 @@ def _check_module_lock_discipline(path: str,
 
 def _global_decls(fn) -> set:
     out: set = set()
-    for node in ast.walk(fn):
+    for node in _fast_walk(fn):
         if isinstance(node, ast.Global):
             out.update(node.names)
     return out
@@ -1318,7 +1438,7 @@ def _check_stream_lock_guard(path: str, tree: ast.Module) -> list[Finding]:
     if not _is_stream_module(path):
         return []
     findings: list[Finding] = []
-    for cls in ast.walk(tree):
+    for cls in _fast_walk(tree):
         if not isinstance(cls, ast.ClassDef):
             continue
         locks = _class_lock_attrs(cls)
@@ -1374,7 +1494,7 @@ def _check_bounded_containers(path: str, tree: ast.Module) -> list[Finding]:
     def has_literal_bound(node) -> bool:
         """An int literal or int-valued module constant anywhere in the
         subtree (covers `min(self._cap(), HARD_MAX)` shapes)."""
-        for sub in ast.walk(node):
+        for sub in _fast_walk(node):
             if isinstance(sub, ast.Constant) and \
                     isinstance(sub.value, (int, float)) and \
                     not isinstance(sub.value, bool):
@@ -1388,7 +1508,7 @@ def _check_bounded_containers(path: str, tree: ast.Module) -> list[Finding]:
         """Attrs compared as `len(self.<attr>) <op> <literal bound>`
         anywhere in the function (either comparison side)."""
         out: set[str] = set()
-        for node in ast.walk(fn):
+        for node in _fast_walk(fn):
             if not isinstance(node, ast.Compare):
                 continue
             sides = [node.left] + list(node.comparators)
@@ -1421,7 +1541,7 @@ def _check_bounded_containers(path: str, tree: ast.Module) -> list[Finding]:
         return None
 
     findings: list[Finding] = []
-    for cls in ast.walk(tree):
+    for cls in _fast_walk(tree):
         if not isinstance(cls, ast.ClassDef):
             continue
         for m in cls.body:
@@ -1429,10 +1549,10 @@ def _check_bounded_containers(path: str, tree: ast.Module) -> list[Finding]:
                 continue
             bounded: set[str] | None = None
             seen: set[int] = set()
-            for loop in ast.walk(m):
+            for loop in _fast_walk(m):
                 if not isinstance(loop, (ast.For, ast.While)):
                     continue
-                for node in ast.walk(loop):
+                for node in _fast_walk(loop):
                     if node is loop or id(node) in seen:
                         continue
                     site = growth_site(node)
@@ -1671,7 +1791,7 @@ def _env_read_knobs(tree: ast.Module) -> dict[str, int]:
     reads through a constant alias (`FANOUT_ENV = "WVA_..."`). Returns
     knob -> first read line."""
     aliases: dict[str, str] = {}
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         if isinstance(node, ast.Assign) and \
                 isinstance(node.value, ast.Constant) and \
                 isinstance(node.value.value, str) and \
@@ -1693,7 +1813,7 @@ def _env_read_knobs(tree: ast.Module) -> dict[str, int]:
         return None
 
     reads: dict[str, int] = {}
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         knob = None
         if isinstance(node, ast.Call):
             tail = _call_tail(node)
@@ -1774,6 +1894,29 @@ def _knob_parity_findings(files: list[str], sources: dict[str, str],
                    or base.startswith("test_") or base == "conftest.py")
         if is_test:
             continue  # tests set knobs; operators read the doc for code
+        for knob, line in _env_read_knobs(tree).items():
+            reads.setdefault(knob, (fp, line))
+    # Repo-root scripts (bench_*.py etc.) read doc'd knobs too but are
+    # rarely passed as scan paths; fold them into the read surface so a
+    # package+tools+tests scan doesn't report their knobs as phantom rot.
+    scanned = {os.path.abspath(fp) for fp in files}
+    try:
+        root_scripts = sorted(os.listdir(root))
+    except OSError:
+        root_scripts = []
+    for base in root_scripts:
+        fp = os.path.join(root, base)
+        if (not base.endswith(".py") or base.startswith("test_")
+                or os.path.abspath(fp) in scanned):
+            continue
+        try:
+            with open(fp, encoding="utf-8") as f:
+                text = f.read()
+            tree = ast.parse(text, filename=fp)
+        except (OSError, SyntaxError):
+            continue
+        _index_tree(tree)
+        literals |= set(KNOB_RE.findall(text))
         for knob, line in _env_read_knobs(tree).items():
             reads.setdefault(knob, (fp, line))
     rel_doc = os.path.relpath(doc) if not os.path.isabs(files[0]) else doc
@@ -1862,7 +2005,7 @@ def _check_fault_kinds(path: str, tree: ast.Module,
                     isinstance(v.value, str) and v.value not in kinds:
                 bad(v, v.value)
 
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         if isinstance(node, ast.Call) and _call_tail(node) == "FaultRule":
             arg = None
             if node.args and isinstance(node.args[0], ast.Constant):
@@ -1910,7 +2053,7 @@ def _check_stage_literals(path: str, tree: ast.Module,
             f"unknown reconcile stage {value!r} (not in metrics."
             f"RECONCILE_STAGES: {sorted(stages)})"))
 
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         if isinstance(node, ast.Call):
             if _call_tail(node) == "mark" and node.args and \
                     isinstance(node.args[0], ast.Constant) and \
@@ -1958,10 +2101,10 @@ def _gated_routes_from_trees(trees: dict[str, ast.Module],
     for fp, tree in trees.items():
         if not os.path.abspath(fp).endswith(AUTH_TEST_SUFFIX):
             continue
-        for node in ast.walk(tree):
+        for node in _fast_walk(tree):
             if isinstance(node, ast.ClassDef) and \
                     node.name == AUTH_TEST_CLASS:
-                routes = {n.value for n in ast.walk(node)
+                routes = {n.value for n in _fast_walk(node)
                           if isinstance(n, ast.Constant)
                           and isinstance(n.value, str)
                           and _DEBUG_ROUTE_RE.fullmatch(n.value)}
@@ -1977,7 +2120,7 @@ def _check_debug_route_gating(path: str, tree: ast.Module,
     if not os.path.abspath(path).endswith(DEBUG_MODULE_SUFFIX):
         return []
     findings: list[Finding] = []
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         if isinstance(node, ast.Constant) and \
                 isinstance(node.value, str) and \
                 _DEBUG_ROUTE_RE.fullmatch(node.value) and \
@@ -2008,7 +2151,7 @@ _AUDIT_CALLS = ("note_transfer", "note_readback")
 
 
 def _imports_jax(tree: ast.Module) -> bool:
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         if isinstance(node, ast.Import):
             if any(a.name == "jax" or a.name.startswith("jax.")
                    for a in node.names):
@@ -2025,7 +2168,7 @@ def _readback_sites(subtree) -> list:
     conversion numpy performs via __array__, a d2h copy for a jax array)
     and any .block_until_ready() (incl. jax.block_until_ready(x))."""
     sites = []
-    for node in ast.walk(subtree):
+    for node in _fast_walk(subtree):
         if not isinstance(node, ast.Call):
             continue
         fn = node.func
@@ -2075,7 +2218,7 @@ def _check_unaudited_readbacks(path: str, tree: ast.Module) -> list[Finding]:
     for fn in funcs:
         audited = any(
             isinstance(n, ast.Call) and _call_tail(n) in _AUDIT_CALLS
-            for n in ast.walk(fn))
+            for n in _fast_walk(fn))
         for site in _readback_sites(fn):
             in_func.add(id(site))
             if not audited:
@@ -2100,7 +2243,7 @@ def _stage_use_sites(tree: ast.Module, stage_consts: dict) -> set:
     `stage=` keyword reads deliberately do not count — reading a
     stage's series back is not producing it."""
     used: set = set()
-    for node in ast.walk(tree):
+    for node in _fast_walk(tree):
         if isinstance(node, ast.Call) and _call_tail(node) == "mark" \
                 and node.args:
             arg = node.args[0]
@@ -2172,13 +2315,1013 @@ def _stage_coverage_findings(files: list[str],
         {s: lines.get(s, 1) for s in stages}, used, metrics_fp)
 
 
+# -- compiled-path discipline (WVL5xx) --------------------------------------
+#
+# A package-level call-graph + intraprocedural dataflow engine for the
+# XLA decision path. Entry points are collected from every jit idiom the
+# package uses: decorator form (`@jax.jit`, `@partial(jax.jit, ...)`),
+# call form (`jax.jit(f, ...)`, incl. `jax.jit(partial(f, k_max=...))`
+# factory results and nested-def donation programs), `_AuditedJit`-style
+# wrapper classes, and `pl.pallas_call(...)`. The traced set is the
+# closure of same-package calls reachable from any entry; five rules
+# run over it (WVL501..WVL505, see the module docstring).
+
+_PKG_NAME = "workload_variant_autoscaler_tpu"
+_JIT_TAILS = {"jit", "pjit"}
+_WRAPPER_SEED = "_AuditedJit"
+# helpers whose results come from a bounded vocabulary: a static jit
+# argument routed through one of these cannot retrace per fleet size
+_BUCKET_FNS = {
+    "k_max_bucket", "lane_bucket", "padded_lanes", "head_width",
+    "bisection_trips", "_bucket",
+}
+_DEVICE_COUNT_CALLS = {
+    "jax.devices", "jax.device_count", "jax.local_device_count",
+}
+_LOGGERISH = {"logger", "log", "_log", "_logger"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+
+
+def _pkg_path(path: str) -> bool:
+    return _PKG_NAME in os.path.abspath(path).split(os.sep)
+
+
+def _all_params(fn) -> list:
+    a = fn.args
+    out = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        out.append(a.vararg.arg)
+    if a.kwarg:
+        out.append(a.kwarg.arg)
+    return out
+
+
+def _pos_params(fn) -> list:
+    """Positionally addressable params, in order (argnums index these)."""
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _const_items(node) -> list:
+    """Constants from a Constant or a Tuple/List of Constants."""
+    if isinstance(node, ast.Constant):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts if isinstance(e, ast.Constant)]
+    return []
+
+
+def _jit_spec(keywords, params, shift=0):
+    """(static param names, donated param names, donated call positions)
+    from jit kwargs. `shift` maps argnums through partial-bound
+    positional args onto the underlying def's signature."""
+    static: set = set()
+    donate_names: set = set()
+    donate_pos: set = set()
+    for kw in keywords:
+        vals = _const_items(kw.value)
+        if kw.arg == "static_argnames":
+            static |= {v for v in vals if isinstance(v, str)}
+        elif kw.arg == "static_argnums":
+            for v in vals:
+                if isinstance(v, int) and 0 <= v + shift < len(params):
+                    static.add(params[v + shift])
+        elif kw.arg == "donate_argnames":
+            donate_names |= {v for v in vals if isinstance(v, str)}
+        elif kw.arg == "donate_argnums":
+            for v in vals:
+                if isinstance(v, int):
+                    donate_pos.add(v)
+                    if 0 <= v + shift < len(params):
+                        donate_names.add(params[v + shift])
+    return static, donate_names, donate_pos
+
+
+class _Mod:
+    """One scanned package module: defs, resolved same-package imports,
+    jit aliases, wrapper classes."""
+    __slots__ = ("path", "tree", "funcs", "sym_imports", "mod_imports",
+                 "classes", "consts", "aliases", "device_consts")
+
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.tree = tree
+        self.funcs = {n.name: n for n in tree.body
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+        self.classes = {n.name: n for n in tree.body
+                        if isinstance(n, ast.ClassDef)}
+        self.consts = _module_consts(tree)
+        self.sym_imports: dict = {}   # local name -> (path, remote name)
+        self.mod_imports: dict = {}   # local name -> path
+        self.aliases: dict = {}       # local name -> entry key
+        self.device_consts: set = set()
+
+
+def _import_entries(cur_path: str, node: ast.ImportFrom, by_abs: dict):
+    """Resolve an ImportFrom against the scanned package file set.
+    Yields (local name, kind, target path, remote name), kind "mod" for
+    module-object imports (`from ..ops import fused`) and "sym" for
+    symbol imports (`from .batched import _bisect`)."""
+    out: list = []
+    if node.level:
+        base = os.path.dirname(os.path.abspath(cur_path))
+        for _ in range(node.level - 1):
+            base = os.path.dirname(base)
+    else:
+        mod = node.module or ""
+        parts = os.path.abspath(cur_path).split(os.sep)
+        if not mod.startswith(_PKG_NAME) or _PKG_NAME not in parts:
+            return out
+        base = os.sep.join(parts[:parts.index(_PKG_NAME)]) or os.sep
+    mod_dir = os.path.join(base, *[p for p in (node.module or "").split(".")
+                                   if p])
+    for alias in node.names:
+        local = alias.asname or alias.name
+        sub = os.path.join(mod_dir, alias.name + ".py")
+        if sub in by_abs:
+            out.append((local, "mod", by_abs[sub], alias.name))
+            continue
+        for cand in (mod_dir + ".py", os.path.join(mod_dir, "__init__.py")):
+            if cand in by_abs:
+                out.append((local, "sym", by_abs[cand], alias.name))
+                break
+    return out
+
+
+class _JitCtx:
+    """Package-wide jit entry registry, traced-set closure, and the
+    WVL5xx findings computed over them."""
+
+    def __init__(self):
+        self.mods: dict = {}      # path -> _Mod
+        self.entries: dict = {}   # (path, def lineno) -> spec dict
+        self.traced: dict = {}    # (path, def lineno) -> (_Mod, def node)
+        self.wrapper_names: set = set()
+        self._findings: set = set()   # (path, line, code, message)
+
+    def add(self, path: str, line: int, code: str, message: str) -> None:
+        self._findings.add((path, line, code, message))
+
+    def findings_for(self, path: str) -> list:
+        return [Finding(p, ln, c, m)
+                for (p, ln, c, m) in sorted(self._findings) if p == path]
+
+    def register(self, path, fn, static=(), bound=(), donate_names=(),
+                 donate_pos=(), kind="jit"):
+        key = (path, fn.lineno)
+        e = self.entries.setdefault(key, {
+            "fn": fn, "path": path, "static": set(), "bound": set(),
+            "donate_names": set(), "donate_pos": set(), "kind": kind})
+        e["static"] |= set(static)
+        e["bound"] |= set(bound)
+        e["donate_names"] |= set(donate_names)
+        e["donate_pos"] |= set(donate_pos)
+        return key
+
+
+def _local_defs(fn) -> dict:
+    """All defs nested under `fn` (flat; nearest-name-wins imprecision
+    is acceptable for call resolution)."""
+    out: dict = {}
+    for n in _fast_walk(fn):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n is not fn:
+            out.setdefault(n.name, n)
+    return out
+
+
+def _resolve_fn(ctx: _JitCtx, mod: _Mod, node, stack=(), depth=0):
+    """(def node, owning _Mod) for a Name/Attribute callee, chasing
+    nested defs, module defs, same-package imports, and jit aliases.
+    None when the target leaves the scan or static reach."""
+    if depth > 8:
+        return None
+    if isinstance(node, ast.Name):
+        for scope in reversed(list(stack)):
+            if node.id in scope:
+                return scope[node.id], mod
+        if node.id in mod.funcs:
+            return mod.funcs[node.id], mod
+        if node.id in mod.sym_imports:
+            p, remote = mod.sym_imports[node.id]
+            m2 = ctx.mods.get(p)
+            if m2 is not None:
+                return _resolve_fn(ctx, m2, ast.Name(id=remote), (),
+                                   depth + 1)
+        if node.id in mod.aliases:
+            e = ctx.entries.get(mod.aliases[node.id])
+            if e is not None:
+                owner = ctx.mods.get(e["path"])
+                if owner is not None:
+                    return e["fn"], owner
+    elif isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        p = mod.mod_imports.get(node.value.id)
+        m2 = ctx.mods.get(p) if p else None
+        if m2 is not None:
+            return _resolve_fn(ctx, m2, ast.Name(id=node.attr), (),
+                               depth + 1)
+    return None
+
+
+def _entry_for_call(ctx: _JitCtx, mod: _Mod, call: ast.Call, stack=()):
+    """The entry spec a call resolves to (through aliases/imports), or
+    None when the callee is not a registered jit boundary."""
+    got = _resolve_fn(ctx, mod, call.func, stack)
+    if got is None:
+        return None
+    fn, owner = got
+    return ctx.entries.get((owner.path, fn.lineno))
+
+
+def _unwrap_partial(target):
+    """(underlying callee expr, bound kwarg names, bound positional
+    count) for `partial(f, x, k_max=...)`; identity for anything else."""
+    if isinstance(target, ast.Call) and _call_tail(target) == "partial" \
+            and target.args:
+        bound = {kw.arg for kw in target.keywords if kw.arg}
+        return target.args[0], bound, len(target.args) - 1
+    return target, set(), 0
+
+
+def _entry_spec_from_call(ctx: _JitCtx, mod: _Mod, call: ast.Call, stack):
+    """Register a call-form entry (`jax.jit(f, ...)`, `pallas_call(k)`,
+    `_AuditedJit("name", f)`); returns the entry key or None."""
+    tail = _call_tail(call)
+    if tail in _JIT_TAILS and call.args:
+        d = _dotted(call.func) or ""
+        if d not in ("jit", "pjit") and not d.startswith("jax."):
+            return None
+        target, bound, shift = _unwrap_partial(call.args[0])
+        got = _resolve_fn(ctx, mod, target, stack)
+        if got is None:
+            return None
+        fn, owner = got
+        params = _pos_params(fn)
+        bound |= set(params[:shift])
+        static, dnames, dpos = _jit_spec(call.keywords, params, shift)
+        return ctx.register(owner.path, fn, static, bound, dnames, dpos)
+    if tail == "pallas_call" and call.args:
+        target, bound, shift = _unwrap_partial(call.args[0])
+        got = _resolve_fn(ctx, mod, target, stack)
+        if got is None:
+            return None
+        fn, owner = got
+        bound |= set(_pos_params(fn)[:shift])
+        return ctx.register(owner.path, fn, set(), bound, kind="pallas")
+    if isinstance(call.func, ast.Name) and \
+            call.func.id in ctx.wrapper_names and len(call.args) >= 2:
+        got = _resolve_fn(ctx, mod, call.args[1], stack)
+        if got is None:
+            return None
+        fn, owner = got
+        return ctx.register(owner.path, fn)
+    return None
+
+
+def _entry_from_decorators(ctx: _JitCtx, mod: _Mod, fn) -> None:
+    for dec in fn.decorator_list:
+        if isinstance(dec, (ast.Name, ast.Attribute)):
+            d = _dotted(dec) or ""
+            if d.split(".")[-1] in _JIT_TAILS and \
+                    (d in ("jit", "pjit") or d.startswith("jax.")):
+                ctx.register(mod.path, fn)
+        elif isinstance(dec, ast.Call):
+            d = _dotted(dec.func) or ""
+            inner = None
+            if d.split(".")[-1] in _JIT_TAILS and \
+                    (d in ("jit", "pjit") or d.startswith("jax.")):
+                inner = dec
+            elif _call_tail(dec) == "partial" and dec.args:
+                fd = _dotted(dec.args[0]) or ""
+                if fd.split(".")[-1] in _JIT_TAILS and \
+                        (fd in ("jit", "pjit") or fd.startswith("jax.")):
+                    inner = dec
+            if inner is not None:
+                static, dn, dp = _jit_spec(inner.keywords, _pos_params(fn))
+                ctx.register(mod.path, fn, static, set(), dn, dp)
+
+
+def _scan_module_entries(ctx: _JitCtx, mod: _Mod) -> None:
+    """One walk per module: decorator entries, call-form entries, and
+    `alias = <entry call>` bindings (incl. `global X; X = jax.jit(f)`
+    and `x = _AuditedJit("x", impl)` module aliases)."""
+
+    def walk(node, stack):
+        if isinstance(node, ast.Call):
+            _entry_spec_from_call(ctx, mod, node, stack)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call):
+            key = _entry_spec_from_call(ctx, mod, node.value, stack)
+            if key is not None:
+                mod.aliases.setdefault(node.targets[0].id, key)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _entry_from_decorators(ctx, mod, node)
+            scope = {st.name: st for st in node.body
+                     if isinstance(st, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+            stack = list(stack) + [scope]
+        for child in ast.iter_child_nodes(node):
+            walk(child, stack)
+
+    walk(mod.tree, [dict(mod.funcs)])
+
+
+def _trace_closure(ctx: _JitCtx) -> None:
+    """BFS over same-package calls from every entry def. Nested defs of
+    a traced def are traced with it (they run at trace time)."""
+    queue = []
+    for key, e in sorted(ctx.entries.items()):
+        mod = ctx.mods.get(e["path"])
+        if mod is not None and key not in ctx.traced:
+            ctx.traced[key] = (mod, e["fn"])
+            queue.append((mod, e["fn"]))
+    while queue:
+        mod, fn = queue.pop()
+        stack = [_local_defs(fn)]
+        for n in _fast_walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            got = _resolve_fn(ctx, mod, n.func, stack)
+            if got is None:
+                continue
+            callee, owner = got
+            k2 = (owner.path, callee.lineno)
+            if k2 not in ctx.traced:
+                ctx.traced[k2] = (owner, callee)
+                queue.append((owner, callee))
+
+
+def _check_traced_purity(ctx: _JitCtx) -> None:
+    """WVL501 — traced bodies must be pure up to note_trace(): no
+    time/random/logging/printing, no lock traffic, no self-or-global
+    mutation. Side effects in a traced body run once per TRACE, not per
+    call — they silently vanish from the steady state and reappear on
+    every retrace."""
+    for (path, _), (mod, fn) in sorted(ctx.traced.items()):
+        bound = set(_all_params(fn)) | _fn_local_bindings(fn)
+        for nested in _local_defs(fn).values():
+            bound |= set(_all_params(nested)) | _fn_local_bindings(nested)
+
+        def flag(line, msg, path=path, fn=fn):
+            ctx.add(path, line, "WVL501",
+                    f"traced body {fn.name!r}: {msg} — a side effect "
+                    "inside jit runs per-trace, not per-call")
+
+        for n in _fast_walk(fn):
+            if isinstance(n, ast.Call):
+                d = _dotted(n.func) or ""
+                tail = _call_tail(n)
+                if tail == "note_trace":
+                    continue   # the one allowlisted effect (audit hook)
+                head = d.split(".")[0] if d else ""
+                if head in ("time", "random") or \
+                        d.startswith(("np.random.", "numpy.random.")):
+                    flag(n.lineno, f"call to {d}()")
+                elif head == "logging" or (
+                        isinstance(n.func, ast.Attribute) and
+                        isinstance(n.func.value, ast.Name) and
+                        n.func.value.id.lower() in _LOGGERISH and
+                        n.func.attr in _LOG_METHODS):
+                    flag(n.lineno, "logging call")
+                elif isinstance(n.func, ast.Name) and n.func.id == "print":
+                    flag(n.lineno, "print() call")
+                elif tail == "acquire":
+                    flag(n.lineno, "lock acquisition")
+                elif tail in _MUTATING_METHODS and \
+                        isinstance(n.func, ast.Attribute):
+                    recv = n.func.value
+                    # x.at[i].add(v) is jnp's functional update, not a
+                    # container mutation
+                    if isinstance(recv, ast.Subscript) and \
+                            isinstance(recv.value, ast.Attribute) and \
+                            recv.value.attr == "at":
+                        continue
+                    base = _name_base(recv)
+                    if base is not None and base not in bound:
+                        flag(n.lineno,
+                             f"mutation of non-local {base!r} "
+                             f"via .{tail}()")
+            elif isinstance(n, ast.With) and _with_mentions_lock(n):
+                flag(n.lineno, "lock-scoped with block")
+            elif isinstance(n, ast.Global):
+                flag(n.lineno, "global declaration")
+            elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                for t in targets:
+                    if _self_attr_base(t) is not None:
+                        flag(n.lineno, "self-attribute mutation")
+                    elif isinstance(t, ast.Subscript):
+                        base = _name_base(t.value)
+                        if base is not None and base not in bound:
+                            flag(n.lineno,
+                                 f"subscript store into non-local "
+                                 f"{base!r}")
+
+
+def _bare_params(expr, params: set) -> set:
+    """Param names appearing as bare Name loads in `expr` — a Name that
+    is only an attribute receiver (q.batch_size) does NOT count: its
+    attributes are trace-time shape metadata, not the value itself."""
+    out: set = set()
+
+    def rec(n):
+        if isinstance(n, ast.Attribute):
+            rec_skip_name(n.value)
+            return
+        if isinstance(n, ast.Name):
+            if n.id in params:
+                out.add(n.id)
+            return
+        for c in ast.iter_child_nodes(n):
+            rec(c)
+
+    def rec_skip_name(n):
+        if isinstance(n, ast.Name):
+            return
+        rec(n)
+
+    rec(expr)
+    return out
+
+
+_SHAPE_CTOR_TAILS = {"zeros", "ones", "full", "empty", "arange",
+                     "linspace", "eye", "tri", "range"}
+_STATIC_KWARG_NAMES = {"num_segments", "shape"}
+
+
+def _static_demands(fn) -> set:
+    """Params of a traced def whose values land in trace-time positions:
+    branch conditions, shape/iteration constructors, num_segments= and
+    shape= keywords."""
+    params = set(_all_params(fn))
+    demand: set = set()
+    for n in _fast_walk(fn):
+        if isinstance(n, (ast.If, ast.While, ast.IfExp)):
+            demand |= _bare_params(n.test, params)
+        elif isinstance(n, ast.Call):
+            if _call_tail(n) in _SHAPE_CTOR_TAILS:
+                for a in n.args:
+                    demand |= _bare_params(a, params)
+            for kw in n.keywords:
+                if kw.arg in _STATIC_KWARG_NAMES:
+                    demand |= _bare_params(kw.value, params)
+    return demand
+
+
+def _map_call_args(call: ast.Call, callee) -> list:
+    """(param name, arg expr) pairs for a call against a def's
+    positional signature plus keywords."""
+    params = _pos_params(callee)
+    out = []
+    for i, a in enumerate(call.args):
+        if i < len(params):
+            out.append((params[i], a))
+    for kw in call.keywords:
+        if kw.arg:
+            out.append((kw.arg, kw.value))
+    return out
+
+
+def _check_retrace_stability(ctx: _JitCtx) -> None:
+    """WVL502, def side — every trace-time param of a jit entry must be
+    declared static (or partial-bound); demands propagate through
+    same-package calls, so a helper's jnp.arange(k_max) reaches the
+    entry that forgot to declare k_max."""
+    demands = {key: _static_demands(fn)
+               for key, (_, fn) in ctx.traced.items()}
+    changed = True
+    rounds = 0
+    while changed and rounds < 20:
+        changed = False
+        rounds += 1
+        for key, (mod, fn) in ctx.traced.items():
+            params = set(_all_params(fn))
+            stack = [_local_defs(fn)]
+            for n in _fast_walk(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                got = _resolve_fn(ctx, mod, n.func, stack)
+                if got is None:
+                    continue
+                callee, owner = got
+                need = demands.get((owner.path, callee.lineno))
+                if not need:
+                    continue
+                for pname, arg in _map_call_args(n, callee):
+                    if pname not in need:
+                        continue
+                    for p in _bare_params(arg, params):
+                        if p not in demands[key]:
+                            demands[key].add(p)
+                            changed = True
+    for key, e in sorted(ctx.entries.items()):
+        if key not in ctx.traced:
+            continue
+        missing = sorted(demands.get(key, set())
+                         - e["static"] - e["bound"])
+        if missing:
+            fn = e["fn"]
+            ctx.add(e["path"], fn.lineno, "WVL502",
+                    f"jit entry {fn.name!r}: param(s) "
+                    f"{', '.join(missing)} reach trace-time positions "
+                    "(branch/shape/num_segments) but are not in "
+                    "static_argnums/static_argnames — every distinct "
+                    "value silently recompiles")
+
+
+def _classify_bounded(expr, assigns: dict, mod: _Mod, seen: frozenset):
+    """'bounded' | 'unbounded' | None (unknown) for an expression that
+    feeds a static jit argument. Bounded = constants and bucket-helper
+    results; unbounded = len()/shape/batch_size-derived scalars that
+    track fleet size."""
+    if isinstance(expr, ast.Constant):
+        return "bounded"
+    if isinstance(expr, ast.Name):
+        if expr.id in seen:
+            return None
+        if expr.id in assigns:
+            return _classify_bounded(assigns[expr.id], assigns, mod,
+                                     seen | {expr.id})
+        if expr.id in mod.consts:
+            return "bounded"
+        return None
+    if isinstance(expr, ast.Call):
+        if _call_tail(expr) in _BUCKET_FNS:
+            return "bounded"
+        if isinstance(expr.func, ast.Name) and expr.func.id in (
+                "len", "sum"):
+            return "unbounded"
+        if isinstance(expr.func, ast.Name) and expr.func.id in (
+                "int", "max", "min", "abs", "round"):
+            kinds = [_classify_bounded(a, assigns, mod, seen)
+                     for a in expr.args]
+            if "unbounded" in kinds:
+                return "unbounded"
+            if kinds and all(k == "bounded" for k in kinds):
+                return "bounded"
+        return None
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in ("batch_size", "size", "shape"):
+            return "unbounded"
+        return None
+    if isinstance(expr, ast.Subscript):
+        return _classify_bounded(expr.value, assigns, mod, seen)
+    if isinstance(expr, ast.BinOp):
+        left = _classify_bounded(expr.left, assigns, mod, seen)
+        right = _classify_bounded(expr.right, assigns, mod, seen)
+        if "unbounded" in (left, right):
+            return "unbounded"
+        if left == right == "bounded":
+            return "bounded"
+        return None
+    if isinstance(expr, ast.UnaryOp):
+        return _classify_bounded(expr.operand, assigns, mod, seen)
+    if isinstance(expr, ast.IfExp):
+        kinds = {_classify_bounded(expr.body, assigns, mod, seen),
+                 _classify_bounded(expr.orelse, assigns, mod, seen)}
+        if "unbounded" in kinds:
+            return "unbounded"
+        if kinds == {"bounded"}:
+            return "bounded"
+    return None
+
+
+def _check_static_callsites(ctx: _JitCtx) -> None:
+    """WVL502, call side — a value feeding a STATIC jit param must be
+    provably bounded (constant / bucket helper) or unknown; a scalar
+    that provably tracks fleet size (len()/shape/batch_size chains)
+    retraces once per distinct fleet and is flagged."""
+    for path, mod in sorted(ctx.mods.items()):
+        for fn in [n for n in _fast_walk(mod.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            assigns: dict = {}
+            for n in _walk_own(fn):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                        isinstance(n.targets[0], ast.Name):
+                    assigns[n.targets[0].id] = n.value
+            stack = [_local_defs(fn)]
+            for n in _walk_own(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                e = _entry_for_call(ctx, mod, n, stack)
+                if e is None or not e["static"]:
+                    continue
+                for pname, arg in _map_call_args(n, e["fn"]):
+                    if pname not in e["static"]:
+                        continue
+                    if _classify_bounded(arg, assigns, mod,
+                                         frozenset()) == "unbounded":
+                        ctx.add(path, n.lineno, "WVL502",
+                                f"static jit arg {pname!r} of "
+                                f"{e['fn'].name!r} derives from an "
+                                "unbounded runtime value (len/shape/"
+                                "batch_size) — route it through a "
+                                "bucketing helper (k_max_bucket, "
+                                "lane_bucket) or it retraces per "
+                                "fleet size")
+
+
+def _stmt_loads(st, skip=None) -> list:
+    """(name, lineno) Load events in a statement's own expressions;
+    nested defs/lambdas and the `skip` subtree are excluded, as are the
+    header-managed bodies of compound statements (the caller recurses
+    into those itself)."""
+    out: list = []
+    compound_bodies: set = set()
+    for attr in ("body", "orelse", "finalbody", "handlers"):
+        for sub in getattr(st, attr, []) or []:
+            compound_bodies.add(id(sub))
+
+    def rec(n):
+        if n is skip or id(n) in compound_bodies:
+            return
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.append((n.id, n.lineno))
+        for c in ast.iter_child_nodes(n):
+            rec(c)
+
+    rec(st)
+    return out
+
+
+def _stmt_kills(st) -> set:
+    """Names this statement rebinds (a rebound name holds a NEW buffer;
+    the donated one is gone either way, but reading the name is fine)."""
+    kills: set = set()
+    if isinstance(st, ast.Assign):
+        for t in st.targets:
+            for n in _fast_walk(t):
+                if isinstance(n, ast.Name) and \
+                        isinstance(n.ctx, ast.Store):
+                    kills.add(n.id)
+    elif isinstance(st, ast.AnnAssign) and isinstance(st.target, ast.Name):
+        kills.add(st.target.id)
+    elif isinstance(st, ast.Delete):
+        for t in st.targets:
+            if isinstance(t, ast.Name):
+                kills.add(t.id)
+    elif isinstance(st, (ast.With, ast.AsyncWith)):
+        for item in st.items:
+            if isinstance(item.optional_vars, ast.Name):
+                kills.add(item.optional_vars.id)
+    return kills
+
+
+def _check_donation(ctx: _JitCtx) -> None:
+    """WVL503 — a bare name passed at a donate_argnums position is dead
+    after the call: XLA may reuse its buffer for the output. Any-path
+    reads-after analysis, statement-granular, loop back-edges included;
+    rebinding the name revives it."""
+    for path, mod in sorted(ctx.mods.items()):
+        for fn in [n for n in _fast_walk(mod.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            stack = [_local_defs(fn)]
+            calls = []
+            for n in _walk_own(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                e = _entry_for_call(ctx, mod, n, stack)
+                if e is None:
+                    continue
+                donated = set()
+                for i, a in enumerate(n.args):
+                    if i in e["donate_pos"] and isinstance(a, ast.Name):
+                        donated.add(a.id)
+                for kw in n.keywords:
+                    if kw.arg in e["donate_names"] and \
+                            isinstance(kw.value, ast.Name):
+                        donated.add(kw.value.id)
+                if donated:
+                    calls.append((n, donated, e["fn"].name))
+            for call, donated, callee in calls:
+                reported: set = set()
+
+                def report(name, ln, callee=callee, reported=reported,
+                           path=path):
+                    if (name, ln) in reported:
+                        return
+                    reported.add((name, ln))
+                    ctx.add(path, ln, "WVL503",
+                            f"read of {name!r} after it was donated to "
+                            f"{callee!r} — the buffer may already be "
+                            "reused by XLA; rebind the name or drop "
+                            "the donation")
+
+                def check(st, dead, report=report):
+                    for name, ln in _stmt_loads(st):
+                        if name in dead:
+                            report(name, ln)
+
+                def scan(stmts, dead, armed, call=call, donated=donated,
+                         report=report, check=check):
+                    for st in stmts:
+                        has_call = any(n is call for n in _fast_walk(st))
+                        if isinstance(st, ast.If):
+                            if armed:
+                                check(st, dead)
+                            d1, a1 = scan(st.body, set(dead), armed)
+                            d2, a2 = scan(st.orelse, set(dead), armed)
+                            dead, armed = d1 | d2, a1 or a2
+                            continue
+                        if isinstance(st, (ast.For, ast.AsyncFor,
+                                           ast.While)):
+                            if armed:
+                                check(st, dead)
+                            kills = _stmt_kills(st) | (
+                                {n.id for n in _fast_walk(st.target)
+                                 if isinstance(n, ast.Name)}
+                                if isinstance(st, (ast.For, ast.AsyncFor))
+                                else set())
+                            d1, a1 = scan(st.body, set(dead) - kills,
+                                          armed)
+                            # second pass models the back edge: a
+                            # donation in iteration i is dead at the
+                            # top of iteration i+1
+                            d2, a2 = scan(st.body, (d1 | dead) - kills,
+                                          a1)
+                            de, ae = scan(st.orelse, dead | d1 | d2,
+                                          armed or a2)
+                            dead, armed = dead | d1 | d2 | de, ae
+                            continue
+                        if isinstance(st, (ast.With, ast.AsyncWith)):
+                            if armed:
+                                check(st, dead)
+                            dead = dead - _stmt_kills(st)
+                            dead, armed = scan(st.body, dead, armed)
+                            continue
+                        if isinstance(st, ast.Try):
+                            d1, a1 = scan(st.body, set(dead), armed)
+                            dd, aa = d1, a1
+                            for h in st.handlers:
+                                dh, ah = scan(h.body, dead | d1, a1)
+                                dd, aa = dd | dh, aa or ah
+                            d3, a3 = scan(st.orelse, dd, aa)
+                            d4, a4 = scan(st.finalbody, dd | d3,
+                                          aa or a3)
+                            dead, armed = dd | d3 | d4, a4
+                            continue
+                        # simple statement
+                        if has_call:
+                            armed = True
+                            dead = (dead | donated) - _stmt_kills(st)
+                            continue
+                        if armed:
+                            if isinstance(st, ast.AugAssign) and \
+                                    isinstance(st.target, ast.Name) and \
+                                    st.target.id in dead:
+                                report(st.target.id, st.lineno)
+                            check(st, dead)
+                        dead = dead - _stmt_kills(st)
+                    return dead, armed
+
+                scan(fn.body, set(), False)
+
+
+def _is_array_expr(expr, arrays: set, ctx: _JitCtx, mod: _Mod,
+                   stack) -> bool:
+    """Does `expr` evaluate to a jax device array, per local dataflow?
+    Params and np.* values stay unknown — only jnp.*, device_put, and
+    jit-entry results seed the array set."""
+    if isinstance(expr, ast.Name):
+        return expr.id in arrays
+    if isinstance(expr, ast.Call):
+        d = _dotted(expr.func) or ""
+        if d.startswith(("jnp.", "jax.numpy.")) or d == "jax.device_put":
+            return True
+        if _entry_for_call(ctx, mod, expr, stack) is not None:
+            return True
+        if isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr not in ("item", "tolist") and \
+                _is_array_expr(expr.func.value, arrays, ctx, mod, stack):
+            return True
+        return False
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in ("shape", "dtype", "ndim", "size"):
+            return False   # static metadata, no device sync
+        return _is_array_expr(expr.value, arrays, ctx, mod, stack)
+    if isinstance(expr, ast.Subscript):
+        return _is_array_expr(expr.value, arrays, ctx, mod, stack)
+    if isinstance(expr, ast.BinOp):
+        return _is_array_expr(expr.left, arrays, ctx, mod, stack) or \
+            _is_array_expr(expr.right, arrays, ctx, mod, stack)
+    if isinstance(expr, ast.Compare):
+        return _is_array_expr(expr.left, arrays, ctx, mod, stack) or \
+            any(_is_array_expr(c, arrays, ctx, mod, stack)
+                for c in expr.comparators)
+    if isinstance(expr, ast.UnaryOp):
+        return _is_array_expr(expr.operand, arrays, ctx, mod, stack)
+    if isinstance(expr, ast.IfExp):
+        return _is_array_expr(expr.body, arrays, ctx, mod, stack) or \
+            _is_array_expr(expr.orelse, arrays, ctx, mod, stack)
+    return False
+
+
+def _walk_host(fn, ctx: _JitCtx, path: str):
+    """Walk a host function's subtree, pruning nested defs that are
+    themselves traced (their body runs under jit, not on the host)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                (path, node.lineno) in ctx.traced:
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_host_sync(ctx: _JitCtx) -> None:
+    """WVL504 — bool()/int()/float()/.item()/.tolist()/iteration/branch
+    conditions on jax array values force a blocking d2h sync; outside
+    functions that route through note_transfer/note_readback the
+    transfer audit (and the 1-d2h-per-cycle budget) cannot see it.
+    Closes the gap WVL305 leaves: WVL305 only sees explicit
+    np.asarray/block_until_ready."""
+    for path, mod in sorted(ctx.mods.items()):
+        apath = os.path.abspath(path)
+        if not any(d in apath for d in _READBACK_DIRS):
+            continue
+        if not _imports_jax(mod.tree):
+            continue
+        funcs: list = []
+
+        def collect(body, funcs=funcs):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    funcs.append(node)
+                elif isinstance(node, ast.ClassDef):
+                    collect(node.body)
+
+        collect(mod.tree.body)
+        for fn in funcs:
+            if (path, fn.lineno) in ctx.traced:
+                continue
+            if any(isinstance(n, ast.Call) and
+                   _call_tail(n) in _AUDIT_CALLS for n in _fast_walk(fn)):
+                continue   # audited function: syncs are counted there
+            stack = [_local_defs(fn)]
+            arrays: set = set()
+            for _ in range(2):   # two passes settle simple chains
+                for n in _walk_host(fn, ctx, path):
+                    if isinstance(n, ast.Assign) and \
+                            _is_array_expr(n.value, arrays, ctx, mod,
+                                           stack):
+                        for t in n.targets:
+                            if isinstance(t, ast.Name):
+                                arrays.add(t.id)
+                            elif isinstance(t, (ast.Tuple, ast.List)):
+                                for e in t.elts:
+                                    if isinstance(e, ast.Name):
+                                        arrays.add(e.id)
+
+            def is_arr(e, arrays=arrays, mod=mod, stack=stack):
+                return _is_array_expr(e, arrays, ctx, mod, stack)
+
+            seen_lines: set = set()
+
+            def flag(line, what, path=path, fn=fn,
+                     seen_lines=seen_lines):
+                if line in seen_lines:
+                    return
+                seen_lines.add(line)
+                ctx.add(path, line, "WVL504",
+                        f"implicit host sync in {fn.name!r}: {what} on "
+                        "a device array outside any audited function — "
+                        "route the readback through "
+                        "JAX_AUDIT.note_readback/note_transfer")
+
+            for n in _walk_host(fn, ctx, path):
+                if isinstance(n, ast.Call):
+                    if isinstance(n.func, ast.Name) and \
+                            n.func.id in ("bool", "int", "float") and \
+                            n.args and is_arr(n.args[0]):
+                        flag(n.lineno, f"{n.func.id}()")
+                    elif isinstance(n.func, ast.Attribute) and \
+                            n.func.attr in ("item", "tolist") and \
+                            is_arr(n.func.value):
+                        flag(n.lineno, f".{n.func.attr}()")
+                elif isinstance(n, (ast.If, ast.While)) and \
+                        is_arr(n.test):
+                    flag(n.lineno, "a branch condition")
+                elif isinstance(n, ast.IfExp) and is_arr(n.test):
+                    flag(n.lineno, "a conditional expression")
+                elif isinstance(n, (ast.For, ast.AsyncFor)) and \
+                        is_arr(n.iter):
+                    flag(n.lineno, "iteration")
+                elif isinstance(n, (ast.ListComp, ast.SetComp,
+                                    ast.DictComp, ast.GeneratorExp)):
+                    for gen in n.generators:
+                        if is_arr(gen.iter):
+                            flag(n.lineno, "iteration")
+
+
+def _is_device_count_expr(expr) -> bool:
+    for n in _fast_walk(expr):
+        if isinstance(n, ast.Call) and \
+                (_dotted(n.func) or "") in _DEVICE_COUNT_CALLS:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "size" and \
+                isinstance(n.value, ast.Attribute) and \
+                n.value.attr == "devices":
+            return True
+    return False
+
+
+def _check_mesh_constants(ctx: _JitCtx) -> None:
+    """WVL505 — a traced body must not bake the host's device count in
+    as a Python constant (directly or through a module-level
+    N = len(jax.devices()) binding): the compiled program silently pins
+    the topology it was traced on. Device counts arrive as shaped
+    arguments or mesh axes."""
+    for path, mod in ctx.mods.items():
+        for n in _fast_walk(mod.tree):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and \
+                    _is_device_count_expr(n.value):
+                mod.device_consts.add(n.targets[0].id)
+    for (path, _), (mod, fn) in sorted(ctx.traced.items()):
+        local = set(_all_params(fn)) | _fn_local_bindings(fn)
+        for n in _fast_walk(fn):
+            if isinstance(n, ast.Call):
+                d = _dotted(n.func) or ""
+                if d in _DEVICE_COUNT_CALLS:
+                    ctx.add(path, n.lineno, "WVL505",
+                            f"traced body {fn.name!r} calls {d}() — "
+                            "the device count is baked into the "
+                            "compiled program as a constant; pass it "
+                            "as a shaped argument or mesh axis")
+            elif isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, ast.Load) and \
+                    n.id in mod.device_consts and n.id not in local:
+                ctx.add(path, n.lineno, "WVL505",
+                        f"traced body {fn.name!r} closes over "
+                        f"{n.id!r}, a device-count constant — the "
+                        "compiled program pins the trace-time "
+                        "topology")
+
+
+def build_jit_ctx(trees: dict) -> _JitCtx:
+    """Build the package call-graph context and run WVL501..WVL505 over
+    it. `trees` maps path -> parsed module; non-package paths are
+    ignored (tests and tools host jit-free code and fixtures)."""
+    ctx = _JitCtx()
+    for path, tree in sorted(trees.items()):
+        if _pkg_path(path):
+            _index_tree(tree)
+            ctx.mods[path] = _Mod(path, tree)
+    by_abs = {os.path.abspath(p): p for p in ctx.mods}
+    for path, mod in ctx.mods.items():
+        for node in _fast_walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                for local, kind, target, remote in _import_entries(
+                        path, node, by_abs):
+                    if kind == "mod":
+                        mod.mod_imports[local] = target
+                    else:
+                        mod.sym_imports[local] = (target, remote)
+    # wrapper classes: _AuditedJit plus anything whose base chain
+    # reaches it (by bare name — the names are package-unique)
+    ctx.wrapper_names = {_WRAPPER_SEED}
+    changed = True
+    while changed:
+        changed = False
+        for mod in ctx.mods.values():
+            for cname, cnode in mod.classes.items():
+                if cname in ctx.wrapper_names:
+                    continue
+                for base in cnode.bases:
+                    b = _dotted(base) or ""
+                    if b.split(".")[-1] in ctx.wrapper_names:
+                        ctx.wrapper_names.add(cname)
+                        changed = True
+    for mod in ctx.mods.values():
+        _scan_module_entries(ctx, mod)
+    _trace_closure(ctx)
+    _check_traced_purity(ctx)
+    _check_retrace_stability(ctx)
+    _check_static_callsites(ctx)
+    _check_donation(ctx)
+    _check_host_sync(ctx)
+    _check_mesh_constants(ctx)
+    return ctx
+
+
 # -- driver ----------------------------------------------------------------
 
 
 _STRUCTURAL_CODES = frozenset({
     "WVL001", "WVL002", "WVL003", "WVL101", "WVL102", "WVL103", "WVL104",
     "WVL105", "WVL106", "WVL305", "WVL307", "WVL401", "WVL402", "WVL403",
-    "WVL404", "WVL405",
+    "WVL404", "WVL405", "WVL501", "WVL502", "WVL503", "WVL504", "WVL505",
 })
 
 
@@ -2189,22 +3332,30 @@ def lint_source(path: str, source: str,
                 fault_kinds: frozenset | None = None,
                 stages: frozenset | None = None,
                 gated_routes: frozenset | None = None,
+                jit_ctx: _JitCtx | None = None,
+                tree: ast.Module | None = None,
                 ) -> list[Finding]:
-    try:
-        tree = ast.parse(source, path)
-    except SyntaxError as e:
-        return [Finding(path, e.lineno or 0, "WVL000",
-                        f"syntax error: {e.msg}")]
-    v = _StructuralVisitor(path)
-    v.visit(tree)
-    findings = v.findings
+    if tree is None:
+        try:
+            tree = ast.parse(source, path)
+        except SyntaxError as e:
+            return [Finding(path, e.lineno or 0, "WVL000",
+                            f"syntax error: {e.msg}")]
+    _index_tree(tree)
+    findings = _structural_findings(path, tree)
     findings += _undefined_names(path, source, tree)
     findings += _unused(path, source, tree)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef):
-            findings += _check_class_concurrency(path, node)
+    # WVL401/403 need a lock-typed attribute; no factory name in the
+    # text means no class can own one
+    if any(f + "(" in source for f in _LOCK_FACTORIES):
+        for node in _fast_walk(tree):
+            if isinstance(node, ast.ClassDef):
+                findings += _check_class_concurrency(path, node)
     findings += _check_module_lock_discipline(path, tree)
-    findings += _check_thread_shared_state(path, tree)
+    # WVL402's reachability is same-file: without a fanout()/Thread()
+    # handoff in the text there is nothing to reach mutations from
+    if "fanout" in source or "Thread" in source:
+        findings += _check_thread_shared_state(path, tree)
     findings += _check_stream_lock_guard(path, tree)
     findings += _check_bounded_containers(path, tree)
     findings += _check_unaudited_readbacks(path, tree)
@@ -2226,6 +3377,12 @@ def lint_source(path: str, source: str,
         active.add("WVL322")
     if gated_routes:
         findings += _check_debug_route_gating(path, tree, gated_routes)
+    if jit_ctx is None and _pkg_path(path):
+        # standalone lint of a package file (tests' fixture path): build
+        # a single-module context so WVL5xx still runs
+        jit_ctx = build_jit_ctx({path: tree})
+    if jit_ctx is not None:
+        findings += jit_ctx.findings_for(path)
 
     noqa = _noqa_lines(source)
     fired_by_line: dict[int, set[str]] = {}
@@ -2269,37 +3426,150 @@ def iter_py_files(paths: list[str]):
                         yield os.path.join(root, f)
 
 
+def _selector_match(code: str, selectors: list[str]) -> bool:
+    """WVL5xx-style selectors: a trailing run of x/X is a wildcard, so
+    WVL5xx matches every WVL5 code and WVL503 matches only itself."""
+    for sel in selectors:
+        prefix = sel.upper().rstrip("X") if sel.upper().endswith("X") \
+            else sel.upper()
+        if code.upper().startswith(prefix):
+            return True
+    return False
+
+
+def _cache_path() -> str | None:
+    """Per-tree result cache location. WVA_LINT_CACHE overrides; the
+    value "off" disables caching entirely."""
+    env = os.environ.get("WVA_LINT_CACHE", "")
+    if env == "off":
+        return None
+    return env or os.path.join(os.getcwd(), ".wvalint_cache.json")
+
+
+def _scan_hash(sources: dict[str, str]) -> str:
+    """Content hash of the whole scan: the linter's own source plus
+    every scanned file. Cross-file rules (signatures, call graph, knob
+    parity) make any file's findings a function of every file, so one
+    hash guards them all; per-file entries let a warm identical re-run
+    skip lint_source entirely."""
+    h = hashlib.sha256()
+    try:
+        with open(__file__, "rb") as f:
+            h.update(f.read())
+    except OSError:
+        pass
+    for fp in sorted(sources):
+        h.update(fp.encode())
+        h.update(hashlib.sha256(sources[fp].encode()).digest())
+    return h.hexdigest()
+
+
 def main(argv=None) -> int:
-    paths = (argv or sys.argv[1:]) or ["."]
+    ap = argparse.ArgumentParser(
+        prog="wvalint",
+        description="stdlib-only static analysis gate (see module "
+                    "docstring for the rule catalog)")
+    ap.add_argument("paths", nargs="*", default=["."],
+                    help="files or directories to lint (default: .)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON document on stdout")
+    ap.add_argument("--select", default="",
+                    help="comma-separated code selectors to keep "
+                         "(WVL503 or WVL5xx family wildcards)")
+    ap.add_argument("--ignore", default="",
+                    help="comma-separated code selectors to drop")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the content-hash result cache")
+    args = ap.parse_args(sys.argv[1:] if argv is None else argv)
+    paths = args.paths or ["."]
     files = list(iter_py_files(paths))
-    trees: dict[str, ast.Module] = {}
     sources: dict[str, str] = {}
     for fp in files:
         with open(fp, encoding="utf-8") as f:
             sources[fp] = f.read()
+
+    cache_fp = None if args.no_cache else _cache_path()
+    scan_hash = _scan_hash(sources) if cache_fp else ""
+    per_file: dict[str, list[Finding]] | None = None
+    if cache_fp and os.path.exists(cache_fp):
+        try:
+            with open(cache_fp, encoding="utf-8") as f:
+                cached = json.load(f)
+            if cached.get("scan") == scan_hash and \
+                    set(cached.get("files", {})) == set(files):
+                per_file = {
+                    fp: [Finding(fp, ln, code, msg)
+                         for ln, code, msg in rows]
+                    for fp, rows in cached["files"].items()}
+        except (OSError, ValueError, TypeError, KeyError):
+            per_file = None
+
+    trees: dict[str, ast.Module] = {}
+    for fp in files:
         try:
             trees[fp] = ast.parse(sources[fp], fp)
+            _index_tree(trees[fp])
         except SyntaxError:
             pass
-    sigs = _collect_signatures(trees)
-    rets = _collect_return_arities(trees)
-    classes = _resolve_classes(_collect_classes(trees))
-    fault_kinds = _vocab_from_trees(
-        trees, os.path.join("faults", "plan.py"), "ALL_KINDS")
-    stages = _vocab_from_trees(
-        trees, os.path.join("metrics", "__init__.py"), "RECONCILE_STAGES")
-    gated_routes = _gated_routes_from_trees(trees)
+    if per_file is None:
+        sigs = _collect_signatures(trees)
+        rets = _collect_return_arities(trees)
+        classes = _resolve_classes(_collect_classes(trees))
+        fault_kinds = _vocab_from_trees(
+            trees, os.path.join("faults", "plan.py"), "ALL_KINDS")
+        stages = _vocab_from_trees(
+            trees, os.path.join("metrics", "__init__.py"),
+            "RECONCILE_STAGES")
+        gated_routes = _gated_routes_from_trees(trees)
+        jit_ctx = build_jit_ctx(trees)
+        per_file = {}
+        for fp in files:
+            per_file[fp] = lint_source(
+                fp, sources[fp], sigs, rets, classes, fault_kinds,
+                stages, gated_routes, jit_ctx, trees.get(fp))
+        if cache_fp:
+            payload = {"scan": scan_hash, "files": {
+                fp: [[f.line, f.code, f.message] for f in fs]
+                for fp, fs in per_file.items()}}
+            try:
+                tmp = cache_fp + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(payload, f)
+                os.replace(tmp, cache_fp)
+            except OSError:
+                pass
+
     findings: list[Finding] = []
     for fp in files:
-        findings += lint_source(fp, sources[fp], sigs, rets, classes,
-                                fault_kinds, stages, gated_routes)
+        findings += per_file.get(fp, [])
+    # cross-file doc-parity rules read non-Python inputs (docs/*.md):
+    # they stay outside the cache and recompute every run
     findings += _metrics_doc_findings(files, sources)
     findings += _knob_parity_findings(files, sources, trees)
     findings += _stage_coverage_findings(files, trees)
-    for f in sorted(findings, key=lambda f: (f.path, f.line)):
-        print(f.format())
-    if findings:
-        print(f"\n{len(findings)} finding(s) in {len(files)} files")
+
+    if args.select:
+        sel = [s for s in args.select.split(",") if s.strip()]
+        findings = [f for f in findings if _selector_match(f.code, sel)]
+    if args.ignore:
+        ign = [s for s in args.ignore.split(",") if s.strip()]
+        findings = [f for f in findings
+                    if not _selector_match(f.code, ign)]
+
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "files": len(files),
+            "count": len(findings),
+            "findings": [{"path": f.path, "line": f.line,
+                          "code": f.code, "message": f.message}
+                         for f in findings]}, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        if findings:
+            print(f"\n{len(findings)} finding(s) in {len(files)} files")
     return min(len(findings), 125)
 
 

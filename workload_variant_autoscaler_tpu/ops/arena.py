@@ -21,6 +21,11 @@ between kernel dispatches on that single thread (the fanout'd status
 writers never touch it); `tools/wvalint.py` WVL402 follows `self.<attr>`
 method calls into same-file classes, so any future thread-reachable
 mutation of these buffers is caught statically.
+
+The scatter/pack programs the arena dispatches are additionally gated
+by the WVL5xx compiled-path family (traced-body purity, donation
+soundness, implicit host-sync via WVL504 — the implicit cousins of the
+WVL305 readback choke points).
 """
 
 from __future__ import annotations

@@ -27,6 +27,12 @@ candidate) with:
 Everything is dtype-polymorphic: float64 under jax_enable_x64 (used by the
 tests to cross-check against the numpy reference kernel to ~1e-9), float32
 on TPU.
+
+The jit entries here (and everything they trace into) are lint-gated
+by `tools/wvalint.py` WVL501/WVL502: traced bodies stay pure and every
+shape-relevant scalar rides the bucket vocabulary (`k_max_bucket`,
+`lane_bucket`, ...), so the zero-steady-state-retrace invariant the
+JAX self-audit measures is also enforced statically.
 """
 
 from __future__ import annotations

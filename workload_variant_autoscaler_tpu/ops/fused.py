@@ -36,6 +36,12 @@ differently per program, which the wait-time cancellation (w = t - s)
 then amplifies — observed ≤1e-12 relative, asserted ≤1e-9.
 
 `WVA_FUSED_SOLVE=off` (models/system.py) restores the staged pipeline.
+
+The donated-buffer call shape and the traced epilogue are lint-gated by
+`tools/wvalint.py` WVL503/WVL501: no caller may read a donated slab
+after `decide_batch` on any path, and no side effect can ride the
+traced program — the discipline PR 8 reasoned about by hand is now a
+static check.
 """
 
 from __future__ import annotations

@@ -31,6 +31,10 @@ CPU, compiled on TPU).
 Mosaic gotchas encoded below: never use bool vectors as select *values*
 (i8 storage -> mask reuse needs an unsupported i8->i1 trunci), and keep
 `done` as int32 in the fori_loop carry for the same reason.
+
+`pl.pallas_call` bodies count as traced entries for `tools/wvalint.py`
+WVL501/WVL505: the kernel and its helpers are statically held to the
+same purity and no-baked-device-count discipline as the jit entries.
 """
 
 from __future__ import annotations

@@ -20,6 +20,11 @@ given, so both bindings reuse one compiled-program cache keyed by
 (k_max, mesh, percentile) — Mesh hashes by device assignment + axis
 names, so rebuilding a mesh with a different device count or axis can
 never reuse a stale executable.
+
+`tools/wvalint.py` WVL505 enforces the other half of that rule
+statically: no traced body may close over `len(jax.devices())` or a
+device-count module constant — counts arrive as mesh axes or shaped
+arguments, so a host-mesh build can never pin the chip-slice path.
 """
 
 from __future__ import annotations

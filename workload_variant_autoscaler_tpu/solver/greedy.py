@@ -8,6 +8,10 @@ slices_per_replica * chips_per_slice chips of the slice's generation
 (the reference's numInstances x multiplicity, greedy.go:139-140). Servers
 that fit no full allocation get best-effort treatment per the configured
 saturation policy.
+
+Where this solver hands work to the compiled decision path, the seam
+is covered by the `tools/wvalint.py` WVL5xx family (retrace-stable
+boundaries, no implicit host syncs on device values).
 """
 
 from __future__ import annotations
