@@ -99,6 +99,14 @@ bench-streamchaos: ## Streaming under fire: 100x flood shedding + admitted-event
 chaos-stream-smoke: ## Abbreviated flood + restart pair (<10s): caps hold, sheds metered, warm restore, lag inside budget
 	$(PY) bench_streamchaos.py --smoke
 
+.PHONY: bench-streamload
+bench-streamload: ## Sustained ingest throughput: >=10k series/s of real snappy+protobuf POSTs on the rules AND raw-pushdown lanes, pushdown==rules equivalence, pool-scoped limited-mode lanes (writes BENCH_streamload_r20.json)
+	$(PY) bench_streamload.py
+
+.PHONY: streamload-smoke
+streamload-smoke: ## Abbreviated streamload run (<10s): every throughput/equivalence/limited gate except the absolute series/s floor
+	$(PY) bench_streamload.py --smoke
+
 .PHONY: bench-scenarios
 bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO headlines + mean ablations, tail stress, strict SLO)
 	$(PY) bench_loop.py whole-fleet-p95
@@ -111,7 +119,7 @@ bench-scenarios: ## All closed-loop benchmark scenarios (configs 2/4/5 full-SLO 
 	$(PY) bench_loop.py sharegpt-lognormal
 	$(PY) bench_loop.py sharegpt-strict-slo
 
-LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_goodput_live.py bench_profile.py bench_fuse.py bench_shard.py bench_hier.py bench_stream.py bench_streamchaos.py bench_adversary.py __graft_entry__.py
+LINT_PATHS = workload_variant_autoscaler_tpu tools tests bench.py bench_loop.py bench_collect.py bench_goodput.py bench_goodput_live.py bench_profile.py bench_fuse.py bench_shard.py bench_hier.py bench_stream.py bench_streamchaos.py bench_streamload.py bench_adversary.py __graft_entry__.py
 
 .PHONY: lint
 lint: ## Static analysis gate: ruff+mypy when installed, wvalint always (rule catalog: docs/developer-guide/wvalint.md)

@@ -1,0 +1,554 @@
+"""bench_streamload: sustained ingest throughput through the real door.
+
+BENCH_stream_r11 pins per-event *latency*; this bench pins sustained
+*throughput* — the front line for serving millions of users (ROADMAP
+item 3). Three phases, one artifact:
+
+1. **Door throughput**: real snappy+protobuf remote-write POSTs driven
+   through the mounted WSGI route, each carrying a full fleet sweep of
+   series, measured as sustained series/s with per-POST admission
+   latency (p99 must clear the 250 ms budget) and every shed accounted
+   by `inferno_stream_shed_total` reason. Two lanes: the recording-rule
+   contract (`wva:stream:*`, the striped batch path) and the raw
+   vLLM-counter pushdown contract (`vllm:*`, the ledger path).
+2. **Pushdown equivalence**: two identical clusters fed the SAME load
+   trajectory — one as pre-aggregated rule series, one as raw
+   monotonic counters — must publish IDENTICAL per-variant decisions
+   at every step (the deltas are constructed so the server-side
+   derivation is float-exact). A third cluster with
+   `WVA_STREAM_PUSHDOWN=off` must ignore raw series entirely (the
+   rule-based door restored byte-for-byte).
+3. **Pool-scoped limited mode**: a two-chip-pool fleet under
+   WVA_LIMITED_MODE with real node inventory. Flips confined to one
+   pool-connected component must re-solve ONLY that component (scoped
+   lane, processed count == component size << fleet); a
+   cross-component storm must still escalate to ONE coalesced full
+   pass (full + coalesced lanes), as pinned by the
+   `inferno_stream_limited_total{lane}` counter.
+
+`python bench_streamload.py` writes BENCH_streamload_r20.json (asserted
+by tests/test_perf_claims.py); `--smoke` runs an abbreviated pass
+(<10 s) whose invariants tier-1 asserts via tests/test_pushdown.py.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("LOG_LEVEL", "error")
+# deterministic drains: phases 2 and 3 crank the consumer synchronously
+os.environ.setdefault("WVA_STREAM_DEBOUNCE_MS", "0")
+
+from bench_stream import (  # noqa: E402
+    INTERVAL_S,
+    NS,
+    build_cluster,
+    model_name,
+    seed_prom,
+)
+from workload_variant_autoscaler_tpu.collector import (  # noqa: E402
+    FakePromAPI,
+)
+from workload_variant_autoscaler_tpu.controller import (  # noqa: E402
+    ACCELERATOR_CM_NAME,
+    CONFIG_MAP_NAME,
+    CONFIG_MAP_NAMESPACE,
+    SERVICE_CLASS_CM_NAME,
+    ConfigMap,
+    Deployment,
+    InMemoryKube,
+    Reconciler,
+    crd,
+)
+from workload_variant_autoscaler_tpu.controller.kube import Node  # noqa: E402
+from workload_variant_autoscaler_tpu.metrics import (  # noqa: E402
+    LANE_COALESCED,
+    LANE_FULL,
+    LANE_SCOPED,
+    MetricsEmitter,
+)
+from workload_variant_autoscaler_tpu.stream import (  # noqa: E402
+    encode_write_request,
+    remote_write_middleware,
+    snappy_compress,
+)
+
+ARTIFACT = "BENCH_streamload_r20.json"
+TARGET_SERIES_PER_S = 10_000.0
+ADMIT_BUDGET_MS = 250.0
+
+N_MODELS = 64                # fleet sweep per POST: one series set/model
+RULE_POSTS = 120
+RAW_POSTS = 90
+
+# exact-derivation load shape: every value is a binary fraction, so the
+# ledger's delta arithmetic reproduces the rule series bit-for-bit
+IN_TOK = 128.0
+OUT_TOK = 64.0
+TTFT_S = 0.25                # -> 250.0 ms exactly
+ITL_S = 0.015625             # -> 15.625 ms exactly
+TRAJECTORY_RPM = (4800.0, 9600.0, 2400.0, 7200.0, 1200.0, 9600.0)
+
+RULE_FIELDS = ("wva:stream:arrival_rpm", "wva:stream:avg_input_tokens",
+               "wva:stream:avg_output_tokens", "wva:stream:avg_ttft_ms",
+               "wva:stream:avg_itl_ms")
+
+
+def post(app, body: bytes) -> tuple[str, dict]:
+    status: list = []
+    headers: dict = {}
+
+    def start_response(st, hs):
+        status.append(st)
+        headers.update(hs)
+
+    environ = {"PATH_INFO": "/api/v1/write", "REQUEST_METHOD": "POST",
+               "CONTENT_LENGTH": str(len(body)),
+               "HTTP_CONTENT_ENCODING": "snappy",
+               "wsgi.input": io.BytesIO(body)}
+    list(app(environ, start_response))
+    return status[0], headers
+
+
+def rule_sweep_body(n_models: int, rpm_of, ts_ms: int,
+                    in_tok=IN_TOK, out_tok=OUT_TOK,
+                    ttft_ms=TTFT_S * 1000.0,
+                    itl_ms=ITL_S * 1000.0) -> bytes:
+    """One request carrying the five rule series for every model."""
+    series = []
+    for i in range(n_models):
+        labels = {"model_name": model_name(i, n_models), "namespace": NS}
+        for name, value in zip(RULE_FIELDS,
+                               (rpm_of(i), in_tok, out_tok,
+                                ttft_ms, itl_ms)):
+            series.append(({"__name__": name, **labels},
+                           [(value, ts_ms)]))
+    return snappy_compress(encode_write_request(series))
+
+
+def raw_sweep_body(n_models: int, cum_req_of, ts_ms: int) -> bytes:
+    """One request carrying the seven raw vLLM counters for every model
+    (cumulative values derived from the running request total so the
+    per-request averages are constant and float-exact)."""
+    series = []
+    for i in range(n_models):
+        labels = {"model_name": model_name(i, n_models), "namespace": NS,
+                  "instance": "pod-0"}
+        req = cum_req_of(i)
+        for name, value in (
+            ("vllm:request_success_total", req),
+            ("vllm:prompt_tokens_total", req * IN_TOK),
+            ("vllm:generation_tokens_total", req * OUT_TOK),
+            ("vllm:time_to_first_token_seconds_sum", req * TTFT_S),
+            ("vllm:time_to_first_token_seconds_count", req),
+            ("vllm:time_per_output_token_seconds_sum", req * ITL_S),
+            ("vllm:time_per_output_token_seconds_count", req),
+        ):
+            series.append(({"__name__": name, **labels},
+                           [(value, ts_ms)]))
+    return snappy_compress(encode_write_request(series))
+
+
+def capture_sheds(core) -> dict:
+    sheds: dict[str, int] = {}
+    orig = core.emitter.emit_stream_shed
+
+    def capture(reason: str) -> None:
+        orig(reason)
+        sheds[reason] = sheds.get(reason, 0) + 1
+
+    core.emitter.emit_stream_shed = capture
+    return sheds
+
+
+# -- phase 1: door throughput ----------------------------------------------
+
+
+def run_throughput(n_models: int, rule_posts: int, raw_posts: int) -> dict:
+    _kube, rec = build_cluster(n_models, n_models)
+    core = rec.ensure_stream_core()
+    app = remote_write_middleware(core)(lambda _e, _s: [b""])
+    sheds = capture_sheds(core)
+    out: dict = {}
+
+    now_ms = int(time.time() * 1000)
+    # rules lane: pre-encode all bodies (the bench measures the DOOR —
+    # decode, vet, quantize, stripe — not the sender's encoder); the
+    # rule window sits well before the raw lane's so the raw-derived
+    # merges never read as out-of-order
+    bodies = [rule_sweep_body(
+        n_models, lambda i, k=k: 2400.0 + k + i,
+        now_ms - 600_000 + k)
+        for k in range(rule_posts)]
+    lat: list[float] = []
+    t0 = time.perf_counter()
+    for body in bodies:
+        t1 = time.perf_counter()
+        status, _ = post(app, body)
+        lat.append((time.perf_counter() - t1) * 1000.0)
+        assert status.startswith("204"), status
+    wall = time.perf_counter() - t0
+    n_series = rule_posts * n_models * len(RULE_FIELDS)
+    lat.sort()
+    out["rules"] = {
+        "posts": rule_posts, "series": n_series,
+        "groups_per_post": n_models,
+        "wall_s": round(wall, 3),
+        "series_per_s": round(n_series / wall, 1),
+        "p99_admit_ms": round(lat[min(int(round(0.99 * (len(lat) - 1))),
+                                      len(lat) - 1)], 3),
+        "max_admit_ms": round(lat[-1], 3),
+    }
+
+    # raw lane: monotonic counters, 1 s sample spacing
+    base_ms = now_ms - (raw_posts + 1) * 1000
+    bodies = [raw_sweep_body(
+        n_models, lambda i, k=k: (k + 1) * 60.0 + i, base_ms + k * 1000)
+        for k in range(raw_posts)]
+    lat = []
+    t0 = time.perf_counter()
+    for body in bodies:
+        t1 = time.perf_counter()
+        status, _ = post(app, body)
+        lat.append((time.perf_counter() - t1) * 1000.0)
+        assert status.startswith("204"), status
+    wall = time.perf_counter() - t0
+    n_series = raw_posts * n_models * 7
+    lat.sort()
+    out["raw"] = {
+        "posts": raw_posts, "series": n_series,
+        "groups_per_post": n_models,
+        "wall_s": round(wall, 3),
+        "series_per_s": round(n_series / wall, 1),
+        "p99_admit_ms": round(lat[min(int(round(0.99 * (len(lat) - 1))),
+                                      len(lat) - 1)], 3),
+        "max_admit_ms": round(lat[-1], 3),
+    }
+    out["sheds_by_reason"] = dict(sorted(sheds.items()))
+    out["series_admitted"] = (out["rules"]["series"]
+                              + out["raw"]["series"]
+                              - sum(sheds.values()))
+    return out
+
+
+# -- phase 2: pushdown equivalence -----------------------------------------
+
+
+def fleet_decisions(kube, n_variants: int) -> list:
+    out = []
+    for i in range(n_variants):
+        va = kube.get_variant_autoscaling(f"chat-{i}", NS)
+        alloc = va.status.desired_optimized_alloc
+        out.append([va.name, alloc.accelerator, alloc.num_replicas])
+    return out
+
+
+def run_equivalence(n_models: int = 8, steps: int = len(TRAJECTORY_RPM),
+                    variants_per_model: int = 2) -> dict:
+    n_variants = n_models * variants_per_model
+    clusters = {}
+    for key in ("rules", "raw", "off"):
+        kube, rec = build_cluster(n_variants, n_models)
+        core = rec.ensure_stream_core()
+        core.process_once()              # baseline full pass + snapshot
+        app = remote_write_middleware(core)(lambda _e, _s: [b""])
+        clusters[key] = (kube, rec, core, app, capture_sheds(core))
+
+    now_ms = int(time.time() * 1000)
+    ts0 = now_ms - (steps + 1) * 60_000  # all samples in the past
+    rates = TRAJECTORY_RPM[:steps]
+
+    # raw baseline sample at ts0 (first sight: ledger baselines only)
+    _kube, _rec, core, app, _ = clusters["raw"]
+    status, _h = post(app, raw_sweep_body(
+        n_models, lambda i: 1000.0 + i, ts0))
+    assert status.startswith("204"), status
+    core.process_once()
+
+    # off-mode: the same raw payload must be INVISIBLE (no groups, no
+    # sheds, no store writes) — the rule-based door byte-for-byte
+    _okube, _orec, ocore, oapp, osheds = clusters["off"]
+    os.environ["WVA_STREAM_PUSHDOWN"] = "off"
+    try:
+        before = len(ocore._store)
+        status, headers = post(oapp, raw_sweep_body(
+            n_models, lambda i: 1000.0 + i, ts0))
+        off_clean = (status.startswith("204")
+                     and headers.get("X-Ingested-Groups") == "0"
+                     and len(ocore._store) == before
+                     and not osheds)
+    finally:
+        del os.environ["WVA_STREAM_PUSHDOWN"]
+
+    trajectory = []
+    equal = True
+    cum = [1000.0 + i for i in range(n_models)]
+    for k, rpm in enumerate(rates):
+        ts = ts0 + (k + 1) * 60_000
+        # rule cluster: the pre-aggregated truth
+        _kube, _rec, core, app, _ = clusters["rules"]
+        status, _h = post(app, rule_sweep_body(
+            n_models, lambda _i: rpm, ts))
+        assert status.startswith("204"), status
+        core.process_once()
+        # raw cluster: one minute's worth of counter growth at the same
+        # rate (delta == rpm over dt == 60000 ms -> derived rpm exact)
+        for i in range(n_models):
+            cum[i] += rpm
+        _kube2, _rec2, core2, app2, _ = clusters["raw"]
+        status, _h = post(app2, raw_sweep_body(
+            n_models, lambda i: cum[i], ts))
+        assert status.startswith("204"), status
+        core2.process_once()
+        d_rules = fleet_decisions(clusters["rules"][0], n_variants)
+        d_raw = fleet_decisions(clusters["raw"][0], n_variants)
+        step_equal = d_rules == d_raw
+        equal = equal and step_equal
+        trajectory.append({"step": k, "rpm": rpm, "equal": step_equal,
+                           "replicas": [r[2] for r in d_rules]})
+    return {
+        "models": n_models, "variants": n_variants,
+        "steps": len(rates),
+        "pushdown_equals_rules": equal,
+        "off_restores_rule_door": bool(off_clean),
+        "trajectory": trajectory,
+    }
+
+
+# -- phase 3: pool-scoped limited mode -------------------------------------
+
+
+def build_two_pool_cluster(n_models: int = 8, per_model: int = 2):
+    """Two disjoint chip pools: models 0..n/2-1 ride v5e, the rest v6e,
+    so a flip in one half's models stays inside one pool-connected
+    component. WVA_LIMITED_MODE is on from the start and the kube holds
+    real TPU node inventory for both generations."""
+    kube = InMemoryKube(validate_schema=False)
+    kube.put_configmap(ConfigMap(CONFIG_MAP_NAME, CONFIG_MAP_NAMESPACE,
+                                 {"GLOBAL_OPT_INTERVAL": f"{INTERVAL_S:.0f}s",
+                                  "WVA_DRIFT_TOLERANCE": "0",
+                                  "WVA_LIMITED_MODE": "true"}))
+    kube.put_configmap(ConfigMap(
+        ACCELERATOR_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"v5e-1": json.dumps({"chip": "v5e", "chips": "1",
+                              "cost": "20.0"}),
+         "v6e-1": json.dumps({"chip": "v6e", "chips": "1",
+                              "cost": "30.0"})},
+    ))
+    slos = "\n".join(
+        f"  - model: {model_name(i, n_models)}\n"
+        "    slo-tpot: 24\n    slo-ttft: 500"
+        for i in range(n_models))
+    kube.put_configmap(ConfigMap(
+        SERVICE_CLASS_CM_NAME, CONFIG_MAP_NAMESPACE,
+        {"premium": f"name: Premium\npriority: 1\ndata:\n{slos}\n"},
+    ))
+    for gen, accel_label in (("v5e", "tpu-v5-lite-podslice"),
+                             ("v6e", "tpu-v6e-slice")):
+        for n in range(2):
+            kube.put_node(Node(
+                name=f"{gen}-node-{n}",
+                labels={"cloud.google.com/gke-tpu-accelerator":
+                        accel_label},
+                tpu_capacity=32))
+    half = n_models // 2
+    n_variants = n_models * per_model
+    for i in range(n_variants):
+        model_i = i % n_models
+        acc = "v5e-1" if model_i < half else "v6e-1"
+        name = f"chat-{i}"
+        kube.put_deployment(Deployment(name=name, namespace=NS,
+                                       spec_replicas=1, status_replicas=1))
+        kube.put_variant_autoscaling(crd.VariantAutoscaling(
+            metadata=crd.ObjectMeta(name=name, namespace=NS,
+                                    labels={crd.ACCELERATOR_LABEL: acc}),
+            spec=crd.VariantAutoscalingSpec(
+                model_id=model_name(model_i, n_models),
+                slo_class_ref=crd.ConfigMapKeyRef(
+                    name=SERVICE_CLASS_CM_NAME, key="premium"),
+                model_profile=crd.ModelProfile(accelerators=[
+                    crd.AcceleratorProfile(
+                        acc=acc, acc_count=1,
+                        perf_parms=crd.PerfParms(
+                            decode_parms={"alpha": "6.973",
+                                          "beta": "0.027"},
+                            prefill_parms={"gamma": "5.2",
+                                           "delta": "0.1"},
+                        ),
+                        max_batch_size=64,
+                    ),
+                ]),
+            ),
+        ))
+    store = FakePromAPI()
+    seed_prom(store, n_models)
+    rec = Reconciler(kube=kube, prom=store, emitter=MetricsEmitter(),
+                     sleep=lambda _s: None)
+    return kube, rec
+
+
+def run_limited(n_models: int = 8, per_model: int = 2,
+                scoped_events: int = 6) -> dict:
+    os.environ["WVA_STREAM_LAG_BUDGET_MS"] = "5000"
+    try:
+        _kube, rec = build_two_pool_cluster(n_models, per_model)
+        core = rec.ensure_stream_core()
+        lanes: dict[str, int] = {}
+        orig_lane = rec.emitter.emit_stream_limited
+
+        def capture(lane: str) -> None:
+            orig_lane(lane)
+            lanes[lane] = lanes.get(lane, 0) + 1
+
+        rec.emitter.emit_stream_limited = capture
+        core.process_once()             # full pass freezes capacity +
+        n_variants = n_models * per_model   # pool components
+        component = n_variants // 2
+        half = n_models // 2
+        now_ms = int(time.time() * 1000)
+
+        # alternating single-component flips: each must re-solve ONLY
+        # its component (processed == component size, scoped lane)
+        scoped_ok = True
+        app = remote_write_middleware(core)(lambda _e, _s: [b""])
+        for k in range(scoped_events):
+            m_i = (k % half) + (0 if k % 2 == 0 else half)
+            series = [({"__name__": name,
+                        "model_name": model_name(m_i, n_models),
+                        "namespace": NS}, [(value, now_ms + k)])
+                      for name, value in zip(
+                          RULE_FIELDS,
+                          (4800.0 + 600.0 * k, IN_TOK, OUT_TOK,
+                           TTFT_S * 1000.0, ITL_S * 1000.0))]
+            body = snappy_compress(encode_write_request(series))
+            status, _h = post(app, body)
+            assert status.startswith("204"), status
+            results = core.process_once()
+            scoped_ok = scoped_ok and (
+                len(results) == 1
+                and len(results[0].processed) == component)
+        scoped_lanes = lanes.get(LANE_SCOPED, 0)
+
+        # cross-component storm: both pools flip in one drain ->
+        # expansion covers the fleet -> ONE escalated full pass now,
+        # follow-ups coalesce onto ONE pending backstop
+        from workload_variant_autoscaler_tpu.collector import (
+            CollectedLoad,
+        )
+
+        def flood(rpm: float, t_off: float) -> None:
+            for m_i in (0, half):
+                core.observe_load(
+                    model_name(m_i, n_models), NS,
+                    CollectedLoad(arrival_rate_rpm=rpm,
+                                  avg_input_tokens=IN_TOK,
+                                  avg_output_tokens=OUT_TOK,
+                                  avg_ttft_ms=TTFT_S * 1000.0,
+                                  avg_itl_ms=ITL_S * 1000.0))
+
+        flood(9000.0, 0.0)
+        storm_results = core.process_once()
+        storm_full = (len(storm_results) == 1
+                      and len(storm_results[0].processed) == n_variants)
+        flood(9900.0, 0.1)
+        coalesced = core.process_once() == []   # deferred, not solved
+        return {
+            "fleet_variants": n_variants,
+            "component_variants": component,
+            "scoped_events": scoped_events,
+            "scoped_solves_component_only": scoped_ok,
+            "storm_escalates_full": storm_full,
+            "storm_coalesces": coalesced,
+            "lanes": {LANE_SCOPED: lanes.get(LANE_SCOPED, 0),
+                      LANE_FULL: lanes.get(LANE_FULL, 0),
+                      LANE_COALESCED: lanes.get(LANE_COALESCED, 0)},
+            "scoped_lane_count": scoped_lanes,
+        }
+    finally:
+        del os.environ["WVA_STREAM_LAG_BUDGET_MS"]
+
+
+def run(n_models: int = N_MODELS, rule_posts: int = RULE_POSTS,
+        raw_posts: int = RAW_POSTS, smoke: bool = False) -> dict:
+    throughput = run_throughput(n_models, rule_posts, raw_posts)
+    equivalence = run_equivalence(n_models=4 if smoke else 8,
+                                  steps=3 if smoke else
+                                  len(TRAJECTORY_RPM))
+    limited = run_limited(n_models=4 if smoke else 8,
+                          scoped_events=2 if smoke else 6)
+    headline = min(throughput["rules"]["series_per_s"],
+                   throughput["raw"]["series_per_s"])
+    out = {
+        "metric": "stream_ingest_series_per_s",
+        "bench": "streamload",
+        "value": headline,
+        "unit": "series/s sustained, min(rules, raw) lane, real "
+                "snappy+protobuf POSTs through the WSGI door",
+        "target_series_per_s": TARGET_SERIES_PER_S,
+        "admit_budget_ms": ADMIT_BUDGET_MS,
+        "throughput": throughput,
+        "equivalence": equivalence,
+        "limited": limited,
+    }
+    return out
+
+
+def check(out: dict) -> list:
+    """The acceptance gates; returns failure strings (empty == pass)."""
+    fails = []
+    if out["value"] < TARGET_SERIES_PER_S:
+        fails.append(f"throughput {out['value']} < {TARGET_SERIES_PER_S}")
+    for lane in ("rules", "raw"):
+        p99 = out["throughput"][lane]["p99_admit_ms"]
+        if p99 >= ADMIT_BUDGET_MS:
+            fails.append(f"{lane} p99 admit {p99}ms >= {ADMIT_BUDGET_MS}")
+    if out["throughput"]["sheds_by_reason"]:
+        fails.append(f"unexpected sheds "
+                     f"{out['throughput']['sheds_by_reason']}")
+    if not out["equivalence"]["pushdown_equals_rules"]:
+        fails.append("pushdown decisions diverged from rule decisions")
+    if not out["equivalence"]["off_restores_rule_door"]:
+        fails.append("WVA_STREAM_PUSHDOWN=off did not restore rule door")
+    lim = out["limited"]
+    if not (lim["scoped_solves_component_only"]
+            and lim["storm_escalates_full"] and lim["storm_coalesces"]):
+        fails.append(f"limited-mode lanes wrong: {lim}")
+    if lim["lanes"]["scoped"] < 1 or lim["lanes"]["coalesced"] < 1:
+        fails.append(f"lane counts not pinned: {lim['lanes']}")
+    if lim["component_variants"] * 2 > lim["fleet_variants"] + 1:
+        fails.append("component does not partition the fleet")
+    return fails
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    smoke = "--smoke" in argv
+    if smoke:
+        out = run(n_models=16, rule_posts=20, raw_posts=15, smoke=True)
+        out["smoke"] = True
+        fails = [f for f in check(out)
+                 if not f.startswith("throughput")]  # tiny posts: no
+        print(json.dumps(out), flush=True)           # rate floor
+        if fails:
+            print(json.dumps({"failures": fails}), file=sys.stderr)
+            return 1
+        return 0
+    out = run()
+    fails = check(out)
+    print(json.dumps(out), flush=True)
+    if fails:
+        print(json.dumps({"failures": fails}), file=sys.stderr)
+        return 1
+    with open(ARTIFACT, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -143,8 +143,8 @@ class TestCallArity:
     ["workload_variant_autoscaler_tpu", "tools", "tests", "bench.py",
      "bench_loop.py", "bench_collect.py", "bench_goodput.py",
      "bench_goodput_live.py", "bench_profile.py", "bench_fuse.py",
-     "bench_stream.py", "bench_shard.py", "bench_hier.py",
-     "bench_adversary.py", "__graft_entry__.py"],
+     "bench_stream.py", "bench_streamload.py", "bench_shard.py",
+     "bench_hier.py", "bench_adversary.py", "__graft_entry__.py"],
 ])
 def test_package_lints_clean(paths):
     """The gate itself: the shipped source must lint clean — every rule
@@ -2094,8 +2094,8 @@ class TestLintCli:
         ["workload_variant_autoscaler_tpu", "tools", "tests", "bench.py",
          "bench_loop.py", "bench_collect.py", "bench_goodput.py",
          "bench_goodput_live.py", "bench_profile.py", "bench_fuse.py",
-         "bench_stream.py", "bench_shard.py", "bench_hier.py",
-         "bench_adversary.py", "__graft_entry__.py"],
+         "bench_stream.py", "bench_streamload.py", "bench_shard.py",
+         "bench_hier.py", "bench_adversary.py", "__graft_entry__.py"],
     ])
     def test_full_repo_wall_under_5s(self, tmp_path, paths):
         """The tier-1 lint-gate budget: a full-repo run with the result

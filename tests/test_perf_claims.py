@@ -641,3 +641,53 @@ def test_hier_claims_match_artifact():
         "observability.md's warm-restart claim drifted from the artifact"
     assert f"{restart['cold_first_decision_ms']:.1f} ms" in flat
     assert f"{restart['cycle_interval_s']:.0f} s" in flat
+
+
+def test_streamload_claims_match_artifact():
+    """Round-20 streaming end-game: the committed
+    BENCH_streamload_r20.json must (a) justify the sustained-throughput
+    headline — BOTH ingest lanes (recording rules and raw-counter
+    pushdown) over the 10k series/s target with p99 admitted lag inside
+    the 250 ms budget and ZERO sheds, (b) hold the pushdown-equivalence
+    claim — raw-counter decisions equal rule-based decisions EXACTLY at
+    every trajectory step and `off` restores the rule door, (c) hold
+    the pool-scoped limited-mode lane accounting — scoped flips solved
+    one component, the cross-pool storm escalated to ONE full pass and
+    coalesced follow-ups, and (d) match docs/benchmarks.md."""
+    art = _artifact("BENCH_streamload_r20.json")
+    assert art["bench"] == "streamload"
+    thr = art["throughput"]
+    assert art["value"] == min(thr["rules"]["series_per_s"],
+                               thr["raw"]["series_per_s"])
+    assert art["value"] >= art["target_series_per_s"] == 10_000.0, \
+        "artifact no longer justifies the 10k series/s headline"
+    for lane in ("rules", "raw"):
+        assert thr[lane]["series_per_s"] >= art["target_series_per_s"]
+        assert thr[lane]["p99_admit_ms"] < art["admit_budget_ms"]
+        assert thr[lane]["p99_admit_ms"] <= thr[lane]["max_admit_ms"]
+        assert thr[lane]["series"] > 0 and thr[lane]["wall_s"] > 0
+    assert thr["sheds_by_reason"] == {}, \
+        "the throughput run must admit everything (no sheds)"
+    assert thr["series_admitted"] == (thr["rules"]["series"]
+                                      + thr["raw"]["series"])
+    eq = art["equivalence"]
+    assert eq["pushdown_equals_rules"] is True
+    assert eq["off_restores_rule_door"] is True
+    assert len(eq["trajectory"]) == eq["steps"]
+    assert all(step["equal"] for step in eq["trajectory"])
+    # the trajectory actually moved replicas: a frozen fleet would make
+    # the equivalence claim vacuous
+    assert len({tuple(step["replicas"]) for step in eq["trajectory"]}) > 1
+    lim = art["limited"]
+    assert lim["scoped_solves_component_only"] is True
+    assert lim["storm_escalates_full"] is True
+    assert lim["storm_coalesces"] is True
+    assert 0 < lim["component_variants"] < lim["fleet_variants"]
+    assert lim["lanes"]["scoped"] == lim["scoped_events"]
+    assert lim["lanes"]["full"] == 1 and lim["lanes"]["coalesced"] == 1
+    # doc parity: benchmarks.md quotes this artifact
+    doc = (REPO / "docs" / "benchmarks.md").read_text()
+    flat = " ".join(doc.split())
+    assert f"**{art['value']:,.0f} series/s**" in flat, \
+        "benchmarks.md's streamload headline drifted from the artifact"
+    assert f"p99 {thr['raw']['p99_admit_ms']:.1f} ms" in flat

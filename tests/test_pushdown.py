@@ -1,0 +1,483 @@
+"""Raw-counter pushdown (stream/pushdown.py) + pool-scoped limited mode.
+
+Pins the ISSUE-20 end-game semantics:
+
+- the CounterLedger's monotonic-counter contract: counter resets are a
+  new epoch (zero delta, never negative, never a shed), staleness
+  markers retire an origin's baseline without poisoning the group,
+  out-of-order / far-future / NaN / negative samples quarantine the
+  WHOLE batch atomically (vet first, commit after — a poisoned request
+  never half-advances a ledger), first sight is baseline only, and
+  both ledger dimensions hold their literal bounds;
+- the door-level integration: raw vLLM counters POSTed as real
+  snappy+protobuf remote-write derive the same load fields the
+  recording rules would, `WVA_STREAM_PUSHDOWN=off` restores the
+  rule-based door byte-for-byte, and pushdown decisions equal rule
+  decisions EXACTLY over a replica-moving trajectory;
+- pool-scoped limited mode: single-component flips re-solve only their
+  pool-connected component (lane `scoped`), cross-component storms
+  escalate to ONE full pass (lane `full`) and coalesce follow-ups
+  (lane `coalesced`);
+- the bench door: `python bench_streamload.py --smoke` exits 0 in
+  seconds (the tier-1 subprocess gate for the round-20 artifact).
+"""
+
+from __future__ import annotations
+
+import io
+import math
+import os
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+import bench_streamload  # noqa: E402
+from bench_streamload import (  # noqa: E402
+    IN_TOK,
+    ITL_S,
+    OUT_TOK,
+    TTFT_S,
+    build_two_pool_cluster,
+    run_equivalence,
+)
+from bench_stream import (  # noqa: E402
+    build_cluster as build_stream_cluster,
+    model_name,
+)
+from workload_variant_autoscaler_tpu.metrics import (  # noqa: E402
+    LANE_COALESCED,
+    LANE_FULL,
+    LANE_SCOPED,
+    SHED_QUARANTINE_LABELS,
+    SHED_QUARANTINE_NAN,
+    SHED_QUARANTINE_NEGATIVE,
+    SHED_QUARANTINE_TIMESTAMP,
+    SHED_STALE_MARKER,
+    SHED_STORE_FULL,
+)
+from workload_variant_autoscaler_tpu.stream import (  # noqa: E402
+    encode_write_request,
+    remote_write_middleware,
+    snappy_compress,
+)
+from workload_variant_autoscaler_tpu.stream import pushdown  # noqa: E402
+from workload_variant_autoscaler_tpu.stream.pushdown import (  # noqa: E402
+    CounterLedger,
+    LedgerQuarantine,
+    RAW_SERIES,
+    is_stale_marker,
+)
+
+NS = "default"
+STALE = struct.unpack("<d", struct.pack("<Q", 0x7FF0000000000002))[0]
+MIN = 60_000                      # one rule-evaluation step, in ms
+
+
+def fp(role_name: str, instance: str = "pod-0") -> tuple:
+    """An origin fingerprint the way ingest.py builds one: the full
+    sorted label items INCLUDING __name__."""
+    return tuple(sorted({"__name__": role_name, "model_name": "m",
+                         "namespace": NS, "instance": instance}.items()))
+
+
+def counter_points(req: float, ts_ms: int, instance: str = "pod-0",
+                   roles=None) -> list:
+    """The seven raw samples one vLLM pod reports at a cumulative
+    request total of `req` (constant per-request averages, float-exact
+    by construction)."""
+    values = {
+        "vllm:request_success_total": req,
+        "vllm:prompt_tokens_total": req * IN_TOK,
+        "vllm:generation_tokens_total": req * OUT_TOK,
+        "vllm:time_to_first_token_seconds_sum": req * TTFT_S,
+        "vllm:time_to_first_token_seconds_count": req,
+        "vllm:time_per_output_token_seconds_sum": req * ITL_S,
+        "vllm:time_per_output_token_seconds_count": req,
+    }
+    return [(RAW_SERIES[name], fp(name, instance), value, ts_ms)
+            for name, value in values.items()
+            if roles is None or name in roles]
+
+
+class TestStaleMarkerBits:
+    def test_exact_bits_only(self):
+        """The Prometheus StaleNaN is ONE specific quiet NaN; an
+        ordinary NaN is a poisoned sample, not a staleness signal."""
+        assert is_stale_marker(STALE)
+        assert not is_stale_marker(float("nan"))
+        assert not is_stale_marker(
+            struct.unpack("<d", struct.pack("<Q",
+                                            0x7FF0000000000001))[0])
+        assert not is_stale_marker(0.0)
+        assert not is_stale_marker(float("inf"))
+        assert math.isnan(STALE)      # it still reads as NaN elsewhere
+
+
+class TestCounterLedger:
+    def test_first_sight_is_baseline_only(self):
+        led = CounterLedger()
+        fields, stale = led.advance("m", NS, counter_points(100.0, MIN),
+                                    now_s=1e9)
+        assert fields == {} and stale == 0
+        assert led.group_count() == 1
+
+    def test_second_sample_derives_exact_rule_fields(self):
+        led = CounterLedger()
+        led.advance("m", NS, counter_points(0.0, 0), now_s=1e9)
+        fields, _ = led.advance("m", NS, counter_points(4800.0, MIN),
+                                now_s=1e9)
+        # 4800 requests over exactly one minute, binary-fraction
+        # per-request averages: every derived field is float-EXACT
+        assert fields == {"arrival_rate_rpm": 4800.0,
+                          "avg_input_tokens": IN_TOK,
+                          "avg_output_tokens": OUT_TOK,
+                          "avg_ttft_ms": TTFT_S * 1000.0,
+                          "avg_itl_ms": ITL_S * 1000.0}
+
+    def test_counter_reset_is_zero_delta_never_negative(self):
+        led = CounterLedger()
+        led.advance("m", NS, counter_points(5000.0, 0), now_s=1e9)
+        # the pod restarted: counters dropped to near zero — a new
+        # epoch, not a negative rate, not a shed
+        fields, stale = led.advance("m", NS, counter_points(12.0, MIN),
+                                    now_s=1e9)
+        assert stale == 0
+        assert fields.get("arrival_rate_rpm") == 0.0
+        assert "avg_input_tokens" not in fields      # dreq == 0
+        # the epoch re-baselined at 12: the next sample derives a real
+        # rate again from the restarted counter
+        fields, _ = led.advance("m", NS, counter_points(612.0, 2 * MIN),
+                                now_s=1e9)
+        assert fields["arrival_rate_rpm"] == 600.0
+        assert fields["avg_input_tokens"] == IN_TOK
+
+    def test_out_of_order_quarantines_batch_atomically(self):
+        led = CounterLedger()
+        led.advance("m", NS, counter_points(100.0, 2 * MIN), now_s=1e9)
+        # one poisoned sample (out-of-order) in an otherwise-clean
+        # batch: the WHOLE batch is refused and NO baseline advances
+        poisoned = counter_points(200.0, 3 * MIN)
+        poisoned[3] = (poisoned[3][0], poisoned[3][1],
+                       poisoned[3][2], MIN)          # ts < baseline ts
+        with pytest.raises(LedgerQuarantine) as exc:
+            led.advance("m", NS, poisoned, now_s=1e9)
+        assert exc.value.reason == SHED_QUARANTINE_TIMESTAMP
+        # atomicity: a follow-up clean batch still deltas against the
+        # ORIGINAL baselines — had the poisoned batch half-committed,
+        # this delta would be 100, not 200
+        fields, _ = led.advance("m", NS, counter_points(300.0, 4 * MIN),
+                                now_s=1e9)
+        assert fields["arrival_rate_rpm"] == 100.0   # 200 over 2 min
+
+    def test_far_future_nan_negative_quarantine_reasons(self):
+        led = CounterLedger()
+        now_s = 1e9
+        for req, ts_ms, reason in (
+            (100.0, int((now_s + 120.0) * 1000), SHED_QUARANTINE_TIMESTAMP),
+            (float("nan"), MIN, SHED_QUARANTINE_NAN),
+            (float("inf"), MIN, SHED_QUARANTINE_NAN),
+            (-3.0, MIN, SHED_QUARANTINE_NEGATIVE),
+        ):
+            with pytest.raises(LedgerQuarantine) as exc:
+                led.advance("m", NS, counter_points(
+                    req, ts_ms, roles=("vllm:request_success_total",)),
+                    now_s=now_s)
+            assert exc.value.reason == reason
+        # nothing committed: the group exists but holds no baselines
+        fields, _ = led.advance("m", NS, counter_points(50.0, MIN),
+                                now_s=now_s)
+        assert fields == {}                          # still first sight
+
+    def test_stale_marker_retires_origin_and_rebaselines(self):
+        led = CounterLedger()
+        roles = ("vllm:request_success_total",)
+        led.advance("m", NS, counter_points(100.0, MIN, roles=roles),
+                    now_s=1e9)
+        # the series went away: Prometheus writes the StaleNaN — the
+        # baseline is retired (counted), NOT a quarantine
+        pts = [(RAW_SERIES[roles[0]], fp(roles[0]), STALE, 2 * MIN)]
+        fields, stale = led.advance("m", NS, pts, now_s=1e9)
+        assert fields == {} and stale == 1
+        # the next genuine sample is a fresh epoch: baseline only, and
+        # a delta only on the sample after that
+        fields, stale = led.advance(
+            "m", NS, counter_points(7.0, 3 * MIN, roles=roles), now_s=1e9)
+        assert fields == {} and stale == 0
+        fields, _ = led.advance(
+            "m", NS, counter_points(127.0, 4 * MIN, roles=roles),
+            now_s=1e9)
+        assert fields["arrival_rate_rpm"] == 120.0   # 120 over 1 min
+
+    def test_duplicate_delivery_is_skipped(self):
+        led = CounterLedger()
+        roles = ("vllm:request_success_total",)
+        led.advance("m", NS, counter_points(100.0, MIN, roles=roles),
+                    now_s=1e9)
+        # remote-write retries redeliver the same (value, ts): no delta
+        fields, _ = led.advance("m", NS,
+                                counter_points(100.0, MIN, roles=roles),
+                                now_s=1e9)
+        assert fields == {}
+
+    def test_two_pods_aggregate_like_the_rules_would(self):
+        """Two origin series (distinct `instance`) behind one model:
+        deltas SUM — rates add, token averages weight by requests."""
+        led = CounterLedger()
+        for inst in ("pod-0", "pod-1"):
+            led.advance("m", NS, counter_points(0.0, 0, instance=inst),
+                        now_s=1e9)
+        batch = (counter_points(600.0, MIN, instance="pod-0")
+                 + counter_points(1800.0, MIN, instance="pod-1"))
+        fields, _ = led.advance("m", NS, batch, now_s=1e9)
+        assert fields["arrival_rate_rpm"] == 2400.0
+        assert fields["avg_input_tokens"] == IN_TOK
+
+    def test_ledger_bounds_hold(self, monkeypatch):
+        monkeypatch.setattr(pushdown, "MAX_LEDGER_GROUPS", 2)
+        monkeypatch.setattr(pushdown, "MAX_SERIES_PER_GROUP", 3)
+        led = CounterLedger()
+        roles = ("vllm:request_success_total",)
+        led.advance("m0", NS, counter_points(1.0, MIN, roles=roles),
+                    now_s=1e9)
+        led.advance("m1", NS, counter_points(1.0, MIN, roles=roles),
+                    now_s=1e9)
+        with pytest.raises(LedgerQuarantine) as exc:
+            led.advance("m2", NS, counter_points(1.0, MIN, roles=roles),
+                        now_s=1e9)
+        assert exc.value.reason == SHED_STORE_FULL
+        # per-group origin-series bound: a label bomb inside one group
+        pts = [p for k in range(4)
+               for p in counter_points(1.0, MIN, instance=f"pod-{k}",
+                                       roles=roles)]
+        with pytest.raises(LedgerQuarantine) as exc:
+            led.advance("m0", NS, pts, now_s=1e9)
+        assert exc.value.reason == SHED_QUARANTINE_LABELS
+        # forget() releases a group slot
+        led.forget("m1", NS)
+        assert led.group_count() == 1
+        led.advance("m2", NS, counter_points(1.0, MIN, roles=roles),
+                    now_s=1e9)
+
+
+# -- door-level: raw counters through the real WSGI route -------------------
+
+
+def _post(app, body: bytes):
+    status: list = []
+    headers: dict = {}
+
+    def start(st, hs):
+        status.append(st)
+        headers.update(hs)
+
+    environ = {"PATH_INFO": "/api/v1/write", "REQUEST_METHOD": "POST",
+               "CONTENT_LENGTH": str(len(body)),
+               "HTTP_CONTENT_ENCODING": "snappy",
+               "wsgi.input": io.BytesIO(body)}
+    list(app(environ, start))
+    return status[0], headers
+
+
+def raw_body(model: str, req: float, ts_ms: int,
+             value_of=None) -> bytes:
+    """One pod's seven raw counters for `model` as a real wire body."""
+    labels = {"model_name": model, "namespace": NS, "instance": "pod-0"}
+    series = []
+    for name in RAW_SERIES:
+        base = {
+            "vllm:request_success_total": req,
+            "vllm:prompt_tokens_total": req * IN_TOK,
+            "vllm:generation_tokens_total": req * OUT_TOK,
+            "vllm:time_to_first_token_seconds_sum": req * TTFT_S,
+            "vllm:time_to_first_token_seconds_count": req,
+            "vllm:time_per_output_token_seconds_sum": req * ITL_S,
+            "vllm:time_per_output_token_seconds_count": req,
+        }[name]
+        value = base if value_of is None else value_of(name, base)
+        series.append(({"__name__": name, **labels}, [(value, ts_ms)]))
+    return snappy_compress(encode_write_request(series))
+
+
+def raw_door(n_variants=8, n_models=4):
+    kube, rec = build_stream_cluster(n_variants, n_models)
+    core = rec.ensure_stream_core()
+    results = core.process_once()
+    assert len(results) == 1 and len(results[0].processed) == n_variants
+    app = remote_write_middleware(core)(lambda _e, _s: [b""])
+    return kube, rec, core, app
+
+
+class TestRawDoor:
+    def test_raw_trajectory_baselines_then_flips(self):
+        _kube, rec, core, app = raw_door()
+        model = model_name(0, 4)
+        t0 = int(time.time() * 1000) - 3 * MIN
+        # first sight: baseline only — nothing ingested, nothing shed
+        status, headers = _post(app, raw_body(model, 0.0, t0))
+        assert status.startswith("204")
+        assert headers.get("X-Ingested-Groups") == "0"
+        # second sample: the derived fields land and the group flips
+        status, headers = _post(app, raw_body(model, 9600.0, t0 + MIN))
+        assert status.startswith("204")
+        assert headers.get("X-Ingested-Groups") == "1"
+        assert core.queue.pending() == 1
+        acc = core._store[(model, NS)]
+        assert acc.load().arrival_rate_rpm == 9600.0
+        assert acc.load().avg_input_tokens == IN_TOK
+        assert acc.load().avg_ttft_ms == TTFT_S * 1000.0
+
+    def test_counter_reset_mid_trajectory_never_sheds(self):
+        _kube, rec, core, app = raw_door()
+        model = model_name(1, 4)
+        t0 = int(time.time() * 1000) - 5 * MIN
+        assert _post(app, raw_body(model, 0.0, t0))[0].startswith("204")
+        assert _post(app, raw_body(model, 4800.0,
+                                   t0 + MIN))[0].startswith("204")
+        # pod restart: counters drop — the door still answers 204 and
+        # the stored rate reads 0 for that epoch boundary, not negative
+        status, _ = _post(app, raw_body(model, 10.0, t0 + 2 * MIN))
+        assert status.startswith("204")
+        assert core._store[(model, NS)].load().arrival_rate_rpm == 0.0
+        for reason in (SHED_QUARANTINE_NEGATIVE,
+                       SHED_QUARANTINE_TIMESTAMP):
+            assert not rec.emitter.value("inferno_stream_shed_total",
+                                         reason=reason)
+        # the restarted counter resumes deriving real rates
+        assert _post(app, raw_body(model, 2410.0,
+                                   t0 + 3 * MIN))[0].startswith("204")
+        assert core._store[(model, NS)].load().arrival_rate_rpm == 2400.0
+
+    def test_poisoned_nan_sample_sheds_whole_group(self):
+        _kube, rec, core, app = raw_door()
+        model = model_name(2, 4)
+        t0 = int(time.time() * 1000) - 3 * MIN
+        assert _post(app, raw_body(model, 100.0, t0))[0].startswith("204")
+        body = raw_body(
+            model, 200.0, t0 + MIN,
+            value_of=lambda name, base: float("nan")
+            if name == "vllm:prompt_tokens_total" else base)
+        status, headers = _post(app, body)
+        assert status.startswith("429")
+        assert headers.get("X-Shed-Groups") == "1"
+        assert rec.emitter.value("inferno_stream_shed_total",
+                                 reason=SHED_QUARANTINE_NAN) == 1.0
+        # atomicity through the door: baselines did not advance, so the
+        # next clean sample deltas over BOTH intervals
+        assert _post(app, raw_body(model, 9700.0,
+                                   t0 + 2 * MIN))[0].startswith("204")
+        assert core._store[(model, NS)].load().arrival_rate_rpm == \
+            pytest.approx(4800.0)                    # 9600 over 2 min
+
+    def test_stale_marker_is_accounted_not_poison(self):
+        _kube, rec, core, app = raw_door()
+        model = model_name(3, 4)
+        t0 = int(time.time() * 1000) - 4 * MIN
+        assert _post(app, raw_body(model, 60.0, t0))[0].startswith("204")
+        body = raw_body(model, 0.0, t0 + MIN,
+                        value_of=lambda _name, _base: STALE)
+        status, _ = _post(app, body)
+        assert status.startswith("204")              # not a shed reply
+        assert rec.emitter.value("inferno_stream_shed_total",
+                                 reason=SHED_STALE_MARKER) == 7.0
+        # every origin re-baselined: next sample is first-sight again
+        status, headers = _post(app, raw_body(model, 90.0, t0 + 2 * MIN))
+        assert status.startswith("204")
+        assert headers.get("X-Ingested-Groups") == "0"
+
+    def test_pushdown_off_restores_rule_door(self, monkeypatch):
+        monkeypatch.setenv("WVA_STREAM_PUSHDOWN", "off")
+        _kube, rec, core, app = raw_door()
+        assert not core.pushdown_enabled()
+        model = model_name(0, 4)
+        t0 = int(time.time() * 1000) - 2 * MIN
+        before = len(core._store)
+        for k in range(2):
+            status, headers = _post(app, raw_body(model, 600.0 * k,
+                                                  t0 + k * MIN))
+            assert status.startswith("204")
+            assert headers.get("X-Ingested-Groups") == "0"
+        # raw series are invisible: no ledger entry, no store change,
+        # no queue arm, no shed — the rule contract byte-for-byte
+        assert core.pushdown.group_count() == 0
+        assert len(core._store) == before
+        assert core.queue.pending() == 0
+        assert not rec.emitter.value("inferno_stream_shed_total",
+                                     reason=SHED_QUARANTINE_NAN)
+
+
+# -- equivalence + pool-scoped limited mode (via the bench harness) ---------
+
+
+class TestPushdownEquivalence:
+    def test_pushdown_decisions_equal_rule_decisions(self):
+        """The bench's equivalence phase at test scale: raw-counter
+        clusters and rule-fed clusters make IDENTICAL fleet decisions
+        at every trajectory step, and `off` restores the rule door."""
+        out = run_equivalence(n_models=4, steps=3)
+        assert out["pushdown_equals_rules"] is True
+        assert out["off_restores_rule_door"] is True
+        assert len(out["trajectory"]) == 3
+        assert all(step["equal"] for step in out["trajectory"])
+        # the trajectory actually moved replicas (not a trivial match)
+        assert len({tuple(step["replicas"])
+                    for step in out["trajectory"]}) > 1
+
+
+class TestScopedLimitedMode:
+    def test_single_component_flip_solves_component_only(self,
+                                                         monkeypatch):
+        monkeypatch.setenv("WVA_STREAM_LAG_BUDGET_MS", "5000")
+        _kube, rec = build_two_pool_cluster(n_models=4, per_model=2)
+        core = rec.ensure_stream_core()
+        lanes: dict[str, int] = {}
+        orig = rec.emitter.emit_stream_limited
+        rec.emitter.emit_stream_limited = lambda lane: (
+            orig(lane), lanes.__setitem__(lane, lanes.get(lane, 0) + 1))
+        core.process_once()          # full pass freezes capacity + pools
+        assert rec.state.snapshot.pool_components
+        assert rec.state.snapshot.capacity
+        app = remote_write_middleware(core)(lambda _e, _s: [b""])
+        now_ms = int(time.time() * 1000)
+        body = bench_streamload.rule_sweep_body(
+            1, lambda _i: 9600.0, now_ms)
+        assert _post(app, body)[0].startswith("204")
+        results = core.process_once()
+        # model 0 rides the v5e pool: exactly its 4-variant component
+        # re-solved, not the 8-variant fleet
+        assert len(results) == 1 and len(results[0].processed) == 4
+        assert lanes == {LANE_SCOPED: 1}
+
+    def test_cross_component_storm_escalates_then_coalesces(self):
+        out = bench_streamload.run_limited(n_models=4, per_model=2,
+                                           scoped_events=4)
+        assert out["scoped_solves_component_only"] is True
+        assert out["storm_escalates_full"] is True
+        assert out["storm_coalesces"] is True
+        assert out["lanes"][LANE_SCOPED] == 4
+        assert out["lanes"][LANE_FULL] == 1
+        assert out["lanes"][LANE_COALESCED] == 1
+
+
+# -- the bench smoke gate ---------------------------------------------------
+
+
+def test_bench_streamload_smoke():
+    """The tier-1 door for the round-20 artifact: the smoke profile
+    (tiny post counts, every non-throughput gate enforced) must exit 0
+    well inside its budget."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench_streamload.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=120, cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    wall = time.perf_counter() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert wall < 60.0, f"smoke took {wall:.1f}s"

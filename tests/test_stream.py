@@ -198,6 +198,28 @@ class TestDebouncedQueue:
         assert not q.drain()
         assert set(q.drain(force=True).events) == {("m", "ns")}
 
+    def test_offer_many_is_one_lock_trip_with_per_key_caps(self):
+        """The batch door (ingest_batch's flips) admits under one lock
+        acquisition: known keys always merge, new keys past max_pending
+        come back rejected for the caller to shed."""
+        t = {"now": 0.0}
+        q = DebouncedQueue(debounce_s=0.1, clock=lambda: t["now"],
+                           max_pending=2)
+        assert q.offer_many([]) == []
+        rejected = q.offer_many(
+            [(("a", "ns"), SOURCE_REMOTE_WRITE),
+             (("b", "ns"), SOURCE_REMOTE_WRITE),
+             (("c", "ns"), SOURCE_REMOTE_WRITE)])
+        assert rejected == [(("c", "ns"), SOURCE_REMOTE_WRITE)]
+        # a re-offer of a KNOWN key is a merge, never a rejection, and
+        # the earliest observation time survives for the lag clock
+        t["now"] = 0.05
+        assert q.offer_many([(("a", "ns"), SOURCE_SCRAPE)]) == []
+        t["now"] = 0.2
+        drained = q.drain()
+        assert set(drained.events) == {("a", "ns"), ("b", "ns")}
+        assert drained.events[("a", "ns")].t_observed == 0.0
+
 
 # -- change detection + scoped cycles ---------------------------------------
 
@@ -467,6 +489,33 @@ class TestAdaptiveDebounce:
             assert core._pressure == "flood"
         assert rec.emitter.value("inferno_stream_debounce_ms") == \
             pytest.approx(50.0)
+
+    def test_gauge_trajectory_through_real_drains(self, monkeypatch):
+        """The ladder's boundary behavior pinned END TO END: real
+        drains of exactly storm / storm+1 / storm-1 / storm/2 events
+        walk `inferno_stream_debounce_ms` up the doubling ladder, hold
+        it inside the hysteresis band, and halve it back to the base —
+        no flap at any boundary."""
+        self.knobs(monkeypatch)                    # storm=4, max=100ms
+        _kube, rec = build_stream_cluster(8, 8)
+        t, core = sim_core(rec, debounce_s=0.025)
+        core.process_once()                        # baseline full pass
+        rpms = (1200.0, 2400.0, 4800.0, 9600.0, 1200.0, 2400.0, 4800.0)
+        gauge = []
+        for rnd, n_events in enumerate((4, 5, 4, 3, 2, 1, 1)):
+            t["now"] += 0.2
+            for i in range(n_events):
+                core.observe_load(f"llama-8b-m{i}", NS,
+                                  mk_load(rpms[rnd]))
+            t["now"] += 0.2    # window (<= 100ms at the ceiling) closed
+            results = core.process_once()
+            assert len(results) == 1               # one scoped cycle
+            gauge.append(rec.emitter.value("inferno_stream_debounce_ms"))
+        # 25ms base: storm doubles to 50 then 100; the ceiling and the
+        # hysteresis band (3 of 4) hold at 100; <= storm/2 halves back
+        # down; the base is the floor
+        assert gauge == [50.0, 100.0, 100.0, 100.0, 50.0, 25.0, 25.0]
+        assert core._debounce_s == pytest.approx(core._base_debounce_s)
 
 
 class TestLimitedModeStorm:

@@ -78,6 +78,7 @@ from ..solver.hierarchy import (
     DEFAULT_MIN_VARIANTS,
     DEFAULT_SHARD_TARGET,
 )
+from ..solver.greedy import candidate_chip_pools, pool_components
 from ..solver.incremental import (
     DEFAULT_EPSILON,
     DEFAULT_FULL_EVERY,
@@ -819,13 +820,23 @@ class Reconciler:
         # limited mode (realizes the reference's dead greedy path +
         # CollectInventoryK8S stub, collector.go:37-42): allocate against
         # the cluster's actual per-generation chip inventory. Scoped
-        # micro-cycles never run limited: shared capacity couples
-        # variants, so the streaming core escalates those fleets to full
-        # passes (stream/core.py) — this is the belt to that suspender.
+        # micro-cycles run limited ONLY when the streaming core vouches
+        # the scope is closed under pool-connected components
+        # (state.scope_pool_closed): shared capacity couples variants,
+        # but only within a component, so a closed scope solved against
+        # the snapshot's frozen capacity is exact. Open scopes still
+        # escalate to full passes (stream/core.py) — the belt to this
+        # suspender.
         limited = (operator_cm.get("WVA_LIMITED_MODE", "").lower() == "true"
-                   and scope is None)
+                   and (scope is None or self.state.scope_pool_closed))
         capacity: dict[str, int] = {}
-        if limited:
+        if limited and scope is not None:
+            # pool-scoped micro-cycle: the capacity view frozen by the
+            # last full pass, zero node LISTs on the event path
+            capacity = dict(snap.capacity)
+            if not capacity:
+                limited = False
+        elif limited:
             try:
                 capacity = self._kube_call(
                     lambda: collect_inventory_k8s(self.kube),
@@ -853,6 +864,12 @@ class Reconciler:
                     log.info("limited mode capacity", extra=kv(**capacity))
         if scope is None:
             self._note_capacity(capacity if limited else {})
+            if self.state.snapshot is not None:
+                # freeze the capacity view for pool-scoped limited
+                # micro-cycles (empty when unlimited: the streaming core
+                # reads an empty view as "scoped limited unavailable")
+                self.state.snapshot.capacity = (dict(capacity)
+                                                if limited else {})
 
         policy = operator_cm.get("WVA_SATURATION_POLICY", "None")
         if SaturationPolicy.parse(policy).value != policy:
@@ -895,6 +912,15 @@ class Reconciler:
         # cached allocations and skip their kernel lanes entirely.
         system = System()
         optimizer_spec = system.set_from_spec(system_spec)
+        if scope is None and self.state.snapshot is not None:
+            # record each variant's pool-connected component so the
+            # streaming core can scope limited micro-cycles to exactly
+            # the components a drain touched (stream/core.py
+            # _claim_scoped_limited); empty when unlimited — capacity
+            # only couples variants through chip pools
+            self.state.snapshot.pool_components = (
+                pool_components(candidate_chip_pools(system))
+                if limited else {})
         engine_backend = translate.engine_backend()
         ttft_percentile = translate.ttft_percentile(operator_cm)
         engine_mesh = translate.engine_mesh(engine_backend)

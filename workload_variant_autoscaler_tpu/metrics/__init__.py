@@ -183,6 +183,10 @@ INFERNO_STREAM_LAG_SECONDS = "inferno_stream_lag_seconds"
 INFERNO_STREAM_SHED_TOTAL = "inferno_stream_shed_total"
 INFERNO_STREAM_CHECKPOINT_TOTAL = "inferno_stream_checkpoint_total"
 INFERNO_STREAM_DEBOUNCE_MS = "inferno_stream_debounce_ms"
+# limited-mode drain outcomes (stream/core.py): pool-scoped component
+# re-solves vs escalated full passes vs valve-coalesced deferrals —
+# the scoped/full ratio is the degraded-mode reaction-cost headline
+INFERNO_STREAM_LIMITED_TOTAL = "inferno_stream_limited_total"
 # live goodput metering (obs/goodput.py, fed by the Reconciler when a
 # GoodputMeter is attached — WVA_GOODPUT_LIVE): the twin's offline
 # judgment metric as a first-class scrape surface. The badput counter's
@@ -226,12 +230,27 @@ SHED_QUARANTINE_TIMESTAMP = "quarantine-timestamp"
 SHED_QUARANTINE_LABELS = "quarantine-labels"
 SHED_SOURCE_QUARANTINED = "source-quarantined"
 SHED_SCRAPE_ERROR = "scrape-error"
+# raw-counter pushdown (stream/pushdown.py): a Prometheus staleness
+# marker retired a ledger entry — accounted, but NOT poison (the next
+# genuine sample restarts the epoch)
+SHED_STALE_MARKER = "stale-marker"
 STREAM_SHED_REASONS = (
     SHED_BODY_TOO_LARGE, SHED_STORE_FULL, SHED_QUEUE_FULL,
     SHED_DECODE_ERROR, SHED_QUARANTINE_NAN, SHED_QUARANTINE_NEGATIVE,
     SHED_QUARANTINE_TIMESTAMP, SHED_QUARANTINE_LABELS,
-    SHED_SOURCE_QUARANTINED, SHED_SCRAPE_ERROR,
+    SHED_SOURCE_QUARANTINED, SHED_SCRAPE_ERROR, SHED_STALE_MARKER,
 )
+
+LABEL_LANE = "lane"
+# limited-mode drain lanes (the `lane` label values of
+# inferno_stream_limited_total): scoped = re-solved only the
+# pool-connected components containing flipped variants; full = the
+# event escalated to a full-fleet pass; coalesced = the drain was
+# deferred onto one pending backstop pass (the escalation valve)
+LANE_SCOPED = "scoped"
+LANE_FULL = "full"
+LANE_COALESCED = "coalesced"
+STREAM_LIMITED_LANES = (LANE_SCOPED, LANE_FULL, LANE_COALESCED)
 
 LABEL_EVENT = "event"
 # checkpoint lifecycle events (the `event` label values of
@@ -570,6 +589,15 @@ class MetricsEmitter:
             "storms, narrows back with hysteresis when the storm ebbs",
             registry=self.registry,
         )
+        self.stream_limited = Counter(
+            INFERNO_STREAM_LIMITED_TOTAL.removesuffix("_total"),
+            "Limited-mode drain outcomes in the streaming core (scoped: "
+            "only the pool-connected components containing flipped "
+            "variants were re-solved; full: the drain escalated to a "
+            "full-fleet pass; coalesced: the drain was deferred onto one "
+            "pending backstop pass by the escalation valve)",
+            [LABEL_LANE], registry=self.registry,
+        )
         # perf-model drift (beyond-reference: the reference never compares
         # its scraped latencies against its own queueing model)
         self.model_drift = Gauge(
@@ -785,6 +813,10 @@ class MetricsEmitter:
     def emit_stream_debounce_ms(self, value: float) -> None:
         """Publish the adaptive debounce window currently in effect."""
         self.stream_debounce_ms.set(value)
+
+    def emit_stream_limited(self, lane: str) -> None:
+        """One limited-mode drain outcome (consumer thread only)."""
+        self.stream_limited.labels(**{LABEL_LANE: lane}).inc()
 
     def emit_pool_capacity_metrics(self, capacity: dict[str, int]) -> None:
         """Replace the per-generation inventory gauge wholesale each

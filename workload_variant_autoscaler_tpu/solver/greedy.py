@@ -334,6 +334,80 @@ def solve_greedy(
             _best_effort(system, unallocated, available, policy)
 
 
+def server_chip_pools(system: System) -> dict[str, list[str]]:
+    """Per-server chip pools: the chip generation behind every candidate
+    allocation of every server — the coupling graph's edge set (two
+    servers interact exactly when these lists intersect, transitively)."""
+    server_pools: dict[str, list[str]] = {}
+    for name, server in system.servers.items():
+        chips = []
+        for alloc in server.all_allocations.values():
+            acc = system.accelerator(alloc.accelerator)
+            if acc is not None:
+                chips.append(acc.chip)
+        server_pools[name] = chips
+    return server_pools
+
+
+def candidate_chip_pools(system: System) -> dict[str, list[str]]:
+    """Like server_chip_pools, but over the PROFILE-feasible candidate
+    accelerators instead of the solved allocations — available before
+    (or without) any calculate() pass. A superset of the solved pools,
+    so the resulting components are only ever coarser: still a correct
+    partition for scoping, never an under-expansion."""
+    server_pools: dict[str, list[str]] = {}
+    for name, server in system.servers.items():
+        chips = []
+        model = system.models.get(server.model_name)
+        for acc_name, acc in server.candidate_accelerators(
+                system.accelerators).items():
+            if model is None or model.profile(acc_name) is None:
+                continue
+            chips.append(acc.chip)
+        server_pools[name] = chips
+    return server_pools
+
+
+def _chip_union_find(server_pools: dict[str, list[str]]):
+    """Union-find over chip pool names, with every server's candidate
+    chips pre-unioned; returns the path-compressing `find` closure."""
+    parent: dict[str, str] = {}
+
+    def find(x: str) -> str:
+        while parent.setdefault(x, x) != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for chips in server_pools.values():
+        for chip in chips[1:]:
+            ra, rb = find(chips[0]), find(chip)
+            if ra != rb:
+                parent[ra] = rb
+    return find
+
+
+def pool_components(
+    server_pools: dict[str, list[str]],
+) -> dict[str, frozenset[str]]:
+    """Partition servers into pool-connected components: server ->
+    frozenset of every server in its component (itself included).
+    Components' chip pools are disjoint by construction, so re-solving
+    one component against the FULL capacity view is exact — the same
+    invariant solve_greedy_warm's warm restriction and the streaming
+    core's pool-scoped limited mode (stream/core.py) both rest on.
+    A server with no recognised candidate chips is its own singleton
+    component (nothing couples it)."""
+    find = _chip_union_find(server_pools)
+    members: dict[str, set[str]] = {}
+    for name, chips in server_pools.items():
+        root = find(chips[0]) if chips else f"@chipless:{name}"
+        members.setdefault(root, set()).add(name)
+    frozen = {root: frozenset(names) for root, names in members.items()}
+    return {name: frozen[root]
+            for root, names in members.items() for name in names}
+
+
 def solve_greedy_warm(
     system: System,
     policy: SaturationPolicy,
@@ -365,30 +439,10 @@ def solve_greedy_warm(
     """
     changed = set(changed)
     prev_pools = prev_pools or {}
-    # union-find over chip pools; servers attach to their candidates' pools
-    parent: dict[str, str] = {}
-
-    def find(x: str) -> str:
-        while parent.setdefault(x, x) != x:
-            parent[x] = parent[parent[x]]
-            x = parent[x]
-        return x
-
-    def union(a: str, b: str) -> None:
-        ra, rb = find(a), find(b)
-        if ra != rb:
-            parent[ra] = rb
-
-    server_pools: dict[str, list[str]] = {}
-    for name, server in system.servers.items():
-        chips = []
-        for alloc in server.all_allocations.values():
-            acc = system.accelerator(alloc.accelerator)
-            if acc is not None:
-                chips.append(acc.chip)
-        server_pools[name] = chips
-        for chip in chips[1:]:
-            union(chips[0], chip)
+    # union-find over chip pools; servers attach to their candidates'
+    # pools (shared with pool_components / the streaming core)
+    server_pools = server_chip_pools(system)
+    find = _chip_union_find(server_pools)
 
     affected_roots = set()
     for name in changed:

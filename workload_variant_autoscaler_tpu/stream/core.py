@@ -91,20 +91,25 @@ from ..metrics import (
     CHECKPOINT_DISCARD_STALE,
     CHECKPOINT_RESTORE,
     CHECKPOINT_SAVE,
+    LANE_COALESCED,
+    LANE_FULL,
+    LANE_SCOPED,
     SHED_QUARANTINE_NAN,
     SHED_QUARANTINE_NEGATIVE,
     SHED_QUARANTINE_TIMESTAMP,
     SHED_QUEUE_FULL,
+    SHED_STALE_MARKER,
     SHED_STORE_FULL,
     SOURCE_BACKSTOP,
     SOURCE_REMOTE_WRITE,
     SOURCE_SCRAPE,
     SOURCE_WATCH,
 )
-from ..solver.incremental import DEFAULT_EPSILON, quantize
+from ..solver.incremental import DEFAULT_EPSILON, quantize, quantize_batch
 from ..utils import get_logger, kv, parse_float_or
 from ..utils.backoff import CircuitBreaker
 from .checkpoint import CheckpointError, load_checkpoint, save_checkpoint
+from .pushdown import CounterLedger, LedgerQuarantine
 from .queue import DebouncedQueue
 from .state import FleetSnapshot, StreamState
 
@@ -132,6 +137,11 @@ DEFAULT_CHECKPOINT_MAX_AGE_S = 120.0  # WVA_STREAM_CHECKPOINT_MAX_AGE_S
 # ConfigMap says, no stream container outgrows these (wvalint WVL405)
 HARD_MAX_GROUPS = 65536
 HARD_MAX_QUEUE = 65536
+# ingest-store lock stripes: at 10k series/s the single store lock is
+# the contention point (every WSGI worker serializing per group); 16
+# stripes keep P(collision) low at the worker counts WSGI servers run
+# while the per-stripe dicts stay cache-friendly
+N_STRIPES = 16
 # a pushed sample stamped further than this into the future is poison
 # (a skewed sender clock would otherwise pin "newest wins" forever)
 FAR_FUTURE_SLACK_S = 60.0
@@ -191,6 +201,70 @@ class _Plan:
     events: dict = field(default_factory=dict)   # (model, ns) -> Pending
     scope: frozenset = frozenset()
     loads: dict = field(default_factory=dict)    # full_name -> load
+    # a pool-scoped limited-mode micro-cycle: the scope is CLOSED under
+    # the snapshot's pool-connected components, so the reconciler may
+    # run the greedy against the snapshot capacity (state.py)
+    limited: bool = False
+
+
+class _StripedStore:
+    """The ingest store, hash-striped by (model, namespace) group so
+    concurrent WSGI workers land on different locks. Single-key reads
+    (`get`/`in`/`[]`) lock their stripe internally; read-modify-write
+    sequences take `lock_at(stripe_of(key))` and operate on the bare
+    `map_at` dict — the batch door acquires each touched stripe ONCE
+    for a whole request. `len()` is a lock-free sum of stripe sizes
+    (each `len` read is atomic in CPython; the store cap tolerates a
+    transiently approximate total)."""
+
+    __slots__ = ("_locks", "_maps")
+
+    def __init__(self):
+        self._locks = tuple(threading.Lock() for _ in range(N_STRIPES))
+        self._maps = tuple({} for _ in range(N_STRIPES))
+
+    def stripe_of(self, key) -> int:
+        return hash(key) % N_STRIPES
+
+    def lock_at(self, idx: int):
+        return self._locks[idx]
+
+    def map_at(self, idx: int) -> dict:
+        return self._maps[idx]
+
+    def lock_for(self, key):
+        return self._locks[hash(key) % N_STRIPES]
+
+    def map_for(self, key) -> dict:
+        return self._maps[hash(key) % N_STRIPES]
+
+    def __len__(self) -> int:
+        return sum(len(m) for m in self._maps)
+
+    def __contains__(self, key) -> bool:
+        with self.lock_for(key):
+            return key in self.map_for(key)
+
+    def __getitem__(self, key):
+        with self.lock_for(key):
+            return self.map_for(key)[key]
+
+    def get(self, key, default=None):
+        with self.lock_for(key):
+            return self.map_for(key).get(key, default)
+
+    def items(self) -> list:
+        """Stripe-by-stripe snapshot of every (key, accum) pair."""
+        out: list = []
+        for lock, m in zip(self._locks, self._maps):
+            with lock:
+                out.extend(m.items())
+        return out
+
+    def clear(self) -> None:
+        for lock, m in zip(self._locks, self._maps):
+            with lock:
+                m.clear()
 
 
 class StreamCore:
@@ -215,7 +289,10 @@ class StreamCore:
                                     clock=self.clock,
                                     max_pending=self._max_queue())
         self._lock = threading.Lock()
-        self._store: dict[tuple, _Accum] = {}
+        self._store = _StripedStore()
+        # raw-counter pushdown ledger (stream/pushdown.py); gated by
+        # WVA_STREAM_PUSHDOWN at the ingest layer
+        self.pushdown = CounterLedger()
         self._next_full_deadline: Optional[float] = None
         self._scrape_targets: tuple = ()
         # pre-cycle hook (the goodput twin advances its FaultPlan here)
@@ -279,6 +356,13 @@ class StreamCore:
         ms = self._knob("WVA_STREAM_LAG_BUDGET_MS", DEFAULT_LAG_BUDGET_MS)
         return max(ms, 0.0) / 1000.0
 
+    def pushdown_enabled(self) -> bool:
+        """WVA_STREAM_PUSHDOWN: `off` ignores raw-counter series at the
+        door (the rule-based contract, byte-for-byte); `auto` (default)
+        and `on` derive loads from whatever raw counters arrive."""
+        mode = self._knob_str("WVA_STREAM_PUSHDOWN", "auto").strip().lower()
+        return mode not in ("off", "false", "0", "disabled")
+
     # -- quarantine (any thread) ------------------------------------------
 
     def _breaker(self, source: str) -> CircuitBreaker:
@@ -307,11 +391,13 @@ class StreamCore:
         return br.state_code() == CircuitBreaker.STATE_CODES[
             CircuitBreaker.OPEN]
 
-    def _vet(self, key: tuple, fields: dict,
-             ts_ms: float) -> Optional[str]:
-        """Semantic quarantine verdict for one observation, or None if
-        clean. ts_ms is the sample's wall-clock stamp (0 = unstamped,
-        e.g. the scrape path — timestamp checks skipped)."""
+    def _vet(self, fields: dict, ts_ms: float,
+             now_wall: float) -> Optional[str]:
+        """Store-free semantic quarantine verdict for one observation,
+        or None if clean. ts_ms is the sample's wall-clock stamp (0 =
+        unstamped, e.g. the scrape path — timestamp checks skipped).
+        The out-of-order check needs the store's baseline and runs
+        inside the batch door's stripe phase instead."""
         for k, v in fields.items():
             if k not in _LOAD_FIELDS:
                 continue
@@ -323,14 +409,8 @@ class StreamCore:
                 return SHED_QUARANTINE_NAN
             if v < 0.0:
                 return SHED_QUARANTINE_NEGATIVE
-        if ts_ms:
-            if ts_ms / 1000.0 > self.rec.now() + FAR_FUTURE_SLACK_S:
-                return SHED_QUARANTINE_TIMESTAMP
-            with self._lock:
-                acc = self._store.get(key)
-                if acc is not None and acc.sample_ts_ms \
-                        and ts_ms < acc.sample_ts_ms:
-                    return SHED_QUARANTINE_TIMESTAMP
+        if ts_ms and ts_ms / 1000.0 > now_wall + FAR_FUTURE_SLACK_S:
+            return SHED_QUARANTINE_TIMESTAMP
         return None
 
     # -- ingest (any thread) ----------------------------------------------
@@ -377,47 +457,123 @@ class StreamCore:
         """The vetted ingest door: quarantines poisoned observations
         and sheds past the store/queue caps, raising ShedError with the
         metered reason. Returns True when a change was enqueued."""
-        now = self.clock() if t is None else t
-        key = (model, namespace)
-        breaker = self._breaker(source)
-        reason = self._vet(key, fields, ts_ms)
+        reason, changed = self.ingest_batch(
+            [(model, namespace, fields, ts_ms)], source=source, t=t)[0]
         if reason is not None:
-            self.emitter.emit_stream_shed(reason)
-            breaker.record_failure()
             raise ShedError(reason, f"{model}/{namespace}: {reason}")
-        shed = None
-        changed = False
-        with self._lock:
-            acc = self._store.get(key)
-            if acc is None:
-                if len(self._store) >= self._max_groups():
-                    shed = SHED_STORE_FULL
-                else:
-                    acc = _Accum()
-                    self._store[key] = acc
-            if acc is not None:
-                acc.fields.update({k: float(v)
-                                   for k, v in fields.items()
-                                   if k in _LOAD_FIELDS})
-                acc.updated_at = now
-                if ts_ms:
-                    acc.sample_ts_ms = max(acc.sample_ts_ms, ts_ms)
-                load = acc.load()
-                changed = (load is not None
-                           and self._signature(load) != acc.consumed_sig)
-        if shed is not None:
-            # the observation is lost but not silently: metered, and a
-            # full pass (which re-collects everything) is requested so
-            # decisions still converge
-            self._shed_overload(shed, source, now)
-            raise ShedError(shed, f"{model}/{namespace}: {shed}")
-        self.emitter.emit_stream_event(source)
-        breaker.record_success()
-        if changed and not self.queue.offer(key, source, t=now):
+        return changed
+
+    def ingest_batch(self, entries: list,
+                     source: str = SOURCE_REMOTE_WRITE,
+                     t: Optional[float] = None) -> list:
+        """One whole request through the door in three phases: (1)
+        store-free vetting plus ONE vectorized epsilon-quantization over
+        every entry's samples, (2) one acquisition per touched store
+        stripe to fold the groups in and detect signature flips, (3)
+        metering and a single batched queue offer. `entries` is
+        [(model, namespace, fields, ts_ms), ...]; returns per-entry
+        (shed_reason | None, changed) in input order — shed entries are
+        already metered (quarantine verdicts feed the source breaker,
+        overload sheds raise stream pressure and request a full pass)."""
+        now = self.clock() if t is None else t
+        breaker = self._breaker(source)
+        results: list = [(None, False)] * len(entries)
+        now_wall = self.rec.now()
+        cap = self._max_groups()
+        # phase 1: vet + vectorized quantize (no store locks)
+        todo: list = []               # (i, key, clean_fields, ts_ms)
+        flat: list = []               # the quantizer's input batch
+        spans: dict[int, int] = {}    # entry index -> offset into flat
+        for i, (model, ns, fields, ts_ms) in enumerate(entries):
+            reason = self._vet(fields, ts_ms, now_wall)
+            if reason is not None:
+                results[i] = (reason, False)
+                continue
+            clean = {k: float(v) for k, v in fields.items()
+                     if k in _LOAD_FIELDS}
+            if all(f in clean for f in _REQUIRED_FIELDS):
+                spans[i] = len(flat)
+                flat.extend(clean[f] for f in _REQUIRED_FIELDS)
+            todo.append((i, (model, ns), clean, float(ts_ms or 0.0)))
+        q = quantize_batch(flat, self._epsilon())
+        presig = {i: (q[off], round(q[off + 1]), round(q[off + 2]))
+                  for i, off in spans.items()}
+        # phase 2: one striped acquisition per touched stripe
+        by_stripe: dict[int, list] = {}
+        for item in todo:
+            by_stripe.setdefault(
+                self._store.stripe_of(item[1]), []).append(item)
+        flips: list = []
+        for idx, items in by_stripe.items():
+            with self._store.lock_at(idx):
+                m = self._store.map_at(idx)
+                for i, key, clean, ts_ms in items:
+                    acc = m.get(key)
+                    if acc is not None and ts_ms and acc.sample_ts_ms \
+                            and ts_ms < acc.sample_ts_ms:
+                        results[i] = (SHED_QUARANTINE_TIMESTAMP, False)
+                        continue
+                    if acc is None:
+                        if len(self._store) >= min(cap, HARD_MAX_GROUPS):
+                            results[i] = (SHED_STORE_FULL, False)
+                            continue
+                        acc = _Accum()
+                        m[key] = acc
+                    acc.fields.update(clean)
+                    acc.updated_at = now
+                    if ts_ms:
+                        acc.sample_ts_ms = max(acc.sample_ts_ms, ts_ms)
+                    sig = presig.get(i)
+                    if sig is None:
+                        load = acc.load()
+                        sig = (self._signature(load)
+                               if load is not None else None)
+                    changed = sig is not None and sig != acc.consumed_sig
+                    if changed:
+                        flips.append((key, source))
+                    results[i] = (None, changed)
+        # phase 3: metering + ONE batched queue offer (no store locks)
+        for reason, _changed in results:
+            if reason is None:
+                continue
+            if reason == SHED_STORE_FULL:
+                # the observation is lost but not silently: metered,
+                # and a full pass (which re-collects everything) is
+                # requested so decisions still converge
+                self._shed_overload(reason, source, now)
+            else:
+                self.emitter.emit_stream_shed(reason)
+                breaker.record_failure()
+        for reason, _changed in results:
+            if reason is None:
+                self.emitter.emit_stream_event(source)
+                breaker.record_success()
+        for _rejected in self.queue.offer_many(flips, t=now):
             # queue at depth cap: the store holds the data, only the
             # scoped wake is lost — coalesce into a full-pass request
             self._shed_overload(SHED_QUEUE_FULL, source, now)
-        return changed
+        return results
+
+    def ingest_raw(self, model: str, namespace: str, points: list,
+                   source: str = SOURCE_REMOTE_WRITE) -> dict:
+        """Advance the raw-counter pushdown ledger for one group
+        (stream/pushdown.py): `points` is [(role, fingerprint, value,
+        ts_ms), ...]. Returns the derived load fields (possibly empty —
+        first sight of an origin series is baseline only); staleness
+        markers are accounted on the shed counter but do NOT fail the
+        group. Raises ShedError — metered, breaker-recorded — when the
+        ledger quarantines the batch."""
+        breaker = self._breaker(source)
+        try:
+            fields, stale = self.pushdown.advance(
+                model, namespace, points, self.rec.now())
+        except LedgerQuarantine as e:
+            self.emitter.emit_stream_shed(e.reason)
+            breaker.record_failure()
+            raise ShedError(e.reason, str(e)) from e
+        for _ in range(stale):
+            self.emitter.emit_stream_shed(SHED_STALE_MARKER)
+        return fields
 
     def _shed_overload(self, reason: str, source: str,
                        now: float) -> None:
@@ -452,24 +608,24 @@ class StreamCore:
                     (va.spec.model_id, va.namespace), []).append(key)
         scope: set[str] = set()
         loads: dict[str, CollectedLoad] = {}
-        with self._lock:
-            for group in events:
-                acc = self._store.get(group)
+        for group in events:
+            with self._store.lock_for(group):
+                acc = self._store.map_for(group).get(group)
                 load = acc.load() if acc is not None else None
                 if load is not None:
                     acc.consumed_sig = self._signature(load)
-                for vkey in mapping.get(group, ()):
-                    scope.add(vkey)
-                    if load is not None:
-                        loads[vkey] = load
+            for vkey in mapping.get(group, ()):
+                scope.add(vkey)
+                if load is not None:
+                    loads[vkey] = load
         return frozenset(scope), loads
 
     def _mark_consumed(self, events: dict) -> None:
         """A full pass re-collects everything: every drained group's
         current signature is now the solved one."""
-        with self._lock:
-            for group in events:
-                acc = self._store.get(group)
+        for group in events:
+            with self._store.lock_for(group):
+                acc = self._store.map_for(group).get(group)
                 load = acc.load() if acc is not None else None
                 if load is not None:
                     acc.consumed_sig = self._signature(load)
@@ -482,14 +638,15 @@ class StreamCore:
         truth and its event is still pending."""
         loads = dict(self.state.cycle_loads)
         cap = self._max_groups()
-        with self._lock:
-            for group, load in loads.items():
-                acc = self._store.get(group)
+        for group, load in loads.items():
+            with self._store.lock_for(group):
+                m = self._store.map_for(group)
+                acc = m.get(group)
                 if acc is None:
                     if len(self._store) >= min(cap, HARD_MAX_GROUPS):
                         continue
                     acc = _Accum()
-                    self._store[group] = acc
+                    m[group] = acc
                 elif acc.updated_at > t_start:
                     continue
                 acc.fields.update(
@@ -498,13 +655,17 @@ class StreamCore:
                 solvable = acc.load()
                 if solvable is not None:
                     acc.consumed_sig = self._signature(solvable)
-            # bound the store under push abuse / model churn: groups the
-            # fleet no longer sizes (absent from every full pass) age
-            # out after two backstop intervals without a fresh push
-            horizon = t_start - 2.0 * FALLBACK_INTERVAL_S
-            for group in [g for g, acc in self._store.items()
-                          if g not in loads and acc.updated_at < horizon]:
-                del self._store[group]
+        # bound the store under push abuse / model churn: groups the
+        # fleet no longer sizes (absent from every full pass) age
+        # out after two backstop intervals without a fresh push
+        horizon = t_start - 2.0 * FALLBACK_INTERVAL_S
+        for idx in range(N_STRIPES):
+            with self._store.lock_at(idx):
+                m = self._store.map_at(idx)
+                for group in [g for g, acc in m.items()
+                              if g not in loads
+                              and acc.updated_at < horizon]:
+                    del m[group]
 
     def _merge_deferred_locked(self, events: dict) -> dict:
         """Fold the limited-mode deferral buffer into a full plan's
@@ -564,6 +725,60 @@ class StreamCore:
         self.queue.set_window(new)
         self.emitter.emit_stream_debounce_ms(new * 1000.0)
 
+    def _claim_scoped_limited(self, drained) -> Optional[_Plan]:
+        """Limited-mode micro-cycle over the flipped variants' pool
+        components. Capacity couples variants only through shared chip
+        pools, and pool-connected components partition the fleet
+        (solver/greedy.pool_components): a component solved against the
+        full capacity view is exact, because no variant outside it can
+        touch its chips. So a drain whose flipped variants all sit in
+        known components with observed loads re-solves ONLY those
+        components. Any gap — no snapshot components, no frozen
+        capacity, a variant without a component or a member without a
+        load, or the expansion reaching the whole fleet — returns None
+        and falls through to the escalation/coalescing ladder."""
+        snap = self.state.snapshot
+        if snap is None or not snap.pool_components or not snap.capacity:
+            return None
+        mapping: dict[tuple, list] = {}
+        for vkey, va in snap.vas.items():
+            mapping.setdefault(
+                (va.spec.model_id, va.namespace), []).append(vkey)
+        flipped: set[str] = set()
+        for group in drained.events:
+            flipped.update(mapping.get(group, ()))
+        if not flipped:
+            # events for models outside the fleet: nothing to solve
+            return _Plan(kind="drop", events=dict(drained.events))
+        expanded: set[str] = set()
+        for vkey in flipped:
+            members = snap.pool_components.get(vkey)
+            if members is None:
+                return None
+            expanded.update(members)
+        if len(expanded) >= len(snap.vas):
+            # cross-component storm touched every pool: a scoped pass
+            # would be a full pass minus the coalescing valve — escalate
+            return None
+        loads: dict[str, CollectedLoad] = {}
+        for vkey in expanded:
+            va = snap.vas.get(vkey)
+            if va is None:
+                return None
+            group = (va.spec.model_id, va.namespace)
+            with self._store.lock_for(group):
+                acc = self._store.map_for(group).get(group)
+                load = acc.load() if acc is not None else None
+            if load is None:
+                # a coupled member the stream has never sized: the
+                # component cannot be re-solved exactly — full pass
+                return None
+            loads[vkey] = load
+        self._mark_consumed(drained.events)
+        return _Plan(kind="scoped", events=dict(drained.events),
+                     scope=frozenset(expanded), loads=loads,
+                     limited=True)
+
     def _claim(self) -> Optional[_Plan]:
         now = self.clock()
         with self._lock:
@@ -597,6 +812,15 @@ class StreamCore:
             return None
         self._adapt_debounce(len(drained.events))
         if drained.full is not None or self._limited_mode():
+            if drained.full is None:
+                # pool-scoped limited mode: if every flipped variant's
+                # pool-connected component is known, loaded, and smaller
+                # than the fleet, re-solve just those components —
+                # O(changed component), not O(fleet)
+                plan = self._claim_scoped_limited(drained)
+                if plan is not None:
+                    self.emitter.emit_stream_limited(LANE_SCOPED)
+                    return plan
             source = (drained.full.source if drained.full is not None
                       else SOURCE_BACKSTOP)
             with self._lock:
@@ -620,6 +844,9 @@ class StreamCore:
                         # the coalescing window
                         self._last_escalation_at = now
                     events = self._merge_deferred_locked(drained.events)
+            if drained.full is None:
+                self.emitter.emit_stream_limited(
+                    LANE_COALESCED if events is None else LANE_FULL)
             if events is None:
                 return None
             return _Plan(kind="full", source=source, events=events)
@@ -653,8 +880,19 @@ class StreamCore:
                 result = self.rec.reconcile()
                 delay = result.requeue_after
             else:
-                result = self.rec.reconcile(scope=plan.scope,
-                                            stream_loads=plan.loads)
+                if plan.limited:
+                    # tell the reconciler the scope is closed under pool
+                    # components: it may keep the limited gate down and
+                    # solve against the snapshot's frozen capacity
+                    with self._lock:
+                        self.state.scope_pool_closed = True
+                try:
+                    result = self.rec.reconcile(scope=plan.scope,
+                                                stream_loads=plan.loads)
+                finally:
+                    if plan.limited:
+                        with self._lock:
+                            self.state.scope_pool_closed = False
         except Exception as e:  # noqa: BLE001 — run_forever's catch, here
             log.error("stream cycle failed",
                       extra=kv(kind=plan.kind, error=str(e)))
@@ -703,13 +941,14 @@ class StreamCore:
         now = self.clock()
         with self._lock:
             deadline = self._next_full_deadline
-            # monotonic readings do not survive a restart: persist AGES
-            # relative to now, re-anchored on the restoring clock
-            store = [[m, ns, dict(acc.fields),
-                      max(now - acc.updated_at, 0.0), acc.sample_ts_ms,
-                      (list(acc.consumed_sig)
-                       if acc.consumed_sig is not None else None)]
-                     for (m, ns), acc in self._store.items()]
+        # monotonic readings do not survive a restart: persist AGES
+        # relative to now, re-anchored on the restoring clock
+        # (items() snapshots stripe by stripe under the stripe locks)
+        store = [[m, ns, dict(acc.fields),
+                  max(now - acc.updated_at, 0.0), acc.sample_ts_ms,
+                  (list(acc.consumed_sig)
+                   if acc.consumed_sig is not None else None)]
+                 for (m, ns), acc in self._store.items()]
         from ..controller.crd import va_to_dict
         return {
             "taken_at": self.rec.now(),
@@ -723,6 +962,9 @@ class StreamCore:
                 "taken_at": snap.taken_at,
                 "vas": {key: va_to_dict(va)
                         for key, va in snap.vas.items()},
+                "capacity": dict(snap.capacity),
+                "pool_components": {k: sorted(v) for k, v in
+                                    snap.pool_components.items()},
             },
             "cross_cycle": {
                 "cycle_index": st.cycle_index,
@@ -803,6 +1045,11 @@ class StreamCore:
                 vas={key: va_from_dict(obj)
                      for key, obj in snap_d["vas"].items()},
                 taken_at=float(snap_d["taken_at"]),
+                capacity={str(k): int(v) for k, v in
+                          snap_d.get("capacity", {}).items()},
+                pool_components={str(k): frozenset(v) for k, v in
+                                 snap_d.get("pool_components",
+                                            {}).items()},
             )
         cc = payload.get("cross_cycle", {})
         merged = payload.get("merged", {})
@@ -829,19 +1076,23 @@ class StreamCore:
             setattr(st, name,
                     {tuple(k): v for k, v in merged.get(name, [])})
         now = self.clock()
-        with self._lock:
-            self._store = {}
-            for row in store_rows:
-                if len(self._store) >= HARD_MAX_GROUPS:
-                    break
-                model, ns, fields, age_s, ts_ms, sig = row
-                self._store[(str(model), str(ns))] = _Accum(
+        for idx in range(N_STRIPES):
+            with self._store.lock_at(idx):
+                self._store.map_at(idx).clear()
+        for row in store_rows:
+            if len(self._store) >= HARD_MAX_GROUPS:
+                break
+            model, ns, fields, age_s, ts_ms, sig = row
+            key = (str(model), str(ns))
+            with self._store.lock_for(key):
+                self._store.map_for(key)[key] = _Accum(
                     fields={str(k): float(v) for k, v in fields.items()},
                     updated_at=now - max(float(age_s), 0.0),
                     sample_ts_ms=float(ts_ms),
                     consumed_sig=(tuple(sig) if sig is not None
                                   else None),
                 )
+        with self._lock:
             if remaining is not None:
                 self._next_full_deadline = now + max(float(remaining), 0.0)
             self._scrape_targets = tuple(sorted(

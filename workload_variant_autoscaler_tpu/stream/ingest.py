@@ -63,6 +63,7 @@ from ..metrics import (
 from ..utils import get_logger, kv
 from ..utils.backoff import STANDARD_BACKOFF, with_backoff
 from .core import ShedError
+from .pushdown import RAW_SERIES
 from .remotewrite import WireError, parse_write_request, snappy_decompress
 
 log = get_logger("wva.stream.ingest")
@@ -111,6 +112,12 @@ def ingest_write_request(core, body: bytes,
 
     # (model, ns) -> field -> (timestamp, value); newest timestamp wins
     groups: dict[tuple, dict] = {}
+    # (model, ns) -> [(role, fingerprint, value, ts_ms)] raw-counter
+    # samples for the pushdown ledger (stream/pushdown.py); per origin
+    # series the newest sample in a request wins, mirroring the
+    # rule-series rule (a counter's newest reading subsumes the rest)
+    raw_groups: dict[tuple, list] = {}
+    pushdown = core.pushdown_enabled()
     shed = 0
     for series in parse_write_request(raw):
         if len(series.labels) > MAX_LABELS_PER_SERIES:
@@ -119,35 +126,67 @@ def ingest_write_request(core, body: bytes,
             continue
         name = series.labels.get("__name__", "")
         fld = STREAM_SERIES.get(name)
-        if fld is None or not series.samples:
+        role = RAW_SERIES.get(name) if pushdown else None
+        if (fld is None and role is None) or not series.samples:
             continue
         model = series.labels.get("model_name", "")
         ns = series.labels.get("namespace", "")
         if not model or not ns:
             continue
         key = (model, ns)
-        if key not in groups and len(groups) >= MAX_GROUPS_PER_REQUEST:
+        if key not in groups and key not in raw_groups \
+                and len(groups) + len(raw_groups) \
+                >= MAX_GROUPS_PER_REQUEST:
             core.emitter.emit_stream_shed(SHED_QUARANTINE_LABELS)
             shed += 1
             continue
         value, ts = max(series.samples, key=lambda s: s[1])
+        if role is not None:
+            # the origin fingerprint is the FULL labelset, __name__
+            # included — a pod's seven counters are seven distinct
+            # origin series with seven independent monotonic baselines
+            fingerprint = tuple(sorted(series.labels.items()))
+            raw_groups.setdefault(key, []).append(
+                (role, fingerprint, value, float(ts)))
+            continue
         best = groups.setdefault(key, {})
         if fld not in best or ts >= best[fld][0]:
             best[fld] = (ts, value)
-    ingested = 0
-    for (model, ns), fields in groups.items():
-        newest_ts = max((ts for ts, _v in fields.values()), default=0)
+    # pushdown: advance each group's counter ledger and fold the derived
+    # load fields into the same per-group merge the rule series use
+    for key, points in raw_groups.items():
+        model, ns = key
         try:
-            core.ingest_push(model, ns,
-                             {f: v for f, (_ts, v) in fields.items()},
-                             ts_ms=float(newest_ts),
-                             source=SOURCE_REMOTE_WRITE)
+            derived = core.ingest_raw(model, ns, points,
+                                      source=SOURCE_REMOTE_WRITE)
         except ShedError:
-            # quarantined or shed — metered inside the core; the rest
-            # of the request still lands
+            # poisoned batch — metered inside the ledger; the group's
+            # baselines did not advance, the rest of the request lands
             shed += 1
             continue
-        ingested += 1
+        if not derived:
+            continue                       # baseline-only (first sight)
+        raw_ts = max(ts for _r, _f, _v, ts in points)
+        best = groups.setdefault(key, {})
+        for fld, value in derived.items():
+            if fld not in best or raw_ts >= best[fld][0]:
+                best[fld] = (raw_ts, value)
+    entries = []
+    for (model, ns), fields in groups.items():
+        newest_ts = max((ts for ts, _v in fields.values()), default=0)
+        entries.append((model, ns,
+                        {f: v for f, (_ts, v) in fields.items()},
+                        float(newest_ts)))
+    ingested = 0
+    # ONE striped batch through the core: the whole request is vetted
+    # and quantized up front, then folded in per store stripe —
+    # quarantined/shed entries are metered inside; the rest still land
+    for reason, _changed in core.ingest_batch(entries,
+                                              source=SOURCE_REMOTE_WRITE):
+        if reason is None:
+            ingested += 1
+        else:
+            shed += 1
     return ingested, shed
 
 

@@ -1,0 +1,229 @@
+"""Raw-counter pushdown: vanilla Prometheus/vLLM counters at the door.
+
+The remote-write endpoint's original contract (stream/ingest.py) wants
+five PRE-AGGREGATED `wva:stream:*` recording rules — which means every
+cluster feeding this controller must carry a recording-rule deployment
+whose only job is computing `rate()` and ratio expressions the
+controller could compute itself. This module removes that dependency:
+the ingest door accepts the RAW vLLM serving counters and derives the
+same five load quantities server-side, so any vanilla Prometheus (or a
+vLLM pod writing directly) can feed the controller with zero rules.
+
+Wire contract (`WVA_STREAM_PUSHDOWN=auto|on|off`, default auto;
+docs/observability.md "Raw-counter pushdown"): series named
+
+    vllm:request_success_total                 requests served (counter)
+    vllm:prompt_tokens_total                   prompt tokens (counter)
+    vllm:generation_tokens_total               generated tokens (counter)
+    vllm:time_to_first_token_seconds_sum/_count    TTFT (histogram pair)
+    vllm:time_per_output_token_seconds_sum/_count  ITL (histogram pair)
+
+labelled `model_name`/`namespace` like the rule series, are folded into
+a per-(model, namespace) ledger keyed by each series' full label
+fingerprint — one monotonic baseline PER ORIGIN SERIES, so several
+vLLM pods (distinct `instance`/`pod` labels) behind one model aggregate
+instead of fighting over one baseline. Each new sample yields a delta
+against its own baseline and the group's deltas combine exactly the way
+the recording rules would:
+
+    arrival_rate_rpm  = sum_i dreq_i / dt_i * 60
+    avg_input_tokens  = sum_i dprompt_i / sum_i dreq_i
+    avg_output_tokens = sum_i dgen_i    / sum_i dreq_i
+    avg_ttft_ms       = sum_i dttft_sum_i / sum_i dttft_count_i * 1000
+    avg_itl_ms        = sum_i ditl_sum_i  / sum_i ditl_count_i  * 1000
+
+Counter semantics are the whole point, and they are pinned by tests:
+
+- **Counter reset** (a restarting vLLM pod drops to 0): a value BELOW
+  the baseline starts a new epoch — the baseline moves, the delta is
+  ZERO. Never a negative rate, never a shed.
+- **Staleness markers** (the special NaN Prometheus writes when a
+  series goes away, bit pattern 0x7ff0000000000002): the origin's
+  baseline is retired — accounted on
+  `inferno_stream_shed_total{reason="stale-marker"}` but not poison;
+  the next genuine sample re-baselines a fresh epoch.
+- **Out-of-order / far-future samples**: quarantined with the same
+  `quarantine-timestamp` accounting as the rule-based door — the whole
+  group's batch is refused atomically (vet first, commit after), so a
+  poisoned request never half-advances a ledger.
+- **First sight** of an origin series is baseline only: no delta, no
+  derived fields — a rate needs two points.
+
+The ledger is NOT checkpointed (stream/checkpoint.py): after a restart
+every origin re-baselines on its first sample, which costs one derive
+interval and can never fabricate a rate from a stale baseline.
+
+Thread contract: `advance` is called from ingest WSGI threads; all
+ledger state sits behind `self._lock` (wvalint WVL404) and both ledger
+dimensions carry literal bounds (WVL405): `MAX_LEDGER_GROUPS` groups,
+`MAX_SERIES_PER_GROUP` origin series per group.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+from ..metrics import (
+    SHED_QUARANTINE_LABELS,
+    SHED_QUARANTINE_NAN,
+    SHED_QUARANTINE_NEGATIVE,
+    SHED_QUARANTINE_TIMESTAMP,
+    SHED_STORE_FULL,
+)
+
+# raw remote-write series name -> ledger role (the pushdown wire
+# contract; docs/observability.md "Raw-counter pushdown")
+RAW_SERIES = {
+    "vllm:request_success_total": "requests",
+    "vllm:prompt_tokens_total": "prompt_tokens",
+    "vllm:generation_tokens_total": "generation_tokens",
+    "vllm:time_to_first_token_seconds_sum": "ttft_sum",
+    "vllm:time_to_first_token_seconds_count": "ttft_count",
+    "vllm:time_per_output_token_seconds_sum": "itl_sum",
+    "vllm:time_per_output_token_seconds_count": "itl_count",
+}
+
+# ledger bounds (wvalint WVL405): remote-write input is untrusted, so
+# both dimensions the wire can grow carry literal ceilings
+MAX_LEDGER_GROUPS = 8192
+MAX_SERIES_PER_GROUP = 128
+
+# Prometheus staleness marker: a quiet NaN with this exact bit pattern
+# (prometheus/prometheus model/value.StaleNaN)
+STALE_NAN_BITS = 0x7FF0000000000002
+
+# mirrors stream/core.py FAR_FUTURE_SLACK_S (imported there; duplicated
+# here to keep this module import-light — core imports pushdown)
+_FAR_FUTURE_SLACK_S = 60.0
+
+
+def is_stale_marker(value: float) -> bool:
+    """True for the exact StaleNaN bit pattern — an ordinary NaN (a
+    poisoned sample) must NOT read as a staleness marker."""
+    return struct.unpack("<Q", struct.pack("<d", value))[0] \
+        == STALE_NAN_BITS
+
+
+class LedgerQuarantine(ValueError):
+    """A raw-sample batch refused by the ledger; `reason` is the
+    inferno_stream_shed_total label the caller must meter."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.reason = reason
+
+
+class CounterLedger:
+    """The per-(model, namespace) monotonic raw-counter ledger. One per
+    StreamCore; `advance` may be called from any ingest thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (model, ns) -> origin fingerprint -> (role, value, ts_ms)
+        self._groups: dict[tuple, dict] = {}
+
+    def group_count(self) -> int:
+        with self._lock:
+            return len(self._groups)
+
+    def forget(self, model: str, namespace: str) -> None:
+        """Drop a group's baselines (tests / explicit model retirement);
+        absent groups are a no-op."""
+        with self._lock:
+            self._groups.pop((model, namespace), None)
+
+    def advance(self, model: str, namespace: str, points: list,
+                now_s: float) -> tuple[dict, int]:
+        """Fold one request's raw samples for one group into the ledger.
+
+        `points` is [(role, fingerprint, value, ts_ms), ...] where
+        `role` is a RAW_SERIES value and `fingerprint` identifies the
+        origin series (its full sorted label items). Returns (derived
+        fields, stale-marker count); fields may be empty (first sight).
+        Raises LedgerQuarantine — WITHOUT advancing any baseline — when
+        any sample in the batch is poison (NaN/negative value,
+        out-of-order or far-future timestamp) or a ledger bound would
+        be exceeded.
+        """
+        key = (model, namespace)
+        far_future_ms = (now_s + _FAR_FUTURE_SLACK_S) * 1000.0
+        with self._lock:
+            series = self._groups.get(key)
+            if series is None:
+                if len(self._groups) >= MAX_LEDGER_GROUPS:
+                    raise LedgerQuarantine(
+                        SHED_STORE_FULL,
+                        f"{model}/{namespace}: raw-counter ledger full")
+                series = {}
+                self._groups[key] = series
+            # vet the WHOLE batch before committing anything: a poisoned
+            # request must not half-advance the group's baselines
+            stale = []
+            fresh = []
+            for role, fp, value, ts_ms in points:
+                if is_stale_marker(value):
+                    stale.append(fp)
+                    continue
+                if value != value or value in (float("inf"),
+                                               float("-inf")):
+                    raise LedgerQuarantine(
+                        SHED_QUARANTINE_NAN,
+                        f"{model}/{namespace}: NaN/inf raw sample")
+                if value < 0.0:
+                    raise LedgerQuarantine(
+                        SHED_QUARANTINE_NEGATIVE,
+                        f"{model}/{namespace}: negative counter")
+                if ts_ms > far_future_ms:
+                    raise LedgerQuarantine(
+                        SHED_QUARANTINE_TIMESTAMP,
+                        f"{model}/{namespace}: far-future raw sample")
+                prev = series.get(fp)
+                if prev is not None and ts_ms < prev[2]:
+                    raise LedgerQuarantine(
+                        SHED_QUARANTINE_TIMESTAMP,
+                        f"{model}/{namespace}: out-of-order raw sample")
+                if prev is None and \
+                        len(series) + len(fresh) >= MAX_SERIES_PER_GROUP:
+                    raise LedgerQuarantine(
+                        SHED_QUARANTINE_LABELS,
+                        f"{model}/{namespace}: too many origin series")
+                fresh.append((role, fp, value, ts_ms, prev))
+            # commit: per-origin deltas against the monotonic baselines
+            deltas: dict[str, float] = {}
+            rate_rpm = 0.0
+            saw_rate = False
+            for fp in stale:
+                series.pop(fp, None)
+            for role, fp, value, ts_ms, prev in fresh:
+                series[fp] = (role, value, ts_ms)
+                if prev is None:
+                    continue                    # baseline only
+                _role, pvalue, pts_ms = prev
+                if ts_ms == pts_ms:
+                    continue                    # duplicate delivery
+                # counter reset (pod restart): value dropped below the
+                # baseline — new epoch, ZERO delta, never negative
+                delta = value - pvalue if value >= pvalue else 0.0
+                deltas[role] = deltas.get(role, 0.0) + delta
+                if role == "requests":
+                    saw_rate = True
+                    rate_rpm += delta * 60000.0 / (ts_ms - pts_ms)
+        fields: dict[str, float] = {}
+        if saw_rate:
+            fields["arrival_rate_rpm"] = rate_rpm
+        dreq = deltas.get("requests", 0.0)
+        if dreq > 0.0:
+            if "prompt_tokens" in deltas:
+                fields["avg_input_tokens"] = \
+                    deltas["prompt_tokens"] / dreq
+            if "generation_tokens" in deltas:
+                fields["avg_output_tokens"] = \
+                    deltas["generation_tokens"] / dreq
+        if deltas.get("ttft_count", 0.0) > 0.0 and "ttft_sum" in deltas:
+            fields["avg_ttft_ms"] = \
+                deltas["ttft_sum"] / deltas["ttft_count"] * 1000.0
+        if deltas.get("itl_count", 0.0) > 0.0 and "itl_sum" in deltas:
+            fields["avg_itl_ms"] = \
+                deltas["itl_sum"] / deltas["itl_count"] * 1000.0
+        return fields, len(stale)

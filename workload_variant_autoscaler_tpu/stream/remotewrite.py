@@ -90,8 +90,25 @@ def _snappy_decompress(data: bytes) -> bytes:
         raise WireError(
             f"snappy header promises {expected} bytes (cap "
             f"{MAX_UNCOMPRESSED_BYTES})")
-    out = bytearray()
     n = len(data)
+    # zero-copy fast path: a stream that is ONE literal covering the
+    # whole promised length (what literal-only encoders — including
+    # snappy_compress below — emit for payloads up to 64 KiB) needs no
+    # bytearray assembly at all; one slice is the answer. Any mismatch
+    # falls through to the general decoder, which re-reads from the tag.
+    if i < n and expected > 0 and data[i] & 0x03 == 0:
+        length = data[i] >> 2
+        j = i + 1
+        if length >= 60:
+            extra = length - 59
+            if j + extra <= n:
+                length = int.from_bytes(data[j:j + extra], "little")
+                j += extra
+            else:
+                length = -1
+        if length + 1 == expected and j + expected == n:
+            return bytes(data[j:n])
+    out = bytearray()
     while i < n:
         tag = data[i]
         i += 1

@@ -49,6 +49,14 @@ class FleetSnapshot:
     interval_s: float
     vas: dict = field(default_factory=dict)   # full_name -> working VA
     taken_at: float = 0.0
+    # limited-mode capacity view frozen by the last full pass: chip ->
+    # free count, plus each variant's pool-connected component
+    # (full_name -> frozenset of full_names, solver/greedy.
+    # pool_components). A scoped LIMITED micro-cycle re-solves a whole
+    # component against this frozen view instead of paying a fleet-wide
+    # node LIST — exact because components' chip pools are disjoint
+    capacity: dict = field(default_factory=dict)
+    pool_components: dict = field(default_factory=dict)
 
 
 class StreamState:
@@ -93,6 +101,11 @@ class StreamState:
         # cycles with the stream-degraded ladder rung; cleared by the
         # core right after the cycle
         self.stream_pressure: Optional[str] = None
+        # set by the streaming core around a LIMITED scoped micro-cycle:
+        # the scope is closed under the snapshot's pool components, so
+        # the reconciler may solve limited against the snapshot's frozen
+        # capacity instead of escalating to a full pass
+        self.scope_pool_closed: bool = False
         # (model, namespace) -> the CollectedLoad THIS cycle actually
         # sized on, recorded by _prepare; after a full pass the core
         # folds these into its ingest store as the consumed signatures,
